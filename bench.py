@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Headline benchmark: Transformer training throughput on the local device(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md) — its runtime prints
+`THROUGHPUT = %.2f samples/s` (base_model.py:434); our vs_baseline is
+measured-throughput / analytic data-parallel model prediction until a real
+reference run exists, so it tracks how close execution is to the machine's
+roofline (1.0 = matching the cost model's DP estimate).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                              SGDOptimizer)
+    from flexflow_tpu.models.transformer import build_encoder_classifier
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.driver import data_parallel_strategy
+
+    n_dev = len(jax.devices())
+    batch = 32 * n_dev
+    seq, hidden, layers, heads = 128, 512, 6, 8
+
+    cfg = FFConfig(batch_size=batch, mesh_shape={"data": n_dev})
+    ff = FFModel(cfg)
+    x, out = build_encoder_classifier(ff, batch, seq, hidden, layers, heads)
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=out)
+
+    rs = np.random.RandomState(0)
+    xdat = rs.randn(batch, seq, hidden).astype(np.float32)
+    y = rs.randint(0, 16, (batch, 1)).astype(np.int32)
+    batch_data = {"input": xdat, "label": y}
+
+    # warmup (compile)
+    ff._run_train_step(batch_data)
+    import jax as _j
+
+    _j.block_until_ready(ff.params)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ff._run_train_step(batch_data)
+    _j.block_until_ready(ff.params)
+    dt = time.perf_counter() - t0
+    throughput = iters * batch / dt
+
+    cost = CostModel(ff, cfg.mesh_shape)
+    predicted = batch / max(
+        cost.iteration_time(data_parallel_strategy(ff, cfg.mesh_shape)), 1e-9)
+    print(json.dumps({
+        "metric": "transformer_train_throughput",
+        "value": round(throughput, 2),
+        "unit": "samples/s",
+        "vs_baseline": round(throughput / predicted, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

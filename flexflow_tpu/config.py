@@ -1,0 +1,104 @@
+"""Runtime configuration.
+
+The analog of the reference's FFConfig (reference: include/config.h:88-140,
+defaults src/runtime/model.cc:1917-1968, parse_args model.cc:1970-2071).
+Legion's `-ll:*` processor/memory knobs become mesh-shape knobs; the strategy
+table is a map op-name -> ParallelConfig, persisted in the reference's text
+schema (src/runtime/strategy.cc).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+MAX_NUM_WORKERS = 1024  # reference: include/config.h:30-42
+MAX_TENSOR_DIM = 5
+MAX_NUM_INPUTS = 8
+MAX_NUM_WEIGHTS = 4
+MAX_NUM_OUTPUTS = 8
+
+
+@dataclasses.dataclass
+class FFConfig:
+    # training flags (reference defaults model.cc:1917-1938)
+    batch_size: int = 64
+    epochs: int = 1
+    learning_rate: float = 0.01
+    weight_decay: float = 1e-4
+    iterations: int = 1  # per-epoch iteration override for synthetic runs
+
+    # parallelism / machine shape (replaces -ll:gpu/-ll:cpu/numNodes)
+    num_devices: Optional[int] = None  # default: all visible jax devices
+    mesh_shape: Optional[Dict[str, int]] = None  # e.g. {"data": 8} or {"data": 4, "model": 2}
+    ici_mesh_shape: Optional[Dict[str, int]] = None
+    dcn_mesh_shape: Optional[Dict[str, int]] = None
+
+    # search flags (reference model.cc:1930-1932)
+    search_budget: int = 0
+    search_alpha: float = 0.05
+    import_strategy_file: str = ""
+    export_strategy_file: str = ""
+    enable_parameter_parallel: bool = False
+    enable_attribute_parallel: bool = False
+
+    # execution flags
+    profiling: bool = False
+    perform_fusion: bool = False  # XLA fuses; flag kept for API parity
+    simulator_workspace_size: int = 2 * 1024 * 1024 * 1024
+    compute_dtype: str = "float32"  # "bfloat16" for MXU-native training
+    seed: int = 0
+
+    # populated at FFModel construction
+    strategies: Dict[str, "ParallelConfig"] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.num_devices is None:
+            import jax
+
+            self.num_devices = len(jax.devices())
+        if self.mesh_shape is None:
+            self.mesh_shape = {"data": self.num_devices}
+
+    @property
+    def workers_per_node(self) -> int:
+        return self.num_devices
+
+    @property
+    def num_nodes(self) -> int:
+        return 1
+
+    @staticmethod
+    def parse_args(argv: Optional[List[str]] = None) -> "FFConfig":
+        """CLI parity with reference flags (model.cc:1970-2071)."""
+        p = argparse.ArgumentParser(add_help=False)
+        p.add_argument("-e", "--epochs", type=int, default=1)
+        p.add_argument("-b", "--batch-size", type=int, default=64)
+        p.add_argument("--lr", "--learning-rate", dest="lr", type=float, default=0.01)
+        p.add_argument("--wd", "--weight-decay", dest="wd", type=float, default=1e-4)
+        p.add_argument("--budget", "--search-budget", dest="budget", type=int, default=0)
+        p.add_argument("--alpha", "--search-alpha", dest="alpha", type=float, default=0.05)
+        p.add_argument("--import", dest="import_file", type=str, default="")
+        p.add_argument("--export", dest="export_file", type=str, default="")
+        p.add_argument("--enable-parameter-parallel", action="store_true")
+        p.add_argument("--enable-attribute-parallel", action="store_true")
+        p.add_argument("--profiling", action="store_true")
+        p.add_argument("--fusion", action="store_true")
+        p.add_argument("--num-devices", type=int, default=None)
+        args, _ = p.parse_known_args(argv)
+        return FFConfig(
+            batch_size=args.batch_size,
+            epochs=args.epochs,
+            learning_rate=args.lr,
+            weight_decay=args.wd,
+            search_budget=args.budget,
+            search_alpha=args.alpha,
+            import_strategy_file=args.import_file,
+            export_strategy_file=args.export_file,
+            enable_parameter_parallel=args.enable_parameter_parallel,
+            enable_attribute_parallel=args.enable_attribute_parallel,
+            profiling=args.profiling,
+            perform_fusion=args.fusion,
+            num_devices=args.num_devices,
+        )

@@ -1,0 +1,474 @@
+"""FFModel: the user-facing graph builder + training driver.
+
+API parity with the reference's FFModel (include/model.h:250-483; Python
+surface python/flexflow/core/flexflow_cbinding.py): layer factory methods
+append ops to a graph; `compile` resolves strategies (running the MCMC search
+when budget > 0), builds the mesh, and initializes sharded params; the
+training verbs (forward/zero_gradients/backward/update) and `fit` drive
+jitted GSPMD steps.
+
+Execution model difference from the reference: instead of per-op Legion index
+launches scheduled by a mapper (§3.1 of SURVEY.md), the whole step is one XLA
+program; strategies become sharding constraints inside it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.ffconst import (ActiMode, AggrMode, CompMode, DataType,
+                                  LossType, MetricsType, OperatorType, PoolType)
+from flexflow_tpu.ops.attention import MultiHeadAttention
+from flexflow_tpu.ops.base import InputOp, Op
+from flexflow_tpu.ops.conv import BatchNorm, Conv2D, Flat, Pool2D
+from flexflow_tpu.ops.dense import BatchMatmul, Embedding, Linear
+from flexflow_tpu.ops.elementwise import Cast, ElementBinary, ElementUnary, Mean
+from flexflow_tpu.ops.norm import Dropout, LayerNorm, RMSNorm, Softmax
+from flexflow_tpu.ops.tensor_ops import (Concat, Gather, Pad, Reshape, Reverse,
+                                         Split, TopK, Transpose)
+from flexflow_tpu.parallel.mesh import make_mesh
+from flexflow_tpu.parallel.strategy import (load_strategies_from_file,
+                                            save_strategies_to_file)
+from flexflow_tpu.runtime.executor import GraphExecutor
+from flexflow_tpu.runtime.loss import loss_type_from_name
+from flexflow_tpu.runtime.metrics import PerfMetrics, metrics_from_names
+from flexflow_tpu.tensor import Tensor
+
+
+class FFModel:
+    def __init__(self, config: Optional[FFConfig] = None):
+        self.config = config or FFConfig()
+        self.ops: List[Op] = []
+        self._op_counters: Dict[str, int] = {}
+        self._dataloaders: List = []
+        self.mesh = None
+        self.executor: Optional[GraphExecutor] = None
+        self.params = None
+        self.opt_state = None
+        self.bn_state = None
+        self.optimizer = None
+        self.loss_type: Optional[LossType] = None
+        self.metric_types: List[MetricsType] = []
+        self.label_tensor: Optional[Tensor] = None
+        self.comp_mode = CompMode.COMP_MODE_TRAINING
+        self._rng = jax.random.PRNGKey(self.config.seed)
+        self._step_count = 0
+        self._train_step = None
+        self._eval_step = None
+        self._predict_fn = None
+        self._current_batch: Dict[str, np.ndarray] = {}
+        self._cached_backward = None
+        self._perf = PerfMetrics()
+
+    # ------------------------------------------------------------------ graph
+
+    def _name(self, kind: str, name: Optional[str]) -> str:
+        if name:
+            return name
+        n = self._op_counters.get(kind, 0)
+        self._op_counters[kind] = n + 1
+        return f"{kind}_{n}" if n else kind
+
+    def _add(self, op: Op) -> Union[Tensor, List[Tensor]]:
+        assert self.get_op_by_name(op.name) is None, \
+            f"duplicate op name {op.name!r} (params/strategies key by name)"
+        self.ops.append(op)
+        return op.outputs[0] if len(op.outputs) == 1 else op.outputs
+
+    def create_tensor(self, dims: Sequence[int],
+                      dtype: DataType = DataType.DT_FLOAT,
+                      name: Optional[str] = None,
+                      create_grad: bool = True) -> Tensor:
+        op = InputOp(self, self._name("input", name), tuple(dims), dtype)
+        op.finalize()
+        assert self.get_op_by_name(op.name) is None, \
+            f"duplicate input name {op.name!r} (batch dicts key by name)"
+        self.ops.append(op)
+        return op.outputs[0]
+
+    # layer factories (reference: flexflow_c.h flexflow_model_add_*)
+
+    def dense(self, input: Tensor, out_dim: int,
+              activation: ActiMode = ActiMode.AC_MODE_NONE,
+              use_bias: bool = True, name: Optional[str] = None, **kw) -> Tensor:
+        return self._add(Linear(self, self._name("dense", name), [input],
+                                out_dim, activation, use_bias))
+
+    def conv2d(self, input: Tensor, out_channels: int, kernel_h: int,
+               kernel_w: int, stride_h: int, stride_w: int, padding_h: int,
+               padding_w: int, activation: ActiMode = ActiMode.AC_MODE_NONE,
+               groups: int = 1, use_bias: bool = True,
+               name: Optional[str] = None, **kw) -> Tensor:
+        return self._add(Conv2D(self, self._name("conv2d", name), [input],
+                                out_channels, kernel_h, kernel_w, stride_h,
+                                stride_w, padding_h, padding_w, activation,
+                                groups, use_bias))
+
+    def pool2d(self, input: Tensor, kernel_h: int, kernel_w: int,
+               stride_h: int, stride_w: int, padding_h: int, padding_w: int,
+               pool_type: PoolType = PoolType.POOL_MAX,
+               activation: ActiMode = ActiMode.AC_MODE_NONE,
+               name: Optional[str] = None) -> Tensor:
+        return self._add(Pool2D(self, self._name("pool2d", name), [input],
+                                kernel_h, kernel_w, stride_h, stride_w,
+                                padding_h, padding_w, pool_type, activation))
+
+    def embedding(self, input: Tensor, num_entries: int, out_dim: int,
+                  aggr: AggrMode = AggrMode.AGGR_MODE_NONE,
+                  name: Optional[str] = None, **kw) -> Tensor:
+        return self._add(Embedding(self, self._name("embedding", name), [input],
+                                   num_entries, out_dim, aggr))
+
+    def batch_norm(self, input: Tensor, relu: bool = True,
+                   name: Optional[str] = None) -> Tensor:
+        return self._add(BatchNorm(self, self._name("batch_norm", name),
+                                   [input], relu))
+
+    def layer_norm(self, input: Tensor, eps: float = 1e-5,
+                   elementwise_affine: bool = True,
+                   name: Optional[str] = None) -> Tensor:
+        return self._add(LayerNorm(self, self._name("layer_norm", name),
+                                   [input], eps, elementwise_affine))
+
+    def rms_norm(self, input: Tensor, eps: float = 1e-6,
+                 name: Optional[str] = None) -> Tensor:
+        return self._add(RMSNorm(self, self._name("rms_norm", name), [input], eps))
+
+    def batch_matmul(self, a: Tensor, b: Tensor,
+                     name: Optional[str] = None) -> Tensor:
+        return self._add(BatchMatmul(self, self._name("batch_matmul", name), [a, b]))
+
+    def flat(self, input: Tensor, name: Optional[str] = None) -> Tensor:
+        return self._add(Flat(self, self._name("flat", name), [input]))
+
+    def softmax(self, input: Tensor, axis: int = -1,
+                name: Optional[str] = None) -> Tensor:
+        return self._add(Softmax(self, self._name("softmax", name), [input], axis))
+
+    def dropout(self, input: Tensor, rate: float, seed: int = 0,
+                name: Optional[str] = None) -> Tensor:
+        return self._add(Dropout(self, self._name("dropout", name), [input],
+                                 rate, seed))
+
+    def multihead_attention(self, query: Tensor, key: Tensor, value: Tensor,
+                            embed_dim: int, num_heads: int, kdim: int = 0,
+                            vdim: int = 0, dropout: float = 0.0,
+                            bias: bool = True, add_bias_kv: bool = False,
+                            add_zero_attn: bool = False, causal: bool = False,
+                            name: Optional[str] = None, **kw) -> Tensor:
+        return self._add(MultiHeadAttention(
+            self, self._name("multihead_attention", name), [query, key, value],
+            embed_dim, num_heads, kdim, vdim, dropout, bias, add_bias_kv,
+            add_zero_attn, causal))
+
+    def reshape(self, input: Tensor, shape: Sequence[int],
+                name: Optional[str] = None) -> Tensor:
+        return self._add(Reshape(self, self._name("reshape", name), [input], shape))
+
+    def transpose(self, input: Tensor, perm: Sequence[int],
+                  name: Optional[str] = None) -> Tensor:
+        return self._add(Transpose(self, self._name("transpose", name), [input], perm))
+
+    def reverse(self, input: Tensor, axis: int,
+                name: Optional[str] = None) -> Tensor:
+        return self._add(Reverse(self, self._name("reverse", name), [input], axis))
+
+    def concat(self, tensors: Sequence[Tensor], axis: int,
+               name: Optional[str] = None) -> Tensor:
+        return self._add(Concat(self, self._name("concat", name), list(tensors), axis))
+
+    def split(self, input: Tensor, sizes: Union[int, Sequence[int]], axis: int,
+              name: Optional[str] = None) -> List[Tensor]:
+        if isinstance(sizes, int):
+            n = sizes
+            d = input.dims[axis]
+            assert d % n == 0
+            sizes = [d // n] * n
+        out = self._add(Split(self, self._name("split", name), [input],
+                              sizes, axis))
+        return out if isinstance(out, list) else [out]
+
+    def topk(self, input: Tensor, k: int, sorted: bool = True,
+             name: Optional[str] = None) -> List[Tensor]:
+        out = self._add(TopK(self, self._name("topk", name), [input], k, sorted))
+        return out if isinstance(out, list) else [out]
+
+    def gather(self, input: Tensor, index: Tensor, axis: int,
+               name: Optional[str] = None) -> Tensor:
+        return self._add(Gather(self, self._name("gather", name),
+                                [input, index], axis))
+
+    def cast(self, input: Tensor, dtype: DataType,
+             name: Optional[str] = None) -> Tensor:
+        return self._add(Cast(self, self._name("cast", name), [input], dtype))
+
+    def pad(self, input: Tensor, pads, value: float = 0.0,
+            name: Optional[str] = None) -> Tensor:
+        return self._add(Pad(self, self._name("pad", name), [input], pads, value))
+
+    def mean(self, input: Tensor, dims: Sequence[int], keepdims: bool = False,
+             name: Optional[str] = None) -> Tensor:
+        return self._add(Mean(self, self._name("mean", name), [input], dims, keepdims))
+
+    # elementwise unary/binary
+
+    def _unary(self, op_type: OperatorType, x: Tensor, name=None,
+               scalar=None) -> Tensor:
+        kind = op_type.name[3:].lower()
+        return self._add(ElementUnary(self, self._name(kind, name), [x],
+                                      op_type, scalar))
+
+    def _binary(self, op_type: OperatorType, a: Tensor, b: Tensor, name=None) -> Tensor:
+        kind = op_type.name[3:].lower()
+        return self._add(ElementBinary(self, self._name(kind, name), [a, b], op_type))
+
+    def exp(self, x, name=None):
+        return self._unary(OperatorType.OP_EXP, x, name)
+
+    def sin(self, x, name=None):
+        return self._unary(OperatorType.OP_SIN, x, name)
+
+    def cos(self, x, name=None):
+        return self._unary(OperatorType.OP_COS, x, name)
+
+    def relu(self, x, name=None):
+        return self._unary(OperatorType.OP_RELU, x, name)
+
+    def sigmoid(self, x, name=None):
+        return self._unary(OperatorType.OP_SIGMOID, x, name)
+
+    def tanh(self, x, name=None):
+        return self._unary(OperatorType.OP_TANH, x, name)
+
+    def elu(self, x, name=None):
+        return self._unary(OperatorType.OP_ELU, x, name)
+
+    def gelu(self, x, name=None):
+        return self._unary(OperatorType.OP_GELU, x, name)
+
+    def identity(self, x, name=None):
+        return self._unary(OperatorType.OP_IDENTITY, x, name)
+
+    def pow(self, x, exponent: float, name=None):
+        return self._unary(OperatorType.OP_POW, x, name, scalar=exponent)
+
+    def rsqrt(self, x, name=None):
+        return self._unary(OperatorType.OP_RSQRT, x, name)
+
+    def scalar_multiply(self, x, scalar: float, name=None):
+        return self._unary(OperatorType.OP_SCALAR_MULTIPLY, x, name, scalar=scalar)
+
+    def add(self, a, b, name=None):
+        return self._binary(OperatorType.OP_EW_ADD, a, b, name)
+
+    def subtract(self, a, b, name=None):
+        return self._binary(OperatorType.OP_EW_SUB, a, b, name)
+
+    def multiply(self, a, b, name=None):
+        return self._binary(OperatorType.OP_EW_MUL, a, b, name)
+
+    def divide(self, a, b, name=None):
+        return self._binary(OperatorType.OP_EW_DIV, a, b, name)
+
+    def max(self, a, b, name=None):
+        return self._binary(OperatorType.OP_EW_MAX, a, b, name)
+
+    def min(self, a, b, name=None):
+        return self._binary(OperatorType.OP_EW_MIN, a, b, name)
+
+    # -------------------------------------------------------------- compile
+
+    def get_op_by_name(self, name: str) -> Optional[Op]:
+        for op in self.ops:
+            if op.name == name:
+                return op
+        return None
+
+    def compile(self, optimizer=None,
+                loss_type: Union[LossType, str] = LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                metrics: Sequence = (MetricsType.METRICS_ACCURACY,),
+                comp_mode: CompMode = CompMode.COMP_MODE_TRAINING,
+                final_tensor: Optional[Tensor] = None):
+        """Resolve strategies -> build mesh -> init sharded params.
+
+        Reference: FFModel::compile (model.cc:1481-1646): optional strategy
+        search, per-op create_output_and_partition/create_weights, fusion,
+        label tensor, optimizer init.
+        """
+        cfg = self.config
+        self.optimizer = optimizer
+        self.loss_type = loss_type_from_name(loss_type)
+        self.metric_types = metrics_from_names(metrics)
+        self.comp_mode = comp_mode
+        self.mesh = make_mesh(cfg.mesh_shape)
+
+        if cfg.import_strategy_file:
+            cfg.strategies.update(
+                load_strategies_from_file(cfg.import_strategy_file))
+        if cfg.search_budget > 0:
+            from flexflow_tpu.search.driver import optimize_strategies
+
+            best = optimize_strategies(self, budget=cfg.search_budget,
+                                       alpha=cfg.search_alpha)
+            cfg.strategies.update(best)
+            if cfg.export_strategy_file:
+                save_strategies_to_file(cfg.export_strategy_file, cfg.strategies)
+
+        self._final_tensor = final_tensor or self.ops[-1].outputs[0]
+
+        # label tensor shaped like the final op's sample dims (model.cc:1615-1646)
+        fdims = self._final_tensor.dims
+        if self.loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY:
+            self.label_tensor = Tensor(dims=tuple(fdims[:-1]) + (1,),
+                                       dtype=DataType.DT_INT32, name="label")
+        else:
+            self.label_tensor = Tensor(dims=fdims, dtype=DataType.DT_FLOAT,
+                                       name="label")
+
+        self.executor = GraphExecutor(self)
+        self._rng, init_key = jax.random.split(self._rng)
+        self.params = self.executor.init_params(init_key)
+        self.bn_state = self.executor.init_state()
+        if self.optimizer is not None:
+            self.opt_state = self.optimizer.init_state(self.params)
+            self._train_step = self.executor.make_train_step(
+                self.optimizer, self.loss_type, self.metric_types,
+                self._final_tensor)
+        self._eval_step = self.executor.make_eval_step(
+            self.loss_type, self.metric_types, self._final_tensor)
+
+    # ---------------------------------------------------------- train verbs
+
+    def _stage_batch(self):
+        batch = {}
+        for dl in self._dataloaders:
+            batch[dl.name] = dl.next_batch()
+        return batch
+
+    def init_layers(self):
+        """API parity (reference FFModel::init_layers model.cc:1342); params
+        are initialized in compile(), so this is a barrier only."""
+        jax.block_until_ready(self.params)
+
+    def zero_gradients(self):
+        pass  # functional autodiff: gradients are created fresh each step
+
+    def next_batch_all(self):
+        self._current_batch = self._stage_batch()
+
+    def forward(self):
+        pass  # fused into backward's value_and_grad (see class docstring)
+
+    def backward(self):
+        pass  # fused into update()
+
+    def update(self):
+        """Run one fused train step on the staged batch."""
+        batch = self._current_batch or self._stage_batch()
+        self._run_train_step(batch)
+
+    def _run_train_step(self, batch: Dict[str, np.ndarray]):
+        sharded = self.executor.shard_batch(batch)
+        self._rng, step_key = jax.random.split(self._rng)
+        (self.params, self.opt_state, self.bn_state, loss, mets) = \
+            self._train_step(self.params, self.opt_state, self.bn_state,
+                             sharded, step_key)
+        self._step_count += 1
+        self._last_loss = loss
+        self._last_metrics = mets
+        return loss, mets
+
+    # ---------------------------------------------------------------- fit
+
+    def fit(self, epochs: Optional[int] = None, batch_size: Optional[int] = None,
+            callbacks: Sequence = (), verbose: bool = True):
+        """Training loop with throughput print (parity: base_model.py:374-436)."""
+        assert self._train_step is not None, "compile() with an optimizer first"
+        assert self._dataloaders, \
+            "no dataloaders attached; create SingleDataLoader(ff, tensor, data)"
+        epochs = epochs or self.config.epochs
+        bs = batch_size or self.config.batch_size
+        if batch_size is not None:
+            for dl in self._dataloaders:
+                dl.batch_size = batch_size
+        num_batches = min(dl.num_batches for dl in self._dataloaders)
+        assert num_batches > 0, (
+            f"dataset smaller than batch_size "
+            f"({min(dl.num_samples for dl in self._dataloaders)} samples < "
+            f"{bs}); no full batch to train on")
+        warm = None
+        for cb in callbacks:
+            cb.set_model(self)
+            cb.on_train_begin()
+        t0 = time.time()
+        total = 0
+        for epoch in range(epochs):
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch)
+            self._perf = PerfMetrics()
+            for dl in self._dataloaders:
+                dl.reset()
+            epoch_mets = []  # device scalars; converted once per epoch so the
+            # host never blocks mid-epoch (keeps XLA dispatch async)
+            for it in range(num_batches):
+                batch = self._stage_batch()
+                loss, mets = self._run_train_step(batch)
+                epoch_mets.append((mets, bs))
+                total += bs
+                if warm is None:
+                    jax.block_until_ready(self.params)
+                    warm = time.time()  # exclude first-step compile from rate
+                    total = 0
+            for mets, bs in epoch_mets:
+                self._perf.update({k: float(v) for k, v in mets.items()}, bs)
+            if verbose:
+                print(f"epoch {epoch}: loss={float(self._last_loss):.4f} "
+                      + self._perf.report(self.loss_type, self.metric_types))
+            for cb in callbacks:
+                cb.on_epoch_end(epoch)
+        jax.block_until_ready(self.params)
+        elapsed = time.time() - (warm or t0)
+        if total and elapsed > 0 and verbose:
+            print(f"epochs {epochs}, ELAPSED TIME = {elapsed:.4f}s, "
+                  f"THROUGHPUT = {total / elapsed:.2f} samples/s")
+        for cb in callbacks:
+            cb.on_train_end()
+        return self._perf
+
+    def evaluate(self, batch: Dict[str, np.ndarray]):
+        sharded = self.executor.shard_batch(batch)
+        loss, mets, logits = self._eval_step(self.params, self.bn_state, sharded)
+        return float(loss), {k: float(v) for k, v in mets.items()}, logits
+
+    def predict(self, batch: Dict[str, np.ndarray]):
+        """Label-free inference through the forward-only program."""
+        if self._predict_fn is None:
+            fwd = self.executor.make_forward([self._final_tensor])
+            self._predict_fn = jax.jit(fwd)
+        sharded = self.executor.shard_batch(batch)
+        return self._predict_fn(self.params, self.bn_state, sharded)[0]
+
+    # ------------------------------------------------------------ weights IO
+
+    def get_weights(self, op_name: str, weight_name: str = "kernel") -> np.ndarray:
+        return np.asarray(self.params[op_name][weight_name])
+
+    def set_weights(self, op_name: str, weight_name: str, value: np.ndarray):
+        shardings = self.executor.param_shardings()
+        sh = shardings[op_name][weight_name]
+        self.params[op_name][weight_name] = jax.device_put(
+            jnp.asarray(value), sh)
+
+    # ------------------------------------------------------------- strategy
+
+    def export_strategies(self, filename: str):
+        save_strategies_to_file(filename, self.config.strategies)
+
+    def import_strategies(self, filename: str):
+        self.config.strategies.update(load_strategies_from_file(filename))

@@ -1,0 +1,3 @@
+"""Model zoo — the reference's example applications rebuilt on the native API
+(reference: examples/cpp/{AlexNet,ResNet,InceptionV3,Transformer,DLRM},
+examples/python)."""

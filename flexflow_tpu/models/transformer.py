@@ -1,0 +1,84 @@
+"""Transformer builders.
+
+`build_reference_transformer` reproduces the reference benchmark app
+(examples/cpp/Transformer/transformer.cc:30-140: encoder-decoder of
+MHA + residual + 2xdense blocks, defaults hidden 512 / 16 heads / 12 layers /
+seq 128, MSE regression head, SGD 0.01).
+
+`build_encoder_classifier` is the modern variant (pre-LN, GELU FFN, causal
+option) used as the flagship bench model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from flexflow_tpu.ffconst import ActiMode
+from flexflow_tpu.model import FFModel
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    hidden_size: int = 512
+    embedding_size: int = 512
+    num_heads: int = 16
+    num_layers: int = 12
+    sequence_length: int = 128
+
+
+def attention_encoder_decoder(ff: FFModel, x1, x2, hidden_dim, num_heads, i):
+    """One reference layer (transformer.cc:39-56): self-attn + residual +
+    dense(relu)+dense on each stream, plus cross-attention on stream 2."""
+    t1 = ff.add(ff.multihead_attention(x1, x1, x1, hidden_dim, num_heads,
+                                       name=f"enc_attn_{i}"), x1)
+    t1 = ff.dense(ff.dense(t1, hidden_dim, ActiMode.AC_MODE_RELU,
+                           name=f"enc_ff1_{i}"),
+                  hidden_dim, name=f"enc_ff2_{i}")
+    t2 = ff.add(ff.multihead_attention(x2, x2, x2, hidden_dim, num_heads,
+                                       name=f"dec_self_attn_{i}"), x2)
+    t2 = ff.add(ff.multihead_attention(t2, t1, t1, hidden_dim, num_heads,
+                                       name=f"dec_cross_attn_{i}"), t2)
+    t2 = ff.dense(ff.dense(t2, hidden_dim, ActiMode.AC_MODE_RELU,
+                           name=f"dec_ff1_{i}"),
+                  hidden_dim, name=f"dec_ff2_{i}")
+    return t1, t2
+
+
+def build_reference_transformer(ff: FFModel, batch_size: int,
+                                cfg: TransformerConfig = None):
+    cfg = cfg or TransformerConfig()
+    x = ff.create_tensor([batch_size, cfg.sequence_length, cfg.hidden_size],
+                         name="input")
+    t1 = t2 = x
+    for i in range(cfg.num_layers):
+        t1, t2 = attention_encoder_decoder(ff, t1, t2, cfg.hidden_size,
+                                           cfg.num_heads, i)
+    out = ff.dense(t2, 1, name="regression_head")
+    return x, out
+
+
+def encoder_block(ff: FFModel, x, hidden, heads, ffn_mult, i, causal=False,
+                  dropout=0.0):
+    """Pre-LN block: x + MHA(LN(x)); x + FFN(LN(x)) with GELU."""
+    a = ff.layer_norm(x, name=f"ln1_{i}")
+    a = ff.multihead_attention(a, a, a, hidden, heads, dropout=dropout,
+                               causal=causal, name=f"attn_{i}")
+    x = ff.add(x, a, name=f"res1_{i}")
+    f = ff.layer_norm(x, name=f"ln2_{i}")
+    f = ff.dense(f, hidden * ffn_mult, ActiMode.AC_MODE_GELU, name=f"ffn1_{i}")
+    f = ff.dense(f, hidden, name=f"ffn2_{i}")
+    return ff.add(x, f, name=f"res2_{i}")
+
+
+def build_encoder_classifier(ff: FFModel, batch_size: int, seq_len: int = 128,
+                             hidden: int = 512, layers: int = 6, heads: int = 8,
+                             ffn_mult: int = 4, num_classes: int = 16,
+                             causal: bool = False):
+    x = ff.create_tensor([batch_size, seq_len, hidden], name="input")
+    t = x
+    for i in range(layers):
+        t = encoder_block(ff, t, hidden, heads, ffn_mult, i, causal)
+    t = ff.layer_norm(t, name="ln_f")
+    t = ff.mean(t, dims=[1], name="pool")
+    out = ff.dense(t, num_classes, name="head")
+    return x, out

@@ -1,0 +1,20 @@
+"""Operator library.
+
+Each op is a pure-functional compute rule plus shape/weight/partition metadata.
+The analog of the reference's src/ops/*.cu files — but where the reference op
+owns Legion regions, launchers, and hand-written CUDA kernels
+(e.g. src/ops/linear.cu:41-1115), a TPU op here is only:
+
+  * shape inference (`output_shapes`)
+  * weight specs (`weights`) with initializer + sync metadata
+  * a traceable `forward` built from jax/lax/pallas primitives
+  * partition metadata for the strategy search (`partitionable_output_dims`,
+    `weight_partition`) — the analog of create_output_and_partition
+  * an analytic cost hook (`flops`) feeding the C++ simulator
+
+Backward is sharded autodiff (jax.grad under GSPMD) — the reference's
+per-op backward_kernel + replica-reduction machinery (linear.cu:774-835)
+collapses into psum insertions by XLA.
+"""
+
+from flexflow_tpu.ops.base import Op, WeightSpec, InputOp  # noqa: F401

@@ -1,0 +1,143 @@
+"""MultiHeadAttention.
+
+Reference: src/ops/attention.cu (745 LoC, cuDNN cudnnMultiHeadAttnForward;
+partitioning asserted batch-only at attention.cu:118-120).
+
+TPU re-design supersedes that restriction: attention here is partitionable on
+batch, heads ('model' axis — Megatron-style), and sequence ('seq' axis — ring
+attention, flexflow_tpu/parallel/ring_attention.py). The dense path below is
+einsum-built so XLA fuses QK^T -> softmax -> V; a Pallas flash kernel and the
+ring/SP lowering are selected by the executor when the strategy shards `seq`.
+
+API parity: FFModel.multihead_attention mirrors flexflow_c.h's
+flexflow_model_add_multihead_attention signature.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from flexflow_tpu.ffconst import OperatorType
+from flexflow_tpu.ops.base import Op, WeightSpec
+
+
+class MultiHeadAttention(Op):
+    op_type = OperatorType.OP_MULTIHEAD_ATTENTION
+    needs_rng = True
+
+    def __init__(self, model, name, inputs, embed_dim: int, num_heads: int,
+                 kdim: int = 0, vdim: int = 0, dropout: float = 0.0,
+                 bias: bool = True, add_bias_kv: bool = False,
+                 add_zero_attn: bool = False, causal: bool = False):
+        super().__init__(model, name, inputs)
+        if add_bias_kv or add_zero_attn:
+            raise NotImplementedError(
+                "add_bias_kv/add_zero_attn are not supported yet "
+                "(reference cuDNN MHA also lacked them)")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        # kdim/vdim are total projection sizes (reference kProjSize*num_heads
+        # semantics via cudnnSetAttnDescriptor, attention.cu:533-570)
+        self.kdim = kdim if kdim > 0 else embed_dim
+        self.vdim = vdim if vdim > 0 else embed_dim
+        self.dropout = dropout
+        self.bias = bias
+        self.causal = causal
+        assert embed_dim % num_heads == 0
+        assert self.kdim % num_heads == 0 and self.vdim % num_heads == 0
+        self.head_dim = embed_dim // num_heads
+        self.qk_head_dim = self.kdim // num_heads
+        self.v_head_dim = self.vdim // num_heads
+        self.q_in = inputs[0].dims[-1]
+        self.k_in = inputs[1].dims[-1]
+        self.v_in = inputs[2].dims[-1]
+        self.finalize()
+
+    def output_shapes(self):
+        q = self.inputs[0].dims
+        return [tuple(q[:-1]) + (self.embed_dim,)], [self.inputs[0].dtype]
+
+    def weights(self) -> List[WeightSpec]:
+        ws = [
+            WeightSpec("wq", (self.q_in, self.num_heads, self.qk_head_dim),
+                       init="glorot", fan=(self.q_in, self.kdim)),
+            WeightSpec("wk", (self.k_in, self.num_heads, self.qk_head_dim),
+                       init="glorot", fan=(self.k_in, self.kdim)),
+            WeightSpec("wv", (self.v_in, self.num_heads, self.v_head_dim),
+                       init="glorot", fan=(self.v_in, self.vdim)),
+            WeightSpec("wo", (self.num_heads, self.v_head_dim, self.embed_dim),
+                       init="glorot", fan=(self.vdim, self.embed_dim)),
+        ]
+        if self.bias:
+            ws += [WeightSpec("bias_q", (self.num_heads, self.qk_head_dim), init="zero"),
+                   WeightSpec("bias_k", (self.num_heads, self.qk_head_dim), init="zero"),
+                   WeightSpec("bias_v", (self.num_heads, self.v_head_dim), init="zero"),
+                   WeightSpec("bias_o", (self.embed_dim,), init="zero")]
+        return ws
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        q, k, v = xs[0], xs[1], xs[2]
+        # (B, Sq, D) x (D, H, Hd) -> (B, Sq, H, Hd)
+        qh = jnp.einsum("bsd,dhk->bshk", q, params["wq"])
+        kh = jnp.einsum("bsd,dhk->bshk", k, params["wk"])
+        vh = jnp.einsum("bsd,dhk->bshk", v, params["wv"])
+        if self.bias:
+            qh = qh + params["bias_q"]
+            kh = kh + params["bias_k"]
+            vh = vh + params["bias_v"]
+        scale = 1.0 / math.sqrt(self.qk_head_dim)
+        logits = jnp.einsum("bqhk,bshk->bhqs", qh, kh) * scale
+        if self.causal:
+            sq, sk = logits.shape[-2], logits.shape[-1]
+            mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+            logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+        probs = jax.nn.softmax(logits, axis=-1)
+        if training and self.dropout > 0.0 and rng is not None:
+            keep = 1.0 - self.dropout
+            probs = jnp.where(jax.random.bernoulli(rng, keep, probs.shape),
+                              probs / keep, 0.0)
+        ctx = jnp.einsum("bhqs,bshk->bqhk", probs, vh)
+        out = jnp.einsum("bqhk,hkd->bqd", ctx, params["wo"])
+        if self.bias:
+            out = out + params["bias_o"]
+        return [out]
+
+    _contracted_output_dims = (2,)  # hidden dim comes from the wo contraction
+
+    def partitionable_output_dims(self):
+        # batch, seq (ring attention), hidden (head split)
+        return [0, 1, 2]
+
+    def weight_partition(self, axis_map):
+        # hidden-dim sharding => split heads (Megatron): shard the H dim of
+        # wq/wk/wv and of wo's input side.
+        ax = self.axes_for_dim(axis_map, 2)
+        if ax is None:
+            return super().weight_partition(axis_map)
+        out = {
+            "wq": P(None, ax, None),
+            "wk": P(None, ax, None),
+            "wv": P(None, ax, None),
+            "wo": P(ax, None, None),
+        }
+        if self.bias:
+            out["bias_q"] = P(ax, None)
+            out["bias_k"] = P(ax, None)
+            out["bias_v"] = P(ax, None)
+            out["bias_o"] = P(None)
+        return out
+
+    def flops(self):
+        b, sq = self.inputs[0].dims[0], self.inputs[0].dims[1]
+        sk = self.inputs[1].dims[1]
+        d = self.embed_dim
+        proj = 2 * b * (sq * self.q_in + sk * self.k_in + sk * self.v_in) * d \
+            + 2 * b * sq * d * d
+        attn = 2 * b * self.num_heads * sq * sk * self.head_dim * 2
+        return proj + attn

@@ -1,0 +1,208 @@
+"""Conv2D, Pool2D, BatchNorm, Flat.
+
+Reference: src/ops/conv_2d.cu (cuDNN conv + algo search, 4D sample+spatial
+partitioning), src/ops/pool_2d.cu, src/ops/batch_norm.cu, src/ops/flat.cu.
+
+TPU re-design: user-facing tensors are NCHW to match the reference API
+(conv_2d.cu ctor signature), but convs execute via lax.conv_general_dilated
+with explicit dimension_numbers — XLA picks the MXU-friendly internal layout.
+Spatial (attribute) parallelism = shard H/W dims; XLA GSPMD inserts halo
+exchange automatically, replacing the reference's implicit Legion region
+intersections (simulator.cc:360-380 costs them explicitly).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from flexflow_tpu.ffconst import ActiMode, DataType, OperatorType, PoolType
+from flexflow_tpu.ops.base import Op, WeightSpec
+from flexflow_tpu.ops.dense import apply_activation
+
+
+class Conv2D(Op):
+    op_type = OperatorType.OP_CONV2D
+
+    def __init__(self, model, name, inputs, out_channels: int,
+                 kernel_h: int, kernel_w: int, stride_h: int, stride_w: int,
+                 padding_h: int, padding_w: int,
+                 activation: ActiMode = ActiMode.AC_MODE_NONE,
+                 groups: int = 1, use_bias: bool = True):
+        super().__init__(model, name, inputs)
+        self.out_channels = out_channels
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.padding = (padding_h, padding_w)
+        self.activation = activation
+        self.groups = groups
+        self.use_bias = use_bias
+        self.in_channels = inputs[0].dims[1]
+        self.finalize()
+
+    def output_shapes(self):
+        n, c, h, w = self.inputs[0].dims
+        oh = (h + 2 * self.padding[0] - self.kernel[0]) // self.stride[0] + 1
+        ow = (w + 2 * self.padding[1] - self.kernel[1]) // self.stride[1] + 1
+        return [(n, self.out_channels, oh, ow)], [self.inputs[0].dtype]
+
+    def weights(self) -> List[WeightSpec]:
+        kh, kw = self.kernel
+        cin_g = self.in_channels // self.groups
+        fan_in = cin_g * kh * kw
+        fan_out = (self.out_channels // self.groups) * kh * kw
+        ws = [WeightSpec("kernel", (self.out_channels, cin_g, kh, kw),
+                         init="glorot", fan=(fan_in, fan_out))]
+        if self.use_bias:
+            ws.append(WeightSpec("bias", (self.out_channels,), init="zero"))
+        return ws
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        x = xs[0]
+        y = lax.conv_general_dilated(
+            x, params["kernel"],
+            window_strides=self.stride,
+            padding=[(self.padding[0], self.padding[0]),
+                     (self.padding[1], self.padding[1])],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.groups,
+            preferred_element_type=x.dtype,
+        )
+        if self.use_bias:
+            y = y + params["bias"][None, :, None, None]
+        return [apply_activation(y, self.activation)]
+
+    _contracted_output_dims = (1,)  # out-channel comes from the kernel
+
+    def partitionable_output_dims(self):
+        return [0, 1, 2, 3]  # sample, out-channel(param), H, W (attribute)
+
+    def weight_partition(self, axis_map):
+        ax = self.axes_for_dim(axis_map, 1)
+        out = {"kernel": P(ax, None, None, None)}
+        if self.use_bias:
+            out["bias"] = P(ax)
+        return out
+
+    def flops(self):
+        n, c, oh, ow = self.outputs[0].dims
+        kh, kw = self.kernel
+        return 2 * n * c * oh * ow * (self.in_channels // self.groups) * kh * kw
+
+
+class Pool2D(Op):
+    op_type = OperatorType.OP_POOL2D
+
+    def __init__(self, model, name, inputs, kernel_h, kernel_w,
+                 stride_h, stride_w, padding_h, padding_w,
+                 pool_type: PoolType = PoolType.POOL_MAX,
+                 activation: ActiMode = ActiMode.AC_MODE_NONE):
+        super().__init__(model, name, inputs)
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.padding = (padding_h, padding_w)
+        self.pool_type = pool_type
+        self.activation = activation
+        self.finalize()
+
+    def output_shapes(self):
+        n, c, h, w = self.inputs[0].dims
+        oh = (h + 2 * self.padding[0] - self.kernel[0]) // self.stride[0] + 1
+        ow = (w + 2 * self.padding[1] - self.kernel[1]) // self.stride[1] + 1
+        return [(n, c, oh, ow)], [self.inputs[0].dtype]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        x = xs[0]
+        kh, kw = self.kernel
+        window = (1, 1, kh, kw)
+        strides = (1, 1) + self.stride
+        pads = ((0, 0), (0, 0),
+                (self.padding[0], self.padding[0]),
+                (self.padding[1], self.padding[1]))
+        if self.pool_type == PoolType.POOL_MAX:
+            y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pads)
+        else:
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+            y = s / (kh * kw)
+        return [apply_activation(y, self.activation)]
+
+    def partitionable_output_dims(self):
+        return [0, 1, 2, 3]
+
+    def flops(self):
+        return int(np.prod(self.outputs[0].dims)) * self.kernel[0] * self.kernel[1]
+
+
+class BatchNorm(Op):
+    """BatchNorm2D over NCHW with running stats (reference: batch_norm.cu,
+    cuDNN BN; scale init to one / bias to zero via BATCHNORM_INIT_PARA task)."""
+
+    op_type = OperatorType.OP_BATCHNORM
+    stateful = True
+
+    def __init__(self, model, name, inputs, relu: bool = True,
+                 momentum: float = 0.9, eps: float = 1e-5):
+        super().__init__(model, name, inputs)
+        self.relu = relu
+        self.momentum = momentum
+        self.eps = eps
+        self.channels = inputs[0].dims[1]
+        self.finalize()
+
+    def output_shapes(self):
+        return [self.inputs[0].dims], [self.inputs[0].dtype]
+
+    def weights(self):
+        return [WeightSpec("scale", (self.channels,), init="one"),
+                WeightSpec("bias", (self.channels,), init="zero")]
+
+    def init_state(self):
+        return {"mean": np.zeros((self.channels,), np.float32),
+                "var": np.ones((self.channels,), np.float32)}
+
+    def forward_stateful(self, params, state, xs, *, training=False, rng=None):
+        x = xs[0]
+        if training:
+            # batch stats over N,H,W — under data parallelism GSPMD turns these
+            # means into cross-replica psums (i.e. sync BN for free)
+            mean = jnp.mean(x, axis=(0, 2, 3))
+            var = jnp.var(x, axis=(0, 2, 3))
+            new_state = {
+                "mean": self.momentum * state["mean"] + (1 - self.momentum) * mean,
+                "var": self.momentum * state["var"] + (1 - self.momentum) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.eps) * params["scale"]
+        y = (x - mean[None, :, None, None]) * inv[None, :, None, None] \
+            + params["bias"][None, :, None, None]
+        if self.relu:
+            y = jax.nn.relu(y)
+        return [y], new_state
+
+    def partitionable_output_dims(self):
+        return [0, 2, 3]
+
+
+class Flat(Op):
+    op_type = OperatorType.OP_FLAT
+
+    def __init__(self, model, name, inputs):
+        super().__init__(model, name, inputs)
+        self.finalize()
+
+    def output_shapes(self):
+        d = self.inputs[0].dims
+        return [(d[0], int(np.prod(d[1:])))], [self.inputs[0].dtype]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        return [xs[0].reshape(xs[0].shape[0], -1)]
+
+    def flops(self):
+        return 0

@@ -1,0 +1,175 @@
+"""Linear (dense), Embedding, BatchMatmul.
+
+Reference: src/ops/linear.cu (1115 LoC: cuBLAS GEMM + replica-tensor TP
+machinery), src/ops/embedding.cu (custom gather/scatter-add kernels),
+src/ops/batch_matmul.cu (cuBLAS strided batched GEMM).
+
+TPU re-design: Linear is one jnp.einsum feeding the MXU; all outer dims are
+batch (the reference does the same flattening, linear.cu:158). Parameter
+parallelism = shard the kernel's out-feature dim over the 'model' mesh axis;
+sharded autodiff inserts the psum that replaces the reference's replica tensor
++ backward2 reduction (linear.cu:774-835). Embedding's vocab-partitioned
+lookup (DLRM's key strategy) shards the table on dim 0; XLA lowers the gather
+to an all-gather-free one-hot matmul or dynamic-slice + psum under GSPMD.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from flexflow_tpu.ffconst import ActiMode, AggrMode, DataType, OperatorType
+from flexflow_tpu.ops.base import Op, WeightSpec
+
+
+def apply_activation(x, acti: ActiMode):
+    import jax
+
+    if acti == ActiMode.AC_MODE_NONE:
+        return x
+    if acti == ActiMode.AC_MODE_RELU:
+        return jax.nn.relu(x)
+    if acti == ActiMode.AC_MODE_SIGMOID:
+        return jax.nn.sigmoid(x)
+    if acti == ActiMode.AC_MODE_TANH:
+        return jnp.tanh(x)
+    if acti == ActiMode.AC_MODE_GELU:
+        return jax.nn.gelu(x)
+    raise ValueError(f"unknown activation {acti}")
+
+
+class Linear(Op):
+    op_type = OperatorType.OP_LINEAR
+
+    def __init__(self, model, name, inputs, out_dim: int,
+                 activation: ActiMode = ActiMode.AC_MODE_NONE,
+                 use_bias: bool = True):
+        super().__init__(model, name, inputs)
+        self.out_dim = out_dim
+        self.activation = activation
+        self.use_bias = use_bias
+        self.in_dim = inputs[0].dims[-1]
+        self.finalize()
+
+    def output_shapes(self):
+        ishape = self.inputs[0].dims
+        return [tuple(ishape[:-1]) + (self.out_dim,)], [self.inputs[0].dtype]
+
+    def weights(self) -> List[WeightSpec]:
+        ws = [WeightSpec("kernel", (self.in_dim, self.out_dim), init="glorot",
+                         fan=(self.in_dim, self.out_dim))]
+        if self.use_bias:
+            ws.append(WeightSpec("bias", (self.out_dim,), init="zero"))
+        return ws
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        x = xs[0]
+        y = jnp.einsum("...i,io->...o", x, params["kernel"],
+                       preferred_element_type=x.dtype)
+        if self.use_bias:
+            y = y + params["bias"]
+        return [apply_activation(y, self.activation)]
+
+    @property
+    def _contracted_output_dims(self):
+        return (self.outputs[0].num_dims - 1,)
+
+    def partitionable_output_dims(self):
+        # sample dim(s) + out-channel (the reference's parameter-parallel dim,
+        # linear.cu:144-269, gated by --enable-parameter-parallel)
+        nd = self.outputs[0].num_dims
+        return list(range(nd))
+
+    def weight_partition(self, axis_map):
+        ax = self.axes_for_dim(axis_map, self.outputs[0].num_dims - 1)
+        out = {"kernel": P(None, ax)}
+        if self.use_bias:
+            out["bias"] = P(ax)
+        return out
+
+    def flops(self):
+        batch = int(np.prod(self.outputs[0].dims[:-1]))
+        return 2 * batch * self.in_dim * self.out_dim
+
+
+class Embedding(Op):
+    op_type = OperatorType.OP_EMBEDDING
+
+    def __init__(self, model, name, inputs, num_entries: int, out_dim: int,
+                 aggr: AggrMode = AggrMode.AGGR_MODE_NONE):
+        super().__init__(model, name, inputs)
+        self.num_entries = num_entries
+        self.out_dim = out_dim
+        self.aggr = aggr
+        self.finalize()
+
+    def output_shapes(self):
+        ishape = self.inputs[0].dims
+        if self.aggr == AggrMode.AGGR_MODE_NONE:
+            shape = tuple(ishape) + (self.out_dim,)
+        else:
+            # bag aggregation over the last input dim (reference AGGR_MODE_SUM/AVG,
+            # embedding.cu:165-226)
+            shape = tuple(ishape[:-1]) + (self.out_dim,)
+        return [shape], [DataType.DT_FLOAT]
+
+    def weights(self):
+        return [WeightSpec("kernel", (self.num_entries, self.out_dim),
+                           init="glorot", fan=(self.num_entries, self.out_dim))]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        idx = xs[0].astype(jnp.int32)
+        emb = jnp.take(params["kernel"], idx, axis=0)
+        if self.aggr == AggrMode.AGGR_MODE_SUM:
+            emb = jnp.sum(emb, axis=-2)
+        elif self.aggr == AggrMode.AGGR_MODE_AVG:
+            emb = jnp.mean(emb, axis=-2)
+        return [emb]
+
+    @property
+    def _contracted_output_dims(self):
+        return (self.outputs[0].num_dims - 1,)
+
+    def partitionable_output_dims(self):
+        nd = self.outputs[0].num_dims
+        return [0, nd - 1]  # sample + embedding-channel (vocab-split table)
+
+    def weight_partition(self, axis_map):
+        ax = self.axes_for_dim(axis_map, self.outputs[0].num_dims - 1)
+        return {"kernel": P(None, ax)}
+
+    def flops(self):
+        return 0  # memory-bound gather
+
+    def input_axis_map(self, axis_map, input_idx):
+        # index input has no channel dim; keep only sample-dim mappings
+        ndims = self.inputs[input_idx].num_dims
+        return {ax: (d if d is not None and d < ndims else None)
+                for ax, d in (axis_map or {}).items()}
+
+
+class BatchMatmul(Op):
+    op_type = OperatorType.OP_BATCHMATMUL
+
+    def __init__(self, model, name, inputs):
+        super().__init__(model, name, inputs)
+        self.finalize()
+
+    def output_shapes(self):
+        a, b = self.inputs[0].dims, self.inputs[1].dims
+        assert a[:-2] == b[:-2], f"batch dims mismatch {a} @ {b}"
+        assert a[-1] == b[-2], f"contraction mismatch {a} @ {b}"
+        return [tuple(a[:-1]) + (b[-1],)], [self.inputs[0].dtype]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        return [jnp.matmul(xs[0], xs[1])]
+
+    def partitionable_output_dims(self):
+        return list(range(self.outputs[0].num_dims - 2))
+
+    def flops(self):
+        a, b = self.inputs[0].dims, self.inputs[1].dims
+        return 2 * int(np.prod(a)) * b[-1]

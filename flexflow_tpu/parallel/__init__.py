@@ -1,0 +1,2 @@
+from flexflow_tpu.parallel.pconfig import ParallelConfig  # noqa: F401
+from flexflow_tpu.parallel.mesh import make_mesh, default_mesh  # noqa: F401

@@ -1,0 +1,50 @@
+"""Device mesh construction.
+
+Replaces the reference's machine discovery in the mapper
+(src/mapper/mapper.cc:55-144: GPUs/CPUs/memories per node) with
+`jax.sharding.Mesh` construction. Axis vocabulary used across the framework:
+
+  data   — batch/sample parallelism (reference SOAP 'S')
+  model  — parameter/tensor parallelism (reference SOAP 'P'; linear.cu out-channel)
+  seq    — sequence/context parallelism (net-new vs reference, SURVEY §5.7)
+  pipe   — pipeline stages (reference: nmt/ hand-rolled pipeline)
+  expert — MoE expert parallelism (net-new)
+
+Axes of size 1 are always legal, so a single mesh covers every strategy the
+search proposes (GSPMD constraint; SURVEY §7 hard part 2).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+AXIS_ORDER = ("pipe", "data", "expert", "seq", "model")
+
+
+def make_mesh(shape: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh from {axis: size}. Axes ordered canonically so ICI-neighbor
+    axes ('model', 'seq') are innermost (fastest-varying => nearest devices)."""
+    axes = [a for a in AXIS_ORDER if a in shape and shape[a] > 0]
+    extra = [a for a in shape if a not in AXIS_ORDER]
+    axes += sorted(extra)
+    sizes = [shape[a] for a in axes]
+    n = int(np.prod(sizes)) if sizes else 1
+    if devices is None:
+        devices = jax.devices()[:n]
+    if len(devices) < n:
+        raise ValueError(f"mesh {shape} needs {n} devices, have {len(devices)}")
+    dev_array = np.asarray(devices[:n]).reshape(sizes if sizes else (1,))
+    return Mesh(dev_array, axis_names=tuple(axes) if axes else ("data",))
+
+
+def default_mesh(num_devices: Optional[int] = None) -> Mesh:
+    n = num_devices if num_devices is not None else len(jax.devices())
+    return make_mesh({"data": n})
+
+
+def mesh_shape_dict(mesh: Mesh) -> Dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

@@ -1,0 +1,108 @@
+"""ParallelConfig: the per-op parallelization strategy record.
+
+Reference: include/config.h:47-69 `ParallelConfig{device_type, nDims, dim[],
+device_ids[]}`; data-parallel seeding src/runtime/model.cc:483-494.
+
+TPU re-design: the strategy must be expressible as a GSPMD sharding over one
+`jax.sharding.Mesh`, so alongside the reference's per-dim partition degrees we
+carry an explicit `axis_map`: mesh-axis-name -> logical tensor dim (or None for
+"replicated over that axis"). Degrees are derivable from the axis_map + mesh;
+they are kept so the reference text schema round-trips
+(src/runtime/strategy.cc:95-189) and so the C++ simulator can reason about
+degrees without a mesh object.
+
+Dim order: we store degrees in LOGICAL order (dim 0 = sample/batch). The
+reference stores them reversed (Legion domain order, sample last —
+model.cc:489-491); file IO reverses accordingly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class ParallelConfig:
+    device_type: str = "TPU"  # serialized as the reference's GPU enum value
+    dims: Tuple[int, ...] = ()  # partition degree per logical output dim
+    device_ids: Tuple[int, ...] = ()
+    # mesh-axis name -> logical tensor dim it partitions (None = unused/replicated)
+    axis_map: Optional[Dict[str, Optional[int]]] = None
+
+    # ---- constructors -----------------------------------------------------
+
+    @staticmethod
+    def data_parallel(ndims: int, num_parts: int) -> "ParallelConfig":
+        """Reference: Op::get_data_parallel_config model.cc:483-494."""
+        dims = tuple(num_parts if i == 0 else 1 for i in range(ndims))
+        return ParallelConfig(
+            dims=dims,
+            device_ids=tuple(range(num_parts)),
+            axis_map={"data": 0} if num_parts > 1 else {"data": None},
+        )
+
+    @staticmethod
+    def replicated(ndims: int) -> "ParallelConfig":
+        return ParallelConfig(dims=(1,) * ndims, device_ids=(0,), axis_map={})
+
+    @staticmethod
+    def from_axis_map(ndims: int, mesh_shape: Dict[str, int],
+                      axis_map: Dict[str, Optional[int]]) -> "ParallelConfig":
+        dims = [1] * ndims
+        n = 1
+        for ax, d in axis_map.items():
+            if d is not None:
+                dims[d] *= mesh_shape[ax]
+        for v in dims:
+            n *= v
+        return ParallelConfig(dims=tuple(dims), device_ids=tuple(range(n)),
+                              axis_map=dict(axis_map))
+
+    # ---- queries ----------------------------------------------------------
+
+    @property
+    def nDims(self) -> int:
+        return len(self.dims)
+
+    def num_parts(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    def degree(self, dim: int) -> int:
+        return self.dims[dim]
+
+    def is_data_parallel_only(self) -> bool:
+        return all(d == 1 for d in self.dims[1:])
+
+    def to_partition_spec(self, ndims: Optional[int] = None,
+                          mesh_axis_order: Optional[List[str]] = None):
+        """Lower to a jax PartitionSpec. Requires axis_map (set by the
+        strategy layer when it validates degrees against the mesh)."""
+        from jax.sharding import PartitionSpec as P
+
+        ndims = ndims if ndims is not None else self.nDims
+        if not self.axis_map:
+            return P(*([None] * ndims))
+        dim_axes: List[List[str]] = [[] for _ in range(ndims)]
+        order = mesh_axis_order or list(self.axis_map.keys())
+        for ax in order:
+            d = self.axis_map.get(ax)
+            if d is not None and d < ndims:
+                dim_axes[d].append(ax)
+        entries = []
+        for axes in dim_axes:
+            if not axes:
+                entries.append(None)
+            elif len(axes) == 1:
+                entries.append(axes[0])
+            else:
+                entries.append(tuple(axes))
+        return P(*entries)
+
+    def __hash__(self):
+        am = tuple(sorted((k, v if v is not None else -1)
+                          for k, v in (self.axis_map or {}).items()))
+        return hash((self.dims, am))

@@ -1,0 +1,67 @@
+"""Strategy persistence — reference-compatible text schema.
+
+Format (reference: src/runtime/strategy.cc:95-189):
+
+    <num_ops>
+    <op name>
+    <device_type int>        # reference GPU=0? serialized as in enum; we write 1
+    <nDims>
+    <dim[0]> <dim[1]> ... (tab separated, REVERSED logical order: sample last)
+    <num_device_ids>
+    <id0> <id1> ...
+
+The reference keys strategies by hash(op name) (strategy.cc:22-25) used as a
+Legion MappingTagID; we key by the op name itself.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from flexflow_tpu.parallel.pconfig import ParallelConfig
+
+_DEVICE_TYPE_TO_INT = {"GPU": 0, "CPU": 1, "TPU": 0}
+_INT_TO_DEVICE_TYPE = {0: "TPU", 1: "CPU"}
+
+
+def save_strategies_to_file(filename: str, strategies: Dict[str, ParallelConfig]) -> None:
+    with open(filename, "w") as f:
+        f.write(f"{len(strategies)}\n")
+        for name in sorted(strategies):
+            pc = strategies[name]
+            f.write(f"{name}\n")
+            f.write(f"{_DEVICE_TYPE_TO_INT.get(pc.device_type, 0)}\n")
+            f.write(f"{pc.nDims}\n")
+            f.write("\t".join(str(d) for d in reversed(pc.dims)) + "\n")
+            n = pc.num_parts()
+            f.write(f"{n}\n")
+            ids = pc.device_ids if len(pc.device_ids) == n else tuple(range(n))
+            f.write("\t".join(str(i) for i in ids) + "\n")
+
+
+def load_strategies_from_file(filename: str) -> Dict[str, ParallelConfig]:
+    with open(filename) as f:
+        tokens = f.read().split()
+    pos = 0
+
+    def take() -> str:
+        nonlocal pos
+        t = tokens[pos]
+        pos += 1
+        return t
+
+    out: Dict[str, ParallelConfig] = {}
+    num_ops = int(take())
+    for _ in range(num_ops):
+        name = take()
+        device_type = _INT_TO_DEVICE_TYPE.get(int(take()), "TPU")
+        ndims = int(take())
+        rev_dims = [int(take()) for _ in range(ndims)]
+        nids = int(take())
+        ids = tuple(int(take()) for _ in range(nids))
+        out[name] = ParallelConfig(
+            device_type=device_type,
+            dims=tuple(reversed(rev_dims)),
+            device_ids=ids,
+        )
+    return out

@@ -1,0 +1,46 @@
+"""SingleDataLoader: full-dataset-resident batch slicer.
+
+Reference semantics (python/flexflow_dataloader.{h,cc,cu}): the entire dataset
+is attached once into zero-copy memory; `next_batch` is an index launch that
+copies each shard's sample slice to its device. TPU version: the dataset stays
+in host RAM as numpy; `next_batch` returns the next batch slice, and the
+executor device_puts it under the batch NamedSharding (each host feeds its
+addressable shard — multi-host ready).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class SingleDataLoader:
+    def __init__(self, model, tensor, full_array: np.ndarray,
+                 num_samples: Optional[int] = None, batch_size: Optional[int] = None):
+        self.model = model
+        self.tensor = tensor
+        self.name = tensor.name.split(":")[0] if tensor.name else "input"
+        self.data = np.asarray(full_array)
+        self.num_samples = num_samples or self.data.shape[0]
+        self.batch_size = batch_size or model.config.batch_size
+        self.next_index = 0
+        if model is not None:
+            model._dataloaders.append(self)
+
+    @property
+    def num_batches(self) -> int:
+        return self.num_samples // self.batch_size
+
+    def reset(self):
+        self.next_index = 0
+
+    def next_batch(self) -> np.ndarray:
+        b = self.batch_size
+        start = self.next_index
+        if start + b > self.num_samples:
+            start = 0
+            self.next_index = 0
+        out = self.data[start:start + b]
+        self.next_index = start + b
+        return out

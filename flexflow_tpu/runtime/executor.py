@@ -1,0 +1,288 @@
+"""Graph executor: lowers the op graph + strategy table into jitted,
+GSPMD-sharded XLA programs.
+
+This replaces the reference's entire launch machinery — per-op IndexLaunchers,
+the FFMapper's tag->ParallelConfig->device resolution (src/mapper/mapper.cc:
+346-424), and Legion's implicit region copies — with ONE traced program per
+(train step | inference step): each op's output gets a
+`with_sharding_constraint` from its ParallelConfig (the "mapper tag"), and XLA
+GSPMD inserts all resharding/halo/collective traffic over ICI. The jit cache
+plays the role of Legion tracing (flexflow_cbinding.py:394-397).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from flexflow_tpu.ffconst import CompMode, LossType, MetricsType, dtype_to_np
+from flexflow_tpu.ops.base import InputOp, Op
+from flexflow_tpu.parallel.mesh import mesh_shape_dict
+from flexflow_tpu.parallel.pconfig import ParallelConfig
+from flexflow_tpu.runtime.initializer import init_weight
+from flexflow_tpu.runtime.loss import compute_loss
+from flexflow_tpu.runtime.metrics import batch_metrics
+
+
+def resolve_axis_map(pc: ParallelConfig, mesh_shape: Dict[str, int],
+                     ndims: int) -> Dict[str, Optional[int]]:
+    """Fill in pc.axis_map from degrees when a strategy came from a file
+    (degrees only). Greedy: each partitioned dim takes unused mesh axes whose
+    sizes multiply to its degree; sample dim prefers 'data'."""
+    if pc.axis_map is not None:
+        return pc.axis_map
+    remaining = dict(mesh_shape)
+    axis_map: Dict[str, Optional[int]] = {}
+    order = sorted(range(min(ndims, len(pc.dims))),
+                   key=lambda d: (d != 0,))  # sample dim first
+    for d in order:
+        deg = pc.dims[d]
+        if deg == 1:
+            continue
+        # prefer canonical axis for the dim role
+        prefs = (["data"] if d == 0 else []) + list(remaining.keys())
+        # simple search: single axis exact match, then pairs
+        single = [ax for ax in prefs if remaining.get(ax) == deg]
+        if single:
+            axis_map[single[0]] = d
+            del remaining[single[0]]
+            continue
+        found = False
+        axes = list(remaining.keys())
+        for i in range(len(axes)):
+            for j in range(len(axes)):
+                if i != j and remaining[axes[i]] * remaining[axes[j]] == deg:
+                    axis_map[axes[i]] = d
+                    axis_map[axes[j]] = d
+                    del remaining[axes[i]], remaining[axes[j]]
+                    found = True
+                    break
+            if found:
+                break
+        if not found:
+            raise ValueError(
+                f"strategy degree {deg} on dim {d} not expressible on mesh "
+                f"{mesh_shape} (remaining {remaining})")
+    return axis_map
+
+
+class GraphExecutor:
+    def __init__(self, model):
+        self.model = model
+        self.mesh: Mesh = model.mesh
+        self.mesh_shape = mesh_shape_dict(self.mesh)
+        self._op_axis_maps: Dict[str, Dict[str, Optional[int]]] = {}
+        self._resolve_strategies()
+
+    # ---- strategy resolution ------------------------------------------------
+
+    def _resolve_strategies(self):
+        strategies = self.model.config.strategies
+        for op in self.model.ops:
+            if isinstance(op, InputOp):
+                continue
+            pc = strategies.get(op.name)
+            nd = op.outputs[0].num_dims
+            if pc is None:
+                pc = ParallelConfig.data_parallel(
+                    nd, self.mesh_shape.get("data", 1))
+                if "data" not in self.mesh_shape:
+                    pc = ParallelConfig.replicated(nd)
+            am = resolve_axis_map(pc, self.mesh_shape, nd)
+            self._op_axis_maps[op.name] = am
+
+    def op_output_sharding(self, op: Op) -> NamedSharding:
+        am = self._op_axis_maps.get(op.name, {})
+        pspec = ParallelConfig(axis_map=am).to_partition_spec(
+            op.outputs[0].num_dims, list(self.mesh.axis_names))
+        return NamedSharding(self.mesh, pspec)
+
+    def input_sharding(self, tensor) -> NamedSharding:
+        # batch-shard graph inputs on 'data' if present
+        entries = [None] * tensor.num_dims
+        if "data" in self.mesh_shape and self.mesh_shape["data"] > 1:
+            entries[0] = "data"
+        return NamedSharding(self.mesh, P(*entries))
+
+    def param_shardings(self) -> Dict[str, Dict[str, NamedSharding]]:
+        out: Dict[str, Dict[str, NamedSharding]] = {}
+        for op in self.model.ops:
+            specs = op.weight_specs()
+            if not specs:
+                continue
+            am = self._op_axis_maps.get(op.name, {})
+            wp = op.weight_partition(am)
+            out[op.name] = {name: NamedSharding(self.mesh, ps)
+                            for name, ps in wp.items()}
+        return out
+
+    # ---- parameter / state initialization -----------------------------------
+
+    def init_params(self, rng_key) -> Dict[str, Dict[str, jnp.ndarray]]:
+        """Sharded param init: each weight's init runs jitted with its target
+        sharding as out_sharding, so a vocab-sharded embedding table never
+        materializes replicated."""
+        shardings = self.param_shardings()
+        params: Dict[str, Dict[str, jnp.ndarray]] = {}
+        for op in self.model.ops:
+            specs = op.weight_specs()
+            if not specs:
+                continue
+            op_params = {}
+            for i, spec in enumerate(specs):
+                key = jax.random.fold_in(
+                    jax.random.fold_in(rng_key, _stable_hash(op.name)), i)
+                sharding = shardings[op.name].get(spec.name)
+                init_fn = functools.partial(init_weight, spec)
+                dtype = dtype_to_np(spec.dtype)
+                op_params[spec.name] = jax.jit(
+                    lambda k, f=init_fn, d=dtype: f(k, dtype=d),
+                    out_shardings=sharding)(key)
+            params[op.name] = op_params
+        return params
+
+    def init_state(self) -> Dict[str, Dict[str, jnp.ndarray]]:
+        state = {}
+        for op in self.model.ops:
+            if op.stateful:
+                s = op.init_state()
+                state[op.name] = {k: jnp.asarray(v) for k, v in s.items()}
+        return state
+
+    # ---- forward interpretation ---------------------------------------------
+
+    def apply_graph(self, params, state, input_values: Dict[Any, jnp.ndarray],
+                    *, training: bool, rng) -> Tuple[Dict[Any, jnp.ndarray], Dict]:
+        """Interpret the graph in topo order. Returns (tensor->value map,
+        new_state)."""
+        vals: Dict[Any, jnp.ndarray] = dict(input_values)
+        new_state: Dict[str, Dict] = {}
+        # mixed precision: master params stay f32; compute runs in bf16 on the
+        # MXU when config.compute_dtype == "bfloat16" (autodiff through the
+        # casts yields f32 grads)
+        bf16 = self.model.config.compute_dtype == "bfloat16"
+
+        def to_compute(a):
+            return a.astype(jnp.bfloat16) if (bf16 and a.dtype == jnp.float32) else a
+
+        vals = {k: to_compute(v) for k, v in vals.items()}
+        for idx, op in enumerate(self.model.ops):
+            if isinstance(op, InputOp):
+                t = op.outputs[0]
+                if t not in vals:
+                    raise ValueError(f"missing input value for {op.name}")
+                continue
+            xs = [vals[t] for t in op.inputs]
+            op_rng = None
+            if op.needs_rng and rng is not None:
+                op_rng = jax.random.fold_in(rng, idx)
+                seed = getattr(op, "seed", 0)
+                if seed:
+                    op_rng = jax.random.fold_in(op_rng, seed)
+            p = params.get(op.name, {})
+            if bf16:
+                p = {k: to_compute(v) for k, v in p.items()}
+            if op.stateful:
+                outs, ns = op.forward_stateful(p, state.get(op.name, {}), xs,
+                                               training=training, rng=op_rng)
+                new_state[op.name] = ns
+            else:
+                outs = op.forward(p, xs, training=training, rng=op_rng)
+            sharding = self.op_output_sharding(op)
+            for i, t in enumerate(op.outputs):
+                v = outs[i]
+                if v.ndim == t.num_dims:
+                    v = jax.lax.with_sharding_constraint(v, sharding) \
+                        if _spec_rank_ok(sharding.spec, v.ndim) else v
+                vals[t] = v
+        for k, v in state.items():
+            if k not in new_state:
+                new_state[k] = v
+        return vals, new_state
+
+    # ---- compiled steps -----------------------------------------------------
+
+    def make_train_step(self, optimizer, loss_type: LossType,
+                        metric_types: List[MetricsType], final_tensor,
+                        label_key="label"):
+        input_ops = [op for op in self.model.ops if isinstance(op, InputOp)]
+
+        def step(params, opt_state, state, batch, rng):
+            def loss_fn(p):
+                input_values = {op.outputs[0]: batch[op.name] for op in input_ops}
+                vals, new_state = self.apply_graph(
+                    p, state, input_values, training=True, rng=rng)
+                logits = vals[final_tensor]
+                loss = compute_loss(loss_type, logits, batch[label_key])
+                mets = batch_metrics(loss_type, metric_types, logits,
+                                     batch[label_key])
+                return loss, (new_state, mets)
+
+            (loss, (new_state, mets)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opt_state = optimizer.update(params, grads, opt_state)
+            return new_params, new_opt_state, new_state, loss, mets
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def make_eval_step(self, loss_type: LossType,
+                       metric_types: List[MetricsType], final_tensor,
+                       label_key="label"):
+        input_ops = [op for op in self.model.ops if isinstance(op, InputOp)]
+
+        def step(params, state, batch):
+            input_values = {op.outputs[0]: batch[op.name] for op in input_ops}
+            vals, _ = self.apply_graph(params, state, input_values,
+                                       training=False, rng=None)
+            logits = vals[final_tensor]
+            loss = compute_loss(loss_type, logits, batch[label_key])
+            mets = batch_metrics(loss_type, metric_types, logits, batch[label_key])
+            return loss, mets, logits
+
+        return jax.jit(step)
+
+    def make_forward(self, final_tensors=None, training: bool = False):
+        """Plain forward fn over graph inputs (used by __graft_entry__ and
+        inference)."""
+        input_ops = [op for op in self.model.ops if isinstance(op, InputOp)]
+        finals = final_tensors or [self.model.ops[-1].outputs[0]]
+
+        def fwd(params, state, batch, rng=None):
+            input_values = {op.outputs[0]: batch[op.name] for op in input_ops}
+            vals, _ = self.apply_graph(params, state, input_values,
+                                       training=training, rng=rng)
+            return [vals[t] for t in finals]
+
+        return fwd
+
+    def shard_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jnp.ndarray]:
+        out = {}
+        input_by_name = {op.name: op.outputs[0]
+                         for op in self.model.ops if isinstance(op, InputOp)}
+        for k, v in batch.items():
+            if k in input_by_name:
+                sh = self.input_sharding(input_by_name[k])
+            else:
+                nd = v.ndim
+                entries = [None] * nd
+                if "data" in self.mesh_shape and self.mesh_shape["data"] > 1:
+                    entries[0] = "data"
+                sh = NamedSharding(self.mesh, P(*entries))
+            out[k] = jax.device_put(v, sh)
+        return out
+
+
+def _spec_rank_ok(spec, ndim) -> bool:
+    return len(spec) <= ndim
+
+
+def _stable_hash(s: str) -> int:
+    h = 0
+    for ch in s:
+        h = (h * 31 + ord(ch)) % (2 ** 31)
+    return h

@@ -1,0 +1,95 @@
+"""Weight initializers.
+
+Reference: include/initializer.h:28-101 + src/runtime/initializer_kernel.cu
+(curand Glorot-uniform, zero, uniform, normal, constant — each a Legion task
+over the weight partition). Here each is a pure function of a PRNG key; under
+GSPMD the init computation itself is sharded like the weight, so large
+embedding tables initialize without ever materializing unsharded.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.ops.base import WeightSpec
+
+
+class Initializer:
+    def __call__(self, key, shape, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class GlorotUniformInitializer(Initializer):
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+
+    def __call__(self, key, shape, dtype=jnp.float32,
+                 fan: Optional[Tuple[int, int]] = None):
+        if fan is None:
+            fan_in = shape[0] if len(shape) > 1 else shape[0]
+            fan_out = shape[-1] if len(shape) > 1 else shape[0]
+        else:
+            fan_in, fan_out = fan
+        limit = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+class ZeroInitializer(Initializer):
+    def __call__(self, key, shape, dtype=jnp.float32, **kw):
+        return jnp.zeros(shape, dtype)
+
+
+class OneInitializer(Initializer):
+    def __call__(self, key, shape, dtype=jnp.float32, **kw):
+        return jnp.ones(shape, dtype)
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, seed: int = 0, low: float = -0.05, high: float = 0.05):
+        self.low, self.high = low, high
+
+    def __call__(self, key, shape, dtype=jnp.float32, **kw):
+        return jax.random.uniform(key, shape, dtype, self.low, self.high)
+
+
+class NormInitializer(Initializer):
+    def __init__(self, seed: int = 0, mean: float = 0.0, stddev: float = 1.0):
+        self.mean, self.stddev = mean, stddev
+
+    def __call__(self, key, shape, dtype=jnp.float32, **kw):
+        return self.mean + self.stddev * jax.random.normal(key, shape, dtype)
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value: float):
+        self.value = value
+
+    def __call__(self, key, shape, dtype=jnp.float32, **kw):
+        return jnp.full(shape, self.value, dtype)
+
+
+def init_weight(spec: WeightSpec, key, dtype=jnp.float32):
+    """Initialize one weight from its spec (used when no user initializer is
+    attached — reference attaches GlorotUniform/Zero defaults in create_weights,
+    e.g. linear.cu:74-122)."""
+    kind = spec.init
+    if kind == "glorot":
+        return GlorotUniformInitializer()(key, spec.shape, dtype, fan=spec.fan)
+    if kind == "zero":
+        return jnp.zeros(spec.shape, dtype)
+    if kind == "one":
+        return jnp.ones(spec.shape, dtype)
+    if kind == "uniform":
+        low, high = spec.init_args if spec.init_args else (-0.05, 0.05)
+        return jax.random.uniform(key, spec.shape, dtype, low, high)
+    if kind == "normal":
+        mean, std = spec.init_args if spec.init_args else (0.0, 1.0)
+        return mean + std * jax.random.normal(key, spec.shape, dtype)
+    if kind == "constant":
+        (v,) = spec.init_args
+        return jnp.full(spec.shape, v, dtype)
+    raise ValueError(f"unknown init kind {kind}")

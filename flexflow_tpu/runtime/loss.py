@@ -1,0 +1,56 @@
+"""Loss functions.
+
+Reference: src/loss_functions/loss_functions.cu — sparse/dense categorical
+cross-entropy and MSE *backward* kernels with scale = 1/global-batch
+(include/loss_functions.h:47-49). Here losses are forward scalars and autodiff
+produces the gradient; the 1/B scaling comes from the mean reduction, which
+matches the reference's scale factor exactly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import LossType
+
+
+def compute_loss(loss_type: LossType, logits, labels):
+    """Scalar training loss. `labels`: int class ids for sparse CE (reference
+    sparse_categorical_crossentropy_loss_backward), one-hot/dense probs for
+    dense CE, targets for MSE."""
+    if logits.dtype == jnp.bfloat16:
+        logits = logits.astype(jnp.float32)  # softmax/MSE numerics in f32
+    if loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY:
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        lab = labels.astype(jnp.int32)
+        if lab.ndim == logits.ndim:  # trailing singleton label dim
+            lab = lab[..., 0]
+        nll = -jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+    if loss_type == LossType.LOSS_CATEGORICAL_CROSSENTROPY:
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.sum(labels * logp, axis=-1))
+    if loss_type == LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE:
+        return jnp.mean(jnp.square(logits - labels))
+    if loss_type == LossType.LOSS_MEAN_SQUARED_ERROR_SUM_REDUCE:
+        # reference sums over features, averages over batch
+        return jnp.mean(jnp.sum(jnp.square(logits - labels),
+                                axis=tuple(range(1, logits.ndim))))
+    if loss_type == LossType.LOSS_IDENTITY:
+        return jnp.mean(logits)
+    raise ValueError(f"unknown loss {loss_type}")
+
+
+_KERAS_LOSS_NAMES = {
+    "categorical_crossentropy": LossType.LOSS_CATEGORICAL_CROSSENTROPY,
+    "sparse_categorical_crossentropy": LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+    "mean_squared_error": LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+    "mse": LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+}
+
+
+def loss_type_from_name(name) -> LossType:
+    if isinstance(name, LossType):
+        return name
+    return _KERAS_LOSS_NAMES[name]

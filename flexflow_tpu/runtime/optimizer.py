@@ -1,0 +1,105 @@
+"""Optimizers: SGD (momentum/nesterov/weight-decay) and Adam.
+
+Reference: src/runtime/optimizer.cc:93-358 + optimizer_kernel.cu. The
+reference maintains two sync backends per optimizer (parameter-server gather
+and NCCL allreduce); on TPU gradients arrive already summed by the psum that
+sharded autodiff inserts, so the update is a pure elementwise pytree map —
+both backends collapse into one. Update formulas match the reference kernels:
+
+  SGD  (optimizer_kernel.cu:23-95): g += wd*w; v = mom*v + g;
+       g = nesterov ? g + mom*v : v; w -= lr*g
+  Adam (optimizer_kernel.cu:188-293): m,v EMA; alpha_t = alpha *
+       sqrt(1-beta2^t)/(1-beta1^t)  (optimizer.cc:248-254 next())
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+class Optimizer:
+    def init_state(self, params) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def update(self, params, grads, state):
+        """Returns (new_params, new_state). Pure; called inside jit."""
+        raise NotImplementedError
+
+
+class SGDOptimizer(Optimizer):
+    def __init__(self, model=None, lr: float = 0.01, momentum: float = 0.0,
+                 nesterov: bool = False, weight_decay: float = 0.0):
+        self.lr = lr
+        self.momentum = momentum
+        self.nesterov = nesterov
+        self.weight_decay = weight_decay
+
+    def init_state(self, params):
+        if self.momentum > 0.0:
+            v = jax.tree_util.tree_map(jnp.zeros_like, params)
+        else:
+            v = None
+        return {"v": v, "t": jnp.zeros((), jnp.int32)}
+
+    def update(self, params, grads, state):
+        lr, mom, wd = self.lr, self.momentum, self.weight_decay
+
+        if mom > 0.0:
+            def upd(w, g, v):
+                g = g + wd * w
+                v = mom * v + g
+                step = g + mom * v if self.nesterov else v
+                return w - lr * step, v
+
+            flat = jax.tree_util.tree_map(upd, params, grads, state["v"])
+            new_params = jax.tree_util.tree_map(lambda t: t[0], flat,
+                                                is_leaf=lambda t: isinstance(t, tuple))
+            new_v = jax.tree_util.tree_map(lambda t: t[1], flat,
+                                           is_leaf=lambda t: isinstance(t, tuple))
+            return new_params, {"v": new_v, "t": state["t"] + 1}
+
+        def upd_plain(w, g):
+            return w - lr * (g + wd * w)
+
+        new_params = jax.tree_util.tree_map(upd_plain, params, grads)
+        return new_params, {"v": None, "t": state["t"] + 1}
+
+
+class AdamOptimizer(Optimizer):
+    def __init__(self, model=None, alpha: float = 0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, weight_decay: float = 0.0,
+                 epsilon: float = 1e-8):
+        self.alpha = alpha
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.weight_decay = weight_decay
+        self.epsilon = epsilon
+
+    def init_state(self, params):
+        zeros = lambda p: jax.tree_util.tree_map(jnp.zeros_like, p)
+        return {"m": zeros(params), "v": zeros(params),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def update(self, params, grads, state):
+        b1, b2, wd, eps = self.beta1, self.beta2, self.weight_decay, self.epsilon
+        t = state["t"] + 1
+        # bias-corrected step size, as the reference's AdamOptimizer::next()
+        alpha_t = self.alpha * jnp.sqrt(1.0 - jnp.power(b2, t)) \
+            / (1.0 - jnp.power(b1, t))
+
+        def upd(w, g, m, v):
+            g = g + wd * w
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            w = w - alpha_t * m / (jnp.sqrt(v) + eps)
+            return w, m, v
+
+        flat = jax.tree_util.tree_map(upd, params, grads, state["m"], state["v"])
+        is_triple = lambda t_: isinstance(t_, tuple)
+        new_params = jax.tree_util.tree_map(lambda x: x[0], flat, is_leaf=is_triple)
+        new_m = jax.tree_util.tree_map(lambda x: x[1], flat, is_leaf=is_triple)
+        new_v = jax.tree_util.tree_map(lambda x: x[2], flat, is_leaf=is_triple)
+        return new_params, {"m": new_m, "v": new_v, "t": t}

@@ -1,0 +1,17 @@
+"""Auto-parallelization search engine.
+
+Reference: the MCMC simulated-annealing search over per-op ParallelConfigs
+(FFModel::optimize model.cc:1663-1725, strategy_search_task simulator.cu:106)
+driven by an event-driven task-graph simulator (simulator.cc:325-621) whose
+op costs are measured on hardware and whose comm costs come from an analytic
+machine model.
+
+TPU rebuild: the proposal space is mesh-expressible axis maps (GSPMD
+constraint); the machine model is ICI/HBM/MXU; op costs come from analytic
+FLOPs/bytes with optional real-device measurement
+(jit(...).lower().compile() + timed run, cached). The hot simulate+anneal
+loop lives in C++ (flexflow_tpu/search/csrc, loaded via ctypes) with a pure-
+Python fallback.
+"""
+
+from flexflow_tpu.search.driver import optimize_strategies  # noqa: F401

@@ -1,0 +1,143 @@
+"""MCMC strategy search driver.
+
+Reference: FFModel::optimize (model.cc:1663-1725) — simulated annealing over
+per-op ParallelConfigs: start from data-parallel (or imported), propose =
+re-randomize one op's config (rewrite, model.cc:1652-1661), accept if better
+else with prob exp(-alpha * diff), periodic reset-to-best every budget/100
+iterations (capped 1000).
+
+TPU version: proposals are mesh-expressible axis maps (each mesh axis is
+assigned to one of the op's partitionable output dims or left replicated,
+subject to divisibility) — the GSPMD-constrained SOAP space. The objective is
+CostModel.iteration_time; when the C++ simulator library is built it replaces
+the Python loop wholesale (flexflow_tpu/search/csim.py).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, Optional
+
+from flexflow_tpu.ops.base import InputOp
+from flexflow_tpu.parallel.pconfig import ParallelConfig
+from flexflow_tpu.search.cost_model import AxisMap, CostModel
+from flexflow_tpu.search.machine import MachineModel
+
+
+def legal_axis_maps(op, mesh_shape: Dict[str, int],
+                    enable_parameter_parallel: bool = True,
+                    enable_attribute_parallel: bool = True):
+    """All axis maps for one op: each mesh axis -> None or a partitionable
+    output dim whose size divides evenly.
+
+    The two enable flags gate the proposal distribution the way the reference
+    gates it (--enable-parameter-parallel, model.cc:2023 and linear.cu:1082;
+    --enable-attribute-parallel for conv spatial dims, model.cc:2027 — minus
+    the upstream bug where the latter sets the former)."""
+    from flexflow_tpu.ffconst import OperatorType
+
+    dims = list(op.partitionable_output_dims())
+    out_shape = op.outputs[0].dims
+    nd = len(out_shape)
+    if not enable_parameter_parallel:
+        weighted = {OperatorType.OP_LINEAR, OperatorType.OP_EMBEDDING,
+                    OperatorType.OP_CONV2D, OperatorType.OP_MULTIHEAD_ATTENTION}
+        if op.op_type in weighted:
+            param_dim = 1 if op.op_type == OperatorType.OP_CONV2D else nd - 1
+            dims = [d for d in dims if d != param_dim]
+    if not enable_attribute_parallel and op.op_type in (
+            OperatorType.OP_CONV2D, OperatorType.OP_POOL2D):
+        dims = [d for d in dims if d not in (2, 3)]
+    axes = [a for a in mesh_shape if mesh_shape[a] > 1]
+    maps = [{}]
+    for ax in axes:
+        new_maps = []
+        size = mesh_shape[ax]
+        for m in maps:
+            new_maps.append({**m, ax: None})
+            for d in dims:
+                deg = size
+                for a2, d2 in m.items():
+                    if d2 == d:
+                        deg *= mesh_shape[a2]
+                if d < len(out_shape) and out_shape[d] % deg == 0:
+                    new_maps.append({**m, ax: d})
+        maps = new_maps
+    return maps
+
+
+
+
+def data_parallel_strategy(model, mesh_shape: Dict[str, int]) -> Dict[str, AxisMap]:
+    out = {}
+    for op in model.ops:
+        if isinstance(op, InputOp):
+            continue
+        am: AxisMap = {}
+        if mesh_shape.get("data", 1) > 1 and op.outputs[0].num_dims > 0 \
+                and op.outputs[0].dims[0] % mesh_shape["data"] == 0:
+            am["data"] = 0
+        out[op.name] = am
+    return out
+
+
+def optimize_strategies(model, budget: int = 1000, alpha: float = 0.05,
+                        mesh_shape: Optional[Dict[str, int]] = None,
+                        machine: Optional[MachineModel] = None,
+                        measured: Optional[Dict] = None,
+                        seed: int = 0, verbose: bool = False,
+                        use_native: bool = True) -> Dict[str, ParallelConfig]:
+    """Run the search; returns {op_name: ParallelConfig} for the best found."""
+    mesh_shape = mesh_shape or model.config.mesh_shape
+    cost = CostModel(model, mesh_shape, machine=machine, measured=measured)
+
+    if use_native:
+        try:
+            from flexflow_tpu.search.csim import native_optimize
+
+            return native_optimize(model, cost, mesh_shape, budget, alpha, seed,
+                                   verbose=verbose)
+        except (ImportError, OSError):
+            pass  # fall through to the Python annealer
+
+    rng = random.Random(seed)
+    ops = [op for op in model.ops if not isinstance(op, InputOp)]
+    cfgflags = getattr(model, "config", None)
+    epp = getattr(cfgflags, "enable_parameter_parallel", True)
+    eap = getattr(cfgflags, "enable_attribute_parallel", True)
+    # proposal distributions, precomputed once per op
+    op_maps = {op.name: legal_axis_maps(op, mesh_shape, epp, eap) for op in ops}
+
+    current = data_parallel_strategy(model, mesh_shape)
+    current_cost = cost.iteration_time(current)
+    best, best_cost = dict(current), current_cost
+    reset_span = min(max(budget // 100, 1), 1000)  # reference model.cc:1673-1677
+
+    for it in range(budget):
+        if it % reset_span == 0 and it > 0:
+            current, current_cost = dict(best), best_cost
+        op = rng.choice(ops)
+        proposal = dict(current)
+        proposal[op.name] = rng.choice(op_maps[op.name])
+        new_cost = cost.iteration_time(proposal)
+        diff = new_cost - current_cost
+        if diff < 0 or rng.random() < math.exp(-alpha * diff * 1e3):
+            current, current_cost = proposal, new_cost
+            if new_cost < best_cost:
+                best, best_cost = dict(proposal), new_cost
+        if verbose and it % max(budget // 10, 1) == 0:
+            print(f"[search] iter {it}: current {current_cost * 1e3:.3f} ms, "
+                  f"best {best_cost * 1e3:.3f} ms")
+
+    if verbose:
+        dp_cost = cost.iteration_time(data_parallel_strategy(model, mesh_shape))
+        print(f"[search] done: best {best_cost * 1e3:.3f} ms vs DP "
+              f"{dp_cost * 1e3:.3f} ms ({dp_cost / max(best_cost, 1e-12):.2f}x)")
+
+    out = {}
+    for op in ops:
+        am = best.get(op.name, {})
+        out[op.name] = ParallelConfig.from_axis_map(
+            op.outputs[0].num_dims, mesh_shape, am)
+    return out

@@ -1,0 +1,57 @@
+"""TPU machine model for the simulator.
+
+Replaces the reference's hardcoded GPU constants (simulator.cu:43-45:
+inter-GPU 20 MB/ms, inter-node 12/numNodes, GPU<->DRAM 16) with TPU-class
+numbers. Defaults are v5e-ish; override per target. Collective costs use ring
+formulas over the mesh axis being reduced (scaling-book recipe) instead of
+the reference's flat volume/bw (simulator.cc:548-594).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class MachineModel:
+    # per-chip compute
+    peak_flops: float = 197e12  # bf16 MXU FLOP/s (v5e ~197 TFLOPs)
+    peak_flops_f32: float = 49e12
+    hbm_bw: float = 819e9  # bytes/s
+    hbm_bytes: float = 16e9  # capacity per chip
+    # interconnect
+    ici_bw: float = 4.5e10  # bytes/s per link per direction (v5e ~45 GB/s)
+    dcn_bw: float = 6.25e9  # bytes/s per host
+    ici_latency: float = 1e-6  # seconds per hop
+    mxu_efficiency: float = 0.5  # achievable fraction of peak on real shapes
+
+    def compute_time(self, flops: float, bytes_moved: float,
+                     dtype_bytes: int = 4) -> float:
+        """Roofline: max(FLOP time, HBM time)."""
+        f = self.peak_flops if dtype_bytes <= 2 else self.peak_flops_f32
+        return max(flops / (f * self.mxu_efficiency),
+                   bytes_moved / self.hbm_bw)
+
+    def all_reduce_time(self, bytes_per_chip: float, axis_size: int) -> float:
+        """Bidirectional ring all-reduce over one mesh axis."""
+        if axis_size <= 1:
+            return 0.0
+        ring = 2.0 * (axis_size - 1) / axis_size
+        return ring * bytes_per_chip / (2 * self.ici_bw) \
+            + axis_size * self.ici_latency
+
+    def all_gather_time(self, bytes_per_chip: float, axis_size: int) -> float:
+        if axis_size <= 1:
+            return 0.0
+        return (axis_size - 1) / axis_size * bytes_per_chip * axis_size \
+            / (2 * self.ici_bw) + axis_size * self.ici_latency
+
+    def all_to_all_time(self, bytes_per_chip: float, axis_size: int) -> float:
+        if axis_size <= 1:
+            return 0.0
+        # each chip sends (size-1)/size of its shard, split across both ring dirs
+        return bytes_per_chip * (axis_size - 1) / axis_size / (2 * self.ici_bw) \
+            + axis_size * self.ici_latency
+
+    def p2p_time(self, nbytes: float) -> float:
+        return nbytes / self.ici_bw + self.ici_latency

@@ -1,0 +1,79 @@
+"""Tensor handles for the op graph.
+
+A `Tensor` is a symbolic handle: static dims + dtype + owner op — the analog of
+the reference's region-backed Tensor (reference: include/tensor.h:27-80) with
+Legion regions replaced by jax.Arrays materialized at execution time under a
+`NamedSharding`. `Parameter` adds sync type, matching reference
+include/tensor.h Parameter.
+
+Dims are logical and ordered the same way as the reference API surface
+(e.g. conv tensors are NCHW in user-facing shape); layout for the MXU is XLA's
+job, not the graph's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple, TYPE_CHECKING
+
+import numpy as np
+
+from flexflow_tpu.ffconst import DataType, ParameterSyncType, dtype_to_np
+
+if TYPE_CHECKING:
+    from flexflow_tpu.ops.base import Op
+
+
+@dataclasses.dataclass
+class Tensor:
+    dims: Tuple[int, ...]
+    dtype: DataType
+    owner_op: Optional["Op"] = None
+    owner_idx: int = 0
+    name: str = ""
+
+    @property
+    def num_dims(self) -> int:
+        return len(self.dims)
+
+    @property
+    def batch_dim(self) -> int:
+        # Reference convention: dim 0 is the sample dim for activations.
+        return 0
+
+    def get_shape(self) -> Tuple[int, ...]:
+        return self.dims
+
+    def np_dtype(self):
+        return dtype_to_np(self.dtype)
+
+    def volume(self) -> int:
+        return int(np.prod(self.dims)) if self.dims else 1
+
+    def size_bytes(self) -> int:
+        return self.volume() * np.dtype(self.np_dtype()).itemsize
+
+    def __hash__(self):
+        return id(self)
+
+    def __eq__(self, other):
+        return self is other
+
+    def __repr__(self):
+        owner = self.owner_op.name if self.owner_op is not None else "input"
+        return f"Tensor(dims={self.dims}, dtype={self.dtype.name}, owner={owner})"
+
+
+@dataclasses.dataclass
+class Parameter(Tensor):
+    """A trainable weight. sync_type chooses the gradient plane; on TPU both
+    PS and NCCL collapse into psum emitted by sharded autodiff (reference kept
+    them distinct: src/runtime/optimizer.cc:93-358)."""
+
+    sync_type: ParameterSyncType = ParameterSyncType.NONE
+
+    def __hash__(self):
+        return id(self)
+
+    def __eq__(self, other):
+        return self is other

@@ -1,0 +1,22 @@
+"""Test configuration: run everything on an 8-device virtual CPU mesh so
+multi-chip sharding is exercised without TPU hardware (SURVEY.md §4:
+"JAX offers CPU simulation of meshes, so distributed tests can run
+single-host").
+
+Note: this environment preloads jax._src at interpreter startup (sitecustomize
+for the TPU tunnel), so JAX_PLATFORMS env vars set here are too late; we must
+go through jax.config before any backend is initialized.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,188 @@
+"""Op-level golden tests vs numpy/torch-cpu.
+
+Mirrors the reference's op unit-test tier (tests/ops/test_harness.py: dump
+inputs/outputs, compare with np.testing.assert_allclose at 1e-5), but runs
+in-process: build a one-op graph, execute, compare against a numpy or torch
+reference implementation.
+"""
+
+import numpy as np
+import jax
+import pytest
+
+from flexflow_tpu import (ActiMode, AggrMode, DataType, FFConfig, FFModel,
+                          PoolType)
+
+
+def run_single_op(build, feeds):
+    """build(ff) -> output tensor; feeds: {input_name: np.ndarray}."""
+    cfg = FFConfig(num_devices=1, mesh_shape={"data": 1})
+    ff = FFModel(cfg)
+    out = build(ff)
+    ff.compile(optimizer=None, final_tensor=out)
+    fwd = ff.executor.make_forward([out])
+    res = fwd(ff.params, ff.bn_state, feeds)
+    return np.asarray(res[0]), ff
+
+
+def test_dense_matches_numpy():
+    x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+
+    def build(ff):
+        t = ff.create_tensor([4, 16], name="x")
+        return ff.dense(t, 8, ActiMode.AC_MODE_RELU, name="fc")
+
+    y, ff = run_single_op(build, {"x": x})
+    k = ff.get_weights("fc", "kernel")
+    b = ff.get_weights("fc", "bias")
+    ref = np.maximum(x @ k + b, 0)
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_conv2d_matches_torch():
+    torch = pytest.importorskip("torch")
+    x = np.random.RandomState(1).randn(2, 3, 8, 8).astype(np.float32)
+
+    def build(ff):
+        t = ff.create_tensor([2, 3, 8, 8], name="x")
+        return ff.conv2d(t, 4, 3, 3, 1, 1, 1, 1, name="conv")
+
+    y, ff = run_single_op(build, {"x": x})
+    k = ff.get_weights("conv", "kernel")
+    b = ff.get_weights("conv", "bias")
+    with torch.no_grad():
+        ref = torch.nn.functional.conv2d(
+            torch.from_numpy(x), torch.from_numpy(k), torch.from_numpy(b),
+            stride=1, padding=1).numpy()
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_pool2d_max_matches_torch():
+    torch = pytest.importorskip("torch")
+    x = np.random.RandomState(2).randn(2, 3, 8, 8).astype(np.float32)
+
+    def build(ff):
+        t = ff.create_tensor([2, 3, 8, 8], name="x")
+        return ff.pool2d(t, 2, 2, 2, 2, 0, 0, PoolType.POOL_MAX)
+
+    y, _ = run_single_op(build, {"x": x})
+    with torch.no_grad():
+        ref = torch.nn.functional.max_pool2d(torch.from_numpy(x), 2).numpy()
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_embedding_bag_sum():
+    idx = np.random.RandomState(3).randint(0, 50, size=(4, 6)).astype(np.int32)
+
+    def build(ff):
+        t = ff.create_tensor([4, 6], dtype=DataType.DT_INT32, name="x")
+        return ff.embedding(t, 50, 8, AggrMode.AGGR_MODE_SUM, name="emb")
+
+    y, ff = run_single_op(build, {"x": idx})
+    table = ff.get_weights("emb", "kernel")
+    ref = table[idx].sum(axis=1)
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_multihead_attention_matches_torch():
+    torch = pytest.importorskip("torch")
+    rs = np.random.RandomState(4)
+    B, S, D, H = 2, 5, 16, 4
+    x = rs.randn(B, S, D).astype(np.float32)
+
+    def build(ff):
+        q = ff.create_tensor([B, S, D], name="q")
+        return ff.multihead_attention(q, q, q, D, H, bias=False, name="mha")
+
+    y, ff = run_single_op(build, {"q": x})
+    wq = ff.get_weights("mha", "wq").reshape(D, D)  # (D, H, Hd) -> (D, D)
+    wk = ff.get_weights("mha", "wk").reshape(D, D)
+    wv = ff.get_weights("mha", "wv").reshape(D, D)
+    wo = ff.get_weights("mha", "wo").reshape(D, D)  # (H, Hd, D) -> (D, D)
+    mha = torch.nn.MultiheadAttention(D, H, bias=False, batch_first=True)
+    with torch.no_grad():
+        mha.in_proj_weight.copy_(torch.from_numpy(
+            np.concatenate([wq.T, wk.T, wv.T], axis=0)))
+        mha.out_proj.weight.copy_(torch.from_numpy(wo.T))
+        ref, _ = mha(torch.from_numpy(x), torch.from_numpy(x),
+                     torch.from_numpy(x))
+    np.testing.assert_allclose(y, ref.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_layernorm_matches_torch():
+    torch = pytest.importorskip("torch")
+    x = np.random.RandomState(5).randn(3, 7, 12).astype(np.float32)
+
+    def build(ff):
+        t = ff.create_tensor([3, 7, 12], name="x")
+        return ff.layer_norm(t)
+
+    y, _ = run_single_op(build, {"x": x})
+    with torch.no_grad():
+        ref = torch.nn.functional.layer_norm(torch.from_numpy(x), (12,)).numpy()
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_softmax_concat_split_transpose_reverse():
+    x = np.random.RandomState(6).randn(4, 6).astype(np.float32)
+
+    def build(ff):
+        t = ff.create_tensor([4, 6], name="x")
+        a, b = ff.split(t, 2, axis=1)
+        c = ff.concat([a, b], axis=1)
+        r = ff.reverse(c, axis=1)
+        tr = ff.transpose(r, [1, 0])
+        tr2 = ff.transpose(tr, [1, 0])
+        return ff.softmax(tr2)
+
+    y, _ = run_single_op(build, {"x": x})
+    ref = np.exp(x[:, ::-1]) / np.exp(x[:, ::-1]).sum(-1, keepdims=True)
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_batch_matmul():
+    rs = np.random.RandomState(7)
+    a = rs.randn(3, 4, 5).astype(np.float32)
+    b = rs.randn(3, 5, 6).astype(np.float32)
+
+    def build(ff):
+        ta = ff.create_tensor([3, 4, 5], name="a")
+        tb = ff.create_tensor([3, 5, 6], name="b")
+        return ff.batch_matmul(ta, tb)
+
+    cfg = FFConfig(num_devices=1, mesh_shape={"data": 1})
+    ff = FFModel(cfg)
+    out = build(ff)
+    ff.compile(optimizer=None, final_tensor=out)
+    fwd = ff.executor.make_forward([out])
+    y = np.asarray(fwd(ff.params, ff.bn_state, {"a": a, "b": b})[0])
+    np.testing.assert_allclose(y, a @ b, rtol=1e-5, atol=1e-5)
+
+
+def test_topk():
+    x = np.random.RandomState(8).randn(4, 10).astype(np.float32)
+
+    def build(ff):
+        t = ff.create_tensor([4, 10], name="x")
+        vals, idxs = ff.topk(t, 3)
+        return vals
+
+    y, _ = run_single_op(build, {"x": x})
+    ref = -np.sort(-x, axis=1)[:, :3]
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_elementwise_chain():
+    x = np.random.RandomState(9).rand(4, 5).astype(np.float32) + 0.5
+
+    def build(ff):
+        t = ff.create_tensor([4, 5], name="x")
+        a = ff.exp(t)
+        b = ff.scalar_multiply(t, 2.0)
+        c = ff.add(a, b)
+        d = ff.multiply(c, t)
+        return ff.sigmoid(d)
+
+    y, _ = run_single_op(build, {"x": x})
+    ref = 1 / (1 + np.exp(-((np.exp(x) + 2 * x) * x)))
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)
