@@ -1,0 +1,65 @@
+"""Frontend interchange enums.
+
+Reference: python/flexflow/core/flexflow_type.py:49-95 — the OpType vocabulary
+of the .ff text IR emitted by the PyTorch-FX exporter and consumed by
+PyTorchModel/ONNXModelKeras. Values kept identical so .ff files produced by
+the reference exporter parse here and vice versa.
+"""
+
+from enum import Enum
+
+from flexflow_tpu.ffconst import ActiMode, DataType, PoolType  # noqa: F401
+
+
+class OpType(Enum):
+    CONV2D = 2011
+    EMBEDDING = 2012
+    POOL2D = 2013
+    LINEAR = 2014
+    SOFTMAX = 2015
+    CONCAT = 2016
+    FLAT = 2017
+    MSELOSS = 2020
+    BATCH_NORM = 2021
+    RELU = 2022
+    SIGMOID = 2023
+    TANH = 2024
+    ELU = 2025
+    DROPOUT = 2026
+    BATCH_MATMUL = 2027
+    SPLIT = 2028
+    RESHAPE = 2029
+    TRANSPOSE = 2030
+    REVERSE = 2031
+    EXP = 2040
+    ADD = 2041
+    SUBTRACT = 2042
+    MULTIPLY = 2043
+    DIVIDE = 2044
+    INPUT = 2050
+    OUTPUT = 2051
+    MULTIHEAD_ATTENTION = 2060
+    GETITEM = 2070
+    GELU = 2080
+    LAYER_NORM = 2081
+    MEAN = 2082
+    IDENTITY = 2083
+
+
+def enum_to_int(enum_cls, item) -> int:
+    return item.value
+
+
+def int_to_enum(enum_cls, value: int):
+    for item in enum_cls:
+        if item.value == value:
+            return item
+    raise ValueError(f"unknown {enum_cls.__name__} value {value}")
+
+
+def enum_to_str(enum_cls, item) -> str:
+    return item.name
+
+
+def str_to_enum(enum_cls, name: str):
+    return enum_cls[name]

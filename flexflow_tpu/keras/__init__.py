@@ -1,0 +1,15 @@
+"""Keras-clone frontend.
+
+Reference: python/flexflow/keras/ (~3.5k LoC) — a reimplementation of the
+Keras Sequential/functional API whose layers lower onto the native FFModel
+builder, with optimizers/losses/metrics/initializers/callbacks (including the
+VerifyMetrics accuracy-gate callbacks) and bundled datasets.
+"""
+
+from flexflow_tpu.keras import layers  # noqa: F401
+from flexflow_tpu.keras import models  # noqa: F401
+from flexflow_tpu.keras import optimizers  # noqa: F401
+from flexflow_tpu.keras import callbacks  # noqa: F401
+from flexflow_tpu.keras import datasets  # noqa: F401
+from flexflow_tpu.keras.layers import Input  # noqa: F401
+from flexflow_tpu.keras.models import Model, Sequential  # noqa: F401

@@ -1,0 +1,86 @@
+"""Keras callbacks, including the accuracy-gate callbacks the reference CI
+uses as regression tests.
+
+Reference: python/flexflow/keras/callbacks.py:64-90 (VerifyMetrics,
+EpochVerifyMetrics), examples/python/keras/accuracy.py:18-24 (ModelAccuracy
+targets)."""
+
+from __future__ import annotations
+
+import enum
+
+
+class ModelAccuracy(enum.Enum):
+    MNIST_MLP = 90
+    MNIST_CNN = 90
+    REUTERS_MLP = 90
+    CIFAR10_CNN = 90
+    CIFAR10_ALEXNET = 90
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self):
+        pass
+
+    def on_train_end(self):
+        pass
+
+    def on_epoch_begin(self, epoch):
+        pass
+
+    def on_epoch_end(self, epoch):
+        pass
+
+
+class VerifyMetrics(Callback):
+    """Assert at train end that accuracy reached the target."""
+
+    def __init__(self, accuracy: ModelAccuracy):
+        super().__init__()
+        self.target = accuracy.value
+
+    def on_train_end(self):
+        acc = 100.0 * self.model._perf.accuracy
+        assert acc >= self.target, \
+            f"accuracy {acc:.2f}% below target {self.target}%"
+        print(f"[VerifyMetrics] accuracy {acc:.2f}% >= {self.target}% OK")
+
+
+class EpochVerifyMetrics(Callback):
+    """Early-stop once the per-epoch accuracy reaches the target; assert at
+    the end that it ever did. Returning True from on_epoch_end stops fit()
+    (reference callbacks.py early_stop=True)."""
+
+    def __init__(self, accuracy: ModelAccuracy, early_stop: bool = True):
+        super().__init__()
+        self.target = accuracy.value
+        self.early_stop = early_stop
+        self.reached = False
+
+    def on_epoch_end(self, epoch):
+        acc = 100.0 * self.model._perf.accuracy
+        if acc >= self.target:
+            self.reached = True
+            return self.early_stop
+        return False
+
+    def on_train_end(self):
+        assert self.reached, \
+            f"accuracy never reached target {self.target}%"
+
+
+class PrintDebug(Callback):
+    def __init__(self, every: int = 1):
+        super().__init__()
+        self.every = every
+
+    def on_epoch_end(self, epoch):
+        if epoch % self.every == 0:
+            print(f"[PrintDebug] epoch {epoch}: "
+                  f"acc={100.0 * self.model._perf.accuracy:.2f}%")

@@ -1,0 +1,80 @@
+"""Bundled datasets (reference: python/flexflow/keras/datasets/{mnist,
+cifar10,reuters}.py, which download from network).
+
+This environment has zero egress, so loaders read the standard Keras cache
+(~/.keras/datasets/...) when present and otherwise fall back to DETERMINISTIC
+SYNTHETIC data with learnable class structure (cluster-per-class), so
+training/accuracy-gate tests remain meaningful offline. The fallback is
+announced on stderr."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+_KERAS_CACHE = os.path.expanduser("~/.keras/datasets")
+
+
+def _synthetic_images(n, shape, num_classes, seed):
+    rs = np.random.RandomState(seed)
+    y = rs.randint(0, num_classes, n).astype(np.int32)
+    proto = rs.rand(num_classes, *shape).astype(np.float32)
+    x = proto[y] * 160 + rs.rand(n, *shape).astype(np.float32) * 95
+    return x.astype(np.uint8), y
+
+
+class mnist:
+    @staticmethod
+    def load_data(path="mnist.npz"):
+        full = os.path.join(_KERAS_CACHE, path)
+        if os.path.exists(full):
+            with np.load(full, allow_pickle=True) as f:
+                return (f["x_train"], f["y_train"]), (f["x_test"], f["y_test"])
+        print("[flexflow_tpu.keras.datasets] mnist cache missing; using "
+              "deterministic synthetic data (offline environment)",
+              file=sys.stderr)
+        xtr, ytr = _synthetic_images(8192, (28, 28), 10, seed=0)
+        xte, yte = _synthetic_images(1024, (28, 28), 10, seed=1)
+        return (xtr, ytr), (xte, yte)
+
+
+class cifar10:
+    @staticmethod
+    def load_data():
+        full = os.path.join(_KERAS_CACHE, "cifar-10-batches-py")
+        if os.path.exists(full):
+            import pickle
+
+            xs, ys = [], []
+            for i in range(1, 6):
+                with open(os.path.join(full, f"data_batch_{i}"), "rb") as f:
+                    d = pickle.load(f, encoding="bytes")
+                xs.append(d[b"data"].reshape(-1, 3, 32, 32))
+                ys.append(np.asarray(d[b"labels"]))
+            with open(os.path.join(full, "test_batch"), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            return ((np.concatenate(xs), np.concatenate(ys)),
+                    (d[b"data"].reshape(-1, 3, 32, 32),
+                     np.asarray(d[b"labels"])))
+        print("[flexflow_tpu.keras.datasets] cifar10 cache missing; using "
+              "deterministic synthetic data (offline environment)",
+              file=sys.stderr)
+        xtr, ytr = _synthetic_images(8192, (3, 32, 32), 10, seed=2)
+        xte, yte = _synthetic_images(1024, (3, 32, 32), 10, seed=3)
+        return (xtr, ytr), (xte, yte)
+
+
+class reuters:
+    @staticmethod
+    def load_data(num_words=1000, maxlen=200):
+        print("[flexflow_tpu.keras.datasets] reuters: synthetic fallback",
+              file=sys.stderr)
+        rs = np.random.RandomState(4)
+        n, classes = 4096, 46
+        y = rs.randint(0, classes, n).astype(np.int32)
+        x = rs.randint(1, num_words, (n, maxlen)).astype(np.int32)
+        # make it learnable: class-dependent token bias
+        x[:, 0] = y % num_words
+        return (x[: n // 2], y[: n // 2]), (x[n // 2:], y[n // 2:])

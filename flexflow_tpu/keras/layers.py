@@ -1,0 +1,344 @@
+"""Keras layer classes lowering onto FFModel builder calls.
+
+Reference: python/flexflow/keras/layers/{core,convolutional,pool,merge,
+normalization}.py. Shapes are batch-less (batch prepended at compile from
+FFConfig.batch_size, as the reference does)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from flexflow_tpu.ffconst import ActiMode, AggrMode, PoolType
+
+_ACTIVATIONS = {
+    None: ActiMode.AC_MODE_NONE,
+    "linear": ActiMode.AC_MODE_NONE,
+    "relu": ActiMode.AC_MODE_RELU,
+    "sigmoid": ActiMode.AC_MODE_SIGMOID,
+    "tanh": ActiMode.AC_MODE_TANH,
+    "gelu": ActiMode.AC_MODE_GELU,
+}
+
+
+class KerasTensor:
+    def __init__(self, layer: Optional["Layer"], shape: Tuple[int, ...],
+                 inputs: Sequence["KerasTensor"] = ()):
+        self.layer = layer
+        self.shape = tuple(shape)  # WITHOUT batch dim
+        self.inputs = list(inputs)
+
+    def __repr__(self):
+        lname = self.layer.name if self.layer else "input"
+        return f"KerasTensor({lname}, shape={self.shape})"
+
+
+class Layer:
+    _counters = {}
+
+    def __init__(self, name: Optional[str] = None):
+        kind = type(self).__name__.lower()
+        if name is None:
+            n = Layer._counters.get(kind, 0)
+            Layer._counters[kind] = n + 1
+            name = f"{kind}_{n}" if n else kind
+        self.name = name
+
+    def __call__(self, x):
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        shape = self.compute_output_shape([t.shape for t in xs])
+        return KerasTensor(self, shape, xs)
+
+    def compute_output_shape(self, in_shapes: List[Tuple[int, ...]]):
+        raise NotImplementedError
+
+    def build(self, ff, fftensors: List):
+        """Lower onto the FFModel builder; returns the output fftensor."""
+        raise NotImplementedError
+
+
+class InputLayer(Layer):
+    def __init__(self, shape=None, dtype="float32", name=None):
+        super().__init__(name)
+        self.shape = tuple(shape)
+        self.dtype = dtype
+
+    def compute_output_shape(self, in_shapes):
+        return self.shape
+
+
+def Input(shape, dtype="float32", name=None) -> KerasTensor:
+    layer = InputLayer(shape, dtype, name)
+    return KerasTensor(layer, layer.shape, [])
+
+
+class Dense(Layer):
+    def __init__(self, units: int, activation=None, use_bias=True,
+                 kernel_initializer="glorot_uniform", bias_initializer="zeros",
+                 input_shape=None, name=None):
+        super().__init__(name)
+        self.units = units
+        self.activation = _ACTIVATIONS[activation]
+        self.use_bias = use_bias
+        self.input_shape = input_shape
+
+    def compute_output_shape(self, in_shapes):
+        return tuple(in_shapes[0][:-1]) + (self.units,)
+
+    def build(self, ff, xs):
+        return ff.dense(xs[0], self.units, self.activation, self.use_bias,
+                        name=self.name)
+
+
+class Conv2D(Layer):
+    """NCHW (channels_first), matching the reference Keras clone."""
+
+    def __init__(self, filters: int, kernel_size, strides=(1, 1),
+                 padding="valid", activation=None, use_bias=True,
+                 groups=1, input_shape=None, name=None):
+        super().__init__(name)
+        self.filters = filters
+        self.kernel = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.strides = (strides, strides) if isinstance(strides, int) \
+            else tuple(strides)
+        self.padding = padding
+        self.activation = _ACTIVATIONS[activation]
+        self.use_bias = use_bias
+        self.groups = groups
+        self.input_shape = input_shape
+
+    def _pads(self, in_shape):
+        if self.padding == "same":
+            return (self.kernel[0] // 2, self.kernel[1] // 2)
+        if self.padding == "valid":
+            return (0, 0)
+        p = self.padding
+        return (p, p) if isinstance(p, int) else tuple(p)
+
+    def compute_output_shape(self, in_shapes):
+        c, h, w = in_shapes[0]
+        ph, pw = self._pads(in_shapes[0])
+        oh = (h + 2 * ph - self.kernel[0]) // self.strides[0] + 1
+        ow = (w + 2 * pw - self.kernel[1]) // self.strides[1] + 1
+        return (self.filters, oh, ow)
+
+    def build(self, ff, xs):
+        ph, pw = self._pads(None)
+        return ff.conv2d(xs[0], self.filters, self.kernel[0], self.kernel[1],
+                         self.strides[0], self.strides[1], ph, pw,
+                         self.activation, self.groups, self.use_bias,
+                         name=self.name)
+
+
+class _Pool2D(Layer):
+    pool_type = PoolType.POOL_MAX
+
+    def __init__(self, pool_size=(2, 2), strides=None, padding="valid",
+                 name=None):
+        super().__init__(name)
+        self.pool = (pool_size, pool_size) if isinstance(pool_size, int) \
+            else tuple(pool_size)
+        strides = strides if strides is not None else self.pool
+        self.strides = (strides, strides) if isinstance(strides, int) \
+            else tuple(strides)
+        self.padding = padding
+
+    def _pads(self):
+        return (self.pool[0] // 2, self.pool[1] // 2) \
+            if self.padding == "same" else (0, 0)
+
+    def compute_output_shape(self, in_shapes):
+        c, h, w = in_shapes[0]
+        ph, pw = self._pads()
+        oh = (h + 2 * ph - self.pool[0]) // self.strides[0] + 1
+        ow = (w + 2 * pw - self.pool[1]) // self.strides[1] + 1
+        return (c, oh, ow)
+
+    def build(self, ff, xs):
+        ph, pw = self._pads()
+        return ff.pool2d(xs[0], self.pool[0], self.pool[1], self.strides[0],
+                         self.strides[1], ph, pw, self.pool_type,
+                         name=self.name)
+
+
+class MaxPooling2D(_Pool2D):
+    pool_type = PoolType.POOL_MAX
+
+
+class AveragePooling2D(_Pool2D):
+    pool_type = PoolType.POOL_AVG
+
+
+class Flatten(Layer):
+    def compute_output_shape(self, in_shapes):
+        return (int(np.prod(in_shapes[0])),)
+
+    def build(self, ff, xs):
+        return ff.flat(xs[0], name=self.name)
+
+
+class Activation(Layer):
+    def __init__(self, activation, name=None):
+        super().__init__(name)
+        self.kind = activation
+
+    def compute_output_shape(self, in_shapes):
+        return in_shapes[0]
+
+    def build(self, ff, xs):
+        if self.kind == "softmax":
+            return ff.softmax(xs[0], name=self.name)
+        fn = {"relu": ff.relu, "sigmoid": ff.sigmoid, "tanh": ff.tanh,
+              "elu": ff.elu, "gelu": ff.gelu}[self.kind]
+        return fn(xs[0], name=self.name)
+
+
+class Dropout(Layer):
+    def __init__(self, rate, seed=0, name=None):
+        super().__init__(name)
+        self.rate = rate
+        self.seed = seed
+
+    def compute_output_shape(self, in_shapes):
+        return in_shapes[0]
+
+    def build(self, ff, xs):
+        return ff.dropout(xs[0], self.rate, self.seed, name=self.name)
+
+
+class BatchNormalization(Layer):
+    def __init__(self, relu=False, name=None):
+        super().__init__(name)
+        self.relu = relu
+
+    def compute_output_shape(self, in_shapes):
+        return in_shapes[0]
+
+    def build(self, ff, xs):
+        return ff.batch_norm(xs[0], relu=self.relu, name=self.name)
+
+
+class LayerNormalization(Layer):
+    def __init__(self, epsilon=1e-5, name=None):
+        super().__init__(name)
+        self.eps = epsilon
+
+    def compute_output_shape(self, in_shapes):
+        return in_shapes[0]
+
+    def build(self, ff, xs):
+        return ff.layer_norm(xs[0], self.eps, name=self.name)
+
+
+class Embedding(Layer):
+    def __init__(self, input_dim, output_dim, name=None):
+        super().__init__(name)
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+
+    def compute_output_shape(self, in_shapes):
+        return tuple(in_shapes[0]) + (self.output_dim,)
+
+    def build(self, ff, xs):
+        return ff.embedding(xs[0], self.input_dim, self.output_dim,
+                            AggrMode.AGGR_MODE_NONE, name=self.name)
+
+
+class Concatenate(Layer):
+    def __init__(self, axis=1, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def compute_output_shape(self, in_shapes):
+        ax = self.axis - 1 if self.axis > 0 else len(in_shapes[0]) + self.axis
+        out = list(in_shapes[0])
+        out[ax] = sum(s[ax] for s in in_shapes)
+        return tuple(out)
+
+    def build(self, ff, xs):
+        return ff.concat(xs, self.axis, name=self.name)
+
+
+class _Merge(Layer):
+    op = "add"
+
+    def compute_output_shape(self, in_shapes):
+        return in_shapes[0]
+
+    def build(self, ff, xs):
+        return getattr(ff, self.op)(xs[0], xs[1], name=self.name)
+
+
+class Add(_Merge):
+    op = "add"
+
+
+class Subtract(_Merge):
+    op = "subtract"
+
+
+class Multiply(_Merge):
+    op = "multiply"
+
+
+def add(tensors, name=None):
+    return Add(name=name)(tensors)
+
+
+def subtract(tensors, name=None):
+    return Subtract(name=name)(tensors)
+
+
+def multiply(tensors, name=None):
+    return Multiply(name=name)(tensors)
+
+
+def concatenate(tensors, axis=1, name=None):
+    return Concatenate(axis=axis, name=name)(tensors)
+
+
+class Reshape(Layer):
+    def __init__(self, target_shape, name=None):
+        super().__init__(name)
+        self.target_shape = tuple(target_shape)
+
+    def compute_output_shape(self, in_shapes):
+        return self.target_shape
+
+    def build(self, ff, xs):
+        batch = xs[0].dims[0]
+        return ff.reshape(xs[0], (batch,) + self.target_shape, name=self.name)
+
+
+class Permute(Layer):
+    def __init__(self, dims, name=None):
+        super().__init__(name)
+        self.dims = tuple(dims)  # 1-indexed over non-batch dims (Keras)
+
+    def compute_output_shape(self, in_shapes):
+        s = in_shapes[0]
+        return tuple(s[d - 1] for d in self.dims)
+
+    def build(self, ff, xs):
+        perm = (0,) + tuple(d for d in self.dims)
+        return ff.transpose(xs[0], perm, name=self.name)
+
+
+class MultiHeadAttention(Layer):
+    def __init__(self, num_heads, key_dim, name=None):
+        super().__init__(name)
+        self.num_heads = num_heads
+        self.key_dim = key_dim
+
+    def compute_output_shape(self, in_shapes):
+        return in_shapes[0]
+
+    def build(self, ff, xs):
+        q = xs[0]
+        k = xs[1] if len(xs) > 1 else q
+        v = xs[2] if len(xs) > 2 else k
+        embed = q.dims[-1]
+        return ff.multihead_attention(q, k, v, embed, self.num_heads,
+                                      name=self.name)

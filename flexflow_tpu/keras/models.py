@@ -1,0 +1,163 @@
+"""Keras Model / Sequential lowering onto FFModel.
+
+Reference: python/flexflow/keras/models/base_model.py (516 LoC — compile at
+:130 creating the native layers, fit at :196/:374-436 driving dataloaders +
+train loop with tracing + THROUGHPUT print), sequential.py, model.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.ffconst import DataType, LossType, MetricsType
+from flexflow_tpu.keras.layers import InputLayer, KerasTensor, Layer
+from flexflow_tpu.keras.optimizers import get_optimizer
+from flexflow_tpu.model import FFModel
+from flexflow_tpu.runtime.dataloader import SingleDataLoader
+from flexflow_tpu.runtime.loss import loss_type_from_name
+from flexflow_tpu.runtime.metrics import metrics_from_names
+
+
+class BaseModel:
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+        self.ffconfig = FFConfig.parse_args()
+        self.ffmodel: Optional[FFModel] = None
+        self._optimizer = None
+        self._loss = None
+        self._metrics = None
+        self._input_kts: List[KerasTensor] = []
+        self._output_kt: Optional[KerasTensor] = None
+        self._input_fftensors = []
+
+    # -- graph lowering -------------------------------------------------------
+
+    def _topo_layers(self) -> List[KerasTensor]:
+        seen, order = set(), []
+
+        def visit(kt: KerasTensor):
+            if id(kt) in seen:
+                return
+            seen.add(id(kt))
+            for i in kt.inputs:
+                visit(i)
+            order.append(kt)
+
+        visit(self._output_kt)
+        return order
+
+    def _lower(self):
+        cfg = self.ffconfig
+        ff = FFModel(cfg)
+        self.ffmodel = ff
+        kt_to_fft: Dict[int, object] = {}
+        for kt in self._topo_layers():
+            if isinstance(kt.layer, InputLayer):
+                dtype = (DataType.DT_INT32
+                         if str(kt.layer.dtype).startswith("int")
+                         else DataType.DT_FLOAT)
+                t = ff.create_tensor((cfg.batch_size,) + kt.shape,
+                                     dtype=dtype, name=kt.layer.name)
+                kt_to_fft[id(kt)] = t
+            else:
+                xs = [kt_to_fft[id(i)] for i in kt.inputs]
+                kt_to_fft[id(kt)] = kt.layer.build(ff, xs)
+        # bind in DECLARED inputs= order, not graph-traversal order — fit/
+        # evaluate/predict zip data arrays against this list positionally
+        self._input_fftensors = [kt_to_fft[id(kt)] for kt in self._input_kts]
+        self._final_fft = kt_to_fft[id(self._output_kt)]
+
+    # -- keras API ------------------------------------------------------------
+
+    def compile(self, optimizer, loss=None, metrics=None, **kwargs):
+        self._optimizer = get_optimizer(optimizer)
+        self._loss = loss_type_from_name(loss)
+        self._metrics = metrics_from_names(metrics or [])
+        self._lower()
+        self.ffmodel.compile(self._optimizer, self._loss, self._metrics,
+                             final_tensor=self._final_fft)
+
+    def fit(self, x, y, epochs: int = 1, batch_size: Optional[int] = None,
+            callbacks: Sequence = (), verbose: bool = True):
+        assert self.ffmodel is not None, "compile() first"
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        assert len(xs) == len(self._input_fftensors)
+        self.ffmodel._dataloaders = []
+        for t, arr in zip(self._input_fftensors, xs):
+            arr = np.asarray(arr)
+            SingleDataLoader(self.ffmodel, t, arr)
+        y = np.asarray(y)
+        if self._loss == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY \
+                and y.ndim == 1:
+            y = y.reshape(-1, 1)
+        SingleDataLoader(self.ffmodel, self.ffmodel.label_tensor, y)
+        return self.ffmodel.fit(epochs=epochs, batch_size=batch_size,
+                                callbacks=callbacks, verbose=verbose)
+
+    def evaluate(self, x, y, batch_size: Optional[int] = None):
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        b = batch_size or self.ffconfig.batch_size
+        y = np.asarray(y)
+        if self._loss == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY \
+                and y.ndim == 1:
+            y = y.reshape(-1, 1)
+        batch = {t.name.split(":")[0]: np.asarray(a)[:b]
+                 for t, a in zip(self._input_fftensors, xs)}
+        batch["label"] = y[:b]
+        loss, mets, _ = self.ffmodel.evaluate(batch)
+        return loss, mets
+
+    def predict(self, x, batch_size: Optional[int] = None):
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        batch = {t.name.split(":")[0]: np.asarray(a)
+                 for t, a in zip(self._input_fftensors, xs)}
+        return np.asarray(self.ffmodel.predict(batch))
+
+    def summary(self):
+        lines = [f'Model: "{self.name or type(self).__name__}"', "_" * 60]
+        for op in (self.ffmodel.ops if self.ffmodel else []):
+            shape = op.outputs[0].dims if op.outputs else ()
+            lines.append(f"{op.name:30s} {type(op).__name__:20s} {shape}")
+        return "\n".join(lines)
+
+    def get_weights(self, op_name, weight_name="kernel"):
+        return self.ffmodel.get_weights(op_name, weight_name)
+
+
+class Model(BaseModel):
+    def __init__(self, inputs=None, outputs=None, name=None, **kw):
+        super().__init__(name)
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self._input_kts = list(ins)
+        self._output_kt = outputs if not isinstance(outputs, (list, tuple)) \
+            else outputs[0]
+
+
+class Sequential(BaseModel):
+    def __init__(self, layers: Sequence[Layer] = (), name=None):
+        super().__init__(name)
+        self._layers: List[Layer] = []
+        self._kt = None
+        for l in layers:
+            self.add(l)
+
+    def add(self, layer: Layer):
+        from flexflow_tpu.keras.layers import Input
+
+        if self._kt is None:
+            shape = getattr(layer, "input_shape", None)
+            if isinstance(layer, InputLayer):
+                self._kt = Input(layer.shape, layer.dtype, layer.name)
+                self._input_kts = [self._kt]
+                self._output_kt = self._kt
+                return
+            assert shape is not None, \
+                "first layer needs input_shape= (or add an InputLayer)"
+            self._kt = Input(shape)
+            self._input_kts = [self._kt]
+        self._layers.append(layer)
+        self._kt = layer(self._kt)
+        self._output_kt = self._kt

@@ -1,0 +1,39 @@
+"""Keras optimizer facade (reference: python/flexflow/keras/optimizers.py)."""
+
+from __future__ import annotations
+
+from flexflow_tpu.runtime.optimizer import AdamOptimizer, SGDOptimizer
+
+
+class SGD:
+    def __init__(self, learning_rate=0.01, lr=None, momentum=0.0,
+                 nesterov=False, weight_decay=0.0):
+        self.inner = SGDOptimizer(lr=lr if lr is not None else learning_rate,
+                                  momentum=momentum, nesterov=nesterov,
+                                  weight_decay=weight_decay)
+
+
+class Adam:
+    def __init__(self, learning_rate=0.001, lr=None, beta_1=0.9, beta_2=0.999,
+                 epsilon=1e-8, weight_decay=0.0):
+        self.inner = AdamOptimizer(alpha=lr if lr is not None else learning_rate,
+                                   beta1=beta_1, beta2=beta_2, epsilon=epsilon,
+                                   weight_decay=weight_decay)
+
+
+def get_optimizer(opt):
+    if isinstance(opt, (SGD, Adam)):
+        return opt.inner
+    if isinstance(opt, (SGDOptimizer, AdamOptimizer)):
+        return opt
+    if isinstance(opt, str):
+        return {"sgd": SGDOptimizer(lr=0.01),
+                "adam": AdamOptimizer(alpha=0.001)}[opt.lower()]
+    if isinstance(opt, dict):  # reference accepts dicts from config
+        kind = opt.get("type", "sgd").lower()
+        if kind == "sgd":
+            return SGDOptimizer(lr=float(opt.get("lr", 0.01)),
+                                momentum=float(opt.get("momentum", 0.0)),
+                                nesterov=bool(opt.get("nesterov", False)))
+        return AdamOptimizer(alpha=float(opt.get("lr", 0.001)))
+    raise ValueError(f"unknown optimizer {opt!r}")

@@ -430,8 +430,10 @@ class FFModel:
             if verbose:
                 print(f"epoch {epoch}: loss={float(self._last_loss):.4f} "
                       + self._perf.report(self.loss_type, self.metric_types))
-            for cb in callbacks:
-                cb.on_epoch_end(epoch)
+            # a callback returning True from on_epoch_end stops training
+            # (reference keras/callbacks.py early_stop semantics)
+            if any(cb.on_epoch_end(epoch) for cb in callbacks):
+                break
         jax.block_until_ready(self.params)
         elapsed = time.time() - (warm or t0)
         if total and elapsed > 0 and verbose:

@@ -1,0 +1,1 @@
+from flexflow_tpu.onnx.model import ONNXModel, ONNXModelKeras  # noqa: F401

@@ -1,0 +1,221 @@
+"""ONNX importer: walk an onnx.ModelProto and replay nodes onto an FFModel.
+
+Reference: python/flexflow/onnx/model.py — `handleX` dispatch per node op_type,
+including the Gemm->dense fusion pass (model.py:297) and the Keras-flavored
+variant used by keras_exp (ONNXModelKeras).
+
+The `onnx` package is not bundled in this environment; import is deferred to
+construction so the rest of the framework works without it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from flexflow_tpu.ffconst import ActiMode, DataType, PoolType
+
+
+def _attrs(node) -> Dict[str, object]:
+    out = {}
+    for a in node.attribute:
+        if a.type == 1:
+            out[a.name] = a.f
+        elif a.type == 2:
+            out[a.name] = a.i
+        elif a.type == 7:
+            out[a.name] = list(a.ints)
+        elif a.type == 3:
+            out[a.name] = a.s.decode()
+        elif a.type == 4:
+            out[a.name] = a.t
+    return out
+
+
+class ONNXModel:
+    def __init__(self, filename):
+        try:
+            import onnx
+        except ImportError as e:
+            raise ImportError(
+                "the 'onnx' package is required for ONNXModel; install it or "
+                "use the PyTorch-FX / native frontends") from e
+        if isinstance(filename, str):
+            self.model = onnx.load(filename)
+        else:
+            self.model = filename  # already a ModelProto
+        self.symbol_table: Dict[str, object] = {}
+        self.inputs: Dict[str, object] = {}
+        for inp in self.model.graph.input:
+            self.inputs[inp.name] = inp
+        self.initializer = {t.name: t for t in self.model.graph.initializer}
+
+    # ---- handlers (reference model.py:74-360) -------------------------------
+
+    def handleAdd(self, ff, node):
+        return ff.add(self.symbol_table[node.input[0]],
+                      self.symbol_table[node.input[1]], name=node.name or None)
+
+    def handleSub(self, ff, node):
+        return ff.subtract(self.symbol_table[node.input[0]],
+                           self.symbol_table[node.input[1]], name=node.name or None)
+
+    def handleMul(self, ff, node):
+        return ff.multiply(self.symbol_table[node.input[0]],
+                           self.symbol_table[node.input[1]], name=node.name or None)
+
+    def handleConcat(self, ff, node):
+        a = _attrs(node)
+        ts = [self.symbol_table[i] for i in node.input]
+        return ff.concat(ts, int(a.get("axis", 1)), name=node.name or None)
+
+    def handleSplit(self, ff, node):
+        a = _attrs(node)
+        t = self.symbol_table[node.input[0]]
+        axis = int(a.get("axis", 0))
+        sizes = a.get("split")
+        outs = ff.split(t, [int(s) for s in sizes] if sizes
+                        else len(node.output), axis)
+        for name, out in zip(node.output, outs):
+            self.symbol_table[name] = out
+        return None  # outputs registered above
+
+    def _pool(self, ff, node, pool_type):
+        a = _attrs(node)
+        k = a.get("kernel_shape", [2, 2])
+        s = a.get("strides", [1, 1])
+        p = a.get("pads", [0, 0, 0, 0])
+        return ff.pool2d(self.symbol_table[node.input[0]], int(k[0]), int(k[1]),
+                         int(s[0]), int(s[1]), int(p[0]), int(p[1]),
+                         pool_type=pool_type, name=node.name or None)
+
+    def handleAveragePool(self, ff, node):
+        return self._pool(ff, node, PoolType.POOL_AVG)
+
+    def handleMaxPool(self, ff, node):
+        return self._pool(ff, node, PoolType.POOL_MAX)
+
+    def handleGlobalAveragePool(self, ff, node):
+        t = self.symbol_table[node.input[0]]
+        h, w = t.dims[2], t.dims[3]
+        return ff.pool2d(t, h, w, 1, 1, 0, 0, pool_type=PoolType.POOL_AVG,
+                         name=node.name or None)
+
+    def handleBatchNormalization(self, ff, node):
+        return ff.batch_norm(self.symbol_table[node.input[0]], relu=False,
+                             name=node.name or None)
+
+    def handleConv(self, ff, node):
+        a = _attrs(node)
+        t = self.symbol_table[node.input[0]]
+        w = self.initializer[node.input[1]]
+        out_channels = w.dims[0]
+        k = a.get("kernel_shape", [w.dims[2], w.dims[3]])
+        s = a.get("strides", [1, 1])
+        p = a.get("pads", [0, 0, 0, 0])
+        group = int(a.get("group", 1))
+        return ff.conv2d(t, int(out_channels), int(k[0]), int(k[1]),
+                         int(s[0]), int(s[1]), int(p[0]), int(p[1]),
+                         groups=group, use_bias=len(node.input) > 2,
+                         name=node.name or None)
+
+    def handleDropout(self, ff, node):
+        a = _attrs(node)
+        return ff.dropout(self.symbol_table[node.input[0]],
+                          float(a.get("ratio", 0.5)), name=node.name or None)
+
+    def handleFlatten(self, ff, node):
+        return ff.flat(self.symbol_table[node.input[0]], name=node.name or None)
+
+    def handleGemm(self, ff, node):
+        w = self.initializer[node.input[1]]
+        out_dim = w.dims[0]
+        return ff.dense(self.symbol_table[node.input[0]], int(out_dim),
+                        use_bias=len(node.input) > 2, name=node.name or None)
+
+    def handleMatMul(self, ff, node):
+        if node.input[1] in self.initializer:
+            w = self.initializer[node.input[1]]
+            return ff.dense(self.symbol_table[node.input[0]], int(w.dims[-1]),
+                            use_bias=False, name=node.name or None)
+        return ff.batch_matmul(self.symbol_table[node.input[0]],
+                               self.symbol_table[node.input[1]],
+                               name=node.name or None)
+
+    def handleRelu(self, ff, node):
+        return ff.relu(self.symbol_table[node.input[0]], name=node.name or None)
+
+    def handleSigmoid(self, ff, node):
+        return ff.sigmoid(self.symbol_table[node.input[0]], name=node.name or None)
+
+    def handleTanh(self, ff, node):
+        return ff.tanh(self.symbol_table[node.input[0]], name=node.name or None)
+
+    def handleElu(self, ff, node):
+        return ff.elu(self.symbol_table[node.input[0]], name=node.name or None)
+
+    def handleSoftmax(self, ff, node):
+        return ff.softmax(self.symbol_table[node.input[0]], name=node.name or None)
+
+    def handlePad(self, ff, node):
+        # reference: identity passthrough (model.py:223-228)
+        return self.symbol_table[node.input[0]]
+
+    def handleReshape(self, ff, node):
+        shape_t = self.initializer.get(node.input[1])
+        if shape_t is None:
+            return self.symbol_table[node.input[0]]
+        import onnx.numpy_helper as nph
+
+        shape = [int(v) for v in nph.to_array(shape_t)]
+        return ff.reshape(self.symbol_table[node.input[0]], shape,
+                          name=node.name or None)
+
+    def handleTranspose(self, ff, node):
+        a = _attrs(node)
+        perm = a.get("perm")
+        return ff.transpose(self.symbol_table[node.input[0]], perm,
+                            name=node.name or None)
+
+    def handleCast(self, ff, node):
+        return self.symbol_table[node.input[0]]
+
+    def handleUnsqueeze(self, ff, node):
+        t = self.symbol_table[node.input[0]]
+        a = _attrs(node)
+        axes = a.get("axes", [0])
+        shape = list(t.dims)
+        for ax in sorted(int(x) for x in axes):
+            shape.insert(ax, 1)
+        return ff.reshape(t, shape, name=node.name or None)
+
+    def handleIdentity(self, ff, node):
+        return self.symbol_table[node.input[0]]
+
+    # ---- driver -------------------------------------------------------------
+
+    def apply(self, ffmodel, input_dict: Dict[str, object]):
+        """input_dict: onnx graph input name -> FFModel tensor."""
+        self.symbol_table = dict(input_dict)
+        outputs = None
+        for node in self.model.graph.node:
+            handler = getattr(self, "handle" + node.op_type, None)
+            if handler is None:
+                raise AssertionError(f"unsupported ONNX op {node.op_type}")
+            out = handler(ffmodel, node)
+            if out is not None:
+                self.symbol_table[node.output[0]] = out
+                outputs = out
+        graph_outs = [self.symbol_table[o.name]
+                      for o in self.model.graph.output
+                      if o.name in self.symbol_table]
+        return graph_outs[0] if len(graph_outs) == 1 else (graph_outs or outputs)
+
+
+class ONNXModelKeras(ONNXModel):
+    """Variant used by the keras_exp path (reference model.py: ONNXModelKeras
+    — same walker, Keras-exported Gemm/Dense naming)."""
+
+    def __init__(self, filename, ffconfig=None, ffmodel=None):
+        super().__init__(filename)
+
+    handleDense = ONNXModel.handleGemm

@@ -1,0 +1,161 @@
+"""ctypes bridge to the native search core (csrc/sim.cc).
+
+Builds the cost tables the C++ simulator consumes: per-op choice lists
+(legal axis maps) with compute + grad-sync costs from the Python CostModel,
+and per-edge resharding cost matrices. Compiles libffsim.so on first use
+(g++, no pybind11 in this environment — plain C ABI + ctypes).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from flexflow_tpu.ops.base import InputOp
+from flexflow_tpu.parallel.pconfig import ParallelConfig
+
+_CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
+_LIB_PATH = os.path.join(_CSRC, "libffsim.so")
+_lib = None
+
+
+def _load_lib():
+    global _lib
+    if _lib is not None:
+        return _lib
+    src = os.path.join(_CSRC, "sim.cc")
+    if (not os.path.exists(_LIB_PATH)
+            or os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)):
+        subprocess.run(["g++", "-O3", "-std=c++17", "-fPIC", "-Wall",
+                        "-shared", "-o", _LIB_PATH, src],
+                       check=True, capture_output=True)
+    lib = ctypes.CDLL(_LIB_PATH)
+    d, i32, i64 = (np.ctypeslib.ndpointer(dtype=np.float64, flags="C"),
+                   np.ctypeslib.ndpointer(dtype=np.int32, flags="C"),
+                   np.ctypeslib.ndpointer(dtype=np.int64, flags="C"))
+    lib.ff_simulate.restype = ctypes.c_double
+    lib.ff_simulate.argtypes = [ctypes.c_int, ctypes.c_int, i64, d, d,
+                                i32, i32, i64, d, i32]
+    lib.ff_mcmc.restype = ctypes.c_double
+    lib.ff_mcmc.argtypes = [ctypes.c_int, ctypes.c_int, i64, d, d,
+                            i32, i32, i64, d, i32,
+                            ctypes.c_int, ctypes.c_double, ctypes.c_uint64, i32]
+    _lib = lib
+    return lib
+
+
+class CompiledSearchProblem:
+    """The graph + strategy space factorized into flat cost tables."""
+
+    def __init__(self, model, cost, mesh_shape: Dict[str, int],
+                 epp: bool = True, eap: bool = True):
+        from flexflow_tpu.search.driver import legal_axis_maps
+
+        self.ops = [op for op in model.ops if not isinstance(op, InputOp)]
+        self.op_index = {op.name: i for i, op in enumerate(self.ops)}
+        self.mesh_shape = mesh_shape
+        self.op_maps: List[List[dict]] = [
+            legal_axis_maps(op, mesh_shape, epp, eap) for op in self.ops]
+
+        # per-op cost tables
+        offsets = [0]
+        compute, sync = [], []
+        for op, maps in zip(self.ops, self.op_maps):
+            for am in maps:
+                compute.append(cost.op_compute_time(op, am))
+                sync.append(cost.op_grad_sync_time(op, am))
+            offsets.append(len(compute))
+        self.op_cost_offsets = np.asarray(offsets, np.int64)
+        self.op_compute_costs = np.asarray(compute, np.float64)
+        self.op_sync_costs = np.asarray(sync, np.float64)
+
+        # edges (sorted by consumer index — required by the C scheduler)
+        edges = []  # (src_idx, dst_idx, input_idx, tensor)
+        for dst_idx, op in enumerate(self.ops):
+            for input_idx, t in enumerate(op.inputs):
+                if t.owner_op is None or isinstance(t.owner_op, InputOp):
+                    continue
+                src_idx = self.op_index[t.owner_op.name]
+                edges.append((src_idx, dst_idx, input_idx, t))
+        edges.sort(key=lambda x: x[1])
+        self.edge_src = np.asarray([e[0] for e in edges], np.int32)
+        self.edge_dst = np.asarray([e[1] for e in edges], np.int32)
+        eoffsets = [0]
+        ecosts: List[float] = []
+        for src_idx, dst_idx, input_idx, t in edges:
+            src_maps = self.op_maps[src_idx]
+            dst_maps = self.op_maps[dst_idx]
+            dst_op = self.ops[dst_idx]
+            for pm in src_maps:
+                for cm in dst_maps:
+                    want = dst_op.input_axis_map(cm, input_idx)
+                    ecosts.append(cost.resharding_time(pm, want, t))
+            eoffsets.append(len(ecosts))
+        self.edge_cost_offsets = np.asarray(eoffsets, np.int64)
+        self.edge_costs = np.asarray(ecosts, np.float64)
+        self.num_edges = len(edges)
+
+    def choices_for(self, strategy: Dict[str, dict]) -> np.ndarray:
+        out = np.zeros(len(self.ops), np.int32)
+        for i, (op, maps) in enumerate(zip(self.ops, self.op_maps)):
+            am = strategy.get(op.name, {})
+            norm = {ax: d for ax, d in am.items() if d is not None}
+            for j, m in enumerate(maps):
+                if {ax: d for ax, d in m.items() if d is not None} == norm:
+                    out[i] = j
+                    break
+            else:
+                raise ValueError(
+                    f"strategy for op {op.name!r} ({norm}) is not in its "
+                    f"legal axis-map list — check divisibility against mesh "
+                    f"{self.mesh_shape} and the enable-*-parallel flags")
+        return out
+
+    def simulate(self, choices: np.ndarray) -> float:
+        lib = _load_lib()
+        return lib.ff_simulate(
+            len(self.ops), self.num_edges, self.op_cost_offsets,
+            self.op_compute_costs, self.op_sync_costs, self.edge_src,
+            self.edge_dst, self.edge_cost_offsets, self.edge_costs,
+            np.ascontiguousarray(choices, np.int32))
+
+    def mcmc(self, init_choices: np.ndarray, budget: int, alpha: float,
+             seed: int):
+        lib = _load_lib()
+        best = np.zeros(len(self.ops), np.int32)
+        best_cost = lib.ff_mcmc(
+            len(self.ops), self.num_edges, self.op_cost_offsets,
+            self.op_compute_costs, self.op_sync_costs, self.edge_src,
+            self.edge_dst, self.edge_cost_offsets, self.edge_costs,
+            np.ascontiguousarray(init_choices, np.int32),
+            budget, alpha, seed, best)
+        return best, best_cost
+
+
+def native_optimize(model, cost, mesh_shape: Dict[str, int], budget: int,
+                    alpha: float, seed: int,
+                    verbose: bool = False) -> Dict[str, ParallelConfig]:
+    from flexflow_tpu.search.driver import data_parallel_strategy
+
+    cfg = getattr(model, "config", None)
+    epp = getattr(cfg, "enable_parameter_parallel", True)
+    eap = getattr(cfg, "enable_attribute_parallel", True)
+    prob = CompiledSearchProblem(model, cost, mesh_shape, epp, eap)
+    init = prob.choices_for(data_parallel_strategy(model, mesh_shape))
+    dp_cost = prob.simulate(init)
+    best, best_cost = prob.mcmc(init, budget, alpha, seed)
+    if verbose:
+        print(f"[search/native] best {best_cost * 1e3:.3f} ms vs DP "
+              f"{dp_cost * 1e3:.3f} ms "
+              f"({dp_cost / max(best_cost, 1e-12):.2f}x), "
+              f"{len(prob.ops)} ops, {prob.num_edges} edges")
+    out = {}
+    for i, op in enumerate(prob.ops):
+        am = prob.op_maps[i][int(best[i])]
+        out[op.name] = ParallelConfig.from_axis_map(
+            op.outputs[0].num_dims, mesh_shape, am)
+    return out

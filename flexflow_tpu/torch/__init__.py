@@ -1,0 +1,2 @@
+from flexflow_tpu.torch.model import PyTorchModel  # noqa: F401
+from flexflow_tpu.torch.fx import torch_to_flexflow  # noqa: F401

@@ -1,0 +1,242 @@
+"""PyTorch-FX exporter: torch.nn.Module -> .ff text IR.
+
+Reference: python/flexflow/torch/fx.py:47-357. Line format (parser at
+torch/model.py):
+
+    <name>, <in1>:<in2>:..., <out1>:..., <OPTYPE>[, params...]
+
+Uses torch.fx.symbolic_trace; supported modules/functions mirror the
+reference's parse_* table plus LayerNorm/GELU/MultiheadAttention extensions.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from flexflow_tpu.ffconst import ActiMode, PoolType
+from flexflow_tpu.flexflow_type import OpType, enum_to_int, enum_to_str
+
+
+class Node:
+    def __init__(self, name, inedges, outedges):
+        self.name = name
+        self.inedges = inedges
+        self.outedges = outedges
+
+
+class InputNode(Node):
+    def __init__(self, name, users):
+        super().__init__(name, None, list(users))
+
+
+class OutputNode(Node):
+    def __init__(self, name, args):
+        super().__init__(name, args, None)
+
+
+class ModuleNode(Node):
+    def __init__(self, name, args, users, module):
+        super().__init__(name, args, list(users))
+        self.module = module
+
+
+class FunctionNode(Node):
+    def __init__(self, name, args, users, target):
+        super().__init__(name, args, list(users))
+        self.target = target
+
+
+def _symbolic_trace(model):
+    import torch
+
+    assert isinstance(model, torch.nn.Module)
+    traced = torch.fx.symbolic_trace(model)
+    modules_by_name = dict(model.named_modules())
+    graph: List[Node] = []
+    for node in traced.graph.nodes:
+        if node.op == "call_module":
+            graph.append(ModuleNode(node.name, node.args, node.users,
+                                    modules_by_name[node.target]))
+        elif node.op == "placeholder":
+            graph.append(InputNode(node.name, node.users))
+        elif node.op == "get_attr":
+            pass
+        elif node.op in ("call_function", "call_method"):
+            graph.append(FunctionNode(node.name, node.args, node.users,
+                                      node.target))
+        elif node.op == "output":
+            graph.append(OutputNode(node.name, node.args))
+        else:
+            raise AssertionError(f"unhandled fx op {node.op}")
+    return graph
+
+
+def _inoutedge(op_str, inedges, outedges):
+    if inedges is not None:
+        for e in inedges:
+            name = e.name if hasattr(e, "name") else str(e)
+            op_str += name + ":"
+    op_str += ", "
+    if outedges is not None:
+        for e in outedges:
+            name = e.name if hasattr(e, "name") else str(e)
+            op_str += name + ":"
+    op_str += ", "
+    return op_str
+
+
+def _tensor_args(node):
+    out = []
+    for a in node.inedges:
+        if isinstance(a, (list, tuple)):  # e.g. torch.cat([x, y], dim)
+            out += [e for e in a
+                    if hasattr(e, "name") or type(e).__name__ == "Node"]
+        elif hasattr(a, "name") or type(a).__name__ == "Node":
+            out.append(a)
+    return out
+
+
+def _emit(node) -> str:
+    import torch
+    import torch.nn as nn
+
+    s = node.name + ", "
+    if isinstance(node, InputNode):
+        s = _inoutedge(s, None, node.outedges)
+        return s + enum_to_str(OpType, OpType.INPUT) + "\n"
+    if isinstance(node, OutputNode):
+        ins = node.inedges[0] if isinstance(node.inedges[0], (tuple, list)) \
+            else node.inedges
+        s = _inoutedge(s, list(ins), None)
+        return s + enum_to_str(OpType, OpType.OUTPUT) + "\n"
+
+    if isinstance(node, ModuleNode):
+        m = node.module
+        s = _inoutedge(s, _tensor_args(node), node.outedges)
+        if isinstance(m, nn.Linear):
+            return s + (f"{enum_to_str(OpType, OpType.LINEAR)}, "
+                        f"{m.out_features}, "
+                        f"{enum_to_int(ActiMode, ActiMode.AC_MODE_NONE)}, "
+                        f"{1 if m.bias is not None else 0}\n")
+        if isinstance(m, nn.Conv2d):
+            return s + (f"{enum_to_str(OpType, OpType.CONV2D)}, "
+                        f"{m.out_channels}, {m.kernel_size[0]}, "
+                        f"{m.kernel_size[1]}, {m.stride[0]}, {m.stride[1]}, "
+                        f"{m.padding[0]}, {m.padding[1]}, "
+                        f"{enum_to_int(ActiMode, ActiMode.AC_MODE_NONE)}, "
+                        f"{m.groups}, {1 if m.bias is not None else 0}\n")
+        if isinstance(m, (nn.MaxPool2d, nn.AvgPool2d)):
+            pt = PoolType.POOL_MAX if isinstance(m, nn.MaxPool2d) \
+                else PoolType.POOL_AVG
+            k = m.kernel_size if isinstance(m.kernel_size, int) else m.kernel_size[0]
+            st = m.stride if isinstance(m.stride, int) else m.stride[0]
+            p = m.padding if isinstance(m.padding, int) else m.padding[0]
+            return s + (f"{enum_to_str(OpType, OpType.POOL2D)}, {k}, {st}, "
+                        f"{p}, {enum_to_int(PoolType, pt)}, "
+                        f"{enum_to_int(ActiMode, ActiMode.AC_MODE_NONE)}\n")
+        if isinstance(m, (nn.AdaptiveMaxPool2d, nn.AdaptiveAvgPool2d)):
+            pt = PoolType.POOL_MAX if isinstance(m, nn.AdaptiveMaxPool2d) \
+                else PoolType.POOL_AVG
+            # reference FIXME kept: emit 3/1/0 (fx.py parse_adaptivepool2d)
+            return s + (f"{enum_to_str(OpType, OpType.POOL2D)}, 3, 1, 0, "
+                        f"{enum_to_int(PoolType, pt)}, "
+                        f"{enum_to_int(ActiMode, ActiMode.AC_MODE_NONE)}\n")
+        if isinstance(m, nn.BatchNorm2d):
+            return s + enum_to_str(OpType, OpType.BATCH_NORM) + "\n"
+        if isinstance(m, nn.LayerNorm):
+            return s + enum_to_str(OpType, OpType.LAYER_NORM) + "\n"
+        if isinstance(m, nn.Dropout):
+            return s + f"{enum_to_str(OpType, OpType.DROPOUT)}, {m.p}\n"
+        if isinstance(m, nn.ReLU):
+            return s + enum_to_str(OpType, OpType.RELU) + "\n"
+        if isinstance(m, nn.Sigmoid):
+            return s + enum_to_str(OpType, OpType.SIGMOID) + "\n"
+        if isinstance(m, nn.Tanh):
+            return s + enum_to_str(OpType, OpType.TANH) + "\n"
+        if isinstance(m, nn.ELU):
+            return s + enum_to_str(OpType, OpType.ELU) + "\n"
+        if isinstance(m, nn.GELU):
+            return s + enum_to_str(OpType, OpType.GELU) + "\n"
+        if isinstance(m, nn.Softmax):
+            return s + enum_to_str(OpType, OpType.SOFTMAX) + "\n"
+        if isinstance(m, nn.Flatten):
+            return s + enum_to_str(OpType, OpType.FLAT) + "\n"
+        if isinstance(m, nn.Identity):
+            return s + enum_to_str(OpType, OpType.IDENTITY) + "\n"
+        if isinstance(m, nn.Embedding):
+            return s + (f"{enum_to_str(OpType, OpType.EMBEDDING)}, "
+                        f"{m.num_embeddings}, {m.embedding_dim}\n")
+        if isinstance(m, nn.MultiheadAttention):
+            return s + (f"{enum_to_str(OpType, OpType.MULTIHEAD_ATTENTION)}, "
+                        f"{m.embed_dim}, {m.num_heads}\n")
+        raise AssertionError(f"unsupported module {type(m).__name__}")
+
+    assert isinstance(node, FunctionNode)
+    t = node.target
+    tname = t if isinstance(t, str) else getattr(t, "__name__", str(t))
+    tensor_ins = _tensor_args(node)
+    s = _inoutedge(s, tensor_ins, node.outedges)
+    if tname in ("add", "add_", "__add__", "iadd"):
+        return s + enum_to_str(OpType, OpType.ADD) + "\n"
+    if tname in ("sub", "__sub__"):
+        return s + enum_to_str(OpType, OpType.SUBTRACT) + "\n"
+    if tname in ("mul", "__mul__"):
+        return s + enum_to_str(OpType, OpType.MULTIPLY) + "\n"
+    if tname in ("truediv", "__truediv__", "div"):
+        return s + enum_to_str(OpType, OpType.DIVIDE) + "\n"
+    if tname == "relu":
+        return s + enum_to_str(OpType, OpType.RELU) + "\n"
+    if tname == "gelu":
+        return s + enum_to_str(OpType, OpType.GELU) + "\n"
+    if tname == "tanh":
+        return s + enum_to_str(OpType, OpType.TANH) + "\n"
+    if tname == "sigmoid":
+        return s + enum_to_str(OpType, OpType.SIGMOID) + "\n"
+    if tname == "exp":
+        return s + enum_to_str(OpType, OpType.EXP) + "\n"
+    if tname == "softmax":
+        return s + enum_to_str(OpType, OpType.SOFTMAX) + "\n"
+    if tname == "flatten":
+        return s + enum_to_str(OpType, OpType.FLAT) + "\n"
+    # list-valued params are ':'-joined — the .ff line is comma-delimited, so
+    # str(list) would corrupt the format (the reference had this bug latent;
+    # its RESHAPE lines already use ':' separators)
+    def _colon(v):
+        if isinstance(v, (list, tuple)):
+            return ":".join(str(x) for x in v)
+        return str(v)
+
+    if tname == "cat":
+        axis = node.inedges[1] if len(node.inedges) > 1 else 1
+        return s + f"{enum_to_str(OpType, OpType.CONCAT)}, {axis}\n"
+    if tname in ("split", "chunk"):
+        sizes = node.inedges[1]
+        return s + f"{enum_to_str(OpType, OpType.SPLIT)}, {_colon(sizes)}\n"
+    if tname == "getitem":
+        idx = node.inedges[1]
+        return s + f"{enum_to_str(OpType, OpType.GETITEM)}, {idx}\n"
+    if tname == "reshape" or tname == "view":
+        shape = []
+        for v in node.inedges[1:]:
+            shape += list(v) if isinstance(v, (list, tuple)) else [v]
+        return s + (enum_to_str(OpType, OpType.RESHAPE) + ", "
+                    + ":".join(str(v) for v in shape) + "\n")
+    if tname == "mean":
+        dims = node.inedges[1] if len(node.inedges) > 1 else [1]
+        if not isinstance(dims, (list, tuple)):
+            dims = [dims]
+        return s + f"{enum_to_str(OpType, OpType.MEAN)}, {_colon(dims)}\n"
+    raise AssertionError(f"unsupported function {tname}")
+
+
+def torch_to_flexflow(model, filename: str) -> None:
+    """Trace and export to a .ff file (reference fx.py:236)."""
+    graph = _symbolic_trace(model)
+    with open(filename, "w") as f:
+        for node in graph:
+            f.write(_emit(node))
+
+
+def torch_to_strings(model) -> List[str]:
+    graph = _symbolic_trace(model)
+    return [_emit(node) for node in graph]

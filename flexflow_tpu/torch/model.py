@@ -1,0 +1,141 @@
+"""PyTorchModel: replay a .ff text IR onto an FFModel.
+
+Reference: python/flexflow/torch/model.py:23-226 — parse each line
+(`name, ins:, outs:, OPTYPE, params...`), call the corresponding native
+builder method, track tensors by producer name in tensor_dict.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from flexflow_tpu.ffconst import ActiMode, AggrMode, PoolType
+from flexflow_tpu.flexflow_type import OpType, int_to_enum, str_to_enum
+
+
+class PyTorchModel:
+    def __init__(self, filename: Optional[str] = None, model=None):
+        self.tensor_dict: Dict[str, object] = {}
+        self.lines: List[str] = []
+        if filename is not None:
+            with open(filename) as f:
+                self.lines = f.readlines()
+        elif model is not None:
+            from flexflow_tpu.torch.fx import torch_to_strings
+
+            self.lines = torch_to_strings(model)
+        else:
+            raise ValueError("need filename or model")
+
+    def _input_key(self, ins: List[str], idx: int) -> str:
+        return ins[idx]
+
+    def apply(self, ffmodel, input_tensors: List) -> List:
+        output_tensors = []
+        input_idx = 0
+        for line in self.lines:
+            items = [i.strip() for i in line.strip().split(",")]
+            assert len(items) >= 3, f"wrong format: {line!r}"
+            op_name = items[0]
+            ins = [i for i in (s.strip() for s in items[1].split(":")) if i]
+            op_type = str_to_enum(OpType, items[3])
+            T = self.tensor_dict
+
+            if op_type == OpType.INPUT:
+                assert not ins
+                T[op_name] = input_tensors[input_idx]
+                input_idx += 1
+            elif op_type == OpType.OUTPUT:
+                output_tensors += [T[i] for i in ins]
+            elif op_type == OpType.LINEAR:
+                od = int(items[4])
+                activ = int_to_enum(ActiMode, int(items[5]))
+                bias = bool(int(items[6]))
+                T[op_name] = ffmodel.dense(T[ins[0]], od, activation=activ,
+                                           use_bias=bias, name=op_name)
+            elif op_type == OpType.CONV2D:
+                oc, kh, kw, sh, sw, ph, pw = (int(v) for v in items[4:11])
+                activ = int_to_enum(ActiMode, int(items[11]))
+                groups = int(items[12])
+                bias = bool(int(items[13]))
+                T[op_name] = ffmodel.conv2d(T[ins[0]], oc, kh, kw, sh, sw,
+                                            ph, pw, activation=activ,
+                                            groups=groups, use_bias=bias,
+                                            name=op_name)
+            elif op_type == OpType.POOL2D:
+                k, s_, p = int(items[4]), int(items[5]), int(items[6])
+                pool = int_to_enum(PoolType, int(items[7]))
+                activ = int_to_enum(ActiMode, int(items[8]))
+                T[op_name] = ffmodel.pool2d(T[ins[0]], k, k, s_, s_, p, p,
+                                            pool_type=pool, activation=activ,
+                                            name=op_name)
+            elif op_type == OpType.BATCH_NORM:
+                T[op_name] = ffmodel.batch_norm(T[ins[0]], relu=False,
+                                                name=op_name)
+            elif op_type == OpType.LAYER_NORM:
+                T[op_name] = ffmodel.layer_norm(T[ins[0]], name=op_name)
+            elif op_type == OpType.DROPOUT:
+                T[op_name] = ffmodel.dropout(T[ins[0]], float(items[4]),
+                                             name=op_name)
+            elif op_type == OpType.RELU:
+                T[op_name] = ffmodel.relu(T[ins[0]], name=op_name)
+            elif op_type == OpType.SIGMOID:
+                T[op_name] = ffmodel.sigmoid(T[ins[0]], name=op_name)
+            elif op_type == OpType.TANH:
+                T[op_name] = ffmodel.tanh(T[ins[0]], name=op_name)
+            elif op_type == OpType.ELU:
+                T[op_name] = ffmodel.elu(T[ins[0]], name=op_name)
+            elif op_type == OpType.GELU:
+                T[op_name] = ffmodel.gelu(T[ins[0]], name=op_name)
+            elif op_type == OpType.IDENTITY:
+                T[op_name] = T[ins[0]]
+            elif op_type == OpType.SOFTMAX:
+                T[op_name] = ffmodel.softmax(T[ins[0]], name=op_name)
+            elif op_type == OpType.FLAT:
+                T[op_name] = ffmodel.flat(T[ins[0]], name=op_name)
+            elif op_type == OpType.ADD:
+                T[op_name] = ffmodel.add(T[ins[0]], T[ins[1]], name=op_name)
+            elif op_type == OpType.SUBTRACT:
+                T[op_name] = ffmodel.subtract(T[ins[0]], T[ins[1]], name=op_name)
+            elif op_type == OpType.MULTIPLY:
+                T[op_name] = ffmodel.multiply(T[ins[0]], T[ins[1]], name=op_name)
+            elif op_type == OpType.DIVIDE:
+                T[op_name] = ffmodel.divide(T[ins[0]], T[ins[1]], name=op_name)
+            elif op_type == OpType.EXP:
+                T[op_name] = ffmodel.exp(T[ins[0]], name=op_name)
+            elif op_type == OpType.CONCAT:
+                axis = int(items[4])
+                T[op_name] = ffmodel.concat([T[i] for i in ins], axis,
+                                            name=op_name)
+            elif op_type == OpType.SPLIT:
+                raw = items[4]
+                sizes = [int(v) for v in raw.split(":")] if ":" in raw \
+                    else int(raw)
+                T[op_name] = ffmodel.split(T[ins[0]], sizes, axis=1,
+                                           name=op_name)
+            elif op_type == OpType.GETITEM:
+                idx = int(items[4])
+                T[op_name] = T[ins[0]][idx]
+            elif op_type == OpType.RESHAPE:
+                shape = [int(v) for v in items[4].split(":") if v]
+                T[op_name] = ffmodel.reshape(T[ins[0]], shape, name=op_name)
+            elif op_type == OpType.EMBEDDING:
+                num, dim = int(items[4]), int(items[5])
+                T[op_name] = ffmodel.embedding(T[ins[0]], num, dim,
+                                               AggrMode.AGGR_MODE_NONE,
+                                               name=op_name)
+            elif op_type == OpType.MULTIHEAD_ATTENTION:
+                ed, nh = int(items[4]), int(items[5])
+                q = T[ins[0]]
+                k = T[ins[1]] if len(ins) > 1 else q
+                v = T[ins[2]] if len(ins) > 2 else k
+                T[op_name] = ffmodel.multihead_attention(q, k, v, ed, nh,
+                                                         name=op_name)
+            elif op_type == OpType.MEAN:
+                raw = items[4]
+                dims = [int(v) for v in raw.split(":") if v] \
+                    if raw not in ("None", "") else [1]
+                T[op_name] = ffmodel.mean(T[ins[0]], dims, name=op_name)
+            else:
+                raise AssertionError(f"unhandled op type {op_type}")
+        return output_tensors

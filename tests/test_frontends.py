@@ -1,0 +1,150 @@
+"""Frontend tests: Keras clone (sequential + functional + callbacks) and the
+PyTorch-FX importer (.ff round-trip + numerics).
+
+Mirrors the reference e2e tier (tests/multi_gpu_tests.sh runs keras/native/fx
+examples) in-process."""
+
+import numpy as np
+import pytest
+
+
+def make_blobs(n=512, d=16, classes=4, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(classes, d) * 3
+    y = rs.randint(0, classes, n)
+    x = centers[y] + rs.randn(n, d)
+    return x.astype(np.float32), y.astype(np.int32)
+
+
+def test_keras_sequential_mlp():
+    from flexflow_tpu.keras import Sequential
+    from flexflow_tpu.keras.layers import Dense
+    from flexflow_tpu.keras.optimizers import SGD
+
+    m = Sequential([
+        Dense(64, activation="relu", input_shape=(16,)),
+        Dense(64, activation="relu"),
+        Dense(4),
+    ])
+    m.compile(optimizer=SGD(learning_rate=0.1),
+              loss="sparse_categorical_crossentropy", metrics=["accuracy"])
+    x, y = make_blobs()
+    perf = m.fit(x, y, epochs=5, verbose=False)
+    assert perf.accuracy > 0.9
+
+
+def test_keras_functional_multi_input_and_callbacks():
+    from flexflow_tpu.keras import Model
+    from flexflow_tpu.keras.layers import Concatenate, Dense, Input
+    from flexflow_tpu.keras.callbacks import (EpochVerifyMetrics,
+                                              ModelAccuracy, VerifyMetrics)
+
+    a = Input((8,), name="ia")
+    b = Input((8,), name="ib")
+    t = Concatenate(axis=1)([a, b])
+    t = Dense(64, activation="relu")(t)
+    out = Dense(4)(t)
+    m = Model(inputs=[a, b], outputs=out)
+    m.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    x, y = make_blobs(d=16)
+    perf = m.fit([x[:, :8], x[:, 8:]], y, epochs=8, verbose=False,
+                 callbacks=[VerifyMetrics(ModelAccuracy.MNIST_MLP),
+                            EpochVerifyMetrics(ModelAccuracy.MNIST_MLP)])
+    assert perf.accuracy > 0.9
+
+
+def test_keras_cnn_mnist_synthetic():
+    from flexflow_tpu.keras import Sequential
+    from flexflow_tpu.keras.layers import (Conv2D, Dense, Flatten,
+                                           MaxPooling2D)
+    from flexflow_tpu.keras.datasets import mnist
+
+    (x, y), _ = mnist.load_data()
+    x = x.reshape(-1, 1, 28, 28).astype(np.float32) / 255.0
+    x, y = x[:1024], y[:1024]
+    m = Sequential([
+        Conv2D(8, 3, strides=2, padding="same", activation="relu",
+               input_shape=(1, 28, 28)),
+        MaxPooling2D(2),
+        Flatten(),
+        Dense(32, activation="relu"),
+        Dense(10),
+    ])
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    perf = m.fit(x, y, epochs=4, verbose=False)
+    assert perf.accuracy > 0.8, perf.accuracy
+
+
+def test_fx_roundtrip_mlp(tmp_path):
+    torch = pytest.importorskip("torch")
+    import torch.nn as nn
+
+    from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+    from flexflow_tpu.torch.fx import torch_to_flexflow
+    from flexflow_tpu.torch.model import PyTorchModel
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(16, 32)
+            self.relu = nn.ReLU()
+            self.fc2 = nn.Linear(32, 4)
+
+        def forward(self, x):
+            return self.fc2(self.relu(self.fc1(x)))
+
+    net = Net()
+    ff_file = str(tmp_path / "net.ff")
+    torch_to_flexflow(net, ff_file)
+
+    cfg = FFConfig(batch_size=8, mesh_shape={"data": 1})
+    ff = FFModel(cfg)
+    x = ff.create_tensor([8, 16], name="x")
+    outs = PyTorchModel(ff_file).apply(ff, [x])
+    assert len(outs) == 1
+    ff.compile(optimizer=None, final_tensor=outs[0])
+
+    # copy torch weights in and compare numerics
+    ff.set_weights("fc1", "kernel", net.fc1.weight.detach().numpy().T)
+    ff.set_weights("fc1", "bias", net.fc1.bias.detach().numpy())
+    ff.set_weights("fc2", "kernel", net.fc2.weight.detach().numpy().T)
+    ff.set_weights("fc2", "bias", net.fc2.bias.detach().numpy())
+    xv = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    got = np.asarray(ff.predict({"x": xv}))
+    with torch.no_grad():
+        want = net(torch.from_numpy(xv)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_fx_cnn_with_residual(tmp_path):
+    torch = pytest.importorskip("torch")
+    import torch.nn as nn
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.torch.model import PyTorchModel
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2d(3, 8, 3, padding=1)
+            self.bn = nn.BatchNorm2d(8)
+            self.relu = nn.ReLU()
+            self.conv2 = nn.Conv2d(8, 8, 3, padding=1)
+            self.pool = nn.MaxPool2d(2)
+            self.flat = nn.Flatten()
+            self.fc = nn.Linear(8 * 4 * 4, 10)
+
+        def forward(self, x):
+            t = self.relu(self.bn(self.conv1(x)))
+            t = t + self.conv2(t)
+            return self.fc(self.flat(self.pool(t)))
+
+    cfg = FFConfig(batch_size=4, mesh_shape={"data": 1})
+    ff = FFModel(cfg)
+    x = ff.create_tensor([4, 3, 8, 8], name="x")
+    outs = PyTorchModel(model=Net()).apply(ff, [x])
+    ff.compile(optimizer=None, final_tensor=outs[0])
+    y = ff.predict({"x": np.zeros((4, 3, 8, 8), np.float32)})
+    assert y.shape == (4, 10)
