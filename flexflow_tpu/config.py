@@ -44,6 +44,7 @@ class FFConfig:
     enable_attribute_parallel: bool = False
 
     # execution flags
+    sp_mode: str = "ring"  # sequence-parallel lowering: "ring" | "ulysses"
     profiling: bool = False
     perform_fusion: bool = False  # XLA fuses; flag kept for API parity
     simulator_workspace_size: int = 2 * 1024 * 1024 * 1024

@@ -63,6 +63,7 @@ class FFModel:
         self._eval_step = None
         self._predict_fn = None
         self._current_batch: Dict[str, np.ndarray] = {}
+        self._aux_tensors: List[Tensor] = []  # scalar losses (MoE balance)
         self._cached_backward = None
         self._perf = PerfMetrics()
 
@@ -139,6 +140,34 @@ class FFModel:
     def rms_norm(self, input: Tensor, eps: float = 1e-6,
                  name: Optional[str] = None) -> Tensor:
         return self._add(RMSNorm(self, self._name("rms_norm", name), [input], eps))
+
+    def lstm(self, input: Tensor, hidden_size: int,
+             return_sequences: bool = True, name: Optional[str] = None) -> Tensor:
+        from flexflow_tpu.ops.recurrent import LSTM
+
+        return self._add(LSTM(self, self._name("lstm", name), [input],
+                              hidden_size, return_sequences))
+
+    def gru(self, input: Tensor, hidden_size: int,
+            return_sequences: bool = True, name: Optional[str] = None) -> Tensor:
+        from flexflow_tpu.ops.recurrent import GRU
+
+        return self._add(GRU(self, self._name("gru", name), [input],
+                             hidden_size, return_sequences))
+
+    def moe(self, input: Tensor, num_experts: int, hidden_dim: int,
+            k: int = 2, capacity_factor: float = 1.25,
+            name: Optional[str] = None) -> Tensor:
+        """Mixture-of-experts FFN (net-new vs reference; expert-parallel over
+        the 'expert' mesh axis). Returns the main output; the load-balancing
+        aux loss is folded into the training loss automatically."""
+        from flexflow_tpu.ops.moe import MoE
+
+        op = MoE(self, self._name("moe", name), [input], num_experts,
+                 hidden_dim, k, capacity_factor)
+        outs = self._add(op)
+        self._aux_tensors.append(outs[1])
+        return outs[0]
 
     def batch_matmul(self, a: Tensor, b: Tensor,
                      name: Optional[str] = None) -> Tensor:

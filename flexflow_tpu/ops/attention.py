@@ -30,6 +30,7 @@ from flexflow_tpu.ops.base import Op, WeightSpec
 class MultiHeadAttention(Op):
     op_type = OperatorType.OP_MULTIHEAD_ATTENTION
     needs_rng = True
+    wants_shard_ctx = True  # executor passes (mesh, axis_map) for SP lowering
 
     def __init__(self, model, name, inputs, embed_dim: int, num_heads: int,
                  kdim: int = 0, vdim: int = 0, dropout: float = 0.0,
@@ -81,7 +82,7 @@ class MultiHeadAttention(Op):
                    WeightSpec("bias_o", (self.embed_dim,), init="zero")]
         return ws
 
-    def forward(self, params, xs, *, training=False, rng=None):
+    def forward(self, params, xs, *, training=False, rng=None, shard_ctx=None):
         q, k, v = xs[0], xs[1], xs[2]
         # (B, Sq, D) x (D, H, Hd) -> (B, Sq, H, Hd)
         qh = jnp.einsum("bsd,dhk->bshk", q, params["wq"])
@@ -92,21 +93,74 @@ class MultiHeadAttention(Op):
             kh = kh + params["bias_k"]
             vh = vh + params["bias_v"]
         scale = 1.0 / math.sqrt(self.qk_head_dim)
-        logits = jnp.einsum("bqhk,bshk->bhqs", qh, kh) * scale
-        if self.causal:
-            sq, sk = logits.shape[-2], logits.shape[-1]
-            mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-            logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
-        probs = jax.nn.softmax(logits, axis=-1)
-        if training and self.dropout > 0.0 and rng is not None:
-            keep = 1.0 - self.dropout
-            probs = jnp.where(jax.random.bernoulli(rng, keep, probs.shape),
-                              probs / keep, 0.0)
-        ctx = jnp.einsum("bhqs,bshk->bqhk", probs, vh)
+
+        seq_axes = []
+        if shard_ctx is not None:
+            seq_axes = [ax for ax, d in (shard_ctx.get("axis_map") or {}).items()
+                        if d == 1 and shard_ctx["mesh"].shape[ax] > 1]
+        if seq_axes:
+            ctx = self._sp_attention(qh, kh, vh, shard_ctx, seq_axes, scale)
+        else:
+            ctx = self._dense_attention(qh, kh, vh, scale, training, rng)
         out = jnp.einsum("bqhk,hkd->bqd", ctx, params["wo"])
         if self.bias:
             out = out + params["bias_o"]
         return [out]
+
+    def _dense_attention(self, qh, kh, vh, scale, training, rng):
+        logits = jnp.einsum("bqhk,bshk->bhqs", qh, kh,
+                            preferred_element_type=jnp.float32) * scale
+        if self.causal:
+            sq, sk = logits.shape[-2], logits.shape[-1]
+            mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+            logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+        probs = jax.nn.softmax(logits, axis=-1).astype(qh.dtype)
+        if training and self.dropout > 0.0 and rng is not None:
+            keep = 1.0 - self.dropout
+            probs = jnp.where(jax.random.bernoulli(rng, keep, probs.shape),
+                              probs / keep, 0.0)
+        return jnp.einsum("bhqs,bshk->bqhk", probs, vh)
+
+    def _sp_attention(self, qh, kh, vh, shard_ctx, seq_axes, scale):
+        """Sequence-parallel lowering: ring attention (default) or Ulysses
+        over the mesh axes sharding the sequence dim. Attention dropout is
+        not applied on this path (noted API gap; reference has no SP at all)."""
+        from jax.sharding import PartitionSpec as P
+
+        from flexflow_tpu.parallel import shard_map_compat
+        from flexflow_tpu.parallel.ring_attention import (ring_attention,
+                                                          ulysses_attention)
+
+        mesh = shard_ctx["mesh"]
+        axis_map = shard_ctx.get("axis_map") or {}
+        mode = shard_ctx.get("sp_mode", "ring")
+        if mode not in ("ring", "ulysses"):
+            raise ValueError(f"sp_mode must be 'ring' or 'ulysses', got {mode!r}")
+        if len(seq_axes) > 1:
+            raise ValueError(
+                f"sequence dim sharded over multiple mesh axes {seq_axes}; "
+                f"ring/ulysses attention needs a single 'seq' axis — merge "
+                f"them in the mesh or adjust the strategy")
+        batch_axes = [ax for ax, d in axis_map.items()
+                      if d == 0 and mesh.shape[ax] > 1]
+        head_axes = [ax for ax, d in axis_map.items()
+                     if d == 2 and mesh.shape[ax] > 1]
+
+        def entry(axes):
+            if not axes:
+                return None
+            return axes[0] if len(axes) == 1 else tuple(axes)
+
+        spec = P(entry(batch_axes), entry(seq_axes), entry(head_axes), None)
+        seq_axis = seq_axes[0]
+        fn = ring_attention if mode == "ring" else ulysses_attention
+
+        def inner(q, k, v):
+            return fn(q, k, v, axis_name=seq_axis, causal=self.causal,
+                      scale=scale)
+
+        return shard_map_compat(inner, mesh, (spec, spec, spec), spec)(
+            qh, kh, vh)
 
     _contracted_output_dims = (2,)  # hidden dim comes from the wo contraction
 

@@ -1,0 +1,133 @@
+"""Mixture-of-Experts op (expert parallelism over the 'expert' mesh axis).
+
+Net-new vs the reference (SURVEY §2.5: "EP — absent, no MoE ops"). GShard-
+style capacity-based top-k routing lowered as dense dispatch/combine einsums:
+under GSPMD, sharding the expert dim over the 'expert' axis turns the
+dispatch einsums into all-to-alls over ICI. Includes the standard load-
+balancing auxiliary loss (Shazeer et al.), surfaced through the op-aux
+mechanism so the executor folds it into the training loss.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from flexflow_tpu.ffconst import DataType, OperatorType
+from flexflow_tpu.ops.base import Op, WeightSpec
+
+
+class MoE(Op):
+    op_type = OperatorType.OP_MOE
+    has_aux = True  # second output = scalar load-balancing loss
+
+    def __init__(self, model, name, inputs, num_experts: int, hidden_dim: int,
+                 k: int = 2, capacity_factor: float = 1.25,
+                 aux_weight: float = 1e-2):
+        super().__init__(model, name, inputs)
+        self.num_experts = num_experts
+        self.hidden_dim = hidden_dim
+        self.k = min(k, num_experts)
+        self.capacity_factor = capacity_factor
+        self.aux_weight = aux_weight
+        self.dim = inputs[0].dims[-1]
+        ntokens = 1
+        for s in inputs[0].dims[:-1]:
+            ntokens *= s
+        self.capacity = max(
+            1, int(capacity_factor * ntokens * self.k / num_experts))
+        self.finalize()
+
+    def output_shapes(self):
+        return ([self.inputs[0].dims, ()],
+                [self.inputs[0].dtype, DataType.DT_FLOAT])
+
+    def weights(self) -> List[WeightSpec]:
+        E, D, F = self.num_experts, self.dim, self.hidden_dim
+        return [
+            WeightSpec("router", (D, E), init="glorot", fan=(D, E)),
+            WeightSpec("w_in", (E, D, F), init="glorot", fan=(D, F)),
+            WeightSpec("w_out", (E, F, D), init="glorot", fan=(F, D)),
+        ]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        x = xs[0]
+        orig_shape = x.shape
+        D, E, C = self.dim, self.num_experts, self.capacity
+        t = x.reshape(-1, D)  # (N, D)
+        N = t.shape[0]
+
+        logits = t @ params["router"].astype(t.dtype)       # (N, E)
+        gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+        # top-k routing with capacity (GShard): iteratively take the best
+        # expert per token, mask, repeat k times
+        combine = jnp.zeros((N, E, C), jnp.float32)
+        remaining = gates
+        aux_me = jnp.mean(gates, axis=0)                    # (E,)
+        ce = jnp.zeros((E,), jnp.float32)
+        slots_used = jnp.zeros((E,), jnp.float32)  # carried across k rounds so
+        # round r's assignments start after round r-1's (distinct slots, total
+        # capacity C per expert — not C per round)
+        for _ in range(self.k):
+            choice = jnp.argmax(remaining, axis=-1)          # (N,)
+            onehot = jax.nn.one_hot(choice, E, dtype=jnp.float32)
+            ce = ce + jnp.mean(onehot, axis=0)
+            pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # rank in round
+            pos_in_e = (jnp.sum(pos, axis=-1)
+                        + jnp.sum(onehot * slots_used, axis=-1)).astype(jnp.int32)
+            fits = (pos_in_e < C).astype(jnp.float32)
+            keep = fits * jnp.max(onehot * remaining, axis=-1)  # gate value
+            slot = jax.nn.one_hot(jnp.clip(pos_in_e, 0, C - 1), C,
+                                  dtype=jnp.float32)
+            combine = combine + keep[:, None, None] * onehot[:, :, None] \
+                * slot[:, None, :]
+            slots_used = slots_used + jnp.sum(onehot * fits[:, None], axis=0)
+            remaining = remaining * (1.0 - onehot)
+
+        # renormalize kept gates over selected experts
+        denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+        combine = jnp.where(denom > 0, combine / jnp.maximum(denom, 1e-9),
+                            combine)
+        dispatch = (combine > 0).astype(t.dtype)             # (N, E, C)
+
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch, t)   # (E, C, D)
+        h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in,
+                                   params["w_in"].astype(t.dtype)))
+        expert_out = jnp.einsum("ecf,efd->ecd", h,
+                                params["w_out"].astype(t.dtype))  # (E, C, D)
+        y = jnp.einsum("nec,ecd->nd", combine.astype(t.dtype), expert_out)
+
+        # load-balancing aux loss: E * sum(mean_gate * mean_assignment)
+        aux = self.aux_weight * E * jnp.sum(aux_me * (ce / self.k))
+        return [y.reshape(orig_shape), aux.astype(jnp.float32)]
+
+    def partitionable_output_dims(self):
+        return list(range(self.outputs[0].num_dims - 1))
+
+    def weight_partition(self, axis_map):
+        # expert weights shard on the expert dim over the 'expert' axis if
+        # present in the mesh, regardless of activation sharding
+        mesh_axes = getattr(self.model, "mesh", None)
+        use_expert = (mesh_axes is not None
+                      and "expert" in getattr(mesh_axes, "axis_names", ())
+                      and mesh_axes.shape["expert"] > 1
+                      and self.num_experts % mesh_axes.shape["expert"] == 0)
+        e = "expert" if use_expert else None
+        return {
+            "router": P(None, None),
+            "w_in": P(e, None, None),
+            "w_out": P(e, None, None),
+        }
+
+    def flops(self):
+        ntokens = self.inputs[0].volume() // self.dim
+        return 2 * 2 * ntokens * self.k * self.dim * self.hidden_dim
+
+    def input_axis_map(self, axis_map, input_idx):
+        ndims = self.inputs[input_idx].num_dims
+        return {ax: (d if d is not None and d < ndims - 1 else None)
+                for ax, d in (axis_map or {}).items()}

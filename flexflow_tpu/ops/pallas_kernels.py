@@ -1,0 +1,122 @@
+"""Pallas TPU kernels.
+
+Hand-tiled kernels for ops where XLA's default lowering leaves MXU/VMEM
+performance on the table (the role src/ops/*.cu kernels played in the
+reference). Currently: flash attention forward (online softmax, q-block grid,
+k-block inner loop in VMEM) with a recompute-based custom VJP that reuses the
+pure-JAX blockwise path for the backward.
+
+On CPU (tests/emulated meshes) kernels run with interpret=True.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+                      causal: bool, scale: float, q_block: int, seq_k: int):
+    qi = pl.program_id(1)  # q block index
+    q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+    bq, d = q.shape
+    nk = seq_k // block_k
+
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    o0 = jnp.zeros((bq, d), jnp.float32)
+
+    def body(j, carry):
+        m, l, o = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * q_block + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        o_new = o * alpha[:, None] + jnp.dot(p, v,
+                                             preferred_element_type=jnp.float32)
+        return m_new, l_new, o_new
+
+    m, l, o = jax.lax.fori_loop(0, nk, body, (m0, l0, o0))
+    o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention_fwd_pallas(q, k, v, causal: bool, scale: float,
+                               block_q: int = 128, block_k: int = 128):
+    """q,k,v: (B, S, H, D) -> (B, S, H, D). Grid: (B*H, S_q/block_q)."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    assert sq % block_q == 0 and sk % block_k == 0
+
+    # (B, S, H, D) -> (B*H, S, D)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
+                               causal=causal, scale=scale, q_block=block_q,
+                               seq_k=sk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=_interpret(),
+    )(qt, kt, vt)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None):
+    """Flash attention with Pallas forward and recompute backward.
+
+    The backward pass re-runs the memory-efficient blockwise recurrence under
+    jax.vjp (FLOPs-for-memory trade, same spirit as jax.checkpoint)."""
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    return flash_attention_fwd_pallas(q, k, v, causal, s)
+
+
+def _flash_fwd_rule(q, k, v, causal, scale):
+    out = flash_attention(q, k, v, causal, scale)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, scale, res, g):
+    from flexflow_tpu.parallel.ring_attention import blockwise_attention
+
+    q, k, v = res
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal,
+                                               scale=s), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

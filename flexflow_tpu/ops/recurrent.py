@@ -1,0 +1,130 @@
+"""Recurrent ops: LSTM, GRU.
+
+Reference: the NMT subsystem's cuDNN-RNN LSTM cells (nmt/lstm.cu, 574 LoC,
+descriptors rnn.h:198-210). TPU design: `lax.scan` over time with fused
+gate matmuls — the per-timestep (B,D)x(D,4H) GEMM rides the MXU and XLA
+pipelines the scan; sequence chunking across devices (the reference's
+LSTM_PER_NODE_LENGTH pipelining) is expressed with the 'pipe' axis utilities
+in parallel/pipeline.py instead of per-timestep device tables.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from flexflow_tpu.ffconst import OperatorType
+from flexflow_tpu.ops.base import Op, WeightSpec
+
+
+class LSTM(Op):
+    op_type = OperatorType.OP_LSTM
+
+    def __init__(self, model, name, inputs, hidden_size: int,
+                 return_sequences: bool = True):
+        super().__init__(model, name, inputs)
+        self.hidden_size = hidden_size
+        self.return_sequences = return_sequences
+        self.in_dim = inputs[0].dims[-1]
+        self.finalize()
+
+    def output_shapes(self):
+        b, s = self.inputs[0].dims[0], self.inputs[0].dims[1]
+        h = self.hidden_size
+        shape = (b, s, h) if self.return_sequences else (b, h)
+        return [shape], [self.inputs[0].dtype]
+
+    def weights(self) -> List[WeightSpec]:
+        d, h = self.in_dim, self.hidden_size
+        return [
+            WeightSpec("wx", (d, 4 * h), init="glorot", fan=(d, 4 * h)),
+            WeightSpec("wh", (h, 4 * h), init="glorot", fan=(h, 4 * h)),
+            WeightSpec("bias", (4 * h,), init="zero"),
+        ]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        x = xs[0]  # (B, S, D)
+        b = x.shape[0]
+        h = self.hidden_size
+        wx, wh, bias = params["wx"], params["wh"], params["bias"]
+        # precompute input contributions for all timesteps in one big GEMM
+        xg = jnp.einsum("bsd,dk->bsk", x, wx) + bias  # (B, S, 4H)
+
+        def cell(carry, xg_t):
+            h_prev, c_prev = carry
+            gates = xg_t + h_prev @ wh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = (jax.nn.sigmoid(t) for t in (i, f, o))
+            g = jnp.tanh(g)
+            c = f * c_prev + i * g
+            h_new = o * jnp.tanh(c)
+            return (h_new, c), h_new
+
+        h0 = jnp.zeros((b, h), x.dtype)
+        c0 = jnp.zeros((b, h), x.dtype)
+        (_, _), hs = lax.scan(cell, (h0, c0), xg.transpose(1, 0, 2))
+        out = hs.transpose(1, 0, 2)  # (B, S, H)
+        return [out if self.return_sequences else out[:, -1]]
+
+    def partitionable_output_dims(self):
+        return [0]  # batch only; seq is the recurrence, hidden in weights
+
+    def flops(self):
+        b, s = self.inputs[0].dims[0], self.inputs[0].dims[1]
+        return 2 * b * s * 4 * self.hidden_size * (self.in_dim + self.hidden_size)
+
+
+class GRU(Op):
+    op_type = OperatorType.OP_GRU
+
+    def __init__(self, model, name, inputs, hidden_size: int,
+                 return_sequences: bool = True):
+        super().__init__(model, name, inputs)
+        self.hidden_size = hidden_size
+        self.return_sequences = return_sequences
+        self.in_dim = inputs[0].dims[-1]
+        self.finalize()
+
+    def output_shapes(self):
+        b, s = self.inputs[0].dims[0], self.inputs[0].dims[1]
+        h = self.hidden_size
+        shape = (b, s, h) if self.return_sequences else (b, h)
+        return [shape], [self.inputs[0].dtype]
+
+    def weights(self) -> List[WeightSpec]:
+        d, h = self.in_dim, self.hidden_size
+        return [
+            WeightSpec("wx", (d, 3 * h), init="glorot", fan=(d, 3 * h)),
+            WeightSpec("wh", (h, 3 * h), init="glorot", fan=(h, 3 * h)),
+            WeightSpec("bias", (3 * h,), init="zero"),
+        ]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        x = xs[0]
+        b, h = x.shape[0], self.hidden_size
+        wx, wh, bias = params["wx"], params["wh"], params["bias"]
+        xg = jnp.einsum("bsd,dk->bsk", x, wx) + bias
+
+        def cell(h_prev, xg_t):
+            xr, xz, xn = jnp.split(xg_t, 3, axis=-1)
+            hr, hz, hn = jnp.split(h_prev @ wh, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h_new = (1 - z) * n + z * h_prev
+            return h_new, h_new
+
+        h0 = jnp.zeros((b, h), x.dtype)
+        _, hs = lax.scan(cell, h0, xg.transpose(1, 0, 2))
+        out = hs.transpose(1, 0, 2)
+        return [out if self.return_sequences else out[:, -1]]
+
+    def partitionable_output_dims(self):
+        return [0]
+
+    def flops(self):
+        b, s = self.inputs[0].dims[0], self.inputs[0].dims[1]
+        return 2 * b * s * 3 * self.hidden_size * (self.in_dim + self.hidden_size)

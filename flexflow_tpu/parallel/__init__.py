@@ -1,2 +1,19 @@
 from flexflow_tpu.parallel.pconfig import ParallelConfig  # noqa: F401
 from flexflow_tpu.parallel.mesh import make_mesh, default_mesh  # noqa: F401
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """shard_map across JAX versions: new jax.shard_map takes check_vma,
+    older jax.experimental.shard_map takes check_rep."""
+    import jax as _jax
+
+    if hasattr(_jax, "shard_map"):
+        return _jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    try:
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+    except TypeError:
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)

@@ -1,0 +1,95 @@
+"""Pipeline parallelism over the 'pipe' mesh axis.
+
+The reference has pipelining only in its hand-rolled NMT subsystem (sequence
+chunked LSTM_PER_NODE_LENGTH=10 per device, per-(layer,timestep)
+ParallelConfig tables — nmt/rnn.h:21-63). TPU re-design: a circulating
+(collective-permute) GPipe loop inside shard_map — every device holds ONE
+stage's params (stacked params sharded on dim 0 over 'pipe'); microbatches
+ripple through the ring via `lax.ppermute`; the whole schedule is a
+`lax.scan`, so it jits into one XLA program and autodiff gives pipelined
+backward for free.
+
+Constraint (classic for this scheme): all stages share one activation shape.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def gpipe_loop(stage_fn: Callable, stage_params, x_mb, axis_name: str):
+    """Run inside shard_map. stage_params: this device's stage params (pytree,
+    leading stage dim already stripped). x_mb: (num_micro, mb, ...) — the full
+    microbatched input (replicated; only stage 0 reads it). Returns
+    (num_micro, mb, ...) outputs (valid on the LAST stage; use
+    `pipeline()` below for the replicated gather)."""
+    n_stage = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    num_micro = x_mb.shape[0]
+    steps = num_micro + n_stage - 1
+    mb_shape = x_mb.shape[1:]
+
+    from flexflow_tpu.parallel.ring_attention import pvary
+
+    buf0 = jnp.zeros(mb_shape, x_mb.dtype)  # activation arriving at this stage
+    out0 = jnp.zeros_like(x_mb)
+    buf0, out0 = pvary(buf0, axis_name), pvary(out0, axis_name)
+    perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+
+    def step(carry, t):
+        buf, outs = carry
+        # stage 0 ingests microbatch t (clamped; bubbles compute garbage that
+        # is never written out)
+        mb_idx = jnp.clip(t, 0, num_micro - 1)
+        inp = jnp.where(idx == 0, x_mb[mb_idx], buf)
+        y = stage_fn(stage_params, inp)
+        # last stage completed microbatch t-(n_stage-1) this step
+        done_idx = t - (n_stage - 1)
+        write = jnp.logical_and(idx == n_stage - 1, done_idx >= 0)
+        outs = lax.cond(
+            write,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, y, jnp.clip(done_idx, 0, num_micro - 1), 0),
+            lambda o: o, outs)
+        buf_next = lax.ppermute(y, axis_name, perm)
+        return (buf_next, outs), None
+
+    (_, outs), _ = lax.scan(step, (buf0, out0), jnp.arange(steps))
+    return outs
+
+
+def pipeline(stage_fn: Callable, stacked_params, x, mesh, axis_name: str = "pipe",
+             num_microbatches: int = None):
+    """User-facing pipelined apply.
+
+    stage_fn(params_i, x) -> y with y.shape == x.shape
+    stacked_params: pytree with leading dim = num_stages
+    x: (batch, ...) global input. Returns (batch, ...) output.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n_stage = mesh.shape[axis_name]
+    num_micro = num_microbatches or n_stage
+    b = x.shape[0]
+    assert b % num_micro == 0, f"batch {b} % microbatches {num_micro}"
+    x_mb = x.reshape(num_micro, b // num_micro, *x.shape[1:])
+
+    def inner(params, xm):
+        params = jax.tree_util.tree_map(lambda a: a[0], params)  # strip stage dim
+        outs = gpipe_loop(stage_fn, params, xm, axis_name)
+        # broadcast final outputs from the last stage to all stages so the
+        # result is replicated over 'pipe' (psum of one-hot contribution)
+        idx = lax.axis_index(axis_name)
+        contrib = jnp.where(idx == n_stage - 1, outs, jnp.zeros_like(outs))
+        return lax.psum(contrib, axis_name)
+
+    from flexflow_tpu.parallel import shard_map_compat
+
+    pspec = jax.tree_util.tree_map(
+        lambda a: P("pipe", *([None] * (a.ndim - 1))), stacked_params)
+    out = shard_map_compat(inner, mesh, (pspec, P()), P())(stacked_params, x_mb)
+    return out.reshape(b, *out.shape[2:])

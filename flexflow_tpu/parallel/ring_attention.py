@@ -1,0 +1,176 @@
+"""Ring attention: sequence/context parallelism over the 'seq' mesh axis.
+
+Net-new capability vs the reference, whose attention asserts batch-only
+partitioning (reference: src/ops/attention.cu:118-120; SURVEY §5.7). Design:
+K/V shards rotate around the ICI ring via `jax.lax.ppermute` while each
+device's Q shard accumulates attention with online-softmax rescaling
+(blockwise/flash-style running max/sum), so sequence length scales with the
+number of devices at O(S/P) activation memory per chip and compute overlaps
+the rotation.
+
+Also provides the Ulysses lowering (all-to-all head<->seq swap) as the
+alternative SP strategy, and a blockwise local attention step shared by both.
+
+All functions here must be called INSIDE shard_map (they use axis_name
+collectives); flexflow_tpu/ops/attention.py wires them into MultiHeadAttention
+when the strategy shards the sequence dim.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def pvary(x, axis_name):
+    """Mark x as device-varying over axis_name (vma typing for scan carries).
+    jax.lax.pvary was renamed to pcast(..., to='varying')."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to="varying")
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, axis_name)
+    return x
+
+
+def _block_attend(q, k, v, m, l, o, scale, mask=None):
+    """One online-softmax accumulation step.
+
+    q: (B, Sq, H, D); k/v: (B, Sk, H, D); m,l: (B, H, Sq); o: (B, Sq, H, D).
+    Returns updated (m, l, o). f32 accumulation regardless of input dtype.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    alpha = jnp.exp(m - m_new)                      # (B, H, Sq)
+    p = jnp.exp(s - m_new[..., None])               # (B, H, Sq, Sk)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Ring self-attention inside shard_map.
+
+    q, k, v: (B, S_local, H, D) — the local sequence shard.
+    Rotates K/V left around `axis_name`; after P steps every Q shard has
+    attended to the full sequence.
+    """
+    p_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    o0 = jnp.zeros((b, sq, h, d), jnp.float32)
+    # mark the fresh accumulators as device-varying over the ring axis so the
+    # scan carry type matches after the first accumulation step
+    m0, l0, o0 = (pvary(t, axis_name) for t in (m0, l0, o0))
+
+    q_pos = my_idx * sq + jnp.arange(sq)  # global positions of local queries
+
+    def step(carry, step_idx):
+        m, l, o, k_cur, v_cur = carry
+        # k_cur currently holds the shard originally owned by (my_idx + step)
+        src = (my_idx + step_idx) % p_size
+        if causal:
+            k_pos = src * sk + jnp.arange(sk)
+            mask = q_pos[:, None] >= k_pos[None, :]          # (Sq, Sk)
+            mask = mask[None, None, :, :]                    # (1,1,Sq,Sk)
+        else:
+            mask = None
+        m, l, o = _block_attend(q, k_cur, v_cur, m, l, o, scale, mask)
+        # rotate: receive the next shard from the right neighbor
+        perm = [(i, (i - 1) % p_size) for i in range(p_size)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (m, l, o, k_nxt, v_nxt), None
+
+    (m, l, o, _, _), _ = lax.scan(step, (m0, l0, o0, k, v),
+                                  jnp.arange(p_size))
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
+                      scale: Optional[float] = None):
+    """Ulysses (DeepSpeed-style) SP inside shard_map: all-to-all swaps the
+    sequence shard for a head shard, attention runs with full sequence on
+    1/P of the heads, then swaps back. Requires num_heads % P == 0."""
+    p_size = lax.axis_size(axis_name)
+    b, sq, h, d = q.shape
+    assert h % p_size == 0, f"heads {h} not divisible by seq-parallel {p_size}"
+
+    def seq2head(x):
+        # (B, S/P, H, D) -> (B, S, H/P, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def head2seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qf, kf, vf = seq2head(q), seq2head(k), seq2head(v)
+    out = blockwise_attention(qf, kf, vf, causal=causal, scale=scale)
+    return head2seq(out)
+
+
+def blockwise_attention(q, k, v, causal: bool = False,
+                        scale: Optional[float] = None,
+                        block_size: int = 512):
+    """Memory-efficient local attention: lax.scan over K/V blocks with online
+    softmax (flash-attention recurrence in pure JAX — XLA keeps the working
+    set at O(block) and fuses; the Pallas kernel in ops/pallas_kernels.py is
+    the hand-tiled variant used on TPU when shapes allow)."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if sk <= block_size:
+        mask = None
+        if causal:
+            mask = (jnp.arange(sq)[:, None] + (sk - sq)
+                    >= jnp.arange(sk)[None, :])[None, None]
+        m, l, o = _block_attend(
+            q, k, v,
+            jnp.full((b, h, sq), NEG_INF, jnp.float32),
+            jnp.zeros((b, h, sq), jnp.float32),
+            jnp.zeros((b, sq, h, d), jnp.float32), scale, mask)
+        return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+    nblocks = (sk + block_size - 1) // block_size
+    assert sk % block_size == 0, f"seq {sk} % block {block_size} != 0"
+    kb = k.reshape(b, nblocks, block_size, h, d)
+    vb = v.reshape(b, nblocks, block_size, h, d)
+    q_pos = jnp.arange(sq) + (sk - sq)  # align causal diag when sq != sk
+
+    def step(carry, blk):
+        m, l, o = carry
+        k_cur, v_cur, blk_idx = blk
+        mask = None
+        if causal:
+            k_pos = blk_idx * block_size + jnp.arange(block_size)
+            mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+        m, l, o = _block_attend(q, k_cur, v_cur, m, l, o, scale, mask)
+        return (m, l, o), None
+
+    init = (jnp.full((b, h, sq), NEG_INF, jnp.float32),
+            jnp.zeros((b, h, sq), jnp.float32),
+            jnp.zeros((b, sq, h, d), jnp.float32))
+    (m, l, o), _ = lax.scan(
+        step, init,
+        (kb.transpose(1, 0, 2, 3, 4), vb.transpose(1, 0, 2, 3, 4),
+         jnp.arange(nblocks)))
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)

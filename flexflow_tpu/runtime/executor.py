@@ -187,12 +187,19 @@ class GraphExecutor:
             p = params.get(op.name, {})
             if bf16:
                 p = {k: to_compute(v) for k, v in p.items()}
+            kwargs = {}
+            if getattr(op, "wants_shard_ctx", False):
+                kwargs["shard_ctx"] = {
+                    "mesh": self.mesh,
+                    "axis_map": self._op_axis_maps.get(op.name, {}),
+                    "sp_mode": getattr(self.model.config, "sp_mode", "ring"),
+                }
             if op.stateful:
                 outs, ns = op.forward_stateful(p, state.get(op.name, {}), xs,
                                                training=training, rng=op_rng)
                 new_state[op.name] = ns
             else:
-                outs = op.forward(p, xs, training=training, rng=op_rng)
+                outs = op.forward(p, xs, training=training, rng=op_rng, **kwargs)
             sharding = self.op_output_sharding(op)
             for i, t in enumerate(op.outputs):
                 v = outs[i]
@@ -212,6 +219,8 @@ class GraphExecutor:
                         label_key="label"):
         input_ops = [op for op in self.model.ops if isinstance(op, InputOp)]
 
+        aux_tensors = list(getattr(self.model, "_aux_tensors", ()))
+
         def step(params, opt_state, state, batch, rng):
             def loss_fn(p):
                 input_values = {op.outputs[0]: batch[op.name] for op in input_ops}
@@ -219,6 +228,8 @@ class GraphExecutor:
                     p, state, input_values, training=True, rng=rng)
                 logits = vals[final_tensor]
                 loss = compute_loss(loss_type, logits, batch[label_key])
+                for t in aux_tensors:  # e.g. MoE load-balancing losses
+                    loss = loss + vals[t]
                 mets = batch_metrics(loss_type, metric_types, logits,
                                      batch[label_key])
                 return loss, (new_state, mets)

@@ -1,0 +1,97 @@
+"""LSTM/GRU golden tests vs torch + pallas flash attention (interpret mode)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel
+
+
+def test_lstm_matches_torch():
+    torch = pytest.importorskip("torch")
+    B, S, D, H = 2, 6, 8, 12
+    rs = np.random.RandomState(0)
+    x = rs.randn(B, S, D).astype(np.float32)
+
+    cfg = FFConfig(batch_size=B, mesh_shape={"data": 1})
+    ff = FFModel(cfg)
+    xt = ff.create_tensor([B, S, D], name="x")
+    out = ff.lstm(xt, H, name="lstm")
+    ff.compile(optimizer=None, final_tensor=out)
+
+    ref = torch.nn.LSTM(D, H, batch_first=True)
+    # torch gate order: i, f, g, o — same as ours
+    wx = ref.weight_ih_l0.detach().numpy().T  # (D, 4H)
+    wh = ref.weight_hh_l0.detach().numpy().T
+    bias = (ref.bias_ih_l0 + ref.bias_hh_l0).detach().numpy()
+    ff.set_weights("lstm", "wx", wx)
+    ff.set_weights("lstm", "wh", wh)
+    ff.set_weights("lstm", "bias", bias)
+
+    got = np.asarray(ff.predict({"x": x}))
+    with torch.no_grad():
+        want, _ = ref(torch.from_numpy(x))
+    np.testing.assert_allclose(got, want.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_gru_matches_torch():
+    torch = pytest.importorskip("torch")
+    B, S, D, H = 2, 5, 8, 10
+    rs = np.random.RandomState(1)
+    x = rs.randn(B, S, D).astype(np.float32)
+
+    cfg = FFConfig(batch_size=B, mesh_shape={"data": 1})
+    ff = FFModel(cfg)
+    xt = ff.create_tensor([B, S, D], name="x")
+    out = ff.gru(xt, H, name="gru")
+    ff.compile(optimizer=None, final_tensor=out)
+
+    ref = torch.nn.GRU(D, H, batch_first=True)
+    ff.set_weights("gru", "wx", ref.weight_ih_l0.detach().numpy().T)
+    ff.set_weights("gru", "wh", ref.weight_hh_l0.detach().numpy().T)
+    # torch keeps separate ih/hh biases; our cell folds ih bias into xg and
+    # applies hh bias inside the recurrence only via wh @ h (hn term differs) —
+    # set hh bias to zero in the reference for an exact comparison
+    with torch.no_grad():
+        ref.bias_hh_l0.zero_()
+    ff.set_weights("gru", "bias", ref.bias_ih_l0.detach().numpy())
+
+    got = np.asarray(ff.predict({"x": x}))
+    with torch.no_grad():
+        want, _ = ref(torch.from_numpy(x))
+    np.testing.assert_allclose(got, want.numpy(), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_matches_dense(causal):
+    from flexflow_tpu.ops.pallas_kernels import flash_attention
+
+    B, S, H, D = 2, 128, 4, 16
+    rs = np.random.RandomState(2)
+    q = rs.randn(B, S, H, D).astype(np.float32)
+    k = rs.randn(B, S, H, D).astype(np.float32)
+    v = rs.randn(B, S, H, D).astype(np.float32)
+
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    if causal:
+        mask = np.tril(np.ones((S, S), bool))
+        s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bkhd->bqhd", p, v)
+
+    got = np.asarray(flash_attention(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), causal))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_grads():
+    from flexflow_tpu.ops.pallas_kernels import flash_attention
+
+    B, S, H, D = 1, 64, 2, 8
+    rs = np.random.RandomState(3)
+    q = jnp.asarray(rs.randn(B, S, H, D).astype(np.float32))
+
+    g = jax.grad(lambda a: jnp.sum(flash_attention(a, a, a, True) ** 2))(q)
+    assert np.isfinite(np.asarray(g)).all()
