@@ -1,0 +1,38 @@
+"""MNIST CNN via the Keras frontend with accuracy gate
+(reference: examples/python/keras/mnist_cnn.py + accuracy callback).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.keras import Sequential
+from flexflow_tpu.keras.callbacks import EpochVerifyMetrics, ModelAccuracy
+from flexflow_tpu.keras.datasets import mnist
+from flexflow_tpu.keras.layers import Conv2D, Dense, Flatten, MaxPooling2D
+
+
+def main():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 1, 28, 28).astype(np.float32) / 255.0
+
+    model = Sequential([
+        Conv2D(32, 3, padding="same", activation="relu",
+               input_shape=(1, 28, 28)),
+        Conv2D(64, 3, padding="same", activation="relu"),
+        MaxPooling2D(2),
+        Flatten(),
+        Dense(128, activation="relu"),
+        Dense(10),
+    ])
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x_train, y_train, epochs=4,
+              callbacks=[EpochVerifyMetrics(ModelAccuracy.MNIST_CNN)])
+
+
+if __name__ == "__main__":
+    main()

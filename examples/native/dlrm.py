@@ -1,0 +1,49 @@
+"""DLRM app (reference: examples/cpp/DLRM/dlrm.cc, run_summit.sh config).
+
+Run: python examples/native/dlrm.py [-b BATCH] [--arch-embedding-size N]...
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.models.dlrm import dlrm
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--arch-sparse-feature-size", type=int, default=64)
+    p.add_argument("--arch-embedding-size", type=int, default=100000)
+    p.add_argument("--num-tables", type=int, default=8)
+    args, _ = p.parse_known_args()
+    cfg = FFConfig.parse_args()
+
+    ff = FFModel(cfg)
+    dense_in, sparse_ins, out = dlrm(
+        ff, cfg.batch_size,
+        embedding_size=args.arch_sparse_feature_size,
+        embedding_entries=args.arch_embedding_size,
+        num_tables=args.num_tables)
+    ff.compile(SGDOptimizer(lr=cfg.learning_rate),
+               LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+               [MetricsType.METRICS_MEAN_SQUARED_ERROR], final_tensor=out)
+
+    rs = np.random.RandomState(0)
+    n = cfg.batch_size * 8
+    SingleDataLoader(ff, dense_in, rs.randn(n, 64).astype(np.float32))
+    for i, s in enumerate(sparse_ins):
+        SingleDataLoader(ff, s, rs.randint(
+            0, args.arch_embedding_size, (n, 1)).astype(np.int32))
+    SingleDataLoader(ff, ff.label_tensor,
+                     rs.rand(n, 1).astype(np.float32))
+    ff.fit(epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""MNIST MLP via the native API (reference: examples/python/native/mnist_mlp.py).
+
+Run: python examples/native/mnist_mlp.py [-e EPOCHS] [-b BATCH]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu import (ActiMode, FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.keras.datasets import mnist
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 784], name="x")
+    t = ff.dense(x, 512, ActiMode.AC_MODE_RELU, name="fc1")
+    t = ff.dense(t, 512, ActiMode.AC_MODE_RELU, name="fc2")
+    t = ff.dense(t, 10, name="fc3")
+    ff.compile(SGDOptimizer(lr=cfg.learning_rate),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY])
+
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train.astype(np.int32).reshape(-1, 1)
+    SingleDataLoader(ff, x, x_train)
+    SingleDataLoader(ff, ff.label_tensor, y_train)
+    ff.init_layers()
+    ff.fit(epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

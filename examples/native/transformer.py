@@ -1,0 +1,53 @@
+"""Transformer benchmark app (reference: examples/cpp/Transformer/
+transformer.cc — encoder-decoder, hidden 512, 16 heads, 12 layers, seq 128,
+MSE head, SGD 0.01).
+
+Run: python examples/native/transformer.py [--num-layers N] [--hidden-size H]
+     [--sequence-length S] [--num-heads A] [-b BATCH] [--budget N]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.models.transformer import (TransformerConfig,
+                                             build_reference_transformer)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-layers", type=int, default=12)
+    p.add_argument("--hidden-size", type=int, default=512)
+    p.add_argument("--sequence-length", type=int, default=128)
+    p.add_argument("--num-heads", type=int, default=16)
+    args, _ = p.parse_known_args()
+    cfg = FFConfig.parse_args()
+    tf_cfg = TransformerConfig(hidden_size=args.hidden_size,
+                               num_heads=args.num_heads,
+                               num_layers=args.num_layers,
+                               sequence_length=args.sequence_length)
+
+    ff = FFModel(cfg)
+    x, out = build_reference_transformer(ff, cfg.batch_size, tf_cfg)
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+               [MetricsType.METRICS_MEAN_SQUARED_ERROR], final_tensor=out)
+
+    rs = np.random.RandomState(0)
+    n = cfg.batch_size * 4
+    xd = rs.randn(n, tf_cfg.sequence_length,
+                  tf_cfg.hidden_size).astype(np.float32)
+    yd = rs.randn(n, tf_cfg.sequence_length, 1).astype(np.float32)
+    SingleDataLoader(ff, x, xd)
+    SingleDataLoader(ff, ff.label_tensor, yd)
+    ff.fit(epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

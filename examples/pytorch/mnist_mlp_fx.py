@@ -1,0 +1,50 @@
+"""PyTorch-FX import flow (reference: examples/python/pytorch/mnist_mlp_pt.py
++ fx.torch_to_flexflow): define a torch module, export .ff, replay, train.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import torch.nn as nn
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.keras.datasets import mnist
+from flexflow_tpu.torch import PyTorchModel, torch_to_flexflow
+
+
+class MLP(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(784, 512)
+        self.relu1 = nn.ReLU()
+        self.fc2 = nn.Linear(512, 512)
+        self.relu2 = nn.ReLU()
+        self.fc3 = nn.Linear(512, 10)
+
+    def forward(self, x):
+        return self.fc3(self.relu2(self.fc2(self.relu1(self.fc1(x)))))
+
+
+def main():
+    torch_to_flexflow(MLP(), "/tmp/mnist_mlp.ff")
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 784], name="x")
+    outs = PyTorchModel("/tmp/mnist_mlp.ff").apply(ff, [x])
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=outs[0])
+
+    (x_train, y_train), _ = mnist.load_data()
+    SingleDataLoader(ff, x, x_train.reshape(-1, 784).astype(np.float32) / 255.0)
+    SingleDataLoader(ff, ff.label_tensor,
+                     y_train.astype(np.int32).reshape(-1, 1))
+    ff.fit(epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

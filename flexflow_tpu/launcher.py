@@ -1,0 +1,77 @@
+"""Multi-host launch driver.
+
+Reference: python/flexflow.py — wraps `mpirun -npernode 1 flexflow_python
+-ll:py 1 -ll:gpu N -ll:fsize ...` (flexflow.py:24-99). TPU analog: multi-
+controller JAX. On a TPU pod each host runs the SAME script;
+`jax.distributed.initialize()` wires the hosts; GSPMD handles cross-host
+(DCN) collectives. This driver:
+
+  * single host: exec the script with the requested device env
+  * multi host (--coordinator given or TPU pod env detected): call
+    jax.distributed.initialize(...) then exec
+
+Usage: python -m flexflow_tpu.launcher script.py [--num-processes N]
+       [--process-id I] [--coordinator host:port] [-- script args...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="flexflow_tpu.launcher")
+    p.add_argument("script")
+    p.add_argument("--num-processes", type=int, default=None,
+                   help="total controller processes (hosts)")
+    p.add_argument("--process-id", type=int, default=None)
+    p.add_argument("--coordinator", type=str, default=None,
+                   help="host:port of process 0")
+    p.add_argument("--cpu-devices", type=int, default=None,
+                   help="emulate N CPU devices (testing)")
+    args, rest = p.parse_known_args(argv)
+    if rest and rest[0] == "--":
+        rest = rest[1:]
+
+    if args.cpu_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.cpu_devices}")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+
+    # multi-host pod detection: require an actual multi-worker signal (a
+    # single-chip dev box can still carry TPU env vars)
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    pod_env = (not args.cpu_devices) and (
+        "," in hostnames or bool(os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")))
+    if args.coordinator or (args.num_processes and args.num_processes > 1):
+        if args.coordinator and (args.num_processes is None
+                                 or args.process_id is None) and not pod_env:
+            p.error("--coordinator requires --num-processes and --process-id "
+                    "(they cannot be auto-detected outside TPU/SLURM "
+                    "environments)")
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id)
+    elif pod_env:
+        # TPU pod: every host runs this same script; initialize with full
+        # auto-detection (docstring's 'TPU pod env detected' path)
+        import jax
+
+        jax.distributed.initialize()
+
+    sys.argv = [args.script] + rest
+    runpy.run_path(args.script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

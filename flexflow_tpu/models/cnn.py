@@ -1,0 +1,127 @@
+"""CNN model zoo: AlexNet, ResNet-50, InceptionV3.
+
+Reference apps: examples/cpp/AlexNet/alexnet.cc:34-130 (canonical train
+loop), examples/cpp/ResNet/resnet.cc (BottleneckBlock), examples/cpp/
+InceptionV3/inception.cc (branchy graph — the op-parallel search showcase).
+All NCHW through the native builder API.
+"""
+
+from __future__ import annotations
+
+from flexflow_tpu.ffconst import ActiMode, PoolType
+from flexflow_tpu.model import FFModel
+
+
+def alexnet(ff: FFModel, batch_size: int, num_classes: int = 1000):
+    """reference: alexnet.cc:43-72 (229x229 input variant)."""
+    x = ff.create_tensor([batch_size, 3, 229, 229], name="input")
+    t = ff.conv2d(x, 64, 11, 11, 4, 4, 2, 2, ActiMode.AC_MODE_RELU, name="conv1")
+    t = ff.pool2d(t, 3, 3, 2, 2, 0, 0, name="pool1")
+    t = ff.conv2d(t, 192, 5, 5, 1, 1, 2, 2, ActiMode.AC_MODE_RELU, name="conv2")
+    t = ff.pool2d(t, 3, 3, 2, 2, 0, 0, name="pool2")
+    t = ff.conv2d(t, 384, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU, name="conv3")
+    t = ff.conv2d(t, 256, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU, name="conv4")
+    t = ff.conv2d(t, 256, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU, name="conv5")
+    t = ff.pool2d(t, 3, 3, 2, 2, 0, 0, name="pool5")
+    t = ff.flat(t)
+    t = ff.dense(t, 4096, ActiMode.AC_MODE_RELU, name="fc6")
+    t = ff.dense(t, 4096, ActiMode.AC_MODE_RELU, name="fc7")
+    t = ff.dense(t, num_classes, name="fc8")
+    return x, t
+
+
+def alexnet_cifar10(ff: FFModel, batch_size: int):
+    """bootcamp_demo CIFAR10 AlexNet (32x32), the accuracy-gate config."""
+    x = ff.create_tensor([batch_size, 3, 32, 32], name="input")
+    t = ff.conv2d(x, 64, 5, 5, 1, 1, 2, 2, ActiMode.AC_MODE_RELU, name="conv1")
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0, name="pool1")
+    t = ff.conv2d(t, 192, 5, 5, 1, 1, 2, 2, ActiMode.AC_MODE_RELU, name="conv2")
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0, name="pool2")
+    t = ff.conv2d(t, 256, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU, name="conv3")
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0, name="pool3")
+    t = ff.flat(t)
+    t = ff.dense(t, 512, ActiMode.AC_MODE_RELU, name="fc1")
+    t = ff.dense(t, 10, name="fc2")
+    return x, t
+
+
+def _bottleneck(ff, t, out_channels, stride, i, downsample):
+    """reference: resnet.cc BottleneckBlock — 1x1 reduce, 3x3, 1x1 expand,
+    projection shortcut on stride/width change; BN after each conv."""
+    shortcut = t
+    c = out_channels
+    b = ff.conv2d(t, c, 1, 1, 1, 1, 0, 0, name=f"res{i}_br1x1a")
+    b = ff.batch_norm(b, relu=True, name=f"res{i}_bn1")
+    b = ff.conv2d(b, c, 3, 3, stride, stride, 1, 1, name=f"res{i}_br3x3")
+    b = ff.batch_norm(b, relu=True, name=f"res{i}_bn2")
+    b = ff.conv2d(b, 4 * c, 1, 1, 1, 1, 0, 0, name=f"res{i}_br1x1b")
+    b = ff.batch_norm(b, relu=False, name=f"res{i}_bn3")
+    if downsample:
+        shortcut = ff.conv2d(t, 4 * c, 1, 1, stride, stride, 0, 0,
+                             name=f"res{i}_proj")
+        shortcut = ff.batch_norm(shortcut, relu=False, name=f"res{i}_bnp")
+    out = ff.add(b, shortcut, name=f"res{i}_add")
+    return ff.relu(out, name=f"res{i}_relu")
+
+
+def resnet50(ff: FFModel, batch_size: int, num_classes: int = 1000,
+             image_size: int = 224):
+    x = ff.create_tensor([batch_size, 3, image_size, image_size], name="input")
+    t = ff.conv2d(x, 64, 7, 7, 2, 2, 3, 3, name="conv1")
+    t = ff.batch_norm(t, relu=True, name="bn1")
+    t = ff.pool2d(t, 3, 3, 2, 2, 1, 1, name="pool1")
+    i = 0
+    for stage, (c, n, s) in enumerate([(64, 3, 1), (128, 4, 2),
+                                       (256, 6, 2), (512, 3, 2)]):
+        for blk in range(n):
+            stride = s if blk == 0 else 1
+            t = _bottleneck(ff, t, c, stride, i, downsample=(blk == 0))
+            i += 1
+    # global average pool
+    h = t.dims[2]
+    t = ff.pool2d(t, h, h, 1, 1, 0, 0, PoolType.POOL_AVG, name="gap")
+    t = ff.flat(t)
+    t = ff.dense(t, num_classes, name="fc")
+    return x, t
+
+
+def _inception_a(ff, t, pool_c, i):
+    """reference: inception.cc InceptionA — 4 branches concat'd."""
+    b1 = ff.conv2d(t, 64, 1, 1, 1, 1, 0, 0, ActiMode.AC_MODE_RELU,
+                   name=f"iA{i}_b1")
+    b2 = ff.conv2d(t, 48, 1, 1, 1, 1, 0, 0, ActiMode.AC_MODE_RELU,
+                   name=f"iA{i}_b2a")
+    b2 = ff.conv2d(b2, 64, 5, 5, 1, 1, 2, 2, ActiMode.AC_MODE_RELU,
+                   name=f"iA{i}_b2b")
+    b3 = ff.conv2d(t, 64, 1, 1, 1, 1, 0, 0, ActiMode.AC_MODE_RELU,
+                   name=f"iA{i}_b3a")
+    b3 = ff.conv2d(b3, 96, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU,
+                   name=f"iA{i}_b3b")
+    b3 = ff.conv2d(b3, 96, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU,
+                   name=f"iA{i}_b3c")
+    b4 = ff.pool2d(t, 3, 3, 1, 1, 1, 1, PoolType.POOL_AVG, name=f"iA{i}_b4a")
+    b4 = ff.conv2d(b4, pool_c, 1, 1, 1, 1, 0, 0, ActiMode.AC_MODE_RELU,
+                   name=f"iA{i}_b4b")
+    return ff.concat([b1, b2, b3, b4], axis=1, name=f"iA{i}_cat")
+
+
+def inception_v3_stem(ff: FFModel, batch_size: int, num_classes: int = 1000):
+    """InceptionV3 stem + 3x InceptionA + head (abridged but faithfully
+    branchy — the op-parallel benefit shows in the A-blocks; reference
+    inception.cc builds the full tower the same way)."""
+    x = ff.create_tensor([batch_size, 3, 299, 299], name="input")
+    t = ff.conv2d(x, 32, 3, 3, 2, 2, 0, 0, ActiMode.AC_MODE_RELU, name="c1")
+    t = ff.conv2d(t, 32, 3, 3, 1, 1, 0, 0, ActiMode.AC_MODE_RELU, name="c2")
+    t = ff.conv2d(t, 64, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU, name="c3")
+    t = ff.pool2d(t, 3, 3, 2, 2, 0, 0, name="p1")
+    t = ff.conv2d(t, 80, 1, 1, 1, 1, 0, 0, ActiMode.AC_MODE_RELU, name="c4")
+    t = ff.conv2d(t, 192, 3, 3, 1, 1, 0, 0, ActiMode.AC_MODE_RELU, name="c5")
+    t = ff.pool2d(t, 3, 3, 2, 2, 0, 0, name="p2")
+    t = _inception_a(ff, t, 32, 0)
+    t = _inception_a(ff, t, 64, 1)
+    t = _inception_a(ff, t, 64, 2)
+    h = t.dims[2]
+    t = ff.pool2d(t, h, h, 1, 1, 0, 0, PoolType.POOL_AVG, name="gap")
+    t = ff.flat(t)
+    t = ff.dense(t, num_classes, name="fc")
+    return x, t

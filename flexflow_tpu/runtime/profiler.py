@@ -1,0 +1,108 @@
+"""Profiling / tracing.
+
+Reference observability (SURVEY §5.1): per-op cudaEvent timing behind
+--profiling (linear.cu:526-553), simulator DOT export (--taskgraph), Legion
+-lg:prof logs. TPU equivalents:
+
+  * profile_step: op-by-op eager execution with wall timers — the analog of
+    the per-op printf path (the jitted program can't be timed per-op, so this
+    deliberately runs unfused)
+  * xla_trace: jax.profiler context writing a Perfetto/TensorBoard trace dir
+    (the -lg:prof analog)
+  * export_taskgraph: the op graph + strategy as Graphviz DOT (the
+    simulator's DotFile analog, simulator.h:78-131)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List
+
+import jax
+
+
+def profile_step(model, batch: Dict, iters: int = 3) -> List[dict]:
+    """Run the forward graph op-by-op (unfused) and time each op.
+    Returns [{op, type, ms, output_shape}] sorted by cost."""
+    from flexflow_tpu.ops.base import InputOp
+
+    ex = model.executor
+    sharded = ex.shard_batch(batch)
+    input_ops = {op.name: op for op in model.ops if isinstance(op, InputOp)}
+    vals = {}
+    for name, op in input_ops.items():
+        if name in sharded:
+            vals[op.outputs[0]] = sharded[name]
+    rows = []
+    rng = jax.random.PRNGKey(0)
+    for idx, op in enumerate(model.ops):
+        if isinstance(op, InputOp):
+            continue
+        xs = [vals[t] for t in op.inputs]
+        p = model.params.get(op.name, {})
+        op_rng = jax.random.fold_in(rng, idx) if op.needs_rng else None
+
+        def run():
+            if op.stateful:
+                outs, _ = op.forward_stateful(
+                    p, model.bn_state.get(op.name, {}), xs,
+                    training=False, rng=op_rng)
+            else:
+                kwargs = {}
+                if getattr(op, "wants_shard_ctx", False):
+                    kwargs["shard_ctx"] = {
+                        "mesh": ex.mesh,
+                        "axis_map": ex._op_axis_maps.get(op.name, {}),
+                        "sp_mode": getattr(model.config, "sp_mode", "ring")}
+                outs = op.forward(p, xs, training=False, rng=op_rng, **kwargs)
+            return outs
+
+        outs = run()  # warmup/compile
+        jax.block_until_ready(outs)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            outs = run()
+        jax.block_until_ready(outs)
+        ms = (time.perf_counter() - t0) / iters * 1e3
+        for i, t in enumerate(op.outputs):
+            vals[t] = outs[i]
+        rows.append({"op": op.name, "type": type(op).__name__, "ms": ms,
+                     "output_shape": op.outputs[0].dims})
+    rows.sort(key=lambda r: -r["ms"])
+    return rows
+
+
+@contextlib.contextmanager
+def xla_trace(logdir: str):
+    """Perfetto/TensorBoard trace of whatever runs inside the context."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def export_taskgraph(model, filename: str):
+    """Op graph + strategies as Graphviz DOT (reference DotFile analog)."""
+    from flexflow_tpu.ops.base import InputOp
+
+    lines = ["digraph taskgraph {", "  rankdir=LR;"]
+    for op in model.ops:
+        am = {}
+        if model.executor is not None:
+            am = model.executor._op_axis_maps.get(op.name, {})
+        label = f"{op.name}\\n{type(op).__name__}"
+        used = {a: d for a, d in am.items() if d is not None}
+        if used:
+            label += f"\\n{used}"
+        shape = "box" if isinstance(op, InputOp) else "ellipse"
+        lines.append(f'  "{op.name}" [label="{label}", shape={shape}];')
+    for op in model.ops:
+        for t in op.inputs:
+            if t.owner_op is not None:
+                lines.append(f'  "{t.owner_op.name}" -> "{op.name}";')
+    lines.append("}")
+    with open(filename, "w") as f:
+        f.write("\n".join(lines))
+    return filename
