@@ -43,6 +43,13 @@ class FFConfig:
     enable_parameter_parallel: bool = False
     enable_attribute_parallel: bool = False
 
+    # dataloader (native threaded gather/prefetch; reference's dataloader is
+    # native too — flexflow_dataloader.cc)
+    native_dataloader: bool = True   # fall back to Python slicing if no g++
+    dataloader_shuffle: bool = False  # reference slices sequentially
+    dataloader_threads: int = 2
+    dataloader_prefetch_slots: int = 3
+
     # execution flags
     sp_mode: str = "ring"  # sequence-parallel lowering: "ring" | "ulysses"
     profiling: bool = False

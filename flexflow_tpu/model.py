@@ -431,38 +431,53 @@ class FFModel:
             f"dataset smaller than batch_size "
             f"({min(dl.num_samples for dl in self._dataloaders)} samples < "
             f"{bs}); no full batch to train on")
+        # native threaded prefetch loader (csrc/dataloader.cc); None falls
+        # back to Python slicing
+        from flexflow_tpu.runtime.native_loader import group_loader_for
+        native_dl = group_loader_for(self)
+        if native_dl is not None:
+            num_batches = native_dl.num_batches
         warm = None
         for cb in callbacks:
             cb.set_model(self)
             cb.on_train_begin()
         t0 = time.time()
         total = 0
-        for epoch in range(epochs):
-            for cb in callbacks:
-                cb.on_epoch_begin(epoch)
-            self._perf = PerfMetrics()
-            for dl in self._dataloaders:
-                dl.reset()
-            epoch_mets = []  # device scalars; converted once per epoch so the
-            # host never blocks mid-epoch (keeps XLA dispatch async)
-            for it in range(num_batches):
-                batch = self._stage_batch()
-                loss, mets = self._run_train_step(batch)
-                epoch_mets.append((mets, bs))
-                total += bs
-                if warm is None:
-                    jax.block_until_ready(self.params)
-                    warm = time.time()  # exclude first-step compile from rate
-                    total = 0
-            for mets, bs in epoch_mets:
-                self._perf.update({k: float(v) for k, v in mets.items()}, bs)
-            if verbose:
-                print(f"epoch {epoch}: loss={float(self._last_loss):.4f} "
-                      + self._perf.report(self.loss_type, self.metric_types))
-            # a callback returning True from on_epoch_end stops training
-            # (reference keras/callbacks.py early_stop semantics)
-            if any(cb.on_epoch_end(epoch) for cb in callbacks):
-                break
+        try:
+            for epoch in range(epochs):
+                for cb in callbacks:
+                    cb.on_epoch_begin(epoch)
+                self._perf = PerfMetrics()
+                if native_dl is not None:
+                    if epoch > 0:
+                        native_dl.reset()  # reshuffle + restart prefetch
+                else:
+                    for dl in self._dataloaders:
+                        dl.reset()
+                epoch_mets = []  # device scalars; converted once per epoch so
+                # the host never blocks mid-epoch (keeps XLA dispatch async)
+                for it in range(num_batches):
+                    batch = (native_dl.next_batch() if native_dl is not None
+                             else self._stage_batch())
+                    loss, mets = self._run_train_step(batch)
+                    epoch_mets.append((mets, bs))
+                    total += bs
+                    if warm is None:
+                        jax.block_until_ready(self.params)
+                        warm = time.time()  # exclude first-step compile
+                        total = 0
+                for mets, bs in epoch_mets:
+                    self._perf.update({k: float(v) for k, v in mets.items()}, bs)
+                if verbose:
+                    print(f"epoch {epoch}: loss={float(self._last_loss):.4f} "
+                          + self._perf.report(self.loss_type, self.metric_types))
+                # a callback returning True from on_epoch_end stops training
+                # (reference keras/callbacks.py early_stop semantics)
+                if any(cb.on_epoch_end(epoch) for cb in callbacks):
+                    break
+        finally:
+            if native_dl is not None:
+                native_dl.close()
         jax.block_until_ready(self.params)
         elapsed = time.time() - (warm or t0)
         if total and elapsed > 0 and verbose:
