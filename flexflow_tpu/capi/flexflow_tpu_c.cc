@@ -1,0 +1,691 @@
+/* flexflow_tpu C API implementation: embeds CPython and forwards each call
+ * into the flexflow_tpu Python package (the same runtime the Python surface
+ * uses — mirroring the reference where flexflow_c.cc forwards into FFModel;
+ * reference: python/flexflow_c.cc).
+ *
+ * No numpy C API usage: C buffers become numpy arrays through
+ * memoryview + np.frombuffer, keeping the build dependency-free.
+ */
+
+#include "flexflow_tpu_c.h"
+
+#include <Python.h>
+
+#include <cmath>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+PyObject *g_ff = nullptr;       /* flexflow_tpu package */
+PyObject *g_ffconst = nullptr;  /* flexflow_tpu.ffconst  */
+PyObject *g_np = nullptr;       /* numpy */
+std::string g_err;
+
+void capture_error() {
+  PyObject *t = nullptr, *v = nullptr, *tb = nullptr;
+  PyErr_Fetch(&t, &v, &tb);
+  PyErr_NormalizeException(&t, &v, &tb);
+  g_err = "unknown error";
+  if (v) {
+    PyObject *s = PyObject_Str(v);
+    if (s) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c) g_err = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(t);
+  Py_XDECREF(v);
+  Py_XDECREF(tb);
+}
+
+/* Steal-nothing check: returns o, capturing the Python error when NULL.
+ * On success the stale error is cleared, so fft_last_error() reflects the
+ * most recent call (every API path that can fail goes through ck). */
+PyObject *ck(PyObject *o) {
+  if (!o)
+    capture_error();
+  else
+    g_err.clear();
+  return o;
+}
+
+PyObject *enum_from_int(const char *enum_name, long value) {
+  PyObject *cls = ck(PyObject_GetAttrString(g_ffconst, enum_name));
+  if (!cls) return nullptr;
+  PyObject *r = ck(PyObject_CallFunction(cls, "l", value));
+  Py_DECREF(cls);
+  return r;
+}
+
+PyObject *int_list(const int *v, int n) {
+  PyObject *l = PyList_New(n);
+  for (int i = 0; i < n; ++i) PyList_SET_ITEM(l, i, PyLong_FromLong(v[i]));
+  return l;
+}
+
+/* call obj.<method>(args..., name=name) where args is a new-ref tuple */
+PyObject *call_with_name(PyObject *obj, const char *method, PyObject *args,
+                         const char *name) {
+  PyObject *meth = ck(PyObject_GetAttrString(obj, method));
+  if (!meth) {
+    Py_DECREF(args);
+    return nullptr;
+  }
+  PyObject *kwargs = PyDict_New();
+  if (name) {
+    PyObject *s = PyUnicode_FromString(name);
+    PyDict_SetItemString(kwargs, "name", s);
+    Py_DECREF(s);
+  }
+  PyObject *r = ck(PyObject_Call(meth, args, kwargs));
+  Py_DECREF(meth);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  return r;
+}
+
+template <typename H>
+H wrap(PyObject *o) {
+  H h;
+  h.impl = o;
+  return h;
+}
+
+PyObject *obj(fft_config_t h) { return (PyObject *)h.impl; }
+PyObject *obj(fft_model_t h) { return (PyObject *)h.impl; }
+PyObject *obj(fft_tensor_t h) { return (PyObject *)h.impl; }
+PyObject *obj(fft_optimizer_t h) { return (PyObject *)h.impl; }
+PyObject *obj(fft_dataloader_t h) { return (PyObject *)h.impl; }
+
+/* wrap a C buffer as a (copied) numpy array: np.frombuffer(mv, dt)
+ * .reshape(shape).copy() */
+PyObject *array_from_buffer(const void *data, int64_t nbytes, const char *dt,
+                            PyObject *shape_list) {
+  PyObject *mv = ck(PyMemoryView_FromMemory((char *)data, (Py_ssize_t)nbytes,
+                                            PyBUF_READ));
+  if (!mv) return nullptr;
+  PyObject *flat = ck(PyObject_CallMethod(g_np, "frombuffer", "Os", mv, dt));
+  Py_DECREF(mv);
+  if (!flat) return nullptr;
+  PyObject *shaped = ck(PyObject_CallMethod(flat, "reshape", "O", shape_list));
+  Py_DECREF(flat);
+  if (!shaped) return nullptr;
+  PyObject *copied = ck(PyObject_CallMethod(shaped, "copy", nullptr));
+  Py_DECREF(shaped);
+  return copied;
+}
+
+int run_verb(fft_model_t m, const char *verb) {
+  PyObject *r = ck(PyObject_CallMethod(obj(m), verb, nullptr));
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int fft_init(const char *repo_root) {
+  if (g_ff) return 0;
+  if (!Py_IsInitialized()) Py_Initialize();
+  if (repo_root) {
+    PyObject *sys_path = PySys_GetObject("path"); /* borrowed */
+    PyObject *p = PyUnicode_FromString(repo_root);
+    PyList_Insert(sys_path, 0, p);
+    Py_DECREF(p);
+  }
+  /* Optional platform override before any backend initializes (test rigs
+   * set FFT_JAX_PLATFORMS=cpu + FFT_NUM_CPU_DEVICES=8 for a virtual mesh;
+   * some environments pre-import jax so plain JAX_PLATFORMS is ignored). */
+  PyRun_SimpleString(
+      "import os as _os\n"
+      "if _os.environ.get('FFT_JAX_PLATFORMS'):\n"
+      "    import jax as _jax\n"
+      "    _jax.config.update('jax_platforms',"
+      " _os.environ['FFT_JAX_PLATFORMS'])\n"
+      "    _n = int(_os.environ.get('FFT_NUM_CPU_DEVICES', '0'))\n"
+      "    if _n:\n"
+      "        _jax.config.update('jax_num_cpu_devices', _n)\n");
+  g_np = ck(PyImport_ImportModule("numpy"));
+  g_ff = ck(PyImport_ImportModule("flexflow_tpu"));
+  g_ffconst = ck(PyImport_ImportModule("flexflow_tpu.ffconst"));
+  return (g_ff && g_ffconst && g_np) ? 0 : -1;
+}
+
+void fft_finalize(void) {
+  Py_XDECREF(g_ff);
+  Py_XDECREF(g_ffconst);
+  Py_XDECREF(g_np);
+  g_ff = g_ffconst = g_np = nullptr;
+  if (Py_IsInitialized()) Py_Finalize();
+}
+
+const char *fft_last_error(void) { return g_err.c_str(); }
+
+/* --------------------------------------------------------------- FFConfig */
+
+fft_config_t fft_config_create(int batch_size, int epochs,
+                               const char **mesh_axes, const int *mesh_sizes,
+                               int n_mesh) {
+  PyObject *cls = ck(PyObject_GetAttrString(g_ff, "FFConfig"));
+  if (!cls) return wrap<fft_config_t>(nullptr);
+  PyObject *kwargs = Py_BuildValue("{s:i,s:i}", "batch_size", batch_size,
+                                   "epochs", epochs);
+  if (n_mesh > 0) {
+    PyObject *mesh = PyDict_New();
+    for (int i = 0; i < n_mesh; ++i) {
+      PyObject *sz = PyLong_FromLong(mesh_sizes[i]);
+      PyDict_SetItemString(mesh, mesh_axes[i], sz);
+      Py_DECREF(sz);
+    }
+    PyDict_SetItemString(kwargs, "mesh_shape", mesh);
+    Py_DECREF(mesh);
+  }
+  PyObject *args = PyTuple_New(0);
+  PyObject *cfg = ck(PyObject_Call(cls, args, kwargs));
+  Py_DECREF(cls);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  return wrap<fft_config_t>(cfg);
+}
+
+void fft_config_destroy(fft_config_t h) { Py_XDECREF(obj(h)); }
+
+static long get_int_attr(PyObject *o, const char *attr, long dflt) {
+  PyObject *a = PyObject_GetAttrString(o, attr);
+  if (!a) {
+    PyErr_Clear();
+    return dflt;
+  }
+  long v = PyLong_AsLong(a);
+  Py_DECREF(a);
+  return v;
+}
+
+int fft_config_get_batch_size(fft_config_t h) {
+  return (int)get_int_attr(obj(h), "batch_size", -1);
+}
+
+int fft_config_get_epochs(fft_config_t h) {
+  return (int)get_int_attr(obj(h), "epochs", -1);
+}
+
+int fft_config_get_num_devices(fft_config_t h) {
+  (void)h;
+  PyObject *jax = ck(PyImport_ImportModule("jax"));
+  if (!jax) return -1;
+  PyObject *n = ck(PyObject_CallMethod(jax, "device_count", nullptr));
+  Py_DECREF(jax);
+  if (!n) return -1;
+  int v = (int)PyLong_AsLong(n);
+  Py_DECREF(n);
+  return v;
+}
+
+void fft_config_set_search_budget(fft_config_t h, int budget) {
+  PyObject *v = PyLong_FromLong(budget);
+  PyObject_SetAttrString(obj(h), "search_budget", v);
+  Py_DECREF(v);
+}
+
+void fft_config_set_import_strategy_file(fft_config_t h, const char *path) {
+  PyObject *v = PyUnicode_FromString(path);
+  PyObject_SetAttrString(obj(h), "import_strategy_file", v);
+  Py_DECREF(v);
+}
+
+void fft_config_set_export_strategy_file(fft_config_t h, const char *path) {
+  PyObject *v = PyUnicode_FromString(path);
+  PyObject_SetAttrString(obj(h), "export_strategy_file", v);
+  Py_DECREF(v);
+}
+
+/* ---------------------------------------------------------------- FFModel */
+
+fft_model_t fft_model_create(fft_config_t cfg) {
+  PyObject *cls = ck(PyObject_GetAttrString(g_ff, "FFModel"));
+  if (!cls) return wrap<fft_model_t>(nullptr);
+  PyObject *m = ck(PyObject_CallFunction(cls, "O", obj(cfg)));
+  Py_DECREF(cls);
+  return wrap<fft_model_t>(m);
+}
+
+void fft_model_destroy(fft_model_t h) { Py_XDECREF(obj(h)); }
+
+fft_tensor_t fft_model_create_tensor(fft_model_t m, const int *dims,
+                                     int ndims, fft_data_type dtype,
+                                     const char *name) {
+  PyObject *dt = enum_from_int("DataType", dtype);
+  if (!dt) return wrap<fft_tensor_t>(nullptr);
+  PyObject *dl = int_list(dims, ndims);
+  PyObject *meth = ck(PyObject_GetAttrString(obj(m), "create_tensor"));
+  if (!meth) {
+    Py_DECREF(dt);
+    Py_DECREF(dl);
+    return wrap<fft_tensor_t>(nullptr);
+  }
+  PyObject *args = Py_BuildValue("(O)", dl);
+  PyObject *kwargs = Py_BuildValue("{s:O,s:s}", "dtype", dt, "name", name);
+  PyObject *t = ck(PyObject_Call(meth, args, kwargs));
+  Py_DECREF(meth);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(dt);
+  Py_DECREF(dl);
+  return wrap<fft_tensor_t>(t);
+}
+
+fft_tensor_t fft_model_add_dense(fft_model_t m, fft_tensor_t in, int out_dim,
+                                 fft_acti_mode act, int use_bias,
+                                 const char *name) {
+  PyObject *a = enum_from_int("ActiMode", act);
+  if (!a) return wrap<fft_tensor_t>(nullptr);
+  PyObject *args = Py_BuildValue("(OiOO)", obj(in), out_dim, a,
+                                 use_bias ? Py_True : Py_False);
+  Py_DECREF(a);
+  return wrap<fft_tensor_t>(call_with_name(obj(m), "dense", args, name));
+}
+
+fft_tensor_t fft_model_add_conv2d(fft_model_t m, fft_tensor_t in,
+                                  int out_channels, int kh, int kw, int sh,
+                                  int sw, int ph, int pw, fft_acti_mode act,
+                                  int groups, int use_bias,
+                                  const char *name) {
+  PyObject *a = enum_from_int("ActiMode", act);
+  if (!a) return wrap<fft_tensor_t>(nullptr);
+  PyObject *args =
+      Py_BuildValue("(OiiiiiiiOiO)", obj(in), out_channels, kh, kw, sh, sw,
+                    ph, pw, a, groups, use_bias ? Py_True : Py_False);
+  Py_DECREF(a);
+  return wrap<fft_tensor_t>(call_with_name(obj(m), "conv2d", args, name));
+}
+
+fft_tensor_t fft_model_add_pool2d(fft_model_t m, fft_tensor_t in, int kh,
+                                  int kw, int sh, int sw, int ph, int pw,
+                                  fft_pool_type type, const char *name) {
+  PyObject *p = enum_from_int("PoolType", type);
+  if (!p) return wrap<fft_tensor_t>(nullptr);
+  PyObject *args =
+      Py_BuildValue("(OiiiiiiO)", obj(in), kh, kw, sh, sw, ph, pw, p);
+  Py_DECREF(p);
+  return wrap<fft_tensor_t>(call_with_name(obj(m), "pool2d", args, name));
+}
+
+fft_tensor_t fft_model_add_embedding(fft_model_t m, fft_tensor_t in,
+                                     int num_entries, int out_dim,
+                                     fft_aggr_mode aggr, const char *name) {
+  PyObject *a = enum_from_int("AggrMode", aggr);
+  if (!a) return wrap<fft_tensor_t>(nullptr);
+  PyObject *args =
+      Py_BuildValue("(OiiO)", obj(in), num_entries, out_dim, a);
+  Py_DECREF(a);
+  return wrap<fft_tensor_t>(call_with_name(obj(m), "embedding", args, name));
+}
+
+fft_tensor_t fft_model_add_flat(fft_model_t m, fft_tensor_t in,
+                                const char *name) {
+  PyObject *args = Py_BuildValue("(O)", obj(in));
+  return wrap<fft_tensor_t>(call_with_name(obj(m), "flat", args, name));
+}
+
+fft_tensor_t fft_model_add_softmax(fft_model_t m, fft_tensor_t in, int axis,
+                                   const char *name) {
+  PyObject *args = Py_BuildValue("(Oi)", obj(in), axis);
+  return wrap<fft_tensor_t>(call_with_name(obj(m), "softmax", args, name));
+}
+
+fft_tensor_t fft_model_add_batch_norm(fft_model_t m, fft_tensor_t in,
+                                      int relu, const char *name) {
+  PyObject *args =
+      Py_BuildValue("(OO)", obj(in), relu ? Py_True : Py_False);
+  return wrap<fft_tensor_t>(call_with_name(obj(m), "batch_norm", args, name));
+}
+
+fft_tensor_t fft_model_add_concat(fft_model_t m, const fft_tensor_t *ins,
+                                  int n, int axis, const char *name) {
+  PyObject *l = PyList_New(n);
+  for (int i = 0; i < n; ++i) {
+    Py_INCREF(obj(ins[i]));
+    PyList_SET_ITEM(l, i, obj(ins[i]));
+  }
+  PyObject *args = Py_BuildValue("(Oi)", l, axis);
+  Py_DECREF(l);
+  return wrap<fft_tensor_t>(call_with_name(obj(m), "concat", args, name));
+}
+
+fft_tensor_t fft_model_add_dropout(fft_model_t m, fft_tensor_t in, float rate,
+                                   const char *name) {
+  PyObject *args = Py_BuildValue("(Of)", obj(in), rate);
+  return wrap<fft_tensor_t>(call_with_name(obj(m), "dropout", args, name));
+}
+
+fft_tensor_t fft_model_add_multihead_attention(fft_model_t m, fft_tensor_t q,
+                                               fft_tensor_t k, fft_tensor_t v,
+                                               int embed_dim, int num_heads,
+                                               int causal, const char *name) {
+  PyObject *meth =
+      ck(PyObject_GetAttrString(obj(m), "multihead_attention"));
+  if (!meth) return wrap<fft_tensor_t>(nullptr);
+  PyObject *args =
+      Py_BuildValue("(OOOii)", obj(q), obj(k), obj(v), embed_dim, num_heads);
+  PyObject *kwargs = Py_BuildValue("{s:O,s:s}", "causal",
+                                   causal ? Py_True : Py_False, "name", name);
+  PyObject *r = ck(PyObject_Call(meth, args, kwargs));
+  Py_DECREF(meth);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  return wrap<fft_tensor_t>(r);
+}
+
+fft_tensor_t fft_model_add_add(fft_model_t m, fft_tensor_t a, fft_tensor_t b,
+                               const char *name) {
+  PyObject *args = Py_BuildValue("(OO)", obj(a), obj(b));
+  return wrap<fft_tensor_t>(call_with_name(obj(m), "add", args, name));
+}
+
+fft_tensor_t fft_model_add_multiply(fft_model_t m, fft_tensor_t a,
+                                    fft_tensor_t b, const char *name) {
+  PyObject *args = Py_BuildValue("(OO)", obj(a), obj(b));
+  return wrap<fft_tensor_t>(call_with_name(obj(m), "multiply", args, name));
+}
+
+fft_tensor_t fft_model_add_relu(fft_model_t m, fft_tensor_t in,
+                                const char *name) {
+  PyObject *args = Py_BuildValue("(O)", obj(in));
+  return wrap<fft_tensor_t>(call_with_name(obj(m), "relu", args, name));
+}
+
+fft_tensor_t fft_model_add_reshape(fft_model_t m, fft_tensor_t in,
+                                   const int *shape, int ndims,
+                                   const char *name) {
+  PyObject *l = int_list(shape, ndims);
+  PyObject *args = Py_BuildValue("(OO)", obj(in), l);
+  Py_DECREF(l);
+  return wrap<fft_tensor_t>(call_with_name(obj(m), "reshape", args, name));
+}
+
+fft_tensor_t fft_model_add_transpose(fft_model_t m, fft_tensor_t in,
+                                     const int *perm, int ndims,
+                                     const char *name) {
+  PyObject *l = int_list(perm, ndims);
+  PyObject *args = Py_BuildValue("(OO)", obj(in), l);
+  Py_DECREF(l);
+  return wrap<fft_tensor_t>(call_with_name(obj(m), "transpose", args, name));
+}
+
+int fft_model_compile(fft_model_t m, fft_optimizer_t opt, fft_loss_type loss,
+                      const fft_metrics_type *metrics, int n_metrics,
+                      fft_tensor_t final) {
+  PyObject *lt = enum_from_int("LossType", loss);
+  if (!lt) return -1;
+  PyObject *ml = PyList_New(n_metrics);
+  for (int i = 0; i < n_metrics; ++i) {
+    PyObject *mt = enum_from_int("MetricsType", metrics[i]);
+    if (!mt) {
+      Py_DECREF(lt);
+      Py_DECREF(ml);
+      return -1;
+    }
+    PyList_SET_ITEM(ml, i, mt);
+  }
+  PyObject *meth = ck(PyObject_GetAttrString(obj(m), "compile"));
+  if (!meth) {
+    Py_DECREF(lt);
+    Py_DECREF(ml);
+    return -1;
+  }
+  PyObject *args = Py_BuildValue("(OOO)", obj(opt), lt, ml);
+  PyObject *kwargs = PyDict_New();
+  if (final.impl)
+    PyDict_SetItemString(kwargs, "final_tensor", obj(final));
+  PyObject *r = ck(PyObject_Call(meth, args, kwargs));
+  Py_DECREF(meth);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(lt);
+  Py_DECREF(ml);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int fft_model_init_layers(fft_model_t m) { return run_verb(m, "init_layers"); }
+
+fft_tensor_t fft_model_get_label_tensor(fft_model_t m) {
+  PyObject *t = ck(PyObject_GetAttrString(obj(m), "label_tensor"));
+  return wrap<fft_tensor_t>(t);
+}
+
+int fft_model_forward(fft_model_t m) { return run_verb(m, "forward"); }
+int fft_model_zero_gradients(fft_model_t m) {
+  return run_verb(m, "zero_gradients");
+}
+int fft_model_backward(fft_model_t m) { return run_verb(m, "backward"); }
+int fft_model_update(fft_model_t m) { return run_verb(m, "update"); }
+int fft_model_next_batch(fft_model_t m) {
+  return run_verb(m, "next_batch_all");
+}
+
+int fft_model_fit(fft_model_t m, int epochs) {
+  PyObject *meth = ck(PyObject_GetAttrString(obj(m), "fit"));
+  if (!meth) return -1;
+  PyObject *args = PyTuple_New(0);
+  PyObject *kwargs = PyDict_New();
+  if (epochs > 0) {
+    PyObject *e = PyLong_FromLong(epochs);
+    PyDict_SetItemString(kwargs, "epochs", e);
+    Py_DECREF(e);
+  }
+  PyObject *r = ck(PyObject_Call(meth, args, kwargs));
+  Py_DECREF(meth);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+float fft_model_get_last_loss(fft_model_t m) {
+  PyObject *l = PyObject_GetAttrString(obj(m), "_last_loss");
+  if (!l) {
+    PyErr_Clear();
+    return NAN;
+  }
+  PyObject *f = ck(PyNumber_Float(l));
+  Py_DECREF(l);
+  if (!f) return NAN;
+  float v = (float)PyFloat_AsDouble(f);
+  Py_DECREF(f);
+  return v;
+}
+
+int fft_model_get_weights(fft_model_t m, const char *op_name,
+                          const char *weight_name, float *buf, int64_t n) {
+  PyObject *w = ck(PyObject_CallMethod(obj(m), "get_weights", "ss", op_name,
+                                       weight_name));
+  if (!w) return -1;
+  PyObject *dt = PyUnicode_FromString("float32");
+  PyObject *cont = ck(PyObject_CallMethod(g_np, "ascontiguousarray", "OO", w,
+                                          dt));
+  Py_DECREF(w);
+  Py_DECREF(dt);
+  if (!cont) return -1;
+  Py_buffer view;
+  if (PyObject_GetBuffer(cont, &view, PyBUF_CONTIG_RO) != 0) {
+    capture_error();
+    Py_DECREF(cont);
+    return -1;
+  }
+  int64_t count = (int64_t)(view.len / sizeof(float));
+  if (count != n) {
+    g_err = "get_weights: size mismatch";
+    PyBuffer_Release(&view);
+    Py_DECREF(cont);
+    return -1;
+  }
+  std::memcpy(buf, view.buf, (size_t)view.len);
+  PyBuffer_Release(&view);
+  Py_DECREF(cont);
+  return 0;
+}
+
+int fft_model_set_weights(fft_model_t m, const char *op_name,
+                          const char *weight_name, const float *buf,
+                          int64_t n) {
+  /* target shape from the live (device) param — no host copy needed */
+  PyObject *params = ck(PyObject_GetAttrString(obj(m), "params"));
+  if (!params) return -1;
+  PyObject *group = ck(PyMapping_GetItemString(params, op_name));
+  Py_DECREF(params);
+  if (!group) return -1;
+  PyObject *cur = ck(PyMapping_GetItemString(group, weight_name));
+  Py_DECREF(group);
+  if (!cur) return -1;
+  PyObject *shape = ck(PyObject_GetAttrString(cur, "shape"));
+  Py_DECREF(cur);
+  if (!shape) return -1;
+  PyObject *arr =
+      array_from_buffer(buf, n * (int64_t)sizeof(float), "float32", shape);
+  Py_DECREF(shape);
+  if (!arr) return -1;
+  PyObject *r = ck(PyObject_CallMethod(obj(m), "set_weights", "ssO", op_name,
+                                       weight_name, arr));
+  Py_DECREF(arr);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ----------------------------------------------------------------- Tensor */
+
+int fft_tensor_get_ndims(fft_tensor_t t) {
+  PyObject *d = ck(PyObject_GetAttrString(obj(t), "dims"));
+  if (!d) return -1;
+  int n = (int)PySequence_Length(d);
+  Py_DECREF(d);
+  return n;
+}
+
+void fft_tensor_get_dims(fft_tensor_t t, int *dims) {
+  PyObject *d = ck(PyObject_GetAttrString(obj(t), "dims"));
+  if (!d) return;
+  Py_ssize_t n = PySequence_Length(d);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *x = PySequence_GetItem(d, i);
+    dims[i] = (int)PyLong_AsLong(x);
+    Py_DECREF(x);
+  }
+  Py_DECREF(d);
+}
+
+void fft_tensor_destroy(fft_tensor_t t) { Py_XDECREF(obj(t)); }
+
+/* ------------------------------------------------------------- Optimizers */
+
+fft_optimizer_t fft_sgd_optimizer_create(double lr, double momentum,
+                                         int nesterov, double weight_decay) {
+  PyObject *cls = ck(PyObject_GetAttrString(g_ff, "SGDOptimizer"));
+  if (!cls) return wrap<fft_optimizer_t>(nullptr);
+  PyObject *args = PyTuple_New(0);
+  PyObject *kwargs = Py_BuildValue(
+      "{s:d,s:d,s:O,s:d}", "lr", lr, "momentum", momentum, "nesterov",
+      nesterov ? Py_True : Py_False, "weight_decay", weight_decay);
+  PyObject *o = ck(PyObject_Call(cls, args, kwargs));
+  Py_DECREF(cls);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  return wrap<fft_optimizer_t>(o);
+}
+
+fft_optimizer_t fft_adam_optimizer_create(double lr, double beta1,
+                                          double beta2, double weight_decay,
+                                          double epsilon) {
+  PyObject *cls = ck(PyObject_GetAttrString(g_ff, "AdamOptimizer"));
+  if (!cls) return wrap<fft_optimizer_t>(nullptr);
+  PyObject *args = PyTuple_New(0);
+  PyObject *kwargs = Py_BuildValue(
+      "{s:d,s:d,s:d,s:d,s:d}", "alpha", lr, "beta1", beta1, "beta2", beta2,
+      "weight_decay", weight_decay, "epsilon", epsilon);
+  PyObject *o = ck(PyObject_Call(cls, args, kwargs));
+  Py_DECREF(cls);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  return wrap<fft_optimizer_t>(o);
+}
+
+void fft_optimizer_destroy(fft_optimizer_t h) { Py_XDECREF(obj(h)); }
+
+/* ------------------------------------------------------------- DataLoader */
+
+fft_dataloader_t fft_single_dataloader_create(fft_model_t m, fft_tensor_t t,
+                                              const void *data,
+                                              int64_t num_samples) {
+  /* element shape = tensor.dims[1:]; dtype from tensor.dtype */
+  int nd = fft_tensor_get_ndims(t);
+  if (nd < 1) return wrap<fft_dataloader_t>(nullptr);
+  std::vector<int> dims(nd);
+  fft_tensor_get_dims(t, dims.data());
+  int64_t per_sample = 1;
+  for (int i = 1; i < nd; ++i) per_sample *= dims[i];
+
+  PyObject *dtype_obj = ck(PyObject_GetAttrString(obj(t), "dtype"));
+  if (!dtype_obj) return wrap<fft_dataloader_t>(nullptr);
+  PyObject *dname = ck(PyObject_GetAttrString(dtype_obj, "name"));
+  Py_DECREF(dtype_obj);
+  if (!dname) return wrap<fft_dataloader_t>(nullptr);
+  const char *dn = PyUnicode_AsUTF8(dname);
+  const char *npdt = nullptr;
+  int64_t esize = 0;
+  if (dn && std::strcmp(dn, "DT_FLOAT") == 0) {
+    npdt = "float32";
+    esize = 4;
+  } else if (dn && std::strcmp(dn, "DT_INT64") == 0) {
+    npdt = "int64";
+    esize = 8;
+  } else if (dn && std::strcmp(dn, "DT_INT32") == 0) {
+    npdt = "int32";
+    esize = 4;
+  } else if (dn && std::strcmp(dn, "DT_DOUBLE") == 0) {
+    npdt = "float64";
+    esize = 8;
+  }
+  if (!npdt) {
+    g_err = std::string("single_dataloader: unsupported tensor dtype ") +
+            (dn ? dn : "?") +
+            " for raw-buffer attach (use float32/float64/int32/int64)";
+    Py_DECREF(dname);
+    return wrap<fft_dataloader_t>(nullptr);
+  }
+  Py_DECREF(dname);
+
+  PyObject *shape = PyList_New(nd);
+  PyList_SET_ITEM(shape, 0, PyLong_FromLongLong(num_samples));
+  for (int i = 1; i < nd; ++i)
+    PyList_SET_ITEM(shape, i, PyLong_FromLong(dims[i]));
+  PyObject *arr = array_from_buffer(data, num_samples * per_sample * esize,
+                                    npdt, shape);
+  Py_DECREF(shape);
+  if (!arr) return wrap<fft_dataloader_t>(nullptr);
+
+  PyObject *cls = ck(PyObject_GetAttrString(g_ff, "SingleDataLoader"));
+  if (!cls) {
+    Py_DECREF(arr);
+    return wrap<fft_dataloader_t>(nullptr);
+  }
+  PyObject *dl = ck(PyObject_CallFunction(cls, "OOO", obj(m), obj(t), arr));
+  Py_DECREF(cls);
+  Py_DECREF(arr);
+  return wrap<fft_dataloader_t>(dl);
+}
+
+void fft_dataloader_destroy(fft_dataloader_t h) { Py_XDECREF(obj(h)); }
+
+int fft_dataloader_num_batches(fft_dataloader_t h) {
+  return (int)get_int_attr(obj(h), "num_batches", -1);
+}
+
+}  /* extern "C" */

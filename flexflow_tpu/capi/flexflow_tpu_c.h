@@ -1,0 +1,223 @@
+/* flexflow_tpu C API.
+ *
+ * A flat C shim over the flexflow_tpu Python framework, serving the same
+ * role as the reference's C API (reference: python/flexflow_c.h:27-851 —
+ * opaque handle types over FFConfig/FFModel/Tensor/optimizers/dataloaders)
+ * so native C/C++ applications can build, train and evaluate models.
+ *
+ * Implementation embeds CPython (the compute path is JAX/XLA either way;
+ * the reference's C API equally just forwards into the same runtime its
+ * Python bindings use). Handles are reference-counted Python objects.
+ *
+ * Thread model: all calls must come from one thread (the embedded
+ * interpreter owns the GIL for the duration of each call).
+ */
+
+#ifndef FLEXFLOW_TPU_C_H
+#define FLEXFLOW_TPU_C_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define FFT_OPAQUE(T) typedef struct T { void *impl; } T
+
+FFT_OPAQUE(fft_config_t);
+FFT_OPAQUE(fft_model_t);
+FFT_OPAQUE(fft_tensor_t);
+FFT_OPAQUE(fft_optimizer_t);
+FFT_OPAQUE(fft_dataloader_t);
+
+/* enums mirror flexflow_tpu.ffconst (reference include/ffconst.h) */
+typedef enum fft_acti_mode {
+  FFT_AC_MODE_NONE = 10,
+  FFT_AC_MODE_RELU = 11,
+  FFT_AC_MODE_SIGMOID = 12,
+  FFT_AC_MODE_TANH = 13,
+  FFT_AC_MODE_GELU = 14,
+} fft_acti_mode;
+
+typedef enum fft_pool_type {
+  FFT_POOL_MAX = 30,
+  FFT_POOL_AVG = 31,
+} fft_pool_type;
+
+typedef enum fft_aggr_mode {
+  FFT_AGGR_MODE_NONE = 20,
+  FFT_AGGR_MODE_SUM = 21,
+  FFT_AGGR_MODE_AVG = 22,
+} fft_aggr_mode;
+
+typedef enum fft_loss_type {
+  FFT_LOSS_CATEGORICAL_CROSSENTROPY = 50,
+  FFT_LOSS_SPARSE_CATEGORICAL_CROSSENTROPY = 51,
+  FFT_LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE = 52,
+  FFT_LOSS_MEAN_SQUARED_ERROR_SUM_REDUCE = 53,
+} fft_loss_type;
+
+typedef enum fft_metrics_type {
+  FFT_METRICS_ACCURACY = 1001,
+  FFT_METRICS_CATEGORICAL_CROSSENTROPY = 1002,
+  FFT_METRICS_SPARSE_CATEGORICAL_CROSSENTROPY = 1004,
+  FFT_METRICS_MEAN_SQUARED_ERROR = 1008,
+  FFT_METRICS_ROOT_MEAN_SQUARED_ERROR = 1016,
+  FFT_METRICS_MEAN_ABSOLUTE_ERROR = 1032,
+} fft_metrics_type;
+
+typedef enum fft_data_type {
+  FFT_DT_FLOAT = 40,
+  FFT_DT_DOUBLE = 41,
+  FFT_DT_INT32 = 42,
+  FFT_DT_INT64 = 43,
+  FFT_DT_BOOLEAN = 44,
+  FFT_DT_HALF = 45,
+  FFT_DT_BFLOAT16 = 46,
+} fft_data_type;
+
+/* ---------------------------------------------------------------- runtime */
+
+/* Initialize the embedded interpreter + import flexflow_tpu.  repo_root may
+ * be NULL if flexflow_tpu is importable from the default sys.path.
+ * Returns 0 on success. Call once before anything else. */
+int fft_init(const char *repo_root);
+
+/* Finalize the interpreter. No fft_* call is valid afterwards. */
+void fft_finalize(void);
+
+/* Last error message ("" if none). Valid until the next fft_* call. */
+const char *fft_last_error(void);
+
+/* --------------------------------------------------------------- FFConfig */
+
+/* mesh_axes/mesh_sizes: named device-mesh axes, e.g. {"data","model"},{4,2}.
+ * Pass n_mesh=0 for single-axis {"data": num_devices}. */
+fft_config_t fft_config_create(int batch_size, int epochs,
+                               const char **mesh_axes, const int *mesh_sizes,
+                               int n_mesh);
+void fft_config_destroy(fft_config_t h);
+int fft_config_get_batch_size(fft_config_t h);
+int fft_config_get_epochs(fft_config_t h);
+int fft_config_get_num_devices(fft_config_t h);
+/* MCMC strategy search knobs (reference --budget / --import / --export) */
+void fft_config_set_search_budget(fft_config_t h, int budget);
+void fft_config_set_import_strategy_file(fft_config_t h, const char *path);
+void fft_config_set_export_strategy_file(fft_config_t h, const char *path);
+
+/* ---------------------------------------------------------------- FFModel */
+
+fft_model_t fft_model_create(fft_config_t cfg);
+void fft_model_destroy(fft_model_t h);
+
+fft_tensor_t fft_model_create_tensor(fft_model_t m, const int *dims,
+                                     int ndims, fft_data_type dtype,
+                                     const char *name);
+
+/* layer factories (reference flexflow_model_add_*) */
+fft_tensor_t fft_model_add_dense(fft_model_t m, fft_tensor_t in, int out_dim,
+                                 fft_acti_mode act, int use_bias,
+                                 const char *name);
+fft_tensor_t fft_model_add_conv2d(fft_model_t m, fft_tensor_t in,
+                                  int out_channels, int kh, int kw, int sh,
+                                  int sw, int ph, int pw, fft_acti_mode act,
+                                  int groups, int use_bias, const char *name);
+fft_tensor_t fft_model_add_pool2d(fft_model_t m, fft_tensor_t in, int kh,
+                                  int kw, int sh, int sw, int ph, int pw,
+                                  fft_pool_type type, const char *name);
+fft_tensor_t fft_model_add_embedding(fft_model_t m, fft_tensor_t in,
+                                     int num_entries, int out_dim,
+                                     fft_aggr_mode aggr, const char *name);
+fft_tensor_t fft_model_add_flat(fft_model_t m, fft_tensor_t in,
+                                const char *name);
+fft_tensor_t fft_model_add_softmax(fft_model_t m, fft_tensor_t in, int axis,
+                                   const char *name);
+fft_tensor_t fft_model_add_batch_norm(fft_model_t m, fft_tensor_t in,
+                                      int relu, const char *name);
+fft_tensor_t fft_model_add_concat(fft_model_t m, const fft_tensor_t *ins,
+                                  int n, int axis, const char *name);
+fft_tensor_t fft_model_add_dropout(fft_model_t m, fft_tensor_t in, float rate,
+                                   const char *name);
+fft_tensor_t fft_model_add_multihead_attention(fft_model_t m, fft_tensor_t q,
+                                               fft_tensor_t k, fft_tensor_t v,
+                                               int embed_dim, int num_heads,
+                                               int causal, const char *name);
+fft_tensor_t fft_model_add_add(fft_model_t m, fft_tensor_t a, fft_tensor_t b,
+                               const char *name);
+fft_tensor_t fft_model_add_multiply(fft_model_t m, fft_tensor_t a,
+                                    fft_tensor_t b, const char *name);
+fft_tensor_t fft_model_add_relu(fft_model_t m, fft_tensor_t in,
+                                const char *name);
+fft_tensor_t fft_model_add_reshape(fft_model_t m, fft_tensor_t in,
+                                   const int *shape, int ndims,
+                                   const char *name);
+fft_tensor_t fft_model_add_transpose(fft_model_t m, fft_tensor_t in,
+                                     const int *perm, int ndims,
+                                     const char *name);
+
+/* compile: resolves strategies (runs the MCMC search when budget>0), builds
+ * the mesh, initializes sharded params. final may be a NULL-impl handle to
+ * use the last op's output. */
+int fft_model_compile(fft_model_t m, fft_optimizer_t opt, fft_loss_type loss,
+                      const fft_metrics_type *metrics, int n_metrics,
+                      fft_tensor_t final);
+
+int fft_model_init_layers(fft_model_t m);
+fft_tensor_t fft_model_get_label_tensor(fft_model_t m);
+
+/* train verbs (reference: forward/zero_gradients/backward/update are fused
+ * into one XLA step here; the verbs are kept for API parity) */
+int fft_model_forward(fft_model_t m);
+int fft_model_zero_gradients(fft_model_t m);
+int fft_model_backward(fft_model_t m);
+int fft_model_update(fft_model_t m);
+int fft_model_next_batch(fft_model_t m);
+
+/* full training loop with throughput print; returns 0 on success */
+int fft_model_fit(fft_model_t m, int epochs);
+
+/* loss of the most recent step (NaN before any step) */
+float fft_model_get_last_loss(fft_model_t m);
+
+/* weights IO (reference Parameter::set_weights/get_weights).
+ * buf is row-major float32 of the parameter's full (unsharded) shape. */
+int fft_model_get_weights(fft_model_t m, const char *op_name,
+                          const char *weight_name, float *buf, int64_t n);
+int fft_model_set_weights(fft_model_t m, const char *op_name,
+                          const char *weight_name, const float *buf,
+                          int64_t n);
+
+/* ----------------------------------------------------------------- Tensor */
+
+int fft_tensor_get_ndims(fft_tensor_t t);
+void fft_tensor_get_dims(fft_tensor_t t, int *dims);
+void fft_tensor_destroy(fft_tensor_t t);
+
+/* ------------------------------------------------------------- Optimizers */
+
+fft_optimizer_t fft_sgd_optimizer_create(double lr, double momentum,
+                                         int nesterov, double weight_decay);
+fft_optimizer_t fft_adam_optimizer_create(double lr, double beta1,
+                                          double beta2, double weight_decay,
+                                          double epsilon);
+void fft_optimizer_destroy(fft_optimizer_t h);
+
+/* ------------------------------------------------------------- DataLoader */
+
+/* Full dataset resident, next_batch slices per shard (reference
+ * SingleDataLoader, python/flexflow_dataloader.cc). data is row-major
+ * float32 (or int32 when the tensor dtype is int) of shape
+ * [num_samples, tensor.dims[1:]...]. */
+fft_dataloader_t fft_single_dataloader_create(fft_model_t m, fft_tensor_t t,
+                                              const void *data,
+                                              int64_t num_samples);
+void fft_dataloader_destroy(fft_dataloader_t h);
+int fft_dataloader_num_batches(fft_dataloader_t h);
+
+#undef FFT_OPAQUE
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* FLEXFLOW_TPU_C_H */

@@ -1,0 +1,34 @@
+"""C API (flexflow_tpu/capi) integration test: build the shim + the C++
+AlexNet app and run it end-to-end on the 8-device virtual CPU mesh.
+
+Reference parity: the C API layer (python/flexflow_c.h) and the C++
+example train loop (examples/cpp/AlexNet/alexnet.cc:34-130)."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CAPI = os.path.join(REPO, "flexflow_tpu", "capi")
+CPP = os.path.join(REPO, "examples", "cpp")
+
+
+@pytest.mark.skipif(shutil.which("g++") is None
+                    or shutil.which("python3-config") is None,
+                    reason="no C++ toolchain or Python dev headers")
+def test_capi_alexnet_end_to_end():
+    subprocess.run(["make"], cwd=CAPI, check=True, capture_output=True)
+    subprocess.run(["make"], cwd=CPP, check=True, capture_output=True)
+    env = dict(os.environ)
+    env.update({
+        "FFT_JAX_PLATFORMS": "cpu",
+        "FFT_NUM_CPU_DEVICES": "8",
+        "FFT_REPO_ROOT": REPO,
+    })
+    r = subprocess.run([os.path.join(CPP, "alexnet"), "16", "1", "32"],
+                       env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "alexnet_c: SUCCESS" in r.stdout
+    assert "devices=8" in r.stdout
