@@ -1,0 +1,46 @@
+"""Bootcamp demo 3/3: AlexNet-CIFAR10 through the native builder API with an
+explicit train loop (reference: bootcamp_demo/native_cnn_cifar10.py +
+examples/cpp/AlexNet/alexnet.cc:102-118 loop structure)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.keras.datasets import cifar10
+from flexflow_tpu.models.cnn import alexnet_cifar10
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    x, out = alexnet_cifar10(ff, cfg.batch_size)
+    ff.compile(SGDOptimizer(lr=cfg.learning_rate),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=out)
+
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.astype(np.int32).reshape(-1, 1)
+    loader_x = SingleDataLoader(ff, x, x_train)
+    loader_y = SingleDataLoader(ff, ff.label_tensor, y_train)
+    ff.init_layers()
+
+    # explicit loop: next_batch / forward / zero / backward / update
+    num_batches = min(loader_x.num_batches, loader_y.num_batches)
+    for epoch in range(cfg.epochs):
+        loader_x.reset()
+        loader_y.reset()
+        for it in range(num_batches):
+            batch = ff._stage_batch()
+            loss, mets = ff._run_train_step(batch)
+            if it % 50 == 0:
+                print(f"epoch {epoch} iter {it}: loss={float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

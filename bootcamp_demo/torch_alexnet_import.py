@@ -1,0 +1,62 @@
+"""Bootcamp demo 2/3: AlexNet-CIFAR10 defined in PyTorch, imported via
+torch.fx (reference: bootcamp_demo/torch_cnn_cifar10.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import torch.nn as nn
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.keras.datasets import cifar10
+from flexflow_tpu.torch import PyTorchModel, torch_to_flexflow
+
+
+class AlexNetCifar(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 64, 5, padding=2)
+        self.relu1 = nn.ReLU()
+        self.pool1 = nn.MaxPool2d(2)
+        self.conv2 = nn.Conv2d(64, 192, 5, padding=2)
+        self.relu2 = nn.ReLU()
+        self.pool2 = nn.MaxPool2d(2)
+        self.conv3 = nn.Conv2d(192, 256, 3, padding=1)
+        self.relu3 = nn.ReLU()
+        self.pool3 = nn.MaxPool2d(2)
+        self.flat = nn.Flatten()
+        self.fc1 = nn.Linear(256 * 4 * 4, 512)
+        self.relu4 = nn.ReLU()
+        self.fc2 = nn.Linear(512, 10)
+
+    def forward(self, x):
+        x = self.pool1(self.relu1(self.conv1(x)))
+        x = self.pool2(self.relu2(self.conv2(x)))
+        x = self.pool3(self.relu3(self.conv3(x)))
+        return self.fc2(self.relu4(self.fc1(self.flat(x))))
+
+
+def main():
+    torch_to_flexflow(AlexNetCifar(), "/tmp/alexnet_cifar.ff")
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 3, 32, 32], name="x")
+    outs = PyTorchModel("/tmp/alexnet_cifar.ff").apply(ff, [x])
+    ff.compile(SGDOptimizer(lr=cfg.learning_rate),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=outs[0])
+
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.astype(np.int32).reshape(-1, 1)
+    SingleDataLoader(ff, x, x_train)
+    SingleDataLoader(ff, ff.label_tensor, y_train)
+    ff.init_layers()
+    ff.fit(epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+#!/bin/bash
+# CI matrix (analog of the reference's .circleci/config.yml: build matrix
+# {parameter-server, NCCL} x {build, 4-GPU tests} + nightly accuracy runs).
+#
+# Our matrix replaces gradient-sync backends (one XLA path here) with
+# execution tiers:
+#   unit      — pytest on the 8-device virtual CPU mesh (tests/conftest.py)
+#   sweep     — every example end-to-end on the virtual mesh
+#   accuracy  — accuracy-gated training runs (nightly tier)
+#   native    — C shim + C++ apps build & run
+#
+# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|all]
+set -e
+
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$ROOT"
+TIER="${1:-all}"
+
+run_unit()     { python -m pytest tests/ -x -q; }
+run_sweep()    { bash tests/multi_device_tests.sh "${NDEV:-8}"; }
+run_accuracy() { bash tests/accuracy_tests.sh "${NDEV:-8}"; }
+run_native()   {
+  make -C flexflow_tpu/capi
+  make -C examples/cpp
+  FFT_JAX_PLATFORMS=cpu FFT_NUM_CPU_DEVICES=4 FFT_REPO_ROOT="$ROOT" \
+    ./examples/cpp/alexnet 16 1 32
+}
+
+case "$TIER" in
+  unit)     run_unit ;;
+  sweep)    run_sweep ;;
+  accuracy) run_accuracy ;;
+  native)   run_native ;;
+  all)      run_unit; run_native; run_sweep ;;
+  *) echo "unknown tier $TIER"; exit 2 ;;
+esac
+echo "ci($TIER): PASSED"

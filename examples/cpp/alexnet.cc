@@ -95,9 +95,11 @@ int main(int argc, char **argv) {
     CHECK(fft_model_backward(ff) == 0);
     CHECK(fft_model_update(ff) == 0);
   }
+  /* fetching the loss blocks on the device (async dispatch) — must happen
+   * inside the timed region or samples/s measures dispatch, not execution */
+  float loss = fft_model_get_last_loss(ff);
   double dt = std::chrono::duration<double>(
                   std::chrono::steady_clock::now() - t0).count();
-  float loss = fft_model_get_last_loss(ff);
   printf("verb-loop epoch: %d batches, loss=%.4f, "
          "THROUGHPUT = %.2f samples/s\n",
          num_batches, loss,

@@ -1,6 +1,5 @@
-"""MNIST CNN via the Keras frontend with accuracy gate
-(reference: examples/python/keras/mnist_cnn.py + accuracy callback).
-"""
+"""CIFAR-10 CNN via the Keras frontend with accuracy gate (reference:
+examples/python/keras/cifar10_cnn.py)."""
 
 import os
 import sys
@@ -11,31 +10,34 @@ import numpy as np
 
 from flexflow_tpu.keras import Sequential
 from flexflow_tpu.keras.callbacks import EpochVerifyMetrics, ModelAccuracy
-from flexflow_tpu.keras.datasets import mnist
-from flexflow_tpu.keras.layers import Conv2D, Dense, Flatten, MaxPooling2D
+from flexflow_tpu.keras.datasets import cifar10
+from flexflow_tpu.keras.layers import (Conv2D, Dense, Flatten, MaxPooling2D)
 
 
-GATE = ModelAccuracy.MNIST_CNN
+GATE = ModelAccuracy.CIFAR10_CNN
 
 
 def main():
-    (x_train, y_train), _ = mnist.load_data()
-    x_train = x_train.reshape(-1, 1, 28, 28).astype(np.float32) / 255.0
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
 
     model = Sequential([
         Conv2D(32, 3, padding="same", activation="relu",
-               input_shape=(1, 28, 28)),
+               input_shape=(3, 32, 32)),
+        Conv2D(32, 3, padding="same", activation="relu"),
+        MaxPooling2D(2),
+        Conv2D(64, 3, padding="same", activation="relu"),
         Conv2D(64, 3, padding="same", activation="relu"),
         MaxPooling2D(2),
         Flatten(),
-        Dense(128, activation="relu"),
+        Dense(512, activation="relu"),
         Dense(10),
     ])
     model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
                   metrics=["accuracy"])
     gates = ([EpochVerifyMetrics(GATE)]
              if os.environ.get("FF_ACCURACY_GATE") else [])
-    model.fit(x_train, y_train, epochs=4,
+    model.fit(x_train, y_train, epochs=int(os.environ.get("EPOCHS", 4)),
               callbacks=gates)
 
 

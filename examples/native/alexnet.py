@@ -1,0 +1,40 @@
+"""AlexNet on CIFAR-10 via the native API (reference:
+examples/cpp/AlexNet/alexnet.cc:34-130 — the canonical train loop).
+
+Run: python examples/native/alexnet.py [-e EPOCHS] [-b BATCH]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.keras.datasets import cifar10
+from flexflow_tpu.models.cnn import alexnet_cifar10
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    x, out = alexnet_cifar10(ff, cfg.batch_size)
+    ff.compile(SGDOptimizer(lr=cfg.learning_rate),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY,
+                MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY],
+               final_tensor=out)
+
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.astype(np.int32).reshape(-1, 1)
+    SingleDataLoader(ff, x, x_train)
+    SingleDataLoader(ff, ff.label_tensor, y_train)
+    ff.init_layers()
+    ff.fit(epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""BERT proxy benchmark via the native API (reference:
+examples/python/native/bert_proxy_native.py — BERT-Large-shaped encoder run
+on random tokens to measure training step time).
+
+Run: python examples/native/bert_proxy.py [-b BATCH] [--layers N]
+     [--hidden H] [--seq-len S] [--iters N]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu import (AdamOptimizer, FFConfig, FFModel, LossType,
+                          MetricsType)
+from flexflow_tpu.models.bert import bert_base
+
+
+def main():
+    ap = argparse.ArgumentParser(add_help=False)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--vocab", type=int, default=30522)
+    ap.add_argument("--iters", type=int, default=8)
+    extra, rest = ap.parse_known_args()
+    cfg = FFConfig.parse_args(rest)
+
+    ff = FFModel(cfg)
+    tokens, pos, out = bert_base(ff, cfg.batch_size, seq_len=extra.seq_len,
+                                 hidden=extra.hidden, layers=extra.layers,
+                                 heads=extra.heads, vocab_size=extra.vocab)
+    ff.compile(AdamOptimizer(alpha=cfg.learning_rate),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=out)
+
+    rs = np.random.RandomState(0)
+    B = cfg.batch_size
+    batch = {
+        "input": rs.randint(0, extra.vocab, (B, extra.seq_len)).astype(np.int32),
+        "positions": np.tile(np.arange(extra.seq_len, dtype=np.int32), (B, 1)),
+        "label": rs.randint(0, 2, (B, 1)).astype(np.int32),
+    }
+    import jax
+
+    ff._run_train_step(batch)
+    jax.block_until_ready(ff.params)
+    t0 = time.time()
+    for _ in range(extra.iters):
+        ff._run_train_step(batch)
+    jax.block_until_ready(ff.params)
+    dt = time.time() - t0
+    print(f"THROUGHPUT = {extra.iters * B / dt:.2f} samples/s "
+          f"({dt / extra.iters * 1000:.1f} ms/iter)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""CANDLE Uno drug-response model via the native API (reference:
+examples/cpp/candle_uno/candle_uno.cc — 7-input concat MLP).
+
+Synthetic feature data (the reference reads CSVs from the CANDLE project).
+
+Run: python examples/native/candle_uno.py [-b BATCH] [-e EPOCHS]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.models.cnn import candle_uno
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    inputs, out = candle_uno(ff, cfg.batch_size,
+                             dense_layers=(1000, 1000, 1000),
+                             dense_feature_layers=(1000, 1000, 1000))
+    ff.compile(SGDOptimizer(lr=cfg.learning_rate),
+               LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+               [MetricsType.METRICS_MEAN_SQUARED_ERROR], final_tensor=out)
+
+    rs = np.random.RandomState(0)
+    n = cfg.batch_size * 4
+    for name, t in inputs.items():
+        SingleDataLoader(ff, t, rs.randn(n, t.dims[1]).astype(np.float32))
+    SingleDataLoader(ff, ff.label_tensor, rs.rand(n, 1).astype(np.float32))
+    ff.init_layers()
+    ff.fit(epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

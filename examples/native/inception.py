@@ -1,0 +1,54 @@
+"""Full InceptionV3 via the native API (reference:
+examples/cpp/InceptionV3/inception.cc:150-174). The branchy graph is the
+op-parallel search showcase: run with --budget N --export s.txt to let the
+MCMC search discover a strategy, then --import s.txt to train under it.
+
+Run: python examples/native/inception.py [-b BATCH] [--iters N]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer)
+from flexflow_tpu.models.cnn import inception_v3
+
+
+def main():
+    ap = argparse.ArgumentParser(add_help=False)
+    ap.add_argument("--iters", type=int, default=4)
+    extra, rest = ap.parse_known_args()
+    cfg = FFConfig.parse_args(rest)
+
+    ff = FFModel(cfg)
+    x, out = inception_v3(ff, cfg.batch_size, num_classes=10)
+    ff.compile(SGDOptimizer(lr=cfg.learning_rate),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY,
+                MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY],
+               final_tensor=out)
+
+    rs = np.random.RandomState(0)
+    B = cfg.batch_size
+    batch = {"input": rs.randn(B, 3, 299, 299).astype(np.float32),
+             "label": rs.randint(0, 10, (B, 1)).astype(np.int32)}
+    import jax
+
+    ff._run_train_step(batch)
+    jax.block_until_ready(ff.params)
+    t0 = time.time()
+    for _ in range(extra.iters):
+        ff._run_train_step(batch)
+    jax.block_until_ready(ff.params)
+    dt = time.time() - t0
+    print(f"THROUGHPUT = {extra.iters * B / dt:.2f} samples/s")
+
+
+if __name__ == "__main__":
+    main()

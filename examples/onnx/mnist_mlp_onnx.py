@@ -1,0 +1,53 @@
+"""MNIST MLP via the ONNX importer (reference:
+examples/python/onnx/mnist_mlp_pt.py: torch -> onnx export -> ONNXModel).
+
+The `onnx` package is not bundled in this image; this example exports with
+torch.onnx when available and exits gracefully otherwise."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+
+def main():
+    try:
+        import onnx  # noqa: F401
+        import torch
+        import torch.nn as nn
+    except ImportError as e:
+        print(f"SKIP: {e} (onnx export path unavailable in this image)")
+        return
+
+    from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                              SGDOptimizer, SingleDataLoader)
+    from flexflow_tpu.keras.datasets import mnist
+    from flexflow_tpu.onnx import ONNXModel
+
+    net = nn.Sequential(nn.Linear(784, 512), nn.ReLU(),
+                        nn.Linear(512, 512), nn.ReLU(), nn.Linear(512, 10))
+    path = "/tmp/mnist_mlp.onnx"
+    torch.onnx.export(net, torch.randn(64, 784), path,
+                      input_names=["input"], output_names=["output"])
+
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 784], name="input")
+    out = ONNXModel(path).apply(ff, {"input": x})
+    ff.compile(SGDOptimizer(lr=cfg.learning_rate),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=out)
+
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train.astype(np.int32).reshape(-1, 1)
+    SingleDataLoader(ff, x, x_train)
+    SingleDataLoader(ff, ff.label_tensor, y_train)
+    ff.init_layers()
+    ff.fit(epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

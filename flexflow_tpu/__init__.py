@@ -13,6 +13,17 @@ Public API mirrors the reference's FFModel surface
 (reference: include/model.h:250-483, python/flexflow/core/flexflow_cbinding.py).
 """
 
+import os as _os
+
+if _os.environ.get("FLEXFLOW_FORCE_CPU_DEVICES"):
+    # FLEXFLOW_FORCE_CPU_DEVICES=N provisions an N-device virtual CPU
+    # platform, provided flexflow_tpu is imported before any jax use (the
+    # test/example sweep scripts rely on this). No-op if the embedding
+    # application already initialized a backend.
+    from flexflow_tpu._env import force_cpu_devices_from_env as _force_cpu
+
+    _force_cpu(_os.environ["FLEXFLOW_FORCE_CPU_DEVICES"])
+
 from flexflow_tpu.ffconst import (  # noqa: F401
     ActiMode,
     AggrMode,

@@ -1,0 +1,36 @@
+"""Virtual-device provisioning shared by every entry point.
+
+This image preloads the TPU plugin at interpreter startup (sitecustomize),
+so JAX_PLATFORMS/XLA_FLAGS in the launching shell can arrive too late; the
+supported post-import path is jax.config. One implementation here serves the
+package import hook (FLEXFLOW_FORCE_CPU_DEVICES), the driver entry
+(__graft_entry__), and the C API (FFT_JAX_PLATFORMS/FFT_NUM_CPU_DEVICES).
+"""
+
+from __future__ import annotations
+
+
+def force_cpu_devices(n: int) -> bool:
+    """Point jax at an n-device virtual CPU platform. Must run before the
+    first backend query (jax.devices() locks platform selection). Returns
+    True if the config was applied, False if the backend was already
+    initialized (in which case the caller should check device count)."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        if n > 0:
+            jax.config.update("jax_num_cpu_devices", int(n))
+        return True
+    except RuntimeError:
+        return False
+
+
+def force_cpu_devices_from_env(value: str) -> bool:
+    """Env-var flavored wrapper: accepts '8', '1', or truthy junk ('true',
+    'yes' -> platform forced, device count left at default)."""
+    try:
+        n = int(value)
+    except ValueError:
+        n = 0
+    return force_cpu_devices(n)

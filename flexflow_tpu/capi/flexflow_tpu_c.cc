@@ -143,13 +143,14 @@ int fft_init(const char *repo_root) {
    * some environments pre-import jax so plain JAX_PLATFORMS is ignored). */
   PyRun_SimpleString(
       "import os as _os\n"
-      "if _os.environ.get('FFT_JAX_PLATFORMS'):\n"
+      "_plat = _os.environ.get('FFT_JAX_PLATFORMS')\n"
+      "if _plat == 'cpu':\n"
+      "    from flexflow_tpu._env import force_cpu_devices_from_env\n"
+      "    force_cpu_devices_from_env("
+      "_os.environ.get('FFT_NUM_CPU_DEVICES', '0'))\n"
+      "elif _plat:\n"
       "    import jax as _jax\n"
-      "    _jax.config.update('jax_platforms',"
-      " _os.environ['FFT_JAX_PLATFORMS'])\n"
-      "    _n = int(_os.environ.get('FFT_NUM_CPU_DEVICES', '0'))\n"
-      "    if _n:\n"
-      "        _jax.config.update('jax_num_cpu_devices', _n)\n");
+      "    _jax.config.update('jax_platforms', _plat)\n");
   g_np = ck(PyImport_ImportModule("numpy"));
   g_ff = ck(PyImport_ImportModule("flexflow_tpu"));
   g_ffconst = ck(PyImport_ImportModule("flexflow_tpu.ffconst"));

@@ -42,6 +42,10 @@ class FFConfig:
     export_strategy_file: str = ""
     enable_parameter_parallel: bool = False
     enable_attribute_parallel: bool = False
+    # time real per-op fwd+bwd on-device for the search's cost table
+    # (reference: measure_operator_cost, simulator.cc:296-316); analytic
+    # roofline costs when off
+    measure_search_costs: bool = False
 
     # dataloader (native threaded gather/prefetch; reference's dataloader is
     # native too — flexflow_dataloader.cc)
@@ -53,7 +57,8 @@ class FFConfig:
     # execution flags
     sp_mode: str = "ring"  # sequence-parallel lowering: "ring" | "ulysses"
     profiling: bool = False
-    perform_fusion: bool = False  # XLA fuses; flag kept for API parity
+    # graph-level FusedOp pass (ops/fused.py); XLA fuses kernels regardless
+    perform_fusion: bool = False
     simulator_workspace_size: int = 2 * 1024 * 1024 * 1024
     compute_dtype: str = "float32"  # "bfloat16" for MXU-native training
     seed: int = 0
@@ -63,9 +68,17 @@ class FFConfig:
 
     def __post_init__(self):
         if self.num_devices is None:
-            import jax
+            if self.mesh_shape is not None:
+                # derive from the mesh without touching the backend (keeps
+                # graph-build/search-only flows from initializing devices)
+                n = 1
+                for s in self.mesh_shape.values():
+                    n *= s
+                self.num_devices = n
+            else:
+                import jax
 
-            self.num_devices = len(jax.devices())
+                self.num_devices = len(jax.devices())
         if self.mesh_shape is None:
             self.mesh_shape = {"data": self.num_devices}
 
@@ -91,6 +104,7 @@ class FFConfig:
         p.add_argument("--export", dest="export_file", type=str, default="")
         p.add_argument("--enable-parameter-parallel", action="store_true")
         p.add_argument("--enable-attribute-parallel", action="store_true")
+        p.add_argument("--measure-costs", action="store_true")
         p.add_argument("--profiling", action="store_true")
         p.add_argument("--fusion", action="store_true")
         p.add_argument("--num-devices", type=int, default=None)
@@ -106,6 +120,7 @@ class FFConfig:
             export_strategy_file=args.export_file,
             enable_parameter_parallel=args.enable_parameter_parallel,
             enable_attribute_parallel=args.enable_attribute_parallel,
+            measure_search_costs=args.measure_costs,
             profiling=args.profiling,
             perform_fusion=args.fusion,
             num_devices=args.num_devices,

@@ -84,3 +84,32 @@ class PrintDebug(Callback):
         if epoch % self.every == 0:
             print(f"[PrintDebug] epoch {epoch}: "
                   f"acc={100.0 * self.model._perf.accuracy:.2f}%")
+
+
+class ModelCheckpoint(Callback):
+    """Periodic checkpointing during fit (the reference's Keras clone has no
+    ModelCheckpoint — SURVEY §5.4 marks this as our orbax-backed extension).
+    Pair with flexflow_tpu.runtime.checkpoint.auto_resume for preemption
+    recovery."""
+
+    def __init__(self, directory: str, every_epochs: int = 1):
+        super().__init__()
+        self.directory = directory
+        self.every_epochs = max(every_epochs, 1)
+        self._last_saved_step = None
+
+    def _save(self):
+        from flexflow_tpu.runtime.checkpoint import save_checkpoint
+
+        # one numbering scheme: the model's global step counter
+        step = self.model._step_count
+        if step != self._last_saved_step:
+            save_checkpoint(self.model, self.directory, step=step)
+            self._last_saved_step = step
+
+    def on_epoch_end(self, epoch):
+        if (epoch + 1) % self.every_epochs == 0:
+            self._save()
+
+    def on_train_end(self):
+        self._save()

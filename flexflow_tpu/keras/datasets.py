@@ -17,6 +17,16 @@ import numpy as np
 _KERAS_CACHE = os.path.expanduser("~/.keras/datasets")
 
 
+def _limit(pair_train, pair_test):
+    """Honor FLEXFLOW_DATASET_LIMIT=N (cap samples per split) so e2e sweeps
+    stay fast; full data when unset."""
+    n = int(os.environ.get("FLEXFLOW_DATASET_LIMIT", 0))
+    if n <= 0:
+        return pair_train, pair_test
+    (xtr, ytr), (xte, yte) = pair_train, pair_test
+    return (xtr[:n], ytr[:n]), (xte[:n], yte[:n])
+
+
 def _synthetic_images(n, shape, num_classes, seed):
     rs = np.random.RandomState(seed)
     y = rs.randint(0, num_classes, n).astype(np.int32)
@@ -31,13 +41,14 @@ class mnist:
         full = os.path.join(_KERAS_CACHE, path)
         if os.path.exists(full):
             with np.load(full, allow_pickle=True) as f:
-                return (f["x_train"], f["y_train"]), (f["x_test"], f["y_test"])
+                return _limit((f["x_train"], f["y_train"]),
+                              (f["x_test"], f["y_test"]))
         print("[flexflow_tpu.keras.datasets] mnist cache missing; using "
               "deterministic synthetic data (offline environment)",
               file=sys.stderr)
         xtr, ytr = _synthetic_images(8192, (28, 28), 10, seed=0)
         xte, yte = _synthetic_images(1024, (28, 28), 10, seed=1)
-        return (xtr, ytr), (xte, yte)
+        return _limit((xtr, ytr), (xte, yte))
 
 
 class cifar10:
@@ -55,15 +66,15 @@ class cifar10:
                 ys.append(np.asarray(d[b"labels"]))
             with open(os.path.join(full, "test_batch"), "rb") as f:
                 d = pickle.load(f, encoding="bytes")
-            return ((np.concatenate(xs), np.concatenate(ys)),
-                    (d[b"data"].reshape(-1, 3, 32, 32),
-                     np.asarray(d[b"labels"])))
+            return _limit((np.concatenate(xs), np.concatenate(ys)),
+                          (d[b"data"].reshape(-1, 3, 32, 32),
+                           np.asarray(d[b"labels"])))
         print("[flexflow_tpu.keras.datasets] cifar10 cache missing; using "
               "deterministic synthetic data (offline environment)",
               file=sys.stderr)
         xtr, ytr = _synthetic_images(8192, (3, 32, 32), 10, seed=2)
         xte, yte = _synthetic_images(1024, (3, 32, 32), 10, seed=3)
-        return (xtr, ytr), (xte, yte)
+        return _limit((xtr, ytr), (xte, yte))
 
 
 class reuters:
@@ -77,4 +88,4 @@ class reuters:
         x = rs.randint(1, num_words, (n, maxlen)).astype(np.int32)
         # make it learnable: class-dependent token bias
         x[:, 0] = y % num_words
-        return (x[: n // 2], y[: n // 2]), (x[n // 2:], y[n // 2:])
+        return _limit((x[: n // 2], y[: n // 2]), (x[n // 2:], y[n // 2:]))

@@ -1,0 +1,41 @@
+"""Env-var-driven logger (reference: python/flexflow/flexflow_logger.py —
+`fflogger` configured from FF_LOGGING_LEVEL / FF_LOGGING_FILE; C++ side uses
+LegionRuntime::Logger categories, model.cc:23).
+
+Usage:
+    from flexflow_tpu.logger import fflogger
+    fflogger.info("compile done")
+
+FLEXFLOW_LOG_LEVEL: debug|info|warning|error (default warning)
+FLEXFLOW_LOG_FILE:  path (default stderr)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LEVELS = {"debug": logging.DEBUG, "info": logging.INFO,
+           "warning": logging.WARNING, "error": logging.ERROR}
+
+
+def _make_logger() -> logging.Logger:
+    logger = logging.getLogger("flexflow_tpu")
+    if logger.handlers:
+        return logger
+    level = _LEVELS.get(
+        os.environ.get("FLEXFLOW_LOG_LEVEL", "warning").lower(),
+        logging.WARNING)
+    logger.setLevel(level)
+    path = os.environ.get("FLEXFLOW_LOG_FILE", "")
+    handler = (logging.FileHandler(path) if path
+               else logging.StreamHandler(sys.stderr))
+    handler.setFormatter(logging.Formatter(
+        "[%(levelname)s %(asctime)s flexflow_tpu] %(message)s"))
+    logger.addHandler(handler)
+    logger.propagate = False
+    return logger
+
+
+fflogger = _make_logger()

@@ -343,13 +343,31 @@ class FFModel:
         if cfg.search_budget > 0:
             from flexflow_tpu.search.driver import optimize_strategies
 
+            measured = None
+            if cfg.measure_search_costs:
+                from flexflow_tpu.search.measure import measure_op_costs
+
+                measured = measure_op_costs(
+                    self, cfg.mesh_shape,
+                    cfg.enable_parameter_parallel,
+                    cfg.enable_attribute_parallel,
+                    verbose=cfg.profiling)
             best = optimize_strategies(self, budget=cfg.search_budget,
-                                       alpha=cfg.search_alpha)
+                                       alpha=cfg.search_alpha,
+                                       measured=measured)
             cfg.strategies.update(best)
             if cfg.export_strategy_file:
                 save_strategies_to_file(cfg.export_strategy_file, cfg.strategies)
 
         self._final_tensor = final_tensor or self.ops[-1].outputs[0]
+
+        if cfg.perform_fusion:
+            # reference: FFModel::apply_fusion after search (model.cc:1538-1593)
+            from flexflow_tpu.ops.fused import apply_fusion
+
+            protected = [self._final_tensor] + list(
+                getattr(self, "_aux_tensors", ()))
+            apply_fusion(self, protected=protected)
 
         # label tensor shaped like the final op's sample dims (model.cc:1615-1646)
         fdims = self._final_tensor.dims

@@ -33,16 +33,17 @@ def _attrs(node) -> Dict[str, object]:
 
 class ONNXModel:
     def __init__(self, filename):
-        try:
-            import onnx
-        except ImportError as e:
-            raise ImportError(
-                "the 'onnx' package is required for ONNXModel; install it or "
-                "use the PyTorch-FX / native frontends") from e
         if isinstance(filename, str):
+            try:
+                import onnx
+            except ImportError as e:
+                raise ImportError(
+                    "the 'onnx' package is required to load .onnx files; "
+                    "install it or pass a ModelProto-like object directly"
+                ) from e
             self.model = onnx.load(filename)
         else:
-            self.model = filename  # already a ModelProto
+            self.model = filename  # ModelProto (or any duck-typed equivalent)
         self.symbol_table: Dict[str, object] = {}
         self.inputs: Dict[str, object] = {}
         for inp in self.model.graph.input:

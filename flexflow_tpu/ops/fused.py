@@ -1,0 +1,175 @@
+"""FusedOp: a producer op plus a chain of fused-on elementwise followers.
+
+Reference: src/ops/fused.cu (FusedOp dispatches member ops' kernels
+back-to-back in one task) + FFModel::apply_fusion (model.cc:1404-1475), which
+merges producer/consumer ops sharing an identical ParallelConfig.
+
+On TPU, XLA already fuses elementwise chains into the producer's kernel, so
+execution-level fusion is free; what this node buys is *graph-level* parity:
+
+  * the strategy table and the search see ONE op per fused group (the
+    reference's motivation — fewer strategy entries, fewer simulated tasks);
+  * the cost model stops charging HBM round-trips for intermediates, which is
+    what the hardware actually does post-XLA-fusion;
+  * per-op profiling reports the group the way the reference's FusedOp
+    profiling does.
+
+Members must be weightless, stateless, single-input, shape-preserving ops
+whose sole consumer is the next member — the conservative subset of the
+reference's fusion condition (model.cc:1424-1475).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from flexflow_tpu.ffconst import OperatorType
+from flexflow_tpu.ops.base import Op
+
+
+class FusedOp(Op):
+    op_type = OperatorType.OP_FUSED
+
+    def __init__(self, leader: Op, members: List[Op]):
+        # Takes the leader's name so existing strategy entries / param keys
+        # keep working (the group is searched and checkpointed as the leader).
+        super().__init__(leader.model, leader.name, leader.inputs)
+        self.leader = leader
+        self.members = list(members)
+        self.stateful = leader.stateful
+        self.needs_rng = leader.needs_rng or any(m.needs_rng for m in members)
+        # graph output = the LAST member's tensors, so downstream consumers'
+        # tensor-object lookups keep resolving (intermediates vanish from the
+        # value map — the fused group has no externally visible intermediates)
+        self.outputs = self.members[-1].outputs
+
+    def finalize(self):  # outputs adopted from members; nothing to infer
+        raise RuntimeError("FusedOp is built by apply_fusion, not finalize()")
+
+    # -- execution ------------------------------------------------------------
+
+    def _run_members(self, outs, *, training, rng):
+        for j, m in enumerate(self.members):
+            m_rng = jax.random.fold_in(rng, j + 1) if (
+                m.needs_rng and rng is not None) else None
+            outs = m.forward({}, outs, training=training, rng=m_rng)
+        return outs
+
+    def forward(self, params, xs, *, training=False, rng=None, **kw):
+        lead_rng = jax.random.fold_in(rng, 0) if (
+            self.leader.needs_rng and rng is not None) else None
+        if getattr(self.leader, "wants_shard_ctx", False) and "shard_ctx" in kw:
+            outs = self.leader.forward(params, xs, training=training,
+                                       rng=lead_rng, shard_ctx=kw["shard_ctx"])
+        else:
+            outs = self.leader.forward(params, xs, training=training,
+                                       rng=lead_rng)
+        return self._run_members(outs, training=training, rng=rng)
+
+    def forward_stateful(self, params, state, xs, *, training=False, rng=None):
+        lead_rng = jax.random.fold_in(rng, 0) if (
+            self.leader.needs_rng and rng is not None) else None
+        outs, new_state = self.leader.forward_stateful(
+            params, state, xs, training=training, rng=lead_rng)
+        return self._run_members(outs, training=training, rng=rng), new_state
+
+    def init_state(self):
+        return self.leader.init_state()
+
+    # -- weights / parallelization: delegate to the leader --------------------
+
+    def weights(self):
+        return self.leader.weights()
+
+    def weight_partition(self, axis_map):
+        return self.leader.weight_partition(axis_map)
+
+    def partitionable_output_dims(self):
+        dims = set(self.leader.partitionable_output_dims())
+        for m in self.members:
+            dims &= set(m.partitionable_output_dims())
+        return sorted(dims)
+
+    def input_axis_map(self, axis_map, input_idx):
+        return self.leader.input_axis_map(axis_map, input_idx)
+
+    _contracted_output_dims = property(
+        lambda self: self.leader._contracted_output_dims)
+
+    def flops(self):
+        return self.leader.flops() + sum(m.flops() for m in self.members)
+
+    def __repr__(self):
+        chain = "+".join(type(m).__name__ for m in self.members)
+        return f"FusedOp({self.leader!r}+{chain})"
+
+
+def _fusable_follower(op: Op, producer_out, consumers: Dict[int, int]) -> bool:
+    """op can be folded onto the group ending in `producer_out`."""
+    return (len(op.inputs) == 1
+            and op.inputs[0] is producer_out
+            and not op.weight_specs()
+            and not op.stateful
+            and len(op.outputs) == 1
+            and op.outputs[0].dims == op.inputs[0].dims
+            and consumers.get(id(producer_out), 0) == 1)
+
+
+def apply_fusion(model, protected=()) -> int:
+    """Rewrite model.ops, folding fusable elementwise chains into FusedOp
+    nodes (reference: FFModel::apply_fusion, model.cc:1404-1475 — repeated
+    until fixpoint there; single left-to-right scan here since chains are the
+    only shape we fuse). Returns the number of ops eliminated.
+
+    `protected`: tensors that must stay externally visible (final tensor, aux
+    losses) — a group never swallows one as an intermediate.
+
+    Strategy compatibility (the reference's identical-ParallelConfig check):
+    a follower with an explicit strategy entry different from the leader's
+    blocks fusion.
+    """
+    from flexflow_tpu.ops.base import InputOp
+
+    strategies = model.config.strategies
+    protected_ids = {id(t) for t in protected}
+    consumers: Dict[int, int] = {}
+    for op in model.ops:
+        for t in op.inputs:
+            consumers[id(t)] = consumers.get(id(t), 0) + 1
+
+    new_ops: List[Op] = []
+    i, eliminated = 0, 0
+    ops = list(model.ops)
+    while i < len(ops):
+        op = ops[i]
+        if isinstance(op, InputOp):
+            new_ops.append(op)
+            i += 1
+            continue
+        leader, members = op, []
+        j = i + 1
+        while j < len(ops):
+            tail_out = (members[-1] if members else leader).outputs[0]
+            cand = ops[j]
+            lead_strat = strategies.get(leader.name)
+            cand_strat = strategies.get(cand.name)
+            if (id(tail_out) not in protected_ids
+                    and _fusable_follower(cand, tail_out, consumers)
+                    and (cand_strat is None or cand_strat == lead_strat)):
+                members.append(cand)
+                j += 1
+            else:
+                break
+        if members:
+            new_ops.append(FusedOp(leader, members))
+            for m in members:
+                strategies.pop(m.name, None)
+            eliminated += len(members)
+            i = j
+        else:
+            new_ops.append(op)
+            i += 1
+    model.ops = new_ops
+    return eliminated

@@ -148,3 +148,15 @@ def _merge_restored(fresh, restored):
     import jax.numpy as jnp
 
     return jnp.asarray(arr)
+
+
+def auto_resume(model, directory: str) -> int:
+    """Slice-preemption recovery (the capability gap SURVEY §5.3 notes in the
+    reference: a failed node kills the job with no recovery). Call after
+    compile(): restores the newest checkpoint in `directory` when one exists
+    and returns its step; returns 0 on a fresh start."""
+    step = latest_step(directory)
+    if step is None:
+        return 0
+    restore_checkpoint(model, directory, step=step)
+    return step

@@ -58,9 +58,18 @@ class CostModel:
 
     def op_compute_time(self, op: Op, axis_map: AxisMap) -> float:
         parts = _parts(axis_map, self.mesh_shape)
-        key = (op.name, parts)
-        if key in self.measured:
-            return self.measured[key]
+        if self.measured:
+            # real-device measurement keyed by per-shard output shape
+            # (search/measure.py; reference cache simulator.cc:298-303),
+            # legacy fallback key: partition count
+            from flexflow_tpu.search.measure import shard_shape
+
+            key = (op.name, shard_shape(op.outputs[0].dims, axis_map,
+                                        self.mesh_shape))
+            if key in self.measured:
+                return self.measured[key]
+            if (op.name, parts) in self.measured:
+                return self.measured[(op.name, parts)]
         flops = op.flops() / max(parts, 1)
         io_bytes = (sum(t.volume() for t in op.inputs)
                     + sum(t.volume() for t in op.outputs)) \

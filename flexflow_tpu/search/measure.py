@@ -1,0 +1,156 @@
+"""Real-device per-op cost measurement feeding the strategy search.
+
+Reference: Simulator::measure_operator_cost (simulator.cc:296-316) + the
+cudaEvent harness Op::inner_measure_operator_cost (model.cu:20-62): each op's
+real kernels are run ~15x per (op, ParallelConfig) sub-shape on GPU 0 and
+cached. Here each candidate sharding's per-shard sub-shapes are timed on one
+chip with a jitted fwd+bwd of the single op.
+
+XLA compiles are seconds, not kernel launches (SURVEY §7 hard part 1), so:
+  * measurements are keyed by (op signature, shard shapes) and shared across
+    identical ops — a 12-layer transformer measures each distinct layer shape
+    once, not 12x;
+  * only shard shapes reachable from `legal_axis_maps` are measured;
+  * results persist in-process in `_SIGNATURE_CACHE` across searches.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from flexflow_tpu.ffconst import DataType, dtype_to_np
+from flexflow_tpu.ops.base import InputOp, Op
+
+# (signature) -> seconds for fwd+bwd of one shard
+_SIGNATURE_CACHE: Dict[Tuple, float] = {}
+
+
+def shard_shape(dims, axis_map, mesh_shape) -> Tuple[int, ...]:
+    """Per-shard shape of a tensor partitioned by axis_map."""
+    out = list(dims)
+    for ax, d in (axis_map or {}).items():
+        if d is not None and d < len(out):
+            deg = mesh_shape.get(ax, 1)
+            out[d] = max(out[d] // deg, 1)
+    return tuple(out)
+
+
+def _op_signature(op: Op, in_shapes, w_shapes) -> Tuple:
+    return (type(op).__name__, tuple(sorted(
+        (k, repr(v)) for k, v in op.attrs.items())),
+        tuple(in_shapes), tuple(w_shapes))
+
+
+def _rand_for(shape, dtype: DataType, rs):
+    np_dt = dtype_to_np(dtype)
+    if np.issubdtype(np_dt, np.integer):
+        return rs.randint(0, 2, shape).astype(np_dt)
+    return rs.randn(*shape).astype(np_dt)
+
+
+def measure_one(op: Op, in_shapes, w_shapes, *, warmup=1, iters=5,
+                timeout_compile=None) -> Optional[float]:
+    """Time one jitted fwd+bwd of `op` at the given per-shard shapes on the
+    default device. Returns seconds, or None if the op can't run standalone
+    (e.g. needs shard context)."""
+    import jax
+    import jax.numpy as jnp
+
+    if getattr(op, "wants_shard_ctx", False) or op.stateful:
+        return None  # needs mesh context / state threading; analytic fallback
+    sig = _op_signature(op, in_shapes, w_shapes)
+    if sig in _SIGNATURE_CACHE:
+        return _SIGNATURE_CACHE[sig]
+    rs = np.random.RandomState(0)
+    try:
+        xs = [jnp.asarray(_rand_for(s, t.dtype, rs))
+              for s, t in zip(in_shapes, op.inputs)]
+        params = {spec.name: jnp.asarray(rs.randn(*s).astype(np.float32))
+                  for spec, s in zip(op.weight_specs(), w_shapes)}
+        rng = jax.random.PRNGKey(0)
+
+        def fwd_bwd(p, xs_):
+            def loss(p_, xs__):
+                outs = op.forward(p_, list(xs__), training=True,
+                                  rng=rng if op.needs_rng else None)
+                return sum(jnp.sum(jnp.square(o.astype(jnp.float32)))
+                           for o in outs)
+
+            l, g = jax.value_and_grad(loss, argnums=(0, 1))(p, tuple(xs_))
+            return l, g
+
+        step = jax.jit(fwd_bwd)
+        out = step(params, xs)  # compile + warmup
+        jax.block_until_ready(out)
+        for _ in range(warmup):
+            jax.block_until_ready(step(params, xs))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = step(params, xs)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / iters
+    except Exception:
+        return None
+    _SIGNATURE_CACHE[sig] = dt
+    return dt
+
+
+def measure_op_costs(model, mesh_shape: Dict[str, int],
+                     enable_parameter_parallel: bool = True,
+                     enable_attribute_parallel: bool = True,
+                     iters: int = 5, verbose: bool = False) -> Dict:
+    """Build the `measured` table for CostModel: {(op_name, shard_out_shape):
+    seconds}. Measures every distinct per-shard signature reachable by the
+    search's proposal space (reference: cache keyed by op+config hash,
+    simulator.cc:298-303)."""
+    from flexflow_tpu.search.driver import legal_axis_maps
+
+    measured: Dict = {}
+    n_timed = 0
+    for op in model.ops:
+        if isinstance(op, InputOp):
+            continue
+        seen_shapes = set()
+        for am in legal_axis_maps(op, mesh_shape, enable_parameter_parallel,
+                                  enable_attribute_parallel):
+            out_s = shard_shape(op.outputs[0].dims, am, mesh_shape)
+            if out_s in seen_shapes:
+                continue
+            seen_shapes.add(out_s)
+            in_shapes = []
+            for i, t in enumerate(op.inputs):
+                iam = op.input_axis_map(am, i)
+                in_shapes.append(shard_shape(t.dims, iam, mesh_shape))
+            try:
+                wp = op.weight_partition(am)
+            except Exception:
+                wp = {}
+            w_shapes = []
+            for spec in op.weight_specs():
+                ws = list(spec.shape)
+                pspec = wp.get(spec.name)
+                if pspec is not None:
+                    for d, entry in enumerate(pspec):
+                        if entry is None:
+                            continue
+                        axes = entry if isinstance(entry, tuple) else (entry,)
+                        deg = 1
+                        for ax in axes:
+                            deg *= mesh_shape.get(ax, 1)
+                        if d < len(ws):
+                            ws[d] = max(ws[d] // deg, 1)
+                w_shapes.append(tuple(ws))
+            dt = measure_one(op, in_shapes, w_shapes, iters=iters)
+            if dt is not None:
+                measured[(op.name, out_s)] = dt
+                n_timed += 1
+                if verbose:
+                    print(f"[measure] {op.name} shard{out_s}: "
+                          f"{dt * 1e3:.3f} ms")
+    if verbose:
+        print(f"[measure] {n_timed} entries, "
+              f"{len(_SIGNATURE_CACHE)} unique signatures timed")
+    return measured

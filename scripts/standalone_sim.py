@@ -1,0 +1,80 @@
+"""Standalone strategy-search prototype (analog of the reference's legacy
+scripts/simulator.cc: a self-contained MCMC search over a synthetic CNN or
+LSTM graph that emits a strategy file, decoupled from any training run).
+
+Where the reference hardcodes a CNN task graph and protobuf output
+(scripts/simulator.cc:16-40, scripts/cnn.h), this drives the real framework's
+C++ event-driven simulator + MCMC core (search/csrc/sim.cc) over a model
+built with the normal builder API, and writes the framework's text strategy
+schema (parallel/strategy.py; reference src/runtime/strategy.cc:150-189).
+
+Usage:
+  python scripts/standalone_sim.py [--model cnn|lstm|inception]
+      [--budget 2000] [--devices 8] [--export strategy.txt]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# cost-model/search only — no device backend needed; keep any accidental jax
+# use off the (possibly absent) accelerator
+os.environ.setdefault("FLEXFLOW_FORCE_CPU_DEVICES", "1")
+
+
+def build(model_name: str, ff, batch):
+    from flexflow_tpu.models.cnn import alexnet_cifar10, inception_v3
+    from flexflow_tpu.models.nmt import nmt_seq2seq
+
+    if model_name == "cnn":
+        return alexnet_cifar10(ff, batch)[1]
+    if model_name == "inception":
+        return inception_v3(ff, batch, num_classes=10)[1]
+    if model_name == "lstm":
+        return nmt_seq2seq(ff, batch, src_len=10, tgt_len=10, embed_size=64,
+                           hidden_size=64, vocab_size=500, num_layers=2)[2]
+    raise SystemExit(f"unknown --model {model_name}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="cnn",
+                    choices=("cnn", "lstm", "inception"))
+    ap.add_argument("--budget", type=int, default=2000)
+    ap.add_argument("--alpha", type=float, default=0.05)
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--export", default="")
+    args = ap.parse_args()
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.parallel.strategy import save_strategies_to_file
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.driver import (data_parallel_strategy,
+                                            optimize_strategies)
+
+    mesh_shape = {"data": max(args.devices // 2, 1),
+                  "model": 2 if args.devices >= 2 else 1}
+    cfg = FFConfig(batch_size=args.batch, mesh_shape=mesh_shape)
+    ff = FFModel(cfg)
+    build(args.model, ff, args.batch)
+
+    cost = CostModel(ff, mesh_shape)
+    dp_ms = cost.iteration_time(
+        data_parallel_strategy(ff, mesh_shape)) * 1e3
+    best = optimize_strategies(ff, budget=args.budget, alpha=args.alpha,
+                               mesh_shape=mesh_shape, verbose=True)
+    best_am = {name: (pc.axis_map or {}) for name, pc in best.items()}
+    best_ms = cost.iteration_time(best_am) * 1e3
+    print(f"[standalone_sim] {args.model} on {args.devices} devices: "
+          f"DP {dp_ms:.3f} ms -> searched {best_ms:.3f} ms "
+          f"({dp_ms / max(best_ms, 1e-9):.2f}x)")
+    if args.export:
+        save_strategies_to_file(args.export, best)
+        print(f"[standalone_sim] strategy written to {args.export}")
+
+
+if __name__ == "__main__":
+    main()

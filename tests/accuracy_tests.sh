@@ -1,0 +1,23 @@
+#!/bin/bash
+# Accuracy-gate sweep (analog of the reference's tests/accuracy_tests.sh:
+# examples run with VerifyMetrics/EpochVerifyMetrics callbacks that raise if
+# the accuracy target is not reached). Uses real datasets when the Keras
+# cache is present, else the deterministic synthetic stand-ins (which are
+# learnable by construction, so the gates stay meaningful).
+#
+# Usage: tests/accuracy_tests.sh [N_DEVICES]
+set -e
+set -x
+
+NDEV="${1:-8}"
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+export FLEXFLOW_FORCE_CPU_DEVICES="$NDEV"
+export EPOCHS="${EPOCHS:-4}"
+export FF_ACCURACY_GATE=1
+cd "$ROOT"
+
+python examples/keras/mnist_mlp.py
+python examples/keras/mnist_cnn.py
+python examples/keras/cifar10_cnn.py
+
+echo "accuracy_tests: ALL PASSED"

@@ -1,0 +1,46 @@
+#!/bin/bash
+# End-to-end multi-device example sweep (analog of the reference's
+# tests/multi_gpu_tests.sh: run every example at -ll:gpu $GPUS; here every
+# example runs on an N-device virtual CPU mesh via FLEXFLOW_FORCE_CPU_DEVICES).
+#
+# Usage: tests/multi_device_tests.sh [N_DEVICES] [BATCH]
+set -e
+set -x
+
+NDEV="${1:-8}"
+BATCH="${2:-$((16 * NDEV))}"
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+export FLEXFLOW_FORCE_CPU_DEVICES="$NDEV"
+export EPOCHS=1
+# smoke sweep: cap dataset size so every example is a handful of batches
+export FLEXFLOW_DATASET_LIMIT="${FLEXFLOW_DATASET_LIMIT:-256}"
+cd "$ROOT"
+
+# native API examples
+python examples/native/mnist_mlp.py -e 1 -b "$BATCH"
+python examples/native/alexnet.py -e 1 -b "$BATCH"
+python examples/native/multi_head_attention.py -e 1 -b "$BATCH"
+python examples/native/candle_uno.py -e 1 -b "$BATCH"
+python examples/native/resnet50.py -b "$NDEV" --iters 2 --image-size 64 --num-classes 10
+python examples/native/bert_proxy.py -b "$NDEV" --iters 2 --layers 2 --hidden 64 --seq-len 32
+python examples/native/transformer.py -e 1 -b "$((2 * NDEV))" \
+  --num-layers 2 --hidden-size 64 --sequence-length 32 --num-heads 4
+python examples/native/dlrm.py -e 1 -b "$BATCH" \
+  --arch-embedding-size 1000 --num-tables 4
+
+# keras frontend examples
+python examples/keras/mnist_mlp.py
+python examples/keras/mnist_cnn.py
+python examples/keras/candle_uno.py
+
+# importer frontends
+python examples/pytorch/mnist_mlp_fx.py -e 1 -b "$BATCH"
+python examples/pytorch/cnn_fx.py -e 1 -b "$BATCH"
+python examples/onnx/mnist_mlp_onnx.py -e 1 -b "$BATCH"
+
+# bootcamp demo
+python bootcamp_demo/native_alexnet.py -e 1 -b "$BATCH"
+python bootcamp_demo/torch_alexnet_import.py -e 1 -b "$BATCH"
+python bootcamp_demo/keras_alexnet_cifar10.py
+
+echo "multi_device_tests: ALL PASSED"

@@ -32,3 +32,40 @@ def test_capi_alexnet_end_to_end():
     assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
     assert "alexnet_c: SUCCESS" in r.stdout
     assert "devices=8" in r.stdout
+
+
+@pytest.mark.skipif(shutil.which("g++") is None
+                    or shutil.which("python3-config") is None,
+                    reason="no C++ toolchain or Python dev headers")
+def test_capi_dlrm_end_to_end():
+    subprocess.run(["make"], cwd=CAPI, check=True, capture_output=True)
+    subprocess.run(["make"], cwd=CPP, check=True, capture_output=True)
+    env = dict(os.environ)
+    env.update({
+        "FFT_JAX_PLATFORMS": "cpu",
+        "FFT_NUM_CPU_DEVICES": "4",
+        "FFT_REPO_ROOT": REPO,
+    })
+    r = subprocess.run([os.path.join(CPP, "dlrm"), "16", "2", "500", "32"],
+                       env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "dlrm_c: SUCCESS" in r.stdout
+
+
+@pytest.mark.skipif(shutil.which("g++") is None
+                    or shutil.which("python3-config") is None,
+                    reason="no C++ toolchain or Python dev headers")
+def test_capi_transformer_end_to_end():
+    subprocess.run(["make"], cwd=CAPI, check=True, capture_output=True)
+    subprocess.run(["make"], cwd=CPP, check=True, capture_output=True)
+    env = dict(os.environ)
+    env.update({
+        "FFT_JAX_PLATFORMS": "cpu",
+        "FFT_NUM_CPU_DEVICES": "4",
+        "FFT_REPO_ROOT": REPO,
+    })
+    r = subprocess.run(
+        [os.path.join(CPP, "transformer"), "8", "2", "16", "32", "4"],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "transformer_c: SUCCESS" in r.stdout

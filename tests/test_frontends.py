@@ -148,3 +148,74 @@ def test_fx_cnn_with_residual(tmp_path):
     ff.compile(optimizer=None, final_tensor=outs[0])
     y = ff.predict({"x": np.zeros((4, 3, 8, 8), np.float32)})
     assert y.shape == (4, 10)
+
+
+# ---- ONNX importer (duck-typed proto: the onnx package is not bundled) ------
+
+class _FakeAttr:
+    def __init__(self, name, type_, **kw):
+        self.name, self.type = name, type_
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+class _FakeTensorInfo:
+    def __init__(self, name, dims=()):
+        self.name, self.dims = name, list(dims)
+
+
+class _FakeNode:
+    def __init__(self, op_type, inputs, outputs, name="", attrs=()):
+        self.op_type, self.input, self.output = op_type, inputs, outputs
+        self.name, self.attribute = name, list(attrs)
+
+
+class _FakeGraph:
+    def __init__(self, nodes, inputs, outputs, initializer):
+        self.node, self.input, self.output = nodes, inputs, outputs
+        self.initializer = initializer
+
+
+class _FakeModel:
+    def __init__(self, graph):
+        self.graph = graph
+
+
+def _mlp_proto():
+    """input -> Gemm(512) -> Relu -> Gemm(10), Gemm weights as initializers
+    with ONNX (out, in) layout."""
+    nodes = [
+        _FakeNode("Gemm", ["input", "w1", "b1"], ["h1"], name="gemm1"),
+        _FakeNode("Relu", ["h1"], ["r1"], name="relu1"),
+        _FakeNode("Gemm", ["r1", "w2", "b2"], ["out"], name="gemm2"),
+    ]
+    init = [_FakeTensorInfo("w1", (32, 16)), _FakeTensorInfo("b1", (32,)),
+            _FakeTensorInfo("w2", (10, 32)), _FakeTensorInfo("b2", (10,))]
+    return _FakeModel(_FakeGraph(
+        nodes, [_FakeTensorInfo("input", (4, 16))],
+        [_FakeTensorInfo("out")], init))
+
+
+def test_onnx_import_mlp_forward():
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.onnx import ONNXModel
+
+    cfg = FFConfig(batch_size=4, mesh_shape={"data": 1})
+    ff = FFModel(cfg)
+    x = ff.create_tensor([4, 16], name="input")
+    out = ONNXModel(_mlp_proto()).apply(ff, {"input": x})
+    assert out.dims == (4, 10)
+    ff.compile(optimizer=None, final_tensor=out)
+    y = ff.predict({"input": np.zeros((4, 16), np.float32)})
+    assert y.shape == (4, 10)
+
+
+def test_onnx_keras_variant_builds():
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.onnx import ONNXModelKeras
+
+    cfg = FFConfig(batch_size=4, mesh_shape={"data": 1})
+    ff = FFModel(cfg)
+    x = ff.create_tensor([4, 16], name="input")
+    out = ONNXModelKeras(_mlp_proto()).apply(ff, {"input": x})
+    assert out.dims == (4, 10)

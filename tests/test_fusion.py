@@ -1,0 +1,87 @@
+"""FusedOp / apply_fusion tests (reference: FFModel::apply_fusion
+model.cc:1404-1475 + FusedOp fused.cu — fusion must not change semantics)."""
+
+import numpy as np
+
+from flexflow_tpu import (ActiMode, FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer)
+from flexflow_tpu.ops.fused import FusedOp
+
+
+def _build(fusion: bool):
+    cfg = FFConfig(batch_size=8, mesh_shape={"data": 2}, seed=7,
+                   perform_fusion=fusion)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([8, 32], name="x")
+    t = ff.dense(x, 64, name="fc1")          # no built-in activation
+    t = ff.relu(t, name="act1")              # fusable follower
+    t = ff.scalar_multiply(t, 0.5, name="scale1")  # second follower
+    t = ff.dense(t, 32, name="fc2")
+    t = ff.gelu(t, name="act2")
+    out = ff.dense(t, 10, name="fc3")
+    return ff, out
+
+
+def test_fusion_shrinks_graph_and_preserves_forward():
+    ff_plain, out_plain = _build(fusion=False)
+    ff_fused, out_fused = _build(fusion=True)
+    ff_plain.compile(optimizer=None, final_tensor=out_plain)
+    ff_fused.compile(optimizer=None, final_tensor=out_fused)
+
+    n_plain = len(ff_plain.ops)
+    n_fused = len(ff_fused.ops)
+    assert n_fused == n_plain - 3  # act1+scale1 onto fc1, act2 onto fc2
+    fused_ops = [op for op in ff_fused.ops if isinstance(op, FusedOp)]
+    assert len(fused_ops) == 2
+    assert {op.name for op in fused_ops} == {"fc1", "fc2"}
+
+    # identical param keys (leader names) => identical init => identical math
+    xb = {"x": np.random.RandomState(0).randn(8, 32).astype(np.float32)}
+    y_plain = np.asarray(ff_plain.predict(xb))
+    y_fused = np.asarray(ff_fused.predict(xb))
+    np.testing.assert_allclose(y_plain, y_fused, rtol=1e-6, atol=1e-6)
+
+
+def test_fusion_trains():
+    ff, out = _build(fusion=True)
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=out)
+    rs = np.random.RandomState(0)
+    loss, _ = ff._run_train_step(
+        {"x": rs.randn(8, 32).astype(np.float32),
+         "label": rs.randint(0, 10, (8, 1)).astype(np.int32)})
+    assert np.isfinite(float(loss))
+
+
+def test_fusion_respects_multi_consumer():
+    """A tensor with two consumers must not become a fused intermediate."""
+    cfg = FFConfig(batch_size=4, mesh_shape={"data": 1}, perform_fusion=True)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([4, 16], name="x")
+    t = ff.dense(x, 16, name="fc1")
+    a = ff.relu(t, name="act")      # consumer 1 of fc1:out
+    b = ff.add(t, a, name="resid")  # consumer 2 of fc1:out
+    ff.compile(optimizer=None, final_tensor=b)
+    assert not any(isinstance(op, FusedOp) and op.name == "fc1"
+                   for op in ff.ops)
+    y = ff.predict({"x": np.zeros((4, 16), np.float32)})
+    assert np.asarray(y).shape == (4, 16)
+
+
+def test_fusion_blocked_by_conflicting_strategy():
+    from flexflow_tpu.parallel.pconfig import ParallelConfig
+
+    cfg = FFConfig(batch_size=8, mesh_shape={"data": 2}, perform_fusion=True)
+    # explicit conflicting entry on the follower blocks fusion
+    cfg.strategies["act1"] = ParallelConfig.from_axis_map(
+        2, {"data": 2}, {"data": None})
+    cfg.strategies["fc1"] = ParallelConfig.from_axis_map(
+        2, {"data": 2}, {"data": 0})
+    ff = FFModel(cfg)
+    x = ff.create_tensor([8, 32], name="x")
+    t = ff.dense(x, 64, name="fc1")
+    t = ff.relu(t, name="act1")
+    out = ff.dense(t, 10, name="fc2")
+    ff.compile(optimizer=None, final_tensor=out)
+    assert not any(isinstance(op, FusedOp) for op in ff.ops)

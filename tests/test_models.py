@@ -55,6 +55,36 @@ def test_inception_builds_and_steps():
              final=out)
 
 
+def test_inception_v3_full_builds_and_steps():
+    from flexflow_tpu.models.cnn import inception_v3
+
+    B = 2
+    ff = FFModel(FFConfig(batch_size=B, mesh_shape={"data": 2}))
+    x, out = inception_v3(ff, B, num_classes=10, image_size=299)
+    # full tower: stem(7) + 3xA + B + 4xC + D + 2xE + head — branchy
+    assert len(ff.ops) > 90
+    rs = np.random.RandomState(0)
+    one_step(ff, {"input": rs.randn(B, 3, 299, 299).astype(np.float32),
+                  "label": rs.randint(0, 10, (B, 1)).astype(np.int32)},
+             final=out)
+
+
+def test_candle_uno_builds_and_steps():
+    from flexflow_tpu.models.cnn import candle_uno
+
+    B = 8
+    ff = FFModel(FFConfig(batch_size=B, mesh_shape={"data": 4}))
+    inputs, out = candle_uno(ff, B, dense_layers=(64, 64),
+                             dense_feature_layers=(32, 32))
+    assert len(inputs) == 7
+    rs = np.random.RandomState(0)
+    batch = {"label": rs.rand(B, 1).astype(np.float32)}
+    for name, t in inputs.items():
+        batch[name] = rs.randn(B, t.dims[1]).astype(np.float32)
+    one_step(ff, batch, loss=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+             final=out)
+
+
 def test_dlrm_builds_and_steps():
     from flexflow_tpu.models.dlrm import dlrm
 

@@ -65,3 +65,33 @@ def test_compile_with_budget_end_to_end(tmp_path):
     batch = ff._stage_batch()
     loss, _ = ff._run_train_step(batch)
     assert np.isfinite(float(loss))
+
+
+def test_measured_op_costs_feed_search():
+    """search/measure.py (reference: measure_operator_cost,
+    simulator.cc:296-316): real timings populate the cost table, signatures
+    dedup across identical ops, and the search accepts the table."""
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.driver import (data_parallel_strategy,
+                                            optimize_strategies)
+    from flexflow_tpu.search.measure import measure_op_costs
+
+    mesh = {"data": 2, "model": 2}
+    cfg = FFConfig(batch_size=16, mesh_shape=mesh)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([16, 32], name="x")
+    t = ff.dense(x, 64, ActiMode.AC_MODE_RELU, name="fc1")
+    t = ff.dense(t, 64, ActiMode.AC_MODE_RELU, name="fc2")  # same signature
+    t = ff.dense(t, 8, name="out")
+    measured = measure_op_costs(ff, mesh, iters=2)
+    assert measured, "no measurements produced"
+    assert all(v > 0 for v in measured.values())
+    # fc1 at full replication (shard shape == full shape) must be measured
+    assert (("fc1", (16, 64)) in measured) or (("fc1", (8, 64)) in measured)
+
+    cost = CostModel(ff, mesh, measured=measured)
+    dp = cost.iteration_time(data_parallel_strategy(ff, mesh))
+    assert np.isfinite(dp) and dp > 0
+    best = optimize_strategies(ff, budget=30, mesh_shape=mesh,
+                               measured=measured, use_native=False)
+    assert set(best) == {"fc1", "fc2", "out"}
