@@ -33,7 +33,12 @@ def main():
     batch = 32 * n_dev
     seq, hidden, layers, heads = 128, 512, 6, 8
 
-    cfg = FFConfig(batch_size=batch, mesh_shape={"data": n_dev})
+    # bf16 compute is the MXU-native configuration (master params stay f32;
+    # tests/test_training.py::test_bfloat16_mixed_precision_training). CPU
+    # emulates bf16 slowly, so the smoke path stays f32.
+    compute = "bfloat16" if jax.default_backend() == "tpu" else "float32"
+    cfg = FFConfig(batch_size=batch, mesh_shape={"data": n_dev},
+                   compute_dtype=compute)
     ff = FFModel(cfg)
     x, out = build_encoder_classifier(ff, batch, seq, hidden, layers, heads)
     ff.compile(SGDOptimizer(lr=0.01),

@@ -57,6 +57,9 @@ class FFConfig:
     # execution flags
     sp_mode: str = "ring"  # sequence-parallel lowering: "ring" | "ulysses"
     profiling: bool = False
+    # write the simulated schedule as DOT after compile (reference
+    # --taskgraph, model.cc:2066-2069)
+    taskgraph_file: str = ""
     # graph-level FusedOp pass (ops/fused.py); XLA fuses kernels regardless
     perform_fusion: bool = False
     simulator_workspace_size: int = 2 * 1024 * 1024 * 1024
@@ -105,6 +108,7 @@ class FFConfig:
         p.add_argument("--enable-parameter-parallel", action="store_true")
         p.add_argument("--enable-attribute-parallel", action="store_true")
         p.add_argument("--measure-costs", action="store_true")
+        p.add_argument("--taskgraph", dest="taskgraph", type=str, default="")
         p.add_argument("--profiling", action="store_true")
         p.add_argument("--fusion", action="store_true")
         p.add_argument("--num-devices", type=int, default=None)
@@ -121,6 +125,7 @@ class FFConfig:
             enable_parameter_parallel=args.enable_parameter_parallel,
             enable_attribute_parallel=args.enable_attribute_parallel,
             measure_search_costs=args.measure_costs,
+            taskgraph_file=args.taskgraph,
             profiling=args.profiling,
             perform_fusion=args.fusion,
             num_devices=args.num_devices,

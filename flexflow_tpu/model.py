@@ -196,6 +196,21 @@ class FFModel:
             embed_dim, num_heads, kdim, vdim, dropout, bias, add_bias_kv,
             add_zero_attn, causal))
 
+    def transformer_pipeline_stack(self, input: Tensor, num_layers: int,
+                                   num_heads: int, ffn_mult: int = 4,
+                                   causal: bool = False,
+                                   num_microbatches: Optional[int] = None,
+                                   name: Optional[str] = None) -> Tensor:
+        """L identical transformer blocks with stacked weights; under a
+        'pipe' mesh axis the stack runs as a GPipe ring (graph-level pipeline
+        parallelism — the reference's NMT chunked-timestep scheme, rnn.h:21-63,
+        re-designed for TPU as layer stacking; see ops/pipelined.py)."""
+        from flexflow_tpu.ops.pipelined import TransformerPipelineStack
+
+        return self._add(TransformerPipelineStack(
+            self, self._name("transformer_pipeline_stack", name), [input],
+            num_layers, num_heads, ffn_mult, causal, num_microbatches))
+
     def reshape(self, input: Tensor, shape: Sequence[int],
                 name: Optional[str] = None) -> Tensor:
         return self._add(Reshape(self, self._name("reshape", name), [input], shape))
@@ -389,6 +404,11 @@ class FFModel:
                 self._final_tensor)
         self._eval_step = self.executor.make_eval_step(
             self.loss_type, self.metric_types, self._final_tensor)
+
+        if cfg.taskgraph_file:
+            from flexflow_tpu.runtime.profiler import export_sim_taskgraph
+
+            export_sim_taskgraph(self, cfg.taskgraph_file)
 
     # ---------------------------------------------------------- train verbs
 

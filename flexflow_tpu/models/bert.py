@@ -57,3 +57,22 @@ def gpt_lm(ff: FFModel, batch_size: int, seq_len: int = 256,
     t = ff.rms_norm(t, name="ln_f")
     logits = ff.dense(t, vocab_size, use_bias=False, name="lm_head")
     return tokens, logits
+
+
+def gpt_pipelined(ff: FFModel, batch_size: int, seq_len: int = 256,
+                  hidden: int = 512, layers: int = 8, heads: int = 8,
+                  vocab_size: int = 32_000,
+                  num_microbatches=None):
+    """Decoder-only causal LM with the layer stack as ONE pipelined op
+    (ops/pipelined.py): under a 'pipe' mesh axis the blocks run as a GPipe
+    ring; single-device it is a lax.scan over layers. The graph-level PP
+    counterpart of the reference's NMT pipeline (nmt/rnn.h:21-63)."""
+    tokens = ff.create_tensor([batch_size, seq_len], dtype=DataType.DT_INT32,
+                              name="input")
+    t = ff.embedding(tokens, vocab_size, hidden, name="tok_embed")
+    t = ff.transformer_pipeline_stack(t, layers, heads, causal=True,
+                                      num_microbatches=num_microbatches,
+                                      name="blocks")
+    t = ff.rms_norm(t, name="ln_f")
+    logits = ff.dense(t, vocab_size, use_bias=False, name="lm_head")
+    return tokens, logits

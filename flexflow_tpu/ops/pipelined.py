@@ -1,0 +1,172 @@
+"""Pipelined transformer layer stack: graph-level pipeline parallelism.
+
+Reference: pipelining exists only in the hand-rolled NMT subsystem — chunked
+timesteps over per-(layer,timestep) device tables (nmt/rnn.h:21-63,
+SharedVariable weight placement rnn.h:37-51). The TPU re-design is the
+standard stacked-layer scheme: all L identical transformer blocks live in ONE
+op whose weights carry a leading layer dim; under a 'pipe' mesh axis of size
+S the stack reshapes to [S, L/S, ...], each pipe index owns L/S layers, and
+microbatches ripple through the ring via the GPipe loop
+(parallel/pipeline.py). Without a pipe axis the same op is a lax.scan over
+layers — one compiled block body either way (XLA-friendly, no per-layer
+unrolling).
+
+This integrates PP with the strategy system: the stack's weights shard dim 0
+over 'pipe' (weight_partition), batch stays partitionable over 'data'
+(dp x pp composition), and the single-device path is numerically identical
+(tests/test_pipeline_moe.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from flexflow_tpu.ffconst import DataType, OperatorType
+from flexflow_tpu.ops.base import Op, WeightSpec
+
+
+def _layer_norm(h, scale, bias, eps=1e-5):
+    mu = jnp.mean(h, axis=-1, keepdims=True)
+    var = jnp.var(h, axis=-1, keepdims=True)
+    return (h - mu) * lax.rsqrt(var + eps) * scale + bias
+
+
+def _block(p: Dict[str, jnp.ndarray], h: jnp.ndarray, num_heads: int,
+           causal: bool) -> jnp.ndarray:
+    """Pre-LN transformer block: MHA + residual, FFN(gelu) + residual."""
+    B, S, D = h.shape
+    hd = D // num_heads
+    a = _layer_norm(h, p["ln1_scale"], p["ln1_bias"])
+    q = (a @ p["wq"] + p["bq"]).reshape(B, S, num_heads, hd)
+    k = (a @ p["wk"] + p["bk"]).reshape(B, S, num_heads, hd)
+    v = (a @ p["wv"] + p["bv"]).reshape(B, S, num_heads, hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(logits, axis=-1), v)
+    h = h + ctx.reshape(B, S, D) @ p["wo"] + p["bo"]
+    f = _layer_norm(h, p["ln2_scale"], p["ln2_bias"])
+    f = jax.nn.gelu(f @ p["w1"] + p["b1"])
+    return h + f @ p["w2"] + p["b2"]
+
+
+class TransformerPipelineStack(Op):
+    """L identical transformer blocks with stacked weights [L, ...]."""
+
+    op_type = OperatorType.OP_MULTIHEAD_ATTENTION
+    wants_shard_ctx = True
+
+    def __init__(self, model, name, inputs, num_layers: int, num_heads: int,
+                 ffn_mult: int = 4, causal: bool = False,
+                 num_microbatches: Optional[int] = None):
+        super().__init__(model, name, inputs, num_layers=num_layers,
+                         num_heads=num_heads, ffn_mult=ffn_mult,
+                         causal=causal)
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.ffn_mult = ffn_mult
+        self.causal = causal
+        self.num_microbatches = num_microbatches
+        d = inputs[0].dims[-1]
+        assert d % num_heads == 0, f"hidden {d} % heads {num_heads}"
+        self.finalize()
+
+    def output_shapes(self):
+        return [self.inputs[0].dims], [DataType.DT_FLOAT]
+
+    def weights(self) -> List[WeightSpec]:
+        L = self.num_layers
+        D = self.inputs[0].dims[-1]
+        F = D * self.ffn_mult
+        specs = []
+        for nm in ("wq", "wk", "wv", "wo"):
+            specs.append(WeightSpec(nm, (L, D, D), fan=(D, D)))
+        for nm in ("bq", "bk", "bv", "bo"):
+            specs.append(WeightSpec(nm, (L, D), init="zero"))
+        specs += [
+            WeightSpec("w1", (L, D, F), fan=(D, F)),
+            WeightSpec("b1", (L, F), init="zero"),
+            WeightSpec("w2", (L, F, D), fan=(F, D)),
+            WeightSpec("b2", (L, D), init="zero"),
+            WeightSpec("ln1_scale", (L, D), init="one"),
+            WeightSpec("ln1_bias", (L, D), init="zero"),
+            WeightSpec("ln2_scale", (L, D), init="one"),
+            WeightSpec("ln2_bias", (L, D), init="zero"),
+        ]
+        return specs
+
+    # -- parallelization -------------------------------------------------------
+
+    def _pipe_stages(self) -> int:
+        mesh_shape = getattr(self.model.config, "mesh_shape", None) or {}
+        s = mesh_shape.get("pipe", 1)
+        if s > 1 and self.num_layers % s != 0:
+            if not getattr(self, "_warned_pipe_mismatch", False):
+                self._warned_pipe_mismatch = True
+                from flexflow_tpu.logger import fflogger
+
+                fflogger.warning(
+                    "%s: num_layers=%d not divisible by pipe axis size %d — "
+                    "pipeline parallelism DISABLED, running serial on "
+                    "replicated weights (the %d pipe devices stay idle)",
+                    self.name, self.num_layers, s, s)
+            return 1
+        return s if s > 1 else 1
+
+    def weight_partition(self, axis_map):
+        from jax.sharding import PartitionSpec as P
+
+        if self._pipe_stages() > 1:
+            # layer dim (0) over 'pipe' — each stage owns its layers' weights
+            # (the SharedVariable-per-node placement analog, rnn.h:37-51)
+            return {w.name: P(*(["pipe"] + [None] * (len(w.shape) - 1)))
+                    for w in self.weight_specs()}
+        return super().weight_partition(axis_map)
+
+    def partitionable_output_dims(self):
+        return [0]
+
+    def flops(self):
+        B, S, D = self.inputs[0].dims
+        per_layer = (4 * B * S * D * D + 2 * B * S * S * D
+                     + 2 * B * S * D * D * self.ffn_mult)
+        return 2 * per_layer * self.num_layers
+
+    # -- execution -------------------------------------------------------------
+
+    def forward(self, params, xs, *, training=False, rng=None, shard_ctx=None):
+        x = xs[0]
+        L, H, causal = self.num_layers, self.num_heads, self.causal
+        stages = self._pipe_stages()
+        mesh = shard_ctx["mesh"] if shard_ctx else None
+
+        if stages > 1 and mesh is not None and "pipe" in mesh.shape:
+            from flexflow_tpu.parallel.pipeline import pipeline
+
+            per_stage = L // stages
+            stacked = {k: v.reshape(stages, per_stage, *v.shape[1:])
+                       for k, v in params.items()}
+
+            def stage_fn(sp, h):
+                # this stage's per_stage layers, scanned
+                def body(hh, lp):
+                    return _block(lp, hh, H, causal), None
+
+                out, _ = lax.scan(body, h, sp)
+                return out
+
+            num_micro = self.num_microbatches or stages
+            return [pipeline(stage_fn, stacked, x, mesh,
+                             num_microbatches=num_micro, data_axis="data")]
+
+        def body(hh, lp):
+            return _block(lp, hh, H, causal), None
+
+        out, _ = lax.scan(body, x, params)
+        return [out]

@@ -63,12 +63,14 @@ def gpipe_loop(stage_fn: Callable, stage_params, x_mb, axis_name: str):
 
 
 def pipeline(stage_fn: Callable, stacked_params, x, mesh, axis_name: str = "pipe",
-             num_microbatches: int = None):
+             num_microbatches: int = None, data_axis: str = None):
     """User-facing pipelined apply.
 
     stage_fn(params_i, x) -> y with y.shape == x.shape
     stacked_params: pytree with leading dim = num_stages
     x: (batch, ...) global input. Returns (batch, ...) output.
+    data_axis: optional mesh axis the microbatch dim is ALSO sharded over
+    (composes dp x pp: each pipe ring runs on its data slice).
     """
     from jax.sharding import PartitionSpec as P
 
@@ -89,7 +91,11 @@ def pipeline(stage_fn: Callable, stacked_params, x, mesh, axis_name: str = "pipe
 
     from flexflow_tpu.parallel import shard_map_compat
 
+    dp = (data_axis if data_axis and mesh.shape.get(data_axis, 1) > 1
+          else None)
     pspec = jax.tree_util.tree_map(
-        lambda a: P("pipe", *([None] * (a.ndim - 1))), stacked_params)
-    out = shard_map_compat(inner, mesh, (pspec, P()), P())(stacked_params, x_mb)
+        lambda a: P(axis_name, *([None] * (a.ndim - 1))), stacked_params)
+    xspec = P(None, dp) if dp else P()
+    out = shard_map_compat(inner, mesh, (pspec, xspec), xspec)(
+        stacked_params, x_mb)
     return out.reshape(b, *out.shape[2:])

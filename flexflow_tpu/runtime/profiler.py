@@ -106,3 +106,52 @@ def export_taskgraph(model, filename: str):
     with open(filename, "w") as f:
         f.write("\n".join(lines))
     return filename
+
+
+def export_sim_taskgraph(model, filename: str, mesh_shape=None):
+    """Simulated schedule as Graphviz DOT with per-task start/end times
+    (reference: --taskgraph, the simulator's DotFile dump used at
+    simulator.cc:496-545). Uses the model's resolved strategy (compile()
+    first) and the C++ event-driven simulator's timeline."""
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.csim import get_search_problem
+
+    mesh_shape = mesh_shape or model.config.mesh_shape
+    cost = CostModel(model, mesh_shape)
+    prob = get_search_problem(model, cost, mesh_shape)
+    strategy = {}
+    if model.executor is not None:
+        strategy = {name: am
+                    for name, am in model.executor._op_axis_maps.items()}
+    choices = prob.choices_for(strategy)
+    total, rows = prob.simulate_timeline(choices)
+
+    lines = ["digraph sim_taskgraph {", "  rankdir=LR;",
+             f'  label="simulated iteration: {total * 1e3:.3f} ms";']
+    for r in rows:
+        if r["kind"] == "compute":
+            lines.append(
+                f'  "{r["name"]}" [shape=ellipse, label="{r["name"]}\\n'
+                f'[{r["start"] * 1e3:.3f}, {r["finish"] * 1e3:.3f}] ms"];')
+        elif r["kind"] == "grad_sync":
+            node = f'{r["name"]}_sync'
+            lines.append(
+                f'  "{node}" [shape=diamond, label="sync {r["name"]}\\n'
+                f'[{r["start"] * 1e3:.3f}, {r["finish"] * 1e3:.3f}] ms"];')
+            lines.append(f'  "{r["name"]}" -> "{node}" [style=dashed];')
+    for r in rows:
+        if r["kind"] == "comm":
+            lines.append(
+                f'  "{r["src"]}" -> "{r["dst"]}" [color=red, '
+                f'label="[{r["start"] * 1e3:.3f}, '
+                f'{r["finish"] * 1e3:.3f}] ms"];')
+    comm_edges = {(r["src"], r["dst"]) for r in rows if r["kind"] == "comm"}
+    for op in prob.ops:
+        for t in op.inputs:
+            if t.owner_op is not None and t.owner_op.name in prob.op_index:
+                if (t.owner_op.name, op.name) not in comm_edges:
+                    lines.append(f'  "{t.owner_op.name}" -> "{op.name}";')
+    lines.append("}")
+    with open(filename, "w") as f:
+        f.write("\n".join(lines))
+    return total, filename

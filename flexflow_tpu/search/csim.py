@@ -44,6 +44,10 @@ def _load_lib():
     lib.ff_mcmc.argtypes = [ctypes.c_int, ctypes.c_int, i64, d, d,
                             i32, i32, i64, d, i32,
                             ctypes.c_int, ctypes.c_double, ctypes.c_uint64, i32]
+    lib.ff_simulate_timeline.restype = ctypes.c_double
+    lib.ff_simulate_timeline.argtypes = [ctypes.c_int, ctypes.c_int, i64, d, d,
+                                         i32, i32, i64, d, i32,
+                                         d, d, d, d, d, d]
     _lib = lib
     return lib
 
@@ -123,6 +127,37 @@ class CompiledSearchProblem:
             self.edge_dst, self.edge_cost_offsets, self.edge_costs,
             np.ascontiguousarray(choices, np.int32))
 
+    def simulate_timeline(self, choices: np.ndarray):
+        """Per-task schedule under `choices` (reference: simulator DOT export
+        with start/end times, --taskgraph). Returns (total_seconds, rows)
+        where rows = [{kind, name, start, finish, src, dst}]."""
+        lib = _load_lib()
+        n, ne = len(self.ops), self.num_edges
+        cs, cf = np.zeros(n), np.zeros(n)
+        ss, sf = np.zeros(n), np.zeros(n)
+        ms, mf = np.zeros(max(ne, 1)), np.zeros(max(ne, 1))
+        total = lib.ff_simulate_timeline(
+            n, ne, self.op_cost_offsets, self.op_compute_costs,
+            self.op_sync_costs, self.edge_src, self.edge_dst,
+            self.edge_cost_offsets, self.edge_costs,
+            np.ascontiguousarray(choices, np.int32), cs, cf, ms, mf, ss, sf)
+        rows = []
+        for i, op in enumerate(self.ops):
+            rows.append({"kind": "compute", "name": op.name,
+                         "start": cs[i], "finish": cf[i]})
+            if sf[i] > ss[i]:
+                rows.append({"kind": "grad_sync", "name": op.name,
+                             "start": ss[i], "finish": sf[i]})
+        for e in range(ne):
+            if mf[e] > ms[e]:
+                rows.append({"kind": "comm",
+                             "name": f"{self.ops[self.edge_src[e]].name}->"
+                                     f"{self.ops[self.edge_dst[e]].name}",
+                             "start": ms[e], "finish": mf[e],
+                             "src": self.ops[self.edge_src[e]].name,
+                             "dst": self.ops[self.edge_dst[e]].name})
+        return total, rows
+
     def mcmc(self, init_choices: np.ndarray, budget: int, alpha: float,
              seed: int):
         lib = _load_lib()
@@ -136,6 +171,22 @@ class CompiledSearchProblem:
         return best, best_cost
 
 
+def get_search_problem(model, cost, mesh_shape: Dict[str, int],
+                       epp: bool = True, eap: bool = True
+                       ) -> CompiledSearchProblem:
+    """Cache CompiledSearchProblem per (graph, mesh, flags, measured?) on the
+    model — the search pass and the --taskgraph export at compile share one
+    cost-table build instead of enumerating the O(edges x choices^2) tables
+    twice."""
+    key = (tuple(op.name for op in model.ops),
+           tuple(sorted(mesh_shape.items())), epp, eap,
+           bool(getattr(cost, "measured", None)))
+    cache = model.__dict__.setdefault("_csim_problem_cache", {})
+    if key not in cache:
+        cache[key] = CompiledSearchProblem(model, cost, mesh_shape, epp, eap)
+    return cache[key]
+
+
 def native_optimize(model, cost, mesh_shape: Dict[str, int], budget: int,
                     alpha: float, seed: int,
                     verbose: bool = False) -> Dict[str, ParallelConfig]:
@@ -144,7 +195,7 @@ def native_optimize(model, cost, mesh_shape: Dict[str, int], budget: int,
     cfg = getattr(model, "config", None)
     epp = getattr(cfg, "enable_parameter_parallel", True)
     eap = getattr(cfg, "enable_attribute_parallel", True)
-    prob = CompiledSearchProblem(model, cost, mesh_shape, epp, eap)
+    prob = get_search_problem(model, cost, mesh_shape, epp, eap)
     init = prob.choices_for(data_parallel_strategy(model, mesh_shape))
     dp_cost = prob.simulate(init)
     best, best_cost = prob.mcmc(init, budget, alpha, seed)

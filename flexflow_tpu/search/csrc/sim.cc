@@ -35,14 +35,22 @@ extern "C" {
 //   edge_cost_offsets[num_edges+1]    : prefix offsets into edge_costs
 //   edge_costs[...]                   : row-major [src_choice][dst_choice]
 //   choices[num_ops]                  : the strategy being evaluated
-double ff_simulate(int num_ops, int num_edges,
-                   const int64_t* op_cost_offsets,
-                   const double* op_compute_costs,
-                   const double* op_sync_costs,
-                   const int32_t* edge_src, const int32_t* edge_dst,
-                   const int64_t* edge_cost_offsets,
-                   const double* edge_costs,
-                   const int32_t* choices) {
+// One list-schedule implementation serves both entry points: timeline
+// pointers may be null (the hot MCMC path), or caller buffers for task-graph
+// export (reference: the simulator's DotFile dump with per-task times,
+// simulator.h:78-131 + --taskgraph). comm times are per edge; sync times per
+// op (0-width when no sync).
+static double schedule(int num_ops, int num_edges,
+                       const int64_t* op_cost_offsets,
+                       const double* op_compute_costs,
+                       const double* op_sync_costs,
+                       const int32_t* edge_src, const int32_t* edge_dst,
+                       const int64_t* edge_cost_offsets,
+                       const double* edge_costs,
+                       const int32_t* choices,
+                       double* compute_start, double* compute_finish,
+                       double* comm_start, double* comm_finish,
+                       double* sync_start, double* sync_finish) {
   // finish time of each op's compute; streams advance monotonically
   std::vector<double> finish(num_ops, 0.0);
   std::vector<double> ready(num_ops, 0.0);
@@ -58,9 +66,12 @@ double ff_simulate(int num_ops, int num_edges,
       double c = edge_costs[off + (int64_t)choices[s] * n_dst + choices[i]];
       if (c > 0.0) {
         double start = std::max(finish[s], comm_free);
+        if (comm_start) { comm_start[e] = start; }
         comm_free = start + c;
+        if (comm_finish) { comm_finish[e] = comm_free; }
         ready[i] = std::max(ready[i], comm_free);
       } else {
+        if (comm_start) { comm_start[e] = comm_finish[e] = finish[s]; }
         ready[i] = std::max(ready[i], finish[s]);
       }
       ++e;
@@ -68,16 +79,53 @@ double ff_simulate(int num_ops, int num_edges,
     int64_t off = op_cost_offsets[i];
     double comp = op_compute_costs[off + choices[i]];
     double start = std::max(ready[i], compute_free);
+    if (compute_start) { compute_start[i] = start; }
     finish[i] = start + comp;
+    if (compute_finish) { compute_finish[i] = finish[i]; }
     compute_free = finish[i];
     // gradient sync rides the comm stream after this op's compute
     double sync = op_sync_costs[off + choices[i]];
     if (sync > 0.0) {
       double cstart = std::max(finish[i], comm_free);
+      if (sync_start) { sync_start[i] = cstart; }
       comm_free = cstart + sync;
+      if (sync_finish) { sync_finish[i] = comm_free; }
+    } else if (sync_start) {
+      sync_start[i] = sync_finish[i] = finish[i];
     }
   }
   return std::max(compute_free, comm_free);
+}
+
+double ff_simulate(int num_ops, int num_edges,
+                   const int64_t* op_cost_offsets,
+                   const double* op_compute_costs,
+                   const double* op_sync_costs,
+                   const int32_t* edge_src, const int32_t* edge_dst,
+                   const int64_t* edge_cost_offsets,
+                   const double* edge_costs,
+                   const int32_t* choices) {
+  return schedule(num_ops, num_edges, op_cost_offsets, op_compute_costs,
+                  op_sync_costs, edge_src, edge_dst, edge_cost_offsets,
+                  edge_costs, choices, nullptr, nullptr, nullptr, nullptr,
+                  nullptr, nullptr);
+}
+
+double ff_simulate_timeline(int num_ops, int num_edges,
+                            const int64_t* op_cost_offsets,
+                            const double* op_compute_costs,
+                            const double* op_sync_costs,
+                            const int32_t* edge_src, const int32_t* edge_dst,
+                            const int64_t* edge_cost_offsets,
+                            const double* edge_costs,
+                            const int32_t* choices,
+                            double* compute_start, double* compute_finish,
+                            double* comm_start, double* comm_finish,
+                            double* sync_start, double* sync_finish) {
+  return schedule(num_ops, num_edges, op_cost_offsets, op_compute_costs,
+                  op_sync_costs, edge_src, edge_dst, edge_cost_offsets,
+                  edge_costs, choices, compute_start, compute_finish,
+                  comm_start, comm_finish, sync_start, sync_finish);
 }
 
 // MCMC simulated annealing (reference: model.cc:1663-1725).

@@ -143,3 +143,16 @@ def test_gpt_moe_builds_and_steps():
     one_step(ff, {"input": rs.randint(0, 256, (B, 16)).astype(np.int32),
                   "label": rs.randint(0, 256, (B, 16, 1)).astype(np.int32)},
              final=logits, optimizer=AdamOptimizer(alpha=1e-3))
+
+
+def test_gpt_pipelined_builds_and_steps():
+    from flexflow_tpu.models.bert import gpt_pipelined
+
+    B = 8
+    ff = FFModel(FFConfig(batch_size=B, mesh_shape={"pipe": 2, "data": 2}))
+    tokens, logits = gpt_pipelined(ff, B, seq_len=8, hidden=32, layers=4,
+                                   heads=2, vocab_size=128)
+    rs = np.random.RandomState(0)
+    one_step(ff, {"input": rs.randint(0, 128, (B, 8)).astype(np.int32),
+                  "label": rs.randint(0, 128, (B, 8, 1)).astype(np.int32)},
+             final=logits, optimizer=AdamOptimizer(alpha=1e-3))

@@ -135,3 +135,34 @@ def test_cnn_with_batchnorm_trains():
     SingleDataLoader(ff, ff.label_tensor, y.reshape(n, 1))
     perf = ff.fit(verbose=False)
     assert perf.accuracy > 0.8, f"accuracy {perf.accuracy}"
+
+
+def test_bfloat16_mixed_precision_training():
+    """compute_dtype='bfloat16': matmuls run in bf16 (MXU-native), master
+    params stay f32, loss decreases (runtime/executor.py mixed-precision
+    casts; autodiff through the casts yields f32 grads)."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu import (ActiMode, FFConfig, FFModel, LossType,
+                              MetricsType, SGDOptimizer)
+
+    cfg = FFConfig(batch_size=32, mesh_shape={"data": 2},
+                   compute_dtype="bfloat16", seed=3)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([32, 16], name="x")
+    t = ff.dense(x, 64, ActiMode.AC_MODE_RELU, name="fc1")
+    t = ff.dense(t, 4, name="out")
+    ff.compile(SGDOptimizer(lr=0.1),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY])
+    assert ff.params["fc1"]["kernel"].dtype == jnp.float32  # master copy
+
+    rs = np.random.RandomState(0)
+    xd = rs.randn(32, 16).astype(np.float32)
+    y = (xd[:, :4].argmax(1)).astype(np.int32).reshape(-1, 1)  # learnable
+    losses = []
+    for _ in range(30):
+        loss, _ = ff._run_train_step({"x": xd, "label": y})
+        losses.append(float(loss))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0] * 0.7, losses[::10]
