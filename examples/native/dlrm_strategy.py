@@ -1,0 +1,58 @@
+"""Generate a DLRM parallelization strategy file (reference:
+examples/cpp/DLRM/strategies/dlrm_strategy.py + dlrm_strategy_hetero.cc —
+programmatic strategy generation placing embedding tables across devices
+while MLPs run data-parallel).
+
+Mesh terms: each embedding output's channel dim shards over 'model' (table
+vocab rows stay whole, channels split — the memory-balancing analog of the
+reference's per-GPU table placement), interaction + MLPs run data-parallel.
+
+Usage: python examples/native/dlrm_strategy.py --out dlrm_strategy.txt
+       [--num-tables 8] [--data 4] [--model 2]
+Then:  python examples/native/dlrm.py --import dlrm_strategy.txt
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="dlrm_strategy.txt")
+    ap.add_argument("--num-tables", type=int, default=8)
+    ap.add_argument("--data", type=int, default=4)
+    ap.add_argument("--model", type=int, default=2)
+    ap.add_argument("--mlp-bot", type=int, default=3)
+    ap.add_argument("--mlp-top", type=int, default=4)
+    args = ap.parse_args()
+
+    from flexflow_tpu.parallel.pconfig import ParallelConfig
+    from flexflow_tpu.parallel.strategy import save_strategies_to_file
+
+    mesh = {"data": args.data, "model": args.model}
+    strategies = {}
+    # embeddings: batch over 'data', embedding channels over 'model'
+    for i in range(args.num_tables):
+        strategies[f"emb_{i}"] = ParallelConfig.from_axis_map(
+            2, mesh, {"data": 0, "model": 1})
+    # MLPs: pure data parallel (the reference keeps MLPs data-parallel and
+    # embeddings placed, run_summit.sh strategy files)
+    for i in range(args.mlp_bot):
+        strategies[f"bot_{i}"] = ParallelConfig.from_axis_map(
+            2, mesh, {"data": 0})
+    for i in range(args.mlp_top):
+        strategies[f"top_{i}"] = ParallelConfig.from_axis_map(
+            2, mesh, {"data": 0})
+    strategies["interact"] = ParallelConfig.from_axis_map(
+        2, mesh, {"data": 0})
+
+    save_strategies_to_file(args.out, strategies)
+    print(f"wrote {len(strategies)} op strategies for mesh {mesh} "
+          f"to {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -42,10 +42,11 @@ class FFConfig:
     export_strategy_file: str = ""
     enable_parameter_parallel: bool = False
     enable_attribute_parallel: bool = False
-    # time real per-op fwd+bwd on-device for the search's cost table
-    # (reference: measure_operator_cost, simulator.cc:296-316); analytic
-    # roofline costs when off
-    measure_search_costs: bool = False
+    # search cost-table fidelity: False/"" = analytic roofline; "analyze" =
+    # compile-only XLA cost_analysis (flops/bytes through the machine model);
+    # True/"measure" = real on-device fwd+bwd timing (reference:
+    # measure_operator_cost, simulator.cc:296-316)
+    measure_search_costs: object = False
 
     # dataloader (native threaded gather/prefetch; reference's dataloader is
     # native too — flexflow_dataloader.cc)
@@ -108,11 +109,20 @@ class FFConfig:
         p.add_argument("--enable-parameter-parallel", action="store_true")
         p.add_argument("--enable-attribute-parallel", action="store_true")
         p.add_argument("--measure-costs", action="store_true")
+        p.add_argument("--analyze-costs", action="store_true")
         p.add_argument("--taskgraph", dest="taskgraph", type=str, default="")
         p.add_argument("--profiling", action="store_true")
         p.add_argument("--fusion", action="store_true")
         p.add_argument("--num-devices", type=int, default=None)
+        # e.g. --mesh data=4,model=2 (replaces -ll:gpu device-count knobs)
+        p.add_argument("--mesh", type=str, default="")
         args, _ = p.parse_known_args(argv)
+        mesh_shape = None
+        if args.mesh:
+            mesh_shape = {}
+            for part in args.mesh.split(","):
+                ax, _, size = part.partition("=")
+                mesh_shape[ax.strip()] = int(size)
         return FFConfig(
             batch_size=args.batch_size,
             epochs=args.epochs,
@@ -124,9 +134,11 @@ class FFConfig:
             export_strategy_file=args.export_file,
             enable_parameter_parallel=args.enable_parameter_parallel,
             enable_attribute_parallel=args.enable_attribute_parallel,
-            measure_search_costs=args.measure_costs,
+            measure_search_costs=("measure" if args.measure_costs else
+                                  "analyze" if args.analyze_costs else False),
             taskgraph_file=args.taskgraph,
             profiling=args.profiling,
             perform_fusion=args.fusion,
             num_devices=args.num_devices,
+            mesh_shape=mesh_shape,
         )

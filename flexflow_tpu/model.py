@@ -359,7 +359,15 @@ class FFModel:
             from flexflow_tpu.search.driver import optimize_strategies
 
             measured = None
-            if cfg.measure_search_costs:
+            if cfg.measure_search_costs == "analyze":
+                from flexflow_tpu.search.measure import analyze_op_costs
+
+                measured = analyze_op_costs(
+                    self, cfg.mesh_shape,
+                    enable_parameter_parallel=cfg.enable_parameter_parallel,
+                    enable_attribute_parallel=cfg.enable_attribute_parallel,
+                    verbose=cfg.profiling)
+            elif cfg.measure_search_costs:
                 from flexflow_tpu.search.measure import measure_op_costs
 
                 measured = measure_op_costs(

@@ -27,6 +27,12 @@ python examples/native/transformer.py -e 1 -b "$((2 * NDEV))" \
   --num-layers 2 --hidden-size 64 --sequence-length 32 --num-heads 4
 python examples/native/dlrm.py -e 1 -b "$BATCH" \
   --arch-embedding-size 1000 --num-tables 4
+# strategy-file flow: generate a hetero strategy, train under it
+python examples/native/dlrm_strategy.py --out /tmp/ff_dlrm_strategy.txt \
+  --data 2 --model 2
+python examples/native/dlrm.py -e 1 -b "$BATCH" \
+  --arch-embedding-size 1000 --num-tables 8 \
+  --import /tmp/ff_dlrm_strategy.txt --mesh data=2,model=2
 
 # keras frontend examples
 python examples/keras/mnist_mlp.py

@@ -186,3 +186,104 @@ def test_elementwise_chain():
     y, _ = run_single_op(build, {"x": x})
     ref = 1 / (1 + np.exp(-((np.exp(x) + 2 * x) * x)))
     np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_gradients_match_torch_mlp():
+    """Backward golden test (the reference harness also diffs grads and SGD
+    steps, tests/ops/test_harness.py): param grads of a 2-layer MLP with
+    cross-entropy must match torch autograd at 1e-4."""
+    import torch
+    import torch.nn.functional as F
+
+    from flexflow_tpu import (ActiMode, LossType, MetricsType, SGDOptimizer)
+
+    B, D, H, C = 8, 16, 32, 5
+    rs = np.random.RandomState(0)
+    xd = rs.randn(B, D).astype(np.float32)
+    y = rs.randint(0, C, (B, 1)).astype(np.int32)
+
+    cfg = FFConfig(batch_size=B, mesh_shape={"data": 1}, seed=0)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([B, D], name="x")
+    t = ff.dense(x, H, ActiMode.AC_MODE_RELU, name="fc1")
+    out = ff.dense(t, C, name="fc2")
+    ff.compile(SGDOptimizer(lr=0.0),  # lr 0: step leaves params unchanged
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=out)
+
+    w1 = np.asarray(ff.get_weights("fc1", "kernel"))
+    b1 = np.asarray(ff.get_weights("fc1", "bias"))
+    w2 = np.asarray(ff.get_weights("fc2", "kernel"))
+    b2 = np.asarray(ff.get_weights("fc2", "bias"))
+
+    # our grads via a manual value_and_grad on the same loss
+    import jax as _jax
+
+    def loss_fn(params):
+        from flexflow_tpu.runtime.loss import compute_loss
+
+        fwd = ff.executor.make_forward([out], training=True)
+        logits = fwd(params, ff.bn_state, {"x": xd})[0]
+        return compute_loss(ff.loss_type, logits, y)
+
+    grads = _jax.grad(loss_fn)(ff.params)
+
+    # torch reference
+    tw1 = torch.tensor(w1, requires_grad=True)
+    tb1 = torch.tensor(b1, requires_grad=True)
+    tw2 = torch.tensor(w2, requires_grad=True)
+    tb2 = torch.tensor(b2, requires_grad=True)
+    h = F.relu(torch.tensor(xd) @ tw1 + tb1)
+    logits = h @ tw2 + tb2
+    loss = F.cross_entropy(logits, torch.tensor(y.ravel(), dtype=torch.long))
+    loss.backward()
+
+    np.testing.assert_allclose(np.asarray(grads["fc1"]["kernel"]),
+                               tw1.grad.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads["fc2"]["kernel"]),
+                               tw2.grad.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads["fc1"]["bias"]),
+                               tb1.grad.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads["fc2"]["bias"]),
+                               tb2.grad.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_sgd_momentum_step_matches_torch():
+    """One SGD+momentum+weight-decay step matches torch.optim.SGD (reference
+    harness compares manual SGD update sequences)."""
+    import torch
+
+    from flexflow_tpu import (LossType, MetricsType, SGDOptimizer)
+
+    B, D, C = 8, 12, 4
+    rs = np.random.RandomState(1)
+    xd = rs.randn(B, D).astype(np.float32)
+    y = rs.randint(0, C, (B, 1)).astype(np.int32)
+    lr, mom, wd = 0.1, 0.9, 0.01
+
+    cfg = FFConfig(batch_size=B, mesh_shape={"data": 1}, seed=2)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([B, D], name="x")
+    out = ff.dense(x, C, name="fc")
+    ff.compile(SGDOptimizer(lr=lr, momentum=mom, weight_decay=wd),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=out)
+    w0 = np.asarray(ff.get_weights("fc", "kernel")).copy()
+    b0 = np.asarray(ff.get_weights("fc", "bias")).copy()
+
+    tw = torch.tensor(w0, requires_grad=True)
+    tb = torch.tensor(b0, requires_grad=True)
+    opt = torch.optim.SGD([tw, tb], lr=lr, momentum=mom, weight_decay=wd)
+
+    for _ in range(3):  # multi-step: exercises the momentum buffer
+        ff._run_train_step({"x": xd, "label": y})
+        opt.zero_grad()
+        logits = torch.tensor(xd) @ tw + tb
+        torch.nn.functional.cross_entropy(
+            logits, torch.tensor(y.ravel(), dtype=torch.long)).backward()
+        opt.step()
+
+    np.testing.assert_allclose(np.asarray(ff.get_weights("fc", "kernel")),
+                               tw.detach().numpy(), rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(ff.get_weights("fc", "bias")),
+                               tb.detach().numpy(), rtol=2e-4, atol=2e-5)

@@ -95,3 +95,22 @@ def test_measured_op_costs_feed_search():
     best = optimize_strategies(ff, budget=30, mesh_shape=mesh,
                                measured=measured, use_native=False)
     assert set(best) == {"fc1", "fc2", "out"}
+
+
+def test_analyze_costs_end_to_end(tmp_path):
+    """measure_search_costs='analyze': compile-only XLA cost_analysis feeds
+    the search through compile() and the run still trains."""
+    cfg = FFConfig(batch_size=32, mesh_shape={"data": 2, "model": 2},
+                   search_budget=50, measure_search_costs="analyze")
+    ff = FFModel(cfg)
+    x = ff.create_tensor([32, 64], name="x")
+    t = ff.dense(x, 128, ActiMode.AC_MODE_RELU, name="fc1")
+    ff.dense(t, 8, name="out")
+    ff.compile(SGDOptimizer(lr=0.1),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY])
+    rs = np.random.RandomState(0)
+    loss, _ = ff._run_train_step(
+        {"x": rs.randn(32, 64).astype(np.float32),
+         "label": rs.randint(0, 8, (32, 1)).astype(np.int32)})
+    assert np.isfinite(float(loss))
