@@ -1,42 +1,102 @@
 #!/usr/bin/env python
-"""Headline benchmark: Transformer training throughput on the local device(s).
+"""Headline benchmark: Transformer training throughput + MFU.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+`vs_baseline` is MFU vs the hardware roofline (model FLOPs / step-time /
+peak bf16 FLOPs of the attached chips) — the reference's only published
+metric is its own `THROUGHPUT = %.2f samples/s` print
+(python/flexflow/keras/models/base_model.py:434), so the roofline fraction is
+the honest absolute yardstick.
 
-The reference publishes no numbers (BASELINE.md) — its runtime prints
-`THROUGHPUT = %.2f samples/s` (base_model.py:434); our vs_baseline is
-measured-throughput / analytic data-parallel model prediction until a real
-reference run exists, so it tracks how close execution is to the machine's
-roofline (1.0 = matching the cost model's DP estimate).
+Robustness: the TPU tunnel in this environment can hang or fail at backend
+init (round-1 postmortem: bench died at jax.devices() with rc=1 and no
+number on the board). The benchmark therefore runs in a CHILD process with a
+hard timeout; the parent retries TPU with backoff, falls back to CPU, and
+always prints a single structured JSON line — never a bare traceback.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO = os.path.dirname(os.path.abspath(__file__))
 
-import numpy as np
+# peak dense bf16 FLOP/s per chip by device kind (public spec sheets)
+TPU_PEAK_BF16 = {
+    "TPU v4": 275e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+    "TPU v7": 4614e12,
+}
 
 
-def main():
+def _measured_matmul_peak(dtype_name):
+    """Achievable matmul FLOP/s on the default device — the roofline
+    denominator when the chip kind is unknown (and the honest one on CPU)."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 2048
+    a = jnp.ones((n, n), dtype=dtype_name)
+    f = jax.jit(lambda x: x @ x)
+    jax.block_until_ready(f(a))
+    t0 = time.perf_counter()
+    iters = 5
+    out = None
+    for _ in range(iters):
+        out = f(a)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    return 2 * n ** 3 / dt
+
+
+def _peak_flops_per_chip(dev, backend):
+    kind = getattr(dev, "device_kind", "")
+    if backend == "tpu":
+        # longest key first: 'TPU v5 lite' must hit the v5e entry, not 'TPU v5'
+        for k in sorted(TPU_PEAK_BF16, key=len, reverse=True):
+            if kind.lower().startswith(k.lower()):
+                return TPU_PEAK_BF16[k], "spec"
+        return _measured_matmul_peak("bfloat16"), "measured_matmul"
+    return _measured_matmul_peak("float32"), "measured_matmul"
+
+
+def child():
+    import numpy as np
+
     import jax
 
+    if os.environ.get("FF_BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    print("[bench] initializing backend...", file=sys.stderr, flush=True)
+    devs = jax.devices()
+    backend = jax.default_backend()
+    n_dev = len(devs)
+    print(f"[bench] backend={backend} devices={n_dev} "
+          f"kind={getattr(devs[0], 'device_kind', '?')}",
+          file=sys.stderr, flush=True)
+
+    sys.path.insert(0, REPO)
     from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
                               SGDOptimizer)
     from flexflow_tpu.models.transformer import build_encoder_classifier
-    from flexflow_tpu.search.cost_model import CostModel
-    from flexflow_tpu.search.driver import data_parallel_strategy
+    from flexflow_tpu.ops.base import InputOp
 
-    n_dev = len(jax.devices())
-    batch = 32 * n_dev
-    seq, hidden, layers, heads = 128, 512, 6, 8
+    on_tpu = backend == "tpu"
+    if on_tpu:
+        batch, seq, hidden, layers, heads = 16 * n_dev, 512, 1024, 8, 16
+        iters, compute = 20, "bfloat16"
+    else:  # CPU smoke: prove the path end-to-end fast
+        batch, seq, hidden, layers, heads = 8, 128, 256, 2, 4
+        iters, compute = 5, "float32"
 
-    # bf16 compute is the MXU-native configuration (master params stay f32;
-    # tests/test_training.py::test_bfloat16_mixed_precision_training). CPU
-    # emulates bf16 slowly, so the smoke path stays f32.
-    compute = "bfloat16" if jax.default_backend() == "tpu" else "float32"
     cfg = FFConfig(batch_size=batch, mesh_shape={"data": n_dev},
                    compute_dtype=compute)
     ff = FFModel(cfg)
@@ -50,30 +110,99 @@ def main():
     y = rs.randint(0, 16, (batch, 1)).astype(np.int32)
     batch_data = {"input": xdat, "label": y}
 
-    # warmup (compile)
+    print("[bench] compiling train step...", file=sys.stderr, flush=True)
+    ff._run_train_step(batch_data)  # compile + warmup
+    jax.block_until_ready(ff.params)
     ff._run_train_step(batch_data)
-    import jax as _j
+    jax.block_until_ready(ff.params)
 
-    _j.block_until_ready(ff.params)
-
-    iters = 20
+    print(f"[bench] timing {iters} steps...", file=sys.stderr, flush=True)
     t0 = time.perf_counter()
     for _ in range(iters):
         ff._run_train_step(batch_data)
-    _j.block_until_ready(ff.params)
-    dt = time.perf_counter() - t0
-    throughput = iters * batch / dt
+    jax.block_until_ready(ff.params)
+    dt = (time.perf_counter() - t0) / iters
+    throughput = batch / dt
 
-    cost = CostModel(ff, cfg.mesh_shape)
-    predicted = batch / max(
-        cost.iteration_time(data_parallel_strategy(ff, cfg.mesh_shape)), 1e-9)
+    # MFU: train step ~= fwd + 2x fwd for bwd; flops() methods count forward
+    fwd_flops = sum(op.flops() for op in ff.ops
+                    if not isinstance(op, InputOp))
+    step_flops = 3.0 * fwd_flops
+    peak, peak_src = _peak_flops_per_chip(devs[0], backend)
+    mfu = step_flops / dt / (peak * n_dev)
+
     print(json.dumps({
         "metric": "transformer_train_throughput",
         "value": round(throughput, 2),
         "unit": "samples/s",
-        "vs_baseline": round(throughput / predicted, 4),
-    }))
+        "vs_baseline": round(mfu, 4),
+        "mfu": round(mfu, 4),
+        "step_time_ms": round(dt * 1e3, 3),
+        "step_tflops": round(step_flops / 1e12, 3),
+        "peak_tflops_per_chip": round(peak / 1e12, 1),
+        "peak_source": peak_src,
+        "backend": backend,
+        "device_kind": getattr(devs[0], "device_kind", "?"),
+        "n_devices": n_dev,
+        "config": {"batch": batch, "seq": seq, "hidden": hidden,
+                   "layers": layers, "heads": heads, "dtype": compute},
+    }), flush=True)
+
+
+def _run_child(force_cpu, timeout):
+    env = dict(os.environ)
+    env["FF_BENCH_CHILD"] = "1"
+    if force_cpu:
+        env["FF_BENCH_FORCE_CPU"] = "1"
+    else:
+        env.pop("FF_BENCH_FORCE_CPU", None)
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          env=env, capture_output=True, text=True,
+                          timeout=timeout)
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), proc
+            except json.JSONDecodeError:
+                continue
+    return None, proc
+
+
+def main():
+    # (force_cpu, timeout_s, backoff_before_s)
+    t1 = int(os.environ.get("FF_BENCH_TPU_TIMEOUT", "900"))
+    t2 = int(os.environ.get("FF_BENCH_RETRY_TIMEOUT", "600"))
+    attempts = [(False, t1, 0), (False, t2, 30), (True, t2, 5)]
+    errors = []
+    for force_cpu, timeout, backoff in attempts:
+        if backoff:
+            time.sleep(backoff)
+        label = "cpu-fallback" if force_cpu else "tpu"
+        try:
+            result, proc = _run_child(force_cpu, timeout)
+        except subprocess.TimeoutExpired:
+            errors.append(f"{label}: timeout after {timeout}s")
+            continue
+        except Exception as e:  # noqa: BLE001 — never die without JSON
+            errors.append(f"{label}: {type(e).__name__}: {e}")
+            continue
+        if result is not None:
+            if errors:
+                result["attempt_errors"] = errors
+            print(json.dumps(result), flush=True)
+            return 0
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        errors.append(f"{label}: rc={proc.returncode} " + " | ".join(tail[-3:]))
+    print(json.dumps({
+        "metric": "transformer_train_throughput",
+        "value": 0.0,
+        "unit": "samples/s",
+        "vs_baseline": 0.0,
+        "error": "; ".join(errors)[-2000:],
+    }), flush=True)
+    return 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(child() if os.environ.get("FF_BENCH_CHILD") else main())

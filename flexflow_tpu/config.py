@@ -121,7 +121,11 @@ class FFConfig:
         if args.mesh:
             mesh_shape = {}
             for part in args.mesh.split(","):
-                ax, _, size = part.partition("=")
+                ax, eq, size = part.partition("=")
+                if not eq or not ax.strip() or not size.strip().isdigit() \
+                        or int(size) < 1:
+                    p.error(f"--mesh: bad entry {part!r}; expected "
+                            f"'axis=size[,axis=size]', e.g. 'data=4,model=2'")
                 mesh_shape[ax.strip()] = int(size)
         return FFConfig(
             batch_size=args.batch_size,

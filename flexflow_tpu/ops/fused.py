@@ -39,6 +39,10 @@ class FusedOp(Op):
         self.leader = leader
         self.members = list(members)
         self.stateful = leader.stateful
+        # the executor only passes shard_ctx when this attribute is set; a
+        # leader that needs it (SP attention, pipeline stack) must keep it
+        # visible through the fused wrapper
+        self.wants_shard_ctx = getattr(leader, "wants_shard_ctx", False)
         self.needs_rng = leader.needs_rng or any(m.needs_rng for m in members)
         # graph output = the LAST member's tensors, so downstream consumers'
         # tensor-object lookups keep resolving (intermediates vanish from the

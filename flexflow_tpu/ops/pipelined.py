@@ -162,8 +162,16 @@ class TransformerPipelineStack(Op):
                 return out
 
             num_micro = self.num_microbatches or stages
+            # the axis sharding the batch dim comes from the strategy, not a
+            # hardcoded name — a mesh calling its data axis something else
+            # must still shard microbatches over it
+            axis_map = (shard_ctx.get("axis_map") or {}) if shard_ctx else {}
+            batch_axes = [ax for ax, d in axis_map.items()
+                          if d == 0 and ax != "pipe"
+                          and mesh.shape.get(ax, 1) > 1]
+            data_axis = batch_axes[0] if batch_axes else None
             return [pipeline(stage_fn, stacked, x, mesh,
-                             num_microbatches=num_micro, data_axis="data")]
+                             num_microbatches=num_micro, data_axis=data_axis)]
 
         def body(hh, lp):
             return _block(lp, hh, H, causal), None

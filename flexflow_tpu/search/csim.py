@@ -178,9 +178,13 @@ def get_search_problem(model, cost, mesh_shape: Dict[str, int],
     model — the search pass and the --taskgraph export at compile share one
     cost-table build instead of enumerating the O(edges x choices^2) tables
     twice."""
+    measured = getattr(cost, "measured", None)
     key = (tuple(op.name for op in model.ops),
            tuple(sorted(mesh_shape.items())), epp, eap,
-           bool(getattr(cost, "measured", None)))
+           # content hash of the measured table: a refreshed or in-place
+           # updated table must invalidate the cached cost tables (id() can
+           # be reused by a new dict at the same address)
+           hash(frozenset(measured.items())) if measured else None)
     cache = model.__dict__.setdefault("_csim_problem_cache", {})
     if key not in cache:
         cache[key] = CompiledSearchProblem(model, cost, mesh_shape, epp, eap)

@@ -51,16 +51,63 @@ def _rand_for(shape, dtype: DataType, rs):
     return rs.randn(*shape).astype(np_dt)
 
 
-def measure_one(op: Op, in_shapes, w_shapes, *, warmup=1, iters=5,
-                timeout_compile=None) -> Optional[float]:
-    """Time one jitted fwd+bwd of `op` at the given per-shard shapes on the
-    default device. Returns seconds, or None if the op can't run standalone
-    (e.g. needs shard context)."""
+def _single_device_ctx():
+    """A 1-device mesh shard_ctx so wants_shard_ctx ops run their local
+    (dense) lowering inside the measurement harness — the per-shard compute
+    cost is what the simulator wants; comm is priced separately by the
+    machine model."""
+    import jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("_measure",))
+    return {"mesh": mesh, "axis_map": {}, "sp_mode": "ring"}
+
+
+def _build_fwd_bwd(op: Op, params, xs, rng):
+    """fwd+bwd closure differentiating w.r.t. params and FLOAT inputs only
+    (integer inputs — embedding ids — are closed over; value_and_grad on them
+    would raise and previously made such ops silently unmeasurable)."""
     import jax
     import jax.numpy as jnp
 
-    if getattr(op, "wants_shard_ctx", False) or op.stateful:
-        return None  # needs mesh context / state threading; analytic fallback
+    float_idx = tuple(i for i, x in enumerate(xs)
+                      if jnp.issubdtype(x.dtype, jnp.floating))
+    int_xs = {i: x for i, x in enumerate(xs) if i not in float_idx}
+    kwargs = {}
+    if getattr(op, "wants_shard_ctx", False):
+        kwargs["shard_ctx"] = _single_device_ctx()
+    state0 = {k: jnp.asarray(v) for k, v in op.init_state().items()} \
+        if op.stateful else None
+
+    def fwd_bwd(p, fxs):
+        def loss(p_, fxs_):
+            xs_ = [int_xs[i] if i in int_xs else fxs_[float_idx.index(i)]
+                   for i in range(len(xs))]
+            if op.stateful:
+                outs, _ = op.forward_stateful(
+                    p_, state0, xs_, training=True,
+                    rng=rng if op.needs_rng else None)
+            else:
+                outs = op.forward(p_, xs_, training=True,
+                                  rng=rng if op.needs_rng else None, **kwargs)
+            return sum(jnp.sum(jnp.square(o.astype(jnp.float32)))
+                       for o in outs)
+
+        return jax.value_and_grad(loss, argnums=(0, 1))(p, fxs)
+
+    float_vals = tuple(xs[i] for i in float_idx)
+    return fwd_bwd, float_vals
+
+
+def measure_one(op: Op, in_shapes, w_shapes, *, warmup=1, iters=5,
+                timeout_compile=None) -> Optional[float]:
+    """Time one jitted fwd+bwd of `op` at the given per-shard shapes on the
+    default device (reference: every op implements measure_operator_cost,
+    model.cu:20-62 — including attention/BN/LSTM, so we must too).
+    Returns seconds, or None if the op genuinely can't run standalone."""
+    import jax
+    import jax.numpy as jnp
+
     sig = _op_signature(op, in_shapes, w_shapes)
     if sig in _SIGNATURE_CACHE:
         return _SIGNATURE_CACHE[sig]
@@ -71,31 +118,38 @@ def measure_one(op: Op, in_shapes, w_shapes, *, warmup=1, iters=5,
         params = {spec.name: jnp.asarray(rs.randn(*s).astype(np.float32))
                   for spec, s in zip(op.weight_specs(), w_shapes)}
         rng = jax.random.PRNGKey(0)
-
-        def fwd_bwd(p, xs_):
-            def loss(p_, xs__):
-                outs = op.forward(p_, list(xs__), training=True,
-                                  rng=rng if op.needs_rng else None)
-                return sum(jnp.sum(jnp.square(o.astype(jnp.float32)))
-                           for o in outs)
-
-            l, g = jax.value_and_grad(loss, argnums=(0, 1))(p, tuple(xs_))
-            return l, g
-
+        fwd_bwd, fxs = _build_fwd_bwd(op, params, xs, rng)
         step = jax.jit(fwd_bwd)
-        out = step(params, xs)  # compile + warmup
+        out = step(params, fxs)  # compile + warmup
         jax.block_until_ready(out)
         for _ in range(warmup):
-            jax.block_until_ready(step(params, xs))
+            jax.block_until_ready(step(params, fxs))
         t0 = time.perf_counter()
         for _ in range(iters):
-            out = step(params, xs)
+            out = step(params, fxs)
         jax.block_until_ready(out)
         dt = (time.perf_counter() - t0) / iters
-    except Exception:
+    except Exception as e:
+        _log_skip(op, e)
         return None
     _SIGNATURE_CACHE[sig] = dt
     return dt
+
+
+_SKIP_LOGGED = set()
+
+
+def _log_skip(op: Op, err: Exception):
+    """Surface unmeasurable ops once per op name — a silent None here means
+    the search runs on analytic FLOPs for that op (fidelity gap)."""
+    if op.name in _SKIP_LOGGED:
+        return
+    _SKIP_LOGGED.add(op.name)
+    from flexflow_tpu.logger import fflogger
+
+    fflogger.warning("cost measurement skipped for %s (%s: %s) — falling "
+                     "back to analytic estimate", op.name,
+                     type(err).__name__, err)
 
 
 def measure_op_costs(model, mesh_shape: Dict[str, int],
@@ -164,8 +218,6 @@ def analyze_one(op: Op, in_shapes, w_shapes) -> Optional[Tuple[float, float]]:
     import jax
     import jax.numpy as jnp
 
-    if getattr(op, "wants_shard_ctx", False) or op.stateful:
-        return None
     sig = ("analyze",) + _op_signature(op, in_shapes, w_shapes)
     if sig in _SIGNATURE_CACHE:
         return _SIGNATURE_CACHE[sig]
@@ -176,23 +228,15 @@ def analyze_one(op: Op, in_shapes, w_shapes) -> Optional[Tuple[float, float]]:
         params = {spec.name: jnp.asarray(rs.randn(*s).astype(np.float32))
                   for spec, s in zip(op.weight_specs(), w_shapes)}
         rng = jax.random.PRNGKey(0)
-
-        def fwd_bwd(p, xs_):
-            def loss(p_, xs__):
-                outs = op.forward(p_, list(xs__), training=True,
-                                  rng=rng if op.needs_rng else None)
-                return sum(jnp.sum(jnp.square(o.astype(jnp.float32)))
-                           for o in outs)
-
-            return jax.value_and_grad(loss, argnums=(0, 1))(p, tuple(xs_))
-
-        compiled = jax.jit(fwd_bwd).lower(params, xs).compile()
+        fwd_bwd, fxs = _build_fwd_bwd(op, params, xs, rng)
+        compiled = jax.jit(fwd_bwd).lower(params, fxs).compile()
         ca = compiled.cost_analysis() or {}
         if isinstance(ca, (list, tuple)):  # some backends return a list
             ca = ca[0] if ca else {}
         out = (float(ca.get("flops", 0.0)),
                float(ca.get("bytes accessed", 0.0)))
-    except Exception:
+    except Exception as e:
+        _log_skip(op, e)
         return None
     _SIGNATURE_CACHE[sig] = out
     return out
