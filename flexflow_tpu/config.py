@@ -65,6 +65,7 @@ class FFConfig:
     perform_fusion: bool = False
     simulator_workspace_size: int = 2 * 1024 * 1024 * 1024
     compute_dtype: str = "float32"  # "bfloat16" for MXU-native training
+    use_flash_attention: bool = True  # Pallas flash kernel on the dense path
     seed: int = 0
 
     # populated at FFModel construction

@@ -5,9 +5,11 @@ partitioning asserted batch-only at attention.cu:118-120).
 
 TPU re-design supersedes that restriction: attention here is partitionable on
 batch, heads ('model' axis — Megatron-style), and sequence ('seq' axis — ring
-attention, flexflow_tpu/parallel/ring_attention.py). The dense path below is
-einsum-built so XLA fuses QK^T -> softmax -> V; a Pallas flash kernel and the
-ring/SP lowering are selected by the executor when the strategy shards `seq`.
+attention, flexflow_tpu/parallel/ring_attention.py). The dense path uses the
+hand-tiled Pallas flash kernel (ops/pallas_kernels.py) when the backend is TPU
+and the block grid divides the sequence (_flash_ok), falling back to an
+einsum-built softmax that XLA fuses; the ring/Ulysses SP lowering is selected
+when the strategy shards `seq`.
 
 API parity: FFModel.multihead_attention mirrors flexflow_c.h's
 flexflow_model_add_multihead_attention signature.
@@ -99,7 +101,8 @@ class MultiHeadAttention(Op):
             seq_axes = [ax for ax, d in (shard_ctx.get("axis_map") or {}).items()
                         if d == 1 and shard_ctx["mesh"].shape[ax] > 1]
         if seq_axes:
-            ctx = self._sp_attention(qh, kh, vh, shard_ctx, seq_axes, scale)
+            ctx = self._sp_attention(qh, kh, vh, shard_ctx, seq_axes, scale,
+                                     training, rng)
         else:
             ctx = self._dense_attention(qh, kh, vh, scale, training, rng)
         out = jnp.einsum("bqhk,hkd->bqd", ctx, params["wo"])
@@ -107,7 +110,35 @@ class MultiHeadAttention(Op):
             out = out + params["bias_o"]
         return [out]
 
+    def _flash_ok(self, qh, kh) -> bool:
+        """Use the hand-tiled Pallas flash kernel (ops/pallas_kernels.py) on
+        the dense path when the backend runs it natively and the block grid
+        divides the sequence. Role parity with the reference's tuned vendor
+        kernel (attention.cu:244 cudnnMultiHeadAttnForward)."""
+        import os
+
+        cfg = getattr(self.model, "config", None)
+        if cfg is not None and not getattr(cfg, "use_flash_attention", True):
+            return False
+        force = os.environ.get("FF_FORCE_FLASH_ATTENTION") == "1"
+        if jax.default_backend() != "tpu" and not force:
+            return False  # interpret mode is for tests only
+        sq, sk = qh.shape[1], kh.shape[1]
+        if self.qk_head_dim != self.v_head_dim:
+            return False
+        if self.causal and sq != sk:
+            return False  # kernel's causal mask has no cross-attn diag offset
+        for s in (sq, sk):
+            if s % min(128, s) != 0:
+                return False
+        return True
+
     def _dense_attention(self, qh, kh, vh, scale, training, rng):
+        use_dropout = training and self.dropout > 0.0 and rng is not None
+        if not use_dropout and self._flash_ok(qh, kh):
+            from flexflow_tpu.ops.pallas_kernels import flash_attention
+
+            return flash_attention(qh, kh, vh, self.causal, scale)
         logits = jnp.einsum("bqhk,bshk->bhqs", qh, kh,
                             preferred_element_type=jnp.float32) * scale
         if self.causal:
@@ -121,10 +152,13 @@ class MultiHeadAttention(Op):
                               probs / keep, 0.0)
         return jnp.einsum("bhqs,bshk->bqhk", probs, vh)
 
-    def _sp_attention(self, qh, kh, vh, shard_ctx, seq_axes, scale):
+    def _sp_attention(self, qh, kh, vh, shard_ctx, seq_axes, scale,
+                      training=False, rng=None):
         """Sequence-parallel lowering: ring attention (default) or Ulysses
         over the mesh axes sharding the sequence dim. Attention dropout is
-        not applied on this path (noted API gap; reference has no SP at all)."""
+        applied inside the online-softmax recurrence (the Bernoulli mask hits
+        the unnormalized probs, so strategy choice does not change model
+        semantics)."""
         from jax.sharding import PartitionSpec as P
 
         from flexflow_tpu.parallel import shard_map_compat
@@ -154,6 +188,17 @@ class MultiHeadAttention(Op):
         spec = P(entry(batch_axes), entry(seq_axes), entry(head_axes), None)
         seq_axis = seq_axes[0]
         fn = ring_attention if mode == "ring" else ulysses_attention
+        dropout_rate = self.dropout if (training and rng is not None) else 0.0
+
+        if dropout_rate > 0.0:
+            def inner(q, k, v, key):
+                return fn(q, k, v, axis_name=seq_axis, causal=self.causal,
+                          scale=scale, dropout_rate=dropout_rate,
+                          dropout_rng=key)
+
+            key_spec = P(*([None] * jnp.asarray(rng).ndim))
+            return shard_map_compat(inner, mesh, (spec, spec, spec, key_spec),
+                                    spec)(qh, kh, vh, rng)
 
         def inner(q, k, v):
             return fn(q, k, v, axis_name=seq_axis, causal=self.causal,

@@ -39,11 +39,18 @@ def pvary(x, axis_name):
     return x
 
 
-def _block_attend(q, k, v, m, l, o, scale, mask=None):
+def _block_attend(q, k, v, m, l, o, scale, mask=None, dropout_rng=None,
+                  dropout_rate=0.0):
     """One online-softmax accumulation step.
 
     q: (B, Sq, H, D); k/v: (B, Sk, H, D); m,l: (B, H, Sq); o: (B, Sq, H, D).
     Returns updated (m, l, o). f32 accumulation regardless of input dtype.
+
+    Attention dropout: the Bernoulli mask is applied to the unnormalized
+    block probs feeding the value product, while `l` keeps accumulating the
+    undropped sum — the final o/l division then equals dropout(softmax(s)) @ v
+    of the dense formulation exactly (dropout commutes with the global
+    normalization elementwise).
     """
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
@@ -53,14 +60,20 @@ def _block_attend(q, k, v, m, l, o, scale, mask=None):
     alpha = jnp.exp(m - m_new)                      # (B, H, Sq)
     p = jnp.exp(s - m_new[..., None])               # (B, H, Sq, Sk)
     l_new = l * alpha + jnp.sum(p, axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+    pv_in = p
+    if dropout_rng is not None and dropout_rate > 0.0:
+        keep = 1.0 - dropout_rate
+        drop_mask = jax.random.bernoulli(dropout_rng, keep, p.shape)
+        pv_in = jnp.where(drop_mask, p / keep, 0.0)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", pv_in.astype(v.dtype), v,
                     preferred_element_type=jnp.float32)
     o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
     return m_new, l_new, o_new
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None, dropout_rate: float = 0.0,
+                   dropout_rng=None):
     """Ring self-attention inside shard_map.
 
     q, k, v: (B, S_local, H, D) — the local sequence shard.
@@ -81,6 +94,10 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     m0, l0, o0 = (pvary(t, axis_name) for t in (m0, l0, o0))
 
     q_pos = my_idx * sq + jnp.arange(sq)  # global positions of local queries
+    # per-device dropout stream: each (device, ring step) sees an
+    # independent Bernoulli mask over its local (q block, k block) tile
+    if dropout_rng is not None and dropout_rate > 0.0:
+        dropout_rng = jax.random.fold_in(dropout_rng, my_idx)
 
     def step(carry, step_idx):
         m, l, o, k_cur, v_cur = carry
@@ -92,7 +109,12 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
             mask = mask[None, None, :, :]                    # (1,1,Sq,Sk)
         else:
             mask = None
-        m, l, o = _block_attend(q, k_cur, v_cur, m, l, o, scale, mask)
+        step_rng = None
+        if dropout_rng is not None and dropout_rate > 0.0:
+            step_rng = jax.random.fold_in(dropout_rng, step_idx)
+        m, l, o = _block_attend(q, k_cur, v_cur, m, l, o, scale, mask,
+                                dropout_rng=step_rng,
+                                dropout_rate=dropout_rate)
         # rotate: receive the next shard from the right neighbor
         perm = [(i, (i - 1) % p_size) for i in range(p_size)]
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
@@ -106,7 +128,8 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
-                      scale: Optional[float] = None):
+                      scale: Optional[float] = None,
+                      dropout_rate: float = 0.0, dropout_rng=None):
     """Ulysses (DeepSpeed-style) SP inside shard_map: all-to-all swaps the
     sequence shard for a head shard, attention runs with full sequence on
     1/P of the heads, then swaps back. Requires num_heads % P == 0."""
@@ -123,14 +146,21 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
+    if dropout_rng is not None and dropout_rate > 0.0:
+        # after the swap each device owns a disjoint head shard — fold the
+        # device index in so head shards draw independent masks
+        dropout_rng = jax.random.fold_in(dropout_rng, lax.axis_index(axis_name))
     qf, kf, vf = seq2head(q), seq2head(k), seq2head(v)
-    out = blockwise_attention(qf, kf, vf, causal=causal, scale=scale)
+    out = blockwise_attention(qf, kf, vf, causal=causal, scale=scale,
+                              dropout_rate=dropout_rate,
+                              dropout_rng=dropout_rng)
     return head2seq(out)
 
 
 def blockwise_attention(q, k, v, causal: bool = False,
                         scale: Optional[float] = None,
-                        block_size: int = 512):
+                        block_size: int = 512,
+                        dropout_rate: float = 0.0, dropout_rng=None):
     """Memory-efficient local attention: lax.scan over K/V blocks with online
     softmax (flash-attention recurrence in pure JAX — XLA keeps the working
     set at O(block) and fuses; the Pallas kernel in ops/pallas_kernels.py is
@@ -147,7 +177,8 @@ def blockwise_attention(q, k, v, causal: bool = False,
             q, k, v,
             jnp.full((b, h, sq), NEG_INF, jnp.float32),
             jnp.zeros((b, h, sq), jnp.float32),
-            jnp.zeros((b, sq, h, d), jnp.float32), scale, mask)
+            jnp.zeros((b, sq, h, d), jnp.float32), scale, mask,
+            dropout_rng=dropout_rng, dropout_rate=dropout_rate)
         return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
     nblocks = (sk + block_size - 1) // block_size
@@ -163,7 +194,12 @@ def blockwise_attention(q, k, v, causal: bool = False,
         if causal:
             k_pos = blk_idx * block_size + jnp.arange(block_size)
             mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
-        m, l, o = _block_attend(q, k_cur, v_cur, m, l, o, scale, mask)
+        blk_rng = None
+        if dropout_rng is not None and dropout_rate > 0.0:
+            blk_rng = jax.random.fold_in(dropout_rng, blk_idx)
+        m, l, o = _block_attend(q, k_cur, v_cur, m, l, o, scale, mask,
+                                dropout_rng=blk_rng,
+                                dropout_rate=dropout_rate)
         return (m, l, o), None
 
     init = (jnp.full((b, h, sq), NEG_INF, jnp.float32),

@@ -13,6 +13,7 @@ plays the role of Legion tracing (flexflow_cbinding.py:394-397).
 from __future__ import annotations
 
 import functools
+import itertools
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -46,28 +47,33 @@ def resolve_axis_map(pc: ParallelConfig, mesh_shape: Dict[str, int],
             continue
         # prefer canonical axis for the dim role
         prefs = (["data"] if d == 0 else []) + list(remaining.keys())
-        # simple search: single axis exact match, then pairs
         single = [ax for ax in prefs if remaining.get(ax) == deg]
         if single:
             axis_map[single[0]] = d
             del remaining[single[0]]
             continue
-        found = False
+        # general case: smallest subset of remaining axes whose sizes
+        # multiply to the degree (covers 3+-axis factorizations)
+        found = None
         axes = list(remaining.keys())
-        for i in range(len(axes)):
-            for j in range(len(axes)):
-                if i != j and remaining[axes[i]] * remaining[axes[j]] == deg:
-                    axis_map[axes[i]] = d
-                    axis_map[axes[j]] = d
-                    del remaining[axes[i]], remaining[axes[j]]
-                    found = True
+        for r in range(2, len(axes) + 1):
+            for combo in itertools.combinations(axes, r):
+                prod = 1
+                for ax in combo:
+                    prod *= remaining[ax]
+                if prod == deg:
+                    found = combo
                     break
             if found:
                 break
         if not found:
             raise ValueError(
-                f"strategy degree {deg} on dim {d} not expressible on mesh "
-                f"{mesh_shape} (remaining {remaining})")
+                f"strategy degree {deg} on dim {d} not expressible as a "
+                f"product of unused mesh axes (mesh {mesh_shape}, "
+                f"remaining {remaining})")
+        for ax in found:
+            axis_map[ax] = d
+            del remaining[ax]
     return axis_map
 
 
@@ -204,8 +210,14 @@ class GraphExecutor:
             for i, t in enumerate(op.outputs):
                 v = outs[i]
                 if v.ndim == t.num_dims:
-                    v = jax.lax.with_sharding_constraint(v, sharding) \
-                        if _spec_rank_ok(sharding.spec, v.ndim) else v
+                    if not _spec_rank_ok(sharding.spec, v.ndim):
+                        raise ValueError(
+                            f"sharding constraint for {op.name!r} has rank "
+                            f"{len(sharding.spec)} but its output is rank "
+                            f"{v.ndim} — the strategy entry does not match "
+                            f"this op's output; fix or regenerate the "
+                            f"strategy file")
+                    v = jax.lax.with_sharding_constraint(v, sharding)
                 vals[t] = v
         for k, v in state.items():
             if k not in new_state:

@@ -95,3 +95,26 @@ def test_flash_attention_grads():
 
     g = jax.grad(lambda a: jnp.sum(flash_attention(a, a, a, True) ** 2))(q)
     assert np.isfinite(np.asarray(g)).all()
+
+
+def test_mha_flash_path_matches_einsum(monkeypatch):
+    """Model-level equivalence: MultiHeadAttention with the Pallas flash
+    kernel forced on (interpret mode on CPU) vs the einsum softmax path."""
+    B, S, D, H = 2, 128, 32, 4
+    rs = np.random.RandomState(7)
+    x = rs.randn(B, S, D).astype(np.float32)
+
+    def run():
+        cfg = FFConfig(batch_size=B, mesh_shape={"data": 1}, seed=11)
+        ff = FFModel(cfg)
+        xt = ff.create_tensor([B, S, D], name="x")
+        out = ff.multihead_attention(xt, xt, xt, D, H, causal=True,
+                                     name="mha")
+        ff.compile(optimizer=None, final_tensor=out)
+        return np.asarray(ff.predict({"x": x}))
+
+    monkeypatch.delenv("FF_FORCE_FLASH_ATTENTION", raising=False)
+    y_einsum = run()
+    monkeypatch.setenv("FF_FORCE_FLASH_ATTENTION", "1")
+    y_flash = run()
+    np.testing.assert_allclose(y_flash, y_einsum, rtol=2e-4, atol=2e-5)
