@@ -1,0 +1,250 @@
+/* InceptionV3 through the C API (reference: examples/cpp/InceptionV3/ —
+ * the branchy graph where operator placement pays off: each inception
+ * module concatenates 3-4 convolution branches that the strategy search
+ * can place on disjoint device blocks).
+ *
+ * Usage: ./inception [batch_size] [epochs] [num_samples] [budget]
+ * budget > 0 runs the MCMC search and exports inception_strategy.txt
+ * (reference --budget/--export flow). Synthetic data at 3x299x299 by
+ * default (the real InceptionV3 input); pass a smaller size via argv[5].
+ */
+
+#include <chrono>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+#include "flexflow_tpu_c.h"
+
+#define CHECK(cond)                                                     \
+  do {                                                                  \
+    if (!(cond)) {                                                      \
+      fprintf(stderr, "FAILED: %s at %s:%d: %s\n", #cond, __FILE__,     \
+              __LINE__, fft_last_error());                              \
+      exit(1);                                                          \
+    }                                                                   \
+  } while (0)
+
+static fft_model_t FF;
+static int conv_id = 0;
+
+/* conv + BN(relu) — the InceptionV3 building block */
+static fft_tensor_t conv_bn(fft_tensor_t in, int out_ch, int kh, int kw,
+                            int sh, int sw, int ph, int pw) {
+  char name[64];
+  snprintf(name, sizeof(name), "conv_%d", conv_id);
+  fft_tensor_t t = fft_model_add_conv2d(FF, in, out_ch, kh, kw, sh, sw, ph,
+                                        pw, FFT_AC_MODE_NONE, 1, 0, name);
+  snprintf(name, sizeof(name), "bn_%d", conv_id);
+  ++conv_id;
+  return fft_model_add_batch_norm(FF, t, 1, name);
+}
+
+/* reference InceptionA (inception.cc InceptionA): 1x1 / 5x5 / 3x3dbl /
+ * pool branches */
+static fft_tensor_t inception_a(fft_tensor_t in, int pool_ch, int mod) {
+  fft_tensor_t b1 = conv_bn(in, 64, 1, 1, 1, 1, 0, 0);
+  fft_tensor_t b2 = conv_bn(in, 48, 1, 1, 1, 1, 0, 0);
+  b2 = conv_bn(b2, 64, 5, 5, 1, 1, 2, 2);
+  fft_tensor_t b3 = conv_bn(in, 64, 1, 1, 1, 1, 0, 0);
+  b3 = conv_bn(b3, 96, 3, 3, 1, 1, 1, 1);
+  b3 = conv_bn(b3, 96, 3, 3, 1, 1, 1, 1);
+  char name[64];
+  snprintf(name, sizeof(name), "incA%d_pool", mod);
+  fft_tensor_t b4 = fft_model_add_pool2d(FF, in, 3, 3, 1, 1, 1, 1,
+                                         FFT_POOL_AVG, name);
+  b4 = conv_bn(b4, pool_ch, 1, 1, 1, 1, 0, 0);
+  fft_tensor_t branches[4] = {b1, b2, b3, b4};
+  snprintf(name, sizeof(name), "incA%d_cat", mod);
+  return fft_model_add_concat(FF, branches, 4, 1, name);
+}
+
+/* reference InceptionB: grid reduction 35->17 */
+static fft_tensor_t inception_b(fft_tensor_t in, int mod) {
+  fft_tensor_t b1 = conv_bn(in, 384, 3, 3, 2, 2, 0, 0);
+  fft_tensor_t b2 = conv_bn(in, 64, 1, 1, 1, 1, 0, 0);
+  b2 = conv_bn(b2, 96, 3, 3, 1, 1, 1, 1);
+  b2 = conv_bn(b2, 96, 3, 3, 2, 2, 0, 0);
+  char name[64];
+  snprintf(name, sizeof(name), "incB%d_pool", mod);
+  fft_tensor_t b3 = fft_model_add_pool2d(FF, in, 3, 3, 2, 2, 0, 0,
+                                         FFT_POOL_MAX, name);
+  fft_tensor_t branches[3] = {b1, b2, b3};
+  snprintf(name, sizeof(name), "incB%d_cat", mod);
+  return fft_model_add_concat(FF, branches, 3, 1, name);
+}
+
+/* reference InceptionC: factorized 7x7 branches */
+static fft_tensor_t inception_c(fft_tensor_t in, int ch7, int mod) {
+  fft_tensor_t b1 = conv_bn(in, 192, 1, 1, 1, 1, 0, 0);
+  fft_tensor_t b2 = conv_bn(in, ch7, 1, 1, 1, 1, 0, 0);
+  b2 = conv_bn(b2, ch7, 1, 7, 1, 1, 0, 3);
+  b2 = conv_bn(b2, 192, 7, 1, 1, 1, 3, 0);
+  fft_tensor_t b3 = conv_bn(in, ch7, 1, 1, 1, 1, 0, 0);
+  b3 = conv_bn(b3, ch7, 7, 1, 1, 1, 3, 0);
+  b3 = conv_bn(b3, ch7, 1, 7, 1, 1, 0, 3);
+  b3 = conv_bn(b3, ch7, 7, 1, 1, 1, 3, 0);
+  b3 = conv_bn(b3, 192, 1, 7, 1, 1, 0, 3);
+  char name[64];
+  snprintf(name, sizeof(name), "incC%d_pool", mod);
+  fft_tensor_t b4 = fft_model_add_pool2d(FF, in, 3, 3, 1, 1, 1, 1,
+                                         FFT_POOL_AVG, name);
+  b4 = conv_bn(b4, 192, 1, 1, 1, 1, 0, 0);
+  fft_tensor_t branches[4] = {b1, b2, b3, b4};
+  snprintf(name, sizeof(name), "incC%d_cat", mod);
+  return fft_model_add_concat(FF, branches, 4, 1, name);
+}
+
+/* reference InceptionD: grid reduction 17->8 */
+static fft_tensor_t inception_d(fft_tensor_t in, int mod) {
+  fft_tensor_t b1 = conv_bn(in, 192, 1, 1, 1, 1, 0, 0);
+  b1 = conv_bn(b1, 320, 3, 3, 2, 2, 0, 0);
+  fft_tensor_t b2 = conv_bn(in, 192, 1, 1, 1, 1, 0, 0);
+  b2 = conv_bn(b2, 192, 1, 7, 1, 1, 0, 3);
+  b2 = conv_bn(b2, 192, 7, 1, 1, 1, 3, 0);
+  b2 = conv_bn(b2, 192, 3, 3, 2, 2, 0, 0);
+  char name[64];
+  snprintf(name, sizeof(name), "incD%d_pool", mod);
+  fft_tensor_t b3 = fft_model_add_pool2d(FF, in, 3, 3, 2, 2, 0, 0,
+                                         FFT_POOL_MAX, name);
+  fft_tensor_t branches[3] = {b1, b2, b3};
+  snprintf(name, sizeof(name), "incD%d_cat", mod);
+  return fft_model_add_concat(FF, branches, 3, 1, name);
+}
+
+/* reference InceptionE: the widest module (8x8 grid) */
+static fft_tensor_t inception_e(fft_tensor_t in, int mod) {
+  fft_tensor_t b1 = conv_bn(in, 320, 1, 1, 1, 1, 0, 0);
+  fft_tensor_t b2 = conv_bn(in, 384, 1, 1, 1, 1, 0, 0);
+  fft_tensor_t b2a = conv_bn(b2, 384, 1, 3, 1, 1, 0, 1);
+  fft_tensor_t b2b = conv_bn(b2, 384, 3, 1, 1, 1, 1, 0);
+  char name[64];
+  fft_tensor_t pair1[2] = {b2a, b2b};
+  snprintf(name, sizeof(name), "incE%d_cat2", mod);
+  b2 = fft_model_add_concat(FF, pair1, 2, 1, name);
+  fft_tensor_t b3 = conv_bn(in, 448, 1, 1, 1, 1, 0, 0);
+  b3 = conv_bn(b3, 384, 3, 3, 1, 1, 1, 1);
+  fft_tensor_t b3a = conv_bn(b3, 384, 1, 3, 1, 1, 0, 1);
+  fft_tensor_t b3b = conv_bn(b3, 384, 3, 1, 1, 1, 1, 0);
+  fft_tensor_t pair2[2] = {b3a, b3b};
+  snprintf(name, sizeof(name), "incE%d_cat3", mod);
+  b3 = fft_model_add_concat(FF, pair2, 2, 1, name);
+  snprintf(name, sizeof(name), "incE%d_pool", mod);
+  fft_tensor_t b4 = fft_model_add_pool2d(FF, in, 3, 3, 1, 1, 1, 1,
+                                         FFT_POOL_AVG, name);
+  b4 = conv_bn(b4, 192, 1, 1, 1, 1, 0, 0);
+  fft_tensor_t branches[4] = {b1, b2, b3, b4};
+  snprintf(name, sizeof(name), "incE%d_cat", mod);
+  return fft_model_add_concat(FF, branches, 4, 1, name);
+}
+
+int main(int argc, char **argv) {
+  int batch_size = argc > 1 ? atoi(argv[1]) : 8;
+  int epochs = argc > 2 ? atoi(argv[2]) : 1;
+  int num_samples = argc > 3 ? atoi(argv[3]) : 16;
+  int budget = argc > 4 ? atoi(argv[4]) : 0;
+  int image_size = argc > 5 ? atoi(argv[5]) : 299;
+  int classes = 10;
+
+  CHECK(fft_init(getenv("FFT_REPO_ROOT")) == 0);
+  fft_config_t cfg = fft_config_create(batch_size, epochs, nullptr, nullptr, 0);
+  CHECK(cfg.impl);
+  if (budget > 0) {
+    fft_config_set_search_budget(cfg, budget);
+    fft_config_set_export_strategy_file(cfg, "inception_strategy.txt");
+  }
+  printf("inception_v3: batch=%d epochs=%d image=%d devices=%d budget=%d\n",
+         batch_size, epochs, image_size, fft_config_get_num_devices(cfg),
+         budget);
+
+  FF = fft_model_create(cfg);
+  CHECK(FF.impl);
+
+  int input_dims[4] = {batch_size, 3, image_size, image_size};
+  fft_tensor_t input = fft_model_create_tensor(FF, input_dims, 4,
+                                               FFT_DT_FLOAT, "input");
+  CHECK(input.impl);
+
+  /* stem (reference inception.cc top_level_task) */
+  fft_tensor_t t = conv_bn(input, 32, 3, 3, 2, 2, 0, 0);
+  t = conv_bn(t, 32, 3, 3, 1, 1, 0, 0);
+  t = conv_bn(t, 64, 3, 3, 1, 1, 1, 1);
+  t = fft_model_add_pool2d(FF, t, 3, 3, 2, 2, 0, 0, FFT_POOL_MAX, "stem_p1");
+  t = conv_bn(t, 80, 1, 1, 1, 1, 0, 0);
+  t = conv_bn(t, 192, 3, 3, 1, 1, 0, 0);
+  t = fft_model_add_pool2d(FF, t, 3, 3, 2, 2, 0, 0, FFT_POOL_MAX, "stem_p2");
+
+  t = inception_a(t, 32, 0);
+  t = inception_a(t, 64, 1);
+  t = inception_a(t, 64, 2);
+  t = inception_b(t, 0);
+  t = inception_c(t, 128, 0);
+  t = inception_c(t, 160, 1);
+  t = inception_c(t, 160, 2);
+  t = inception_c(t, 192, 3);
+  t = inception_d(t, 0);
+  t = inception_e(t, 0);
+  t = inception_e(t, 1);
+
+  int nd = fft_tensor_get_ndims(t);
+  int dims[8];
+  fft_tensor_get_dims(t, dims);
+  CHECK(nd == 4);
+  t = fft_model_add_pool2d(FF, t, dims[2], dims[3], 1, 1, 0, 0, FFT_POOL_AVG,
+                           "gap");
+  t = fft_model_add_flat(FF, t, "flat");
+  t = fft_model_add_dense(FF, t, classes, FFT_AC_MODE_NONE, 1, "fc");
+  CHECK(t.impl);
+
+  fft_optimizer_t opt = fft_sgd_optimizer_create(0.01, 0.9, 0, 1e-4);
+  fft_metrics_type metrics[1] = {FFT_METRICS_ACCURACY};
+  fft_tensor_t no_final = {nullptr};
+  CHECK(fft_model_compile(FF, opt, FFT_LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                          metrics, 1, no_final) == 0);
+
+  std::vector<float> x((size_t)num_samples * 3 * image_size * image_size);
+  std::vector<int> y((size_t)num_samples);
+  srand(42);
+  for (auto &v : x) v = (float)rand() / RAND_MAX - 0.5f;
+  for (auto &v : y) v = rand() % classes;
+
+  fft_dataloader_t dl_x =
+      fft_single_dataloader_create(FF, input, x.data(), num_samples);
+  CHECK(dl_x.impl);
+  fft_tensor_t label = fft_model_get_label_tensor(FF);
+  fft_dataloader_t dl_y =
+      fft_single_dataloader_create(FF, label, y.data(), num_samples);
+  CHECK(dl_y.impl);
+
+  CHECK(fft_model_init_layers(FF) == 0);
+
+  int num_batches = fft_dataloader_num_batches(dl_x);
+  auto t0 = std::chrono::steady_clock::now();
+  for (int it = 0; it < num_batches; ++it) {
+    CHECK(fft_model_next_batch(FF) == 0);
+    CHECK(fft_model_forward(FF) == 0);
+    CHECK(fft_model_zero_gradients(FF) == 0);
+    CHECK(fft_model_backward(FF) == 0);
+    CHECK(fft_model_update(FF) == 0);
+  }
+  float loss = fft_model_get_last_loss(FF);
+  double dt = std::chrono::duration<double>(
+                  std::chrono::steady_clock::now() - t0).count();
+  printf("epoch: %d batches, loss=%.4f, THROUGHPUT = %.2f samples/s\n",
+         num_batches, loss,
+         dt > 0 ? num_batches * batch_size / dt : 0.0);
+  CHECK(std::isfinite(loss));
+  if (epochs > 1) CHECK(fft_model_fit(FF, epochs - 1) == 0);
+
+  fft_dataloader_destroy(dl_x);
+  fft_dataloader_destroy(dl_y);
+  fft_tensor_destroy(label);
+  fft_tensor_destroy(input);
+  fft_optimizer_destroy(opt);
+  fft_model_destroy(FF);
+  fft_config_destroy(cfg);
+  fft_finalize();
+  printf("inception_c: SUCCESS\n");
+  return 0;
+}

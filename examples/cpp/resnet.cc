@@ -1,0 +1,182 @@
+/* ResNet-50 through the C API (reference: examples/cpp/ResNet/resnet.cc —
+ * the BASELINE north-star model: conv stem, 4 stages of bottleneck blocks
+ * [3,4,6,3], global average pool, dense head).
+ *
+ * Usage: ./resnet [batch_size] [epochs] [num_samples] [image_size] [budget]
+ * budget > 0 runs the MCMC strategy search at compile time and exports the
+ * found strategy to resnet_strategy.txt (reference --budget/--export flow).
+ * Runs on synthetic data; default shapes are ImageNet-at-64 (3x64x64, 10
+ * classes) so the smoke run finishes quickly; pass 224 for the real config.
+ */
+
+#include <chrono>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+#include "flexflow_tpu_c.h"
+
+#define CHECK(cond)                                                     \
+  do {                                                                  \
+    if (!(cond)) {                                                      \
+      fprintf(stderr, "FAILED: %s at %s:%d: %s\n", #cond, __FILE__,     \
+              __LINE__, fft_last_error());                              \
+      exit(1);                                                          \
+    }                                                                   \
+  } while (0)
+
+static int block_id = 0;
+
+/* Bottleneck residual block (reference resnet.cc BottleneckBlock):
+ * 1x1 reduce -> 3x3 -> 1x1 expand, each BN+ReLU (last BN no relu),
+ * projection shortcut when stride != 1 or channels change, add + relu. */
+static fft_tensor_t bottleneck(fft_model_t ff, fft_tensor_t in, int in_ch,
+                               int mid_ch, int stride) {
+  char name[64];
+  int out_ch = mid_ch * 4;
+  fft_tensor_t t = in;
+
+  snprintf(name, sizeof(name), "b%d_conv1", block_id);
+  t = fft_model_add_conv2d(ff, t, mid_ch, 1, 1, 1, 1, 0, 0, FFT_AC_MODE_NONE,
+                           1, 0, name);
+  snprintf(name, sizeof(name), "b%d_bn1", block_id);
+  t = fft_model_add_batch_norm(ff, t, 1, name);
+
+  snprintf(name, sizeof(name), "b%d_conv2", block_id);
+  t = fft_model_add_conv2d(ff, t, mid_ch, 3, 3, stride, stride, 1, 1,
+                           FFT_AC_MODE_NONE, 1, 0, name);
+  snprintf(name, sizeof(name), "b%d_bn2", block_id);
+  t = fft_model_add_batch_norm(ff, t, 1, name);
+
+  snprintf(name, sizeof(name), "b%d_conv3", block_id);
+  t = fft_model_add_conv2d(ff, t, out_ch, 1, 1, 1, 1, 0, 0, FFT_AC_MODE_NONE,
+                           1, 0, name);
+  snprintf(name, sizeof(name), "b%d_bn3", block_id);
+  t = fft_model_add_batch_norm(ff, t, 0, name);
+
+  fft_tensor_t shortcut = in;
+  if (stride != 1 || in_ch != out_ch) {
+    snprintf(name, sizeof(name), "b%d_proj", block_id);
+    shortcut = fft_model_add_conv2d(ff, in, out_ch, 1, 1, stride, stride, 0,
+                                    0, FFT_AC_MODE_NONE, 1, 0, name);
+    snprintf(name, sizeof(name), "b%d_proj_bn", block_id);
+    shortcut = fft_model_add_batch_norm(ff, shortcut, 0, name);
+  }
+  snprintf(name, sizeof(name), "b%d_add", block_id);
+  t = fft_model_add_add(ff, t, shortcut, name);
+  snprintf(name, sizeof(name), "b%d_out", block_id);
+  t = fft_model_add_relu(ff, t, name);
+  ++block_id;
+  return t;
+}
+
+int main(int argc, char **argv) {
+  int batch_size = argc > 1 ? atoi(argv[1]) : 16;
+  int epochs = argc > 2 ? atoi(argv[2]) : 1;
+  int num_samples = argc > 3 ? atoi(argv[3]) : 32;
+  int image_size = argc > 4 ? atoi(argv[4]) : 64;
+  int budget = argc > 5 ? atoi(argv[5]) : 0;
+  int classes = 10;
+
+  CHECK(fft_init(getenv("FFT_REPO_ROOT")) == 0);
+  fft_config_t cfg = fft_config_create(batch_size, epochs, nullptr, nullptr, 0);
+  CHECK(cfg.impl);
+  if (budget > 0) {
+    /* reference --budget/--export flow through the C API */
+    fft_config_set_search_budget(cfg, budget);
+    fft_config_set_export_strategy_file(cfg, "resnet_strategy.txt");
+  }
+  printf("resnet50: batch=%d epochs=%d image=%d devices=%d budget=%d\n",
+         batch_size, epochs, image_size, fft_config_get_num_devices(cfg),
+         budget);
+
+  fft_model_t ff = fft_model_create(cfg);
+  CHECK(ff.impl);
+
+  int input_dims[4] = {batch_size, 3, image_size, image_size};
+  fft_tensor_t input = fft_model_create_tensor(ff, input_dims, 4,
+                                               FFT_DT_FLOAT, "input");
+  CHECK(input.impl);
+
+  /* stem: 7x7/2 conv + BN/ReLU + 3x3/2 maxpool */
+  fft_tensor_t t = fft_model_add_conv2d(ff, input, 64, 7, 7, 2, 2, 3, 3,
+                                        FFT_AC_MODE_NONE, 1, 0, "stem_conv");
+  t = fft_model_add_batch_norm(ff, t, 1, "stem_bn");
+  t = fft_model_add_pool2d(ff, t, 3, 3, 2, 2, 1, 1, FFT_POOL_MAX, "stem_pool");
+
+  /* stages [3,4,6,3] x bottleneck(64,128,256,512) */
+  const int depths[4] = {3, 4, 6, 3};
+  const int widths[4] = {64, 128, 256, 512};
+  int ch = 64;
+  for (int s = 0; s < 4; ++s) {
+    for (int i = 0; i < depths[s]; ++i) {
+      int stride = (i == 0 && s > 0) ? 2 : 1;
+      t = bottleneck(ff, t, ch, widths[s], stride);
+      ch = widths[s] * 4;
+    }
+  }
+
+  /* global average pool = avg pool over the remaining spatial extent */
+  int nd = fft_tensor_get_ndims(t);
+  int dims[8];
+  fft_tensor_get_dims(t, dims);
+  CHECK(nd == 4);
+  t = fft_model_add_pool2d(ff, t, dims[2], dims[3], 1, 1, 0, 0, FFT_POOL_AVG,
+                           "gap");
+  t = fft_model_add_flat(ff, t, "flat");
+  t = fft_model_add_dense(ff, t, classes, FFT_AC_MODE_NONE, 1, "fc");
+  CHECK(t.impl);
+
+  fft_optimizer_t opt = fft_sgd_optimizer_create(0.01, 0.9, 0, 1e-4);
+  fft_metrics_type metrics[1] = {FFT_METRICS_ACCURACY};
+  fft_tensor_t no_final = {nullptr};
+  CHECK(fft_model_compile(ff, opt, FFT_LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                          metrics, 1, no_final) == 0);
+
+  std::vector<float> x((size_t)num_samples * 3 * image_size * image_size);
+  std::vector<int> y((size_t)num_samples);
+  srand(42);
+  for (auto &v : x) v = (float)rand() / RAND_MAX - 0.5f;
+  for (auto &v : y) v = rand() % classes;
+
+  fft_dataloader_t dl_x =
+      fft_single_dataloader_create(ff, input, x.data(), num_samples);
+  CHECK(dl_x.impl);
+  fft_tensor_t label = fft_model_get_label_tensor(ff);
+  fft_dataloader_t dl_y =
+      fft_single_dataloader_create(ff, label, y.data(), num_samples);
+  CHECK(dl_y.impl);
+
+  CHECK(fft_model_init_layers(ff) == 0);
+
+  int num_batches = fft_dataloader_num_batches(dl_x);
+  auto t0 = std::chrono::steady_clock::now();
+  for (int it = 0; it < num_batches; ++it) {
+    CHECK(fft_model_next_batch(ff) == 0);
+    CHECK(fft_model_forward(ff) == 0);
+    CHECK(fft_model_zero_gradients(ff) == 0);
+    CHECK(fft_model_backward(ff) == 0);
+    CHECK(fft_model_update(ff) == 0);
+  }
+  float loss = fft_model_get_last_loss(ff);
+  double dt = std::chrono::duration<double>(
+                  std::chrono::steady_clock::now() - t0).count();
+  printf("epoch: %d batches, loss=%.4f, THROUGHPUT = %.2f samples/s\n",
+         num_batches, loss,
+         dt > 0 ? num_batches * batch_size / dt : 0.0);
+  CHECK(std::isfinite(loss));
+  if (epochs > 1) CHECK(fft_model_fit(ff, epochs - 1) == 0);
+
+  fft_dataloader_destroy(dl_x);
+  fft_dataloader_destroy(dl_y);
+  fft_tensor_destroy(label);
+  fft_tensor_destroy(input);
+  fft_optimizer_destroy(opt);
+  fft_model_destroy(ff);
+  fft_config_destroy(cfg);
+  fft_finalize();
+  printf("resnet_c: SUCCESS\n");
+  return 0;
+}

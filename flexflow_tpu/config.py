@@ -33,6 +33,8 @@ class FFConfig:
     num_devices: Optional[int] = None  # default: all visible jax devices
     mesh_shape: Optional[Dict[str, int]] = None  # e.g. {"data": 8} or {"data": 4, "model": 2}
     ici_mesh_shape: Optional[Dict[str, int]] = None
+    # axis -> number of hosts it spans; feeds the search's two-tier machine
+    # model (collectives over these axes are priced at DCN bandwidth)
     dcn_mesh_shape: Optional[Dict[str, int]] = None
 
     # search flags (reference model.cc:1930-1932)

@@ -375,8 +375,16 @@ class FFModel:
                     cfg.enable_parameter_parallel,
                     cfg.enable_attribute_parallel,
                     verbose=cfg.profiling)
+            machine = None
+            if cfg.dcn_mesh_shape:
+                # two-tier topology: axes listed in dcn_mesh_shape span that
+                # many hosts, so their collectives are priced at the DCN tier
+                from flexflow_tpu.search.machine import MachineModel
+
+                machine = MachineModel(dcn_axes=dict(cfg.dcn_mesh_shape))
             best = optimize_strategies(self, budget=cfg.search_budget,
                                        alpha=cfg.search_alpha,
+                                       machine=machine,
                                        measured=measured)
             cfg.strategies.update(best)
             if cfg.export_strategy_file:
@@ -401,7 +409,16 @@ class FFModel:
             self.label_tensor = Tensor(dims=fdims, dtype=DataType.DT_FLOAT,
                                        name="label")
 
-        self.executor = GraphExecutor(self)
+        from flexflow_tpu.parallel.placement import (PlacementExecutor,
+                                                     has_placement)
+
+        if has_placement(cfg.strategies, self.mesh.size):
+            # some op is placed on a proper device subset: lower via
+            # per-group sub-mesh programs (the reference mapper's per-op
+            # device_ids, mapper.cc:346-424)
+            self.executor = PlacementExecutor(self)
+        else:
+            self.executor = GraphExecutor(self)
         self._rng, init_key = jax.random.split(self._rng)
         self.params = self.executor.init_params(init_key)
         self.bn_state = self.executor.init_state()
@@ -542,7 +559,10 @@ class FFModel:
         """Label-free inference through the forward-only program."""
         if self._predict_fn is None:
             fwd = self.executor.make_forward([self._final_tensor])
-            self._predict_fn = jax.jit(fwd)
+            # the placement executor jits per group (its arrays live on
+            # different sub-meshes, which one outer jit cannot accept)
+            self._predict_fn = fwd if getattr(
+                self.executor, "jits_per_group", False) else jax.jit(fwd)
         sharded = self.executor.shard_batch(batch)
         return self._predict_fn(self.params, self.bn_state, sharded)[0]
 

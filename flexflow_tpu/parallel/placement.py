@@ -1,0 +1,502 @@
+"""Operator placement: run different ops on disjoint device sub-meshes.
+
+The SOAP "O" axis (reference: per-op device_ids in ParallelConfig,
+include/config.h:47-69; FFMapper::slice_task placing each index point on the
+op's own device list, src/mapper/mapper.cc:346-424; MCMC proposing random
+contiguous device ranges, src/runtime/model.cc:496-525).
+
+TPU re-design: GSPMD wants one device set per compiled program, so a strategy
+that places op groups on disjoint contiguous device blocks is lowered as a
+sequence of per-group jitted programs, each compiled over its own
+`jax.sharding.Mesh` slice. JAX dispatches computations asynchronously, so
+groups on disjoint blocks genuinely overlap in wall-clock (the property the
+per-device simulator ranks, search/csrc/sim.cc). Boundary tensors move
+between blocks with `jax.device_put` (ICI transfers).
+
+Training runs as: forward group-by-group -> loss on the final group's block
+-> backward group-by-group in reverse via per-group jitted VJPs (the group
+forward is rematerialized inside the backward jit — jax.checkpoint spirit) ->
+per-group optimizer updates. Gradient parity with the single-mesh executor is
+tested in tests/test_placement.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from flexflow_tpu.ffconst import LossType, MetricsType
+from flexflow_tpu.ops.base import InputOp, Op
+from flexflow_tpu.parallel.pconfig import ParallelConfig
+from flexflow_tpu.runtime.loss import compute_loss
+from flexflow_tpu.runtime.metrics import batch_metrics
+
+
+class PlacementGroup:
+    """A maximal run of consecutive ops sharing one device block."""
+
+    def __init__(self, index: int, place: int, ndev: int, mesh: Mesh):
+        self.index = index
+        self.place = place
+        self.ndev = ndev
+        self.mesh = mesh
+        self.ops: List[Op] = []
+
+    def __repr__(self):
+        return (f"PlacementGroup({self.index}: devices "
+                f"[{self.place},{self.place + self.ndev}), "
+                f"ops={[o.name for o in self.ops]})")
+
+
+def op_block(pc: Optional[ParallelConfig], axis_map, mesh_shape,
+             num_devices: int) -> Tuple[int, int]:
+    """(place, ndev) for an op: the contiguous aligned device block its
+    strategy assigns (mirror of sim.cc align_place)."""
+    parts = 1
+    for ax, d in (axis_map or {}).items():
+        if d is not None:
+            parts *= mesh_shape[ax]
+    ndev = max(1, min(parts, num_devices))
+    place = 0
+    if pc is not None and pc.device_ids:
+        place = min(pc.device_ids)
+        n = len(pc.device_ids)
+        if n in range(1, num_devices + 1) and num_devices % max(n, 1) == 0:
+            ndev = n
+    if ndev >= num_devices or num_devices % ndev != 0:
+        return 0, num_devices
+    place = max(0, min(place, num_devices - ndev))
+    return place - place % ndev, ndev
+
+
+def has_placement(strategies: Dict[str, ParallelConfig],
+                  num_devices: int) -> bool:
+    """True when some op is EXPLICITLY placed off block 0. device_ids
+    defaulting to range(num_parts) (what from_axis_map emits) is not a
+    placement — plain GSPMD strategies with mixed degrees must keep running
+    as one full-mesh program. Any genuine multi-block placement necessarily
+    has an op whose block starts at a non-zero device."""
+    for pc in strategies.values():
+        ids = getattr(pc, "device_ids", ())
+        if (ids and min(ids) > 0 and 0 < len(ids) < num_devices
+                and num_devices % len(ids) == 0):
+            return True
+    return False
+
+
+class PlacementExecutor:
+    """Executes the graph as a sequence of per-group sub-mesh programs.
+
+    Reuses GraphExecutor's strategy resolution (axis maps) but compiles one
+    program per placement group instead of one whole-step program.
+    """
+
+    jits_per_group = True  # callers must not wrap our fns in an outer jit
+
+    def __init__(self, model):
+        from flexflow_tpu.parallel.mesh import mesh_shape_dict
+        from flexflow_tpu.runtime.executor import GraphExecutor
+
+        self.model = model
+        self.base = GraphExecutor(model)  # strategy resolution + helpers
+        self.full_mesh: Mesh = model.mesh
+        self.mesh_shape = mesh_shape_dict(self.full_mesh)
+        self.devices = list(np.asarray(self.full_mesh.devices).reshape(-1))
+        self.num_devices = len(self.devices)
+        self.groups: List[PlacementGroup] = []
+        self._op_group: Dict[str, PlacementGroup] = {}
+        self._build_groups()
+        # strategy table shared with the single-mesh executor (profiler &
+        # tests read executor._op_axis_maps)
+        self._op_axis_maps = self.base._op_axis_maps
+
+    # ---- grouping -----------------------------------------------------------
+
+    def _submesh(self, place: int, ndev: int, axis_map) -> Mesh:
+        """Mesh over devices [place, place+ndev) carrying the axes the
+        group's ops actually shard over (sized from the full mesh), with a
+        trailing fill axis when the used axes don't cover the block."""
+        used = {}
+        for ax, d in (axis_map or {}).items():
+            if d is not None:
+                used[ax] = self.mesh_shape[ax]
+        covered = 1
+        for v in used.values():
+            covered *= v
+        names = list(used.keys())
+        shape = list(used.values())
+        if covered < ndev or not names:
+            names.append("_fill")
+            shape.append(max(ndev // covered, 1))
+        devs = np.asarray(self.devices[place:place + ndev]).reshape(shape)
+        return Mesh(devs, tuple(names))
+
+    def _build_groups(self):
+        strategies = self.model.config.strategies
+        current: Optional[PlacementGroup] = None
+        merged: Dict[str, Optional[int]] = {}
+
+        def coverage(axes: Dict[str, Optional[int]]) -> int:
+            n = 1
+            for ax, d in axes.items():
+                if d is not None:
+                    n *= self.mesh_shape[ax]
+            return n
+
+        for op in self.model.ops:
+            if isinstance(op, InputOp):
+                continue
+            am = self.base._op_axis_maps.get(op.name, {})
+            place, ndev = op_block(strategies.get(op.name), am,
+                                   self.mesh_shape, self.num_devices)
+            candidate = dict(merged)
+            for ax, d in am.items():
+                if d is not None:
+                    candidate[ax] = d
+            # start a new group on a block change, or when this op's mesh
+            # axes can't share one sub-mesh with the group's (e.g. a
+            # 'data'-sharded op and a 'model'-sharded op both 2-way on a
+            # 2-device block need separate programs)
+            if (current is None or current.place != place
+                    or current.ndev != ndev or coverage(candidate) > ndev):
+                merged = {ax: d for ax, d in am.items() if d is not None}
+                current = PlacementGroup(len(self.groups), place, ndev,
+                                         self._submesh(place, ndev, merged))
+                self.groups.append(current)
+            else:
+                merged = candidate
+            current.ops.append(op)
+            self._op_group[op.name] = current
+        # (re)build each group's mesh to cover all axes its member ops use
+        for g in self.groups:
+            axes: Dict[str, Optional[int]] = {}
+            for op in g.ops:
+                for ax, d in self.base._op_axis_maps.get(op.name, {}).items():
+                    if d is not None:
+                        axes[ax] = d
+            g.mesh = self._submesh(g.place, g.ndev, axes)
+
+    # ---- per-group forward --------------------------------------------------
+
+    def _group_sharding(self, g: PlacementGroup, op: Op) -> NamedSharding:
+        am = {ax: d for ax, d in self.base._op_axis_maps.get(op.name, {})
+              .items() if ax in g.mesh.shape}
+        pspec = ParallelConfig(axis_map=am).to_partition_spec(
+            op.outputs[0].num_dims, list(g.mesh.axis_names))
+        return NamedSharding(g.mesh, pspec)
+
+    def _group_forward_fn(self, g: PlacementGroup, training: bool,
+                          exports: frozenset):
+        """Pure fn: (params_g, state_g, inputs_dict, rng) ->
+        (outputs_dict, new_state_g). inputs_dict keys are tensor names;
+        `exports` (captured by value) names the tensors to return."""
+        bf16 = self.model.config.compute_dtype == "bfloat16"
+
+        def to_compute(a):
+            return a.astype(jnp.bfloat16) \
+                if (bf16 and a.dtype == jnp.float32) else a
+
+        op_indices = {op.name: i for i, op in enumerate(self.model.ops)}
+
+        def fn(params_g, state_g, inputs, rng):
+            vals: Dict[str, jnp.ndarray] = {k: to_compute(v)
+                                            for k, v in inputs.items()}
+            new_state: Dict[str, Dict] = {}
+            for op in g.ops:
+                xs = [vals[t.name] for t in op.inputs]
+                op_rng = None
+                if op.needs_rng and rng is not None:
+                    op_rng = jax.random.fold_in(rng, op_indices[op.name])
+                    seed = getattr(op, "seed", 0)
+                    if seed:
+                        op_rng = jax.random.fold_in(op_rng, seed)
+                p = params_g.get(op.name, {})
+                if bf16:
+                    p = {k: to_compute(v) for k, v in p.items()}
+                kwargs = {}
+                if getattr(op, "wants_shard_ctx", False):
+                    kwargs["shard_ctx"] = {
+                        "mesh": g.mesh,
+                        "axis_map": {ax: d for ax, d in
+                                     self.base._op_axis_maps
+                                     .get(op.name, {}).items()
+                                     if ax in g.mesh.shape},
+                        "sp_mode": getattr(self.model.config, "sp_mode",
+                                           "ring"),
+                    }
+                if op.stateful:
+                    outs, ns = op.forward_stateful(
+                        p, state_g.get(op.name, {}), xs,
+                        training=training, rng=op_rng)
+                    new_state[op.name] = ns
+                else:
+                    outs = op.forward(p, xs, training=training, rng=op_rng,
+                                      **kwargs)
+                sharding = self._group_sharding(g, op)
+                for i, t in enumerate(op.outputs):
+                    v = outs[i]
+                    if v.ndim == t.num_dims and len(sharding.spec) <= v.ndim:
+                        v = jax.lax.with_sharding_constraint(v, sharding)
+                    vals[t.name] = v
+            # exported values: tensors consumed outside the group or final
+            outputs = {}
+            for op in g.ops:
+                for t in op.outputs:
+                    if t.name in exports:
+                        outputs[t.name] = vals[t.name]
+            for k, v in state_g.items():
+                if k not in new_state:
+                    new_state[k] = v
+            return outputs, new_state
+
+        return fn
+
+    def _compute_exports(self, final_tensors) -> List[frozenset]:
+        """Which tensor names each group must hand to later groups."""
+        exports: List[set] = [set() for _ in self.groups]
+        keep = {t.name for t in final_tensors}
+        for op in self.model.ops:
+            if isinstance(op, InputOp):
+                continue
+            g = self._op_group[op.name]
+            for t in op.inputs:
+                if t.owner_op is None or isinstance(t.owner_op, InputOp):
+                    continue
+                pg = self._op_group[t.owner_op.name]
+                if pg.index != g.index:
+                    exports[pg.index].add(t.name)
+        for g in self.groups:
+            for op in g.ops:
+                for t in op.outputs:
+                    if t.name in keep:
+                        exports[g.index].add(t.name)
+        return [frozenset(s) for s in exports]
+
+    # ---- parameter init -----------------------------------------------------
+
+    def param_shardings(self):
+        out = {}
+        for op in self.model.ops:
+            specs = op.weight_specs()
+            if not specs:
+                continue
+            g = self._op_group[op.name]
+            am = {ax: d for ax, d in self.base._op_axis_maps
+                  .get(op.name, {}).items() if ax in g.mesh.shape}
+            wp = op.weight_partition(am)
+            out[op.name] = {name: NamedSharding(g.mesh, ps)
+                            for name, ps in wp.items()}
+        return out
+
+    def init_params(self, rng_key):
+        from flexflow_tpu.runtime.executor import _stable_hash
+        from flexflow_tpu.runtime.initializer import init_weight
+        from flexflow_tpu.ffconst import dtype_to_np
+
+        shardings = self.param_shardings()
+        params = {}
+        for op in self.model.ops:
+            specs = op.weight_specs()
+            if not specs:
+                continue
+            op_params = {}
+            for i, spec in enumerate(specs):
+                key = jax.random.fold_in(
+                    jax.random.fold_in(rng_key, _stable_hash(op.name)), i)
+                sharding = shardings[op.name].get(spec.name)
+                init_fn = functools.partial(init_weight, spec)
+                dtype = dtype_to_np(spec.dtype)
+                op_params[spec.name] = jax.jit(
+                    lambda k, f=init_fn, d=dtype: f(k, dtype=d),
+                    out_shardings=sharding)(key)
+            params[op.name] = op_params
+        return params
+
+    def init_state(self):
+        state = {}
+        for op in self.model.ops:
+            if op.stateful:
+                g = self._op_group[op.name]
+                s = op.init_state()
+                sh = NamedSharding(g.mesh, P())
+                state[op.name] = {k: jax.device_put(jnp.asarray(v), sh)
+                                  for k, v in s.items()}
+        return state
+
+    # ---- data movement ------------------------------------------------------
+
+    def _put(self, value, g: PlacementGroup, spec=None):
+        sh = NamedSharding(g.mesh, spec if spec is not None
+                           else P(*([None] * jnp.ndim(value))))
+        return jax.device_put(value, sh)
+
+    def _group_inputs(self, g: PlacementGroup, vals: Dict[str, Any],
+                      batch: Dict[str, Any]) -> Dict[str, Any]:
+        """Collect + transfer the tensors group g consumes from outside."""
+        ins = {}
+        for op in g.ops:
+            for t in op.inputs:
+                if t.name in ins:
+                    continue
+                if t.owner_op is None or isinstance(t.owner_op, InputOp):
+                    src = batch[t.owner_op.name] if t.owner_op is not None \
+                        else batch[t.name]
+                    entries = [None] * jnp.ndim(src)
+                    if "data" in g.mesh.shape and g.mesh.shape["data"] > 1:
+                        entries[0] = "data"
+                    ins[t.name] = self._put(src, g, P(*entries))
+                elif self._op_group[t.owner_op.name].index != g.index:
+                    ins[t.name] = self._put(vals[t.name], g)
+        return ins
+
+    # ---- compiled steps -----------------------------------------------------
+
+    def shard_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        # group inputs are device_put to their consumer blocks inside the
+        # step; here just materialize on device
+        return {k: jnp.asarray(v) for k, v in batch.items()}
+
+    def make_forward(self, final_tensors=None, training: bool = False):
+        finals = final_tensors or [self.model.ops[-1].outputs[0]]
+        exports = self._compute_exports(finals)
+        fwd_jits = [jax.jit(self._group_forward_fn(g, training, exports[i]))
+                    for i, g in enumerate(self.groups)]
+
+        def fwd(params, state, batch, rng=None):
+            vals: Dict[str, Any] = {}
+            for g, f in zip(self.groups, fwd_jits):
+                ins = self._group_inputs(g, vals, batch)
+                p_g = {op.name: params[op.name] for op in g.ops
+                       if op.name in params}
+                s_g = {op.name: state[op.name] for op in g.ops
+                       if op.name in state}
+                outs, _ = f(p_g, s_g, ins, rng)
+                vals.update(outs)
+            return [vals[t.name] for t in finals]
+
+        return fwd
+
+    def make_eval_step(self, loss_type: LossType,
+                       metric_types: List[MetricsType], final_tensor,
+                       label_key="label"):
+        fwd = self.make_forward([final_tensor], training=False)
+        final_group = self._op_group[final_tensor.owner_op.name]
+
+        def loss_mets(logits, labels):
+            loss = compute_loss(loss_type, logits, labels)
+            mets = batch_metrics(loss_type, metric_types, logits, labels)
+            return loss, mets
+
+        loss_jit = jax.jit(loss_mets)
+
+        def step(params, state, batch):
+            logits = fwd(params, state, batch)[0]
+            labels = self._put(batch[label_key], final_group)
+            loss, mets = loss_jit(logits, labels)
+            return loss, mets, logits
+
+        return step
+
+    def make_train_step(self, optimizer, loss_type: LossType,
+                        metric_types: List[MetricsType], final_tensor,
+                        label_key="label"):
+        aux_tensors = list(getattr(self.model, "_aux_tensors", ()))
+        exports = self._compute_exports([final_tensor] + aux_tensors)
+        final_group = self._op_group[final_tensor.owner_op.name]
+
+        fwd_fns = [self._group_forward_fn(g, True, exports[i])
+                   for i, g in enumerate(self.groups)]
+        fwd_jits = [jax.jit(f) for f in fwd_fns]
+
+        # per-group backward: rematerialize the forward inside jax.vjp
+        def make_bwd(gi):
+            def bwd(params_g, state_g, ins, rng, cots):
+                def f(p, i):
+                    outs, _ = fwd_fns[gi](p, state_g, i, rng)
+                    return outs
+                _, vjp = jax.vjp(f, params_g, ins)
+                return vjp(cots)
+            return jax.jit(bwd)
+
+        bwd_jits = [make_bwd(i) for i in range(len(self.groups))]
+
+        def loss_and_grad_logits(logits, labels, aux_vals):
+            def f(lg):
+                loss = compute_loss(loss_type, lg, labels)
+                for a in aux_vals:
+                    loss = loss + a
+                return loss
+            loss, dlogits = jax.value_and_grad(f)(logits)
+            mets = batch_metrics(loss_type, metric_types, logits, labels)
+            return loss, dlogits, mets
+
+        loss_jit = jax.jit(loss_and_grad_logits)
+
+        # tensor name -> producer group (None for graph inputs)
+        tensor_group: Dict[str, Optional[PlacementGroup]] = {}
+        for op in self.model.ops:
+            for t in op.outputs:
+                tensor_group[t.name] = None if isinstance(op, InputOp) \
+                    else self._op_group[op.name]
+
+        def step(params, opt_state, state, batch, rng):
+            # ---- forward ----
+            vals: Dict[str, Any] = {}
+            group_ins = []
+            new_state: Dict[str, Dict] = {}
+            for g, f in zip(self.groups, fwd_jits):
+                ins = self._group_inputs(g, vals, batch)
+                group_ins.append(ins)
+                p_g = {op.name: params[op.name] for op in g.ops
+                       if op.name in params}
+                s_g = {op.name: state[op.name] for op in g.ops
+                       if op.name in state}
+                outs, ns = f(p_g, s_g, ins, rng)
+                vals.update(outs)
+                new_state.update(ns)
+            # ---- loss on the final group's block ----
+            labels = self._put(batch[label_key], final_group)
+            aux_vals = [self._put(vals[t.name], final_group)
+                        for t in aux_tensors]
+            loss, dlogits, mets = loss_jit(vals[final_tensor.name], labels,
+                                           aux_vals)
+            # ---- backward, groups in reverse; cotangents accumulate on the
+            # producer group's block ----
+            cots: Dict[str, Any] = {final_tensor.name: dlogits}
+            for t in aux_tensors:
+                # d(loss)/d(aux) = 1 (aux losses are added to the loss)
+                cots[t.name] = self._put(jnp.ones(()), tensor_group[t.name])
+            grads: Dict[str, Dict] = {}
+            for gi in range(len(self.groups) - 1, -1, -1):
+                g = self.groups[gi]
+                p_g = {op.name: params[op.name] for op in g.ops
+                       if op.name in params}
+                s_g = {op.name: state[op.name] for op in g.ops
+                       if op.name in state}
+                g_cots = {}
+                for name in sorted(exports[gi]):
+                    if name in cots:
+                        g_cots[name] = self._put(cots[name], g)
+                    else:  # exported but unused downstream of the loss
+                        ref = vals[name]
+                        g_cots[name] = self._put(
+                            jnp.zeros(ref.shape, ref.dtype), g)
+                dp, dins = bwd_jits[gi](p_g, s_g, group_ins[gi], rng, g_cots)
+                grads.update(dp)
+                for name, ct in dins.items():
+                    pg = tensor_group.get(name)
+                    if pg is None:
+                        continue  # graph input: no gradient needed
+                    ct = self._put(ct, pg)
+                    cots[name] = cots[name] + ct if name in cots else ct
+            # ---- optimizer update (per-op states live on their blocks) ----
+            new_params, new_opt_state = optimizer.update(params, grads,
+                                                         opt_state)
+            return new_params, new_opt_state, new_state, loss, mets
+
+        return step

@@ -209,15 +209,18 @@ class GraphExecutor:
             sharding = self.op_output_sharding(op)
             for i, t in enumerate(op.outputs):
                 v = outs[i]
-                if v.ndim == t.num_dims:
-                    if not _spec_rank_ok(sharding.spec, v.ndim):
-                        raise ValueError(
-                            f"sharding constraint for {op.name!r} has rank "
-                            f"{len(sharding.spec)} but its output is rank "
-                            f"{v.ndim} — the strategy entry does not match "
-                            f"this op's output; fix or regenerate the "
-                            f"strategy file")
+                if v.ndim == t.num_dims and _spec_rank_ok(sharding.spec, v.ndim):
                     v = jax.lax.with_sharding_constraint(v, sharding)
+                elif i == 0 and v.ndim == t.num_dims:
+                    # the strategy's axis map targets the primary output; a
+                    # rank mismatch there is a bad strategy entry, not a
+                    # condition to silently skip (secondary outputs of other
+                    # ranks — e.g. MoE's scalar aux loss — stay unconstrained)
+                    raise ValueError(
+                        f"sharding constraint for {op.name!r} has rank "
+                        f"{len(sharding.spec)} but its output is rank "
+                        f"{v.ndim} — the strategy entry does not match this "
+                        f"op's output; fix or regenerate the strategy file")
                 vals[t] = v
         for k, v in state.items():
             if k not in new_state:

@@ -124,7 +124,10 @@ def export_sim_taskgraph(model, filename: str, mesh_shape=None):
         strategy = {name: am
                     for name, am in model.executor._op_axis_maps.items()}
     choices = prob.choices_for(strategy)
-    total, rows = prob.simulate_timeline(choices)
+    # honor op placement: the strategy's device blocks shape the timeline
+    places = {name: (min(pc.device_ids) if pc.device_ids else 0)
+              for name, pc in model.config.strategies.items()}
+    total, rows = prob.simulate_timeline(choices, places)
 
     lines = ["digraph sim_taskgraph {", "  rankdir=LR;",
              f'  label="simulated iteration: {total * 1e3:.3f} ms";']

@@ -1,17 +1,19 @@
 """Analytic strategy cost model.
 
 The Python-side cost oracle: given the op graph and a candidate strategy
-(op name -> axis_map over the mesh), estimate one training-iteration time.
-Plays the role of the reference's Simulator::simulate_runtime
-(simulator.cc:325-621) at strategy-ranking fidelity: per-op roofline compute
-cost, resharding cost where producer/consumer shardings disagree (the
-reference's region-intersection comm tasks, simulator.cc:252-285), gradient
-all-reduce per weight (the reference's post-hoc NCCL cost,
-simulator.cc:548-594), and an HBM over-capacity penalty
-(simulator.cc:595-620).
+(op name -> axis_map over the mesh, plus an optional device-block placement
+per op), estimate one training-iteration time. Plays the role of the
+reference's Simulator::simulate_runtime (simulator.cc:325-621): per-op
+roofline compute cost, resharding cost where producer/consumer shardings
+disagree (the reference's region-intersection comm tasks,
+simulator.cc:252-285), gradient all-reduce per weight (the reference's
+post-hoc NCCL cost, simulator.cc:548-594), an HBM over-capacity penalty
+(simulator.cc:595-620), and per-device timelines so op placement is rankable
+(simulator.cc:325-621 per-device busy lists).
 
-The C++ simulator (csrc/) refines this with event-driven per-device
-timelines; this module also feeds it per-op costs.
+`iteration_time` is an exact Python mirror of the C++ scheduler in
+csrc/sim.cc — the native annealer and this objective must agree (tested in
+tests/test_csim.py), so neither can drift silently.
 """
 
 from __future__ import annotations
@@ -24,6 +26,8 @@ from flexflow_tpu.ops.base import InputOp, Op
 from flexflow_tpu.search.machine import MachineModel
 
 AxisMap = Dict[str, Optional[int]]
+
+MEM_PENALTY_PER_BYTE = 1e-3 / 1e6  # 1 ms per MB over HBM (simulator.cc:612-617)
 
 
 def _parts(axis_map: AxisMap, mesh_shape: Dict[str, int]) -> int:
@@ -43,6 +47,16 @@ def _shard_degree_on_dim(axis_map: AxisMap, mesh_shape: Dict[str, int],
     return n
 
 
+def align_place(place: int, ndev: int, num_devices: int) -> int:
+    """Mirror of sim.cc align_place: device blocks are GSPMD-expressible
+    sub-meshes — ndev must divide the device count and the start must be a
+    multiple of ndev, else the block collapses to 0."""
+    if ndev <= 0 or ndev >= num_devices or num_devices % ndev != 0:
+        return 0
+    place = max(0, min(place, num_devices - ndev))
+    return place - place % ndev
+
+
 class CostModel:
     def __init__(self, model, mesh_shape: Dict[str, int],
                  machine: Optional[MachineModel] = None,
@@ -53,6 +67,13 @@ class CostModel:
         self.machine = machine or MachineModel()
         self.measured = measured or {}  # (op_name, parts) -> seconds (fwd+bwd)
         self.dtype_bytes = dtype_bytes
+
+    @property
+    def num_devices(self) -> int:
+        n = 1
+        for v in self.mesh_shape.values():
+            n *= v
+        return n
 
     # ---- per-op --------------------------------------------------------------
 
@@ -79,7 +100,8 @@ class CostModel:
 
     def op_grad_sync_time(self, op: Op, axis_map: AxisMap) -> float:
         """All-reduce of weight grads over mesh axes that parallelize the op
-        but do not shard the weight itself (pure replication axes)."""
+        but do not shard the weight itself (pure replication axes). Priced
+        per axis so DCN-crossing axes get the two-tier cost."""
         specs = op.weight_specs()
         if not specs:
             return 0.0
@@ -101,18 +123,23 @@ class CostModel:
             shard_deg = 1
             for ax in sharded_axes:
                 shard_deg *= self.mesh_shape.get(ax, 1)
-            replicate_deg = 1
             for ax, d in (axis_map or {}).items():
                 if d is not None and ax not in sharded_axes:
-                    replicate_deg *= self.mesh_shape[ax]
-            total += self.machine.all_reduce_time(wbytes / shard_deg,
-                                                  replicate_deg)
+                    total += self.machine.all_reduce_time(
+                        wbytes / shard_deg, self.mesh_shape[ax], ax)
         return total
+
+    def op_mem_bytes(self, op: Op, axis_map: AxisMap) -> float:
+        """Per-device HBM bytes under this choice: weights + grads + opt
+        state (x3) plus activations, divided over the partition."""
+        parts = _parts(axis_map, self.mesh_shape)
+        return (op.weight_bytes() * 3 + op.output_bytes()) / max(parts, 1)
 
     def resharding_time(self, producer_map: AxisMap, consumer_map: AxisMap,
                         tensor) -> float:
         """Cost to move a tensor from its producer's sharding to what the
-        consumer constrains. Zero when maps agree per axis."""
+        consumer constrains. Zero when maps agree per axis. Collectives over
+        DCN-crossing axes are priced at the DCN tier."""
         p = {ax: producer_map.get(ax) for ax in self.mesh_shape}
         c = {ax: consumer_map.get(ax) for ax in self.mesh_shape}
         if p == c:
@@ -127,42 +154,88 @@ class CostModel:
             if size <= 1:
                 continue
             if p.get(ax) is not None and c.get(ax) is not None:
-                cost += self.machine.all_to_all_time(per_chip, size)
+                cost += self.machine.all_to_all_time(per_chip, size, ax)
             elif p.get(ax) is not None:  # consumer wants it replicated
-                cost += self.machine.all_gather_time(per_chip, size)
+                cost += self.machine.all_gather_time(per_chip, size, ax)
             else:  # dynamic-slice, nearly free
                 cost += self.machine.ici_latency
         return cost
 
     # ---- whole strategy ------------------------------------------------------
 
-    def iteration_time(self, strategy: Dict[str, AxisMap]) -> float:
-        """Estimated seconds per training iteration under `strategy`.
-        Serial sum over ops (ranking fidelity; the C++ simulator adds
-        event-driven overlap)."""
-        total = 0.0
-        mem_per_chip = 0.0
+    def iteration_time(self, strategy: Dict[str, AxisMap],
+                       places: Optional[Dict[str, int]] = None) -> float:
+        """Estimated seconds per training iteration under `strategy` (+
+        optional per-op device-block placement). Exact Python mirror of the
+        C++ per-device list schedule (csrc/sim.cc schedule())."""
+        D = self.num_devices
+        dev_compute = [0.0] * D
+        dev_comm = [0.0] * D
+        dev_mem = [0.0] * D
+        finish: Dict[str, float] = {}
+        blocks: Dict[str, tuple] = {}
+
+        def block_of(op, am):
+            ndev = max(1, min(_parts(am, self.mesh_shape), D))
+            place = align_place((places or {}).get(op.name, 0), ndev, D)
+            return place, ndev
+
         for op in self.model.ops:
             if isinstance(op, InputOp):
                 continue
             am = strategy.get(op.name, {})
-            total += self.op_compute_time(op, am)
-            total += self.op_grad_sync_time(op, am)
-            for t in op.inputs:
+            pi, ni = block_of(op, am)
+            blocks[op.name] = (pi, ni)
+            ready = 0.0
+            for input_idx, t in enumerate(op.inputs):
                 if t.owner_op is None or isinstance(t.owner_op, InputOp):
                     continue
-                pam = strategy.get(t.owner_op.name, {})
-                # what the consumer wants for this input
+                src = t.owner_op.name
+                pam = strategy.get(src, {})
                 try:
-                    idx = op.inputs.index(t)
-                    want = op.input_axis_map(am, idx)
+                    want = op.input_axis_map(am, input_idx)
                 except Exception:
                     want = am
-                total += self.resharding_time(pam, want, t)
-            parts = _parts(am, self.mesh_shape)
-            mem_per_chip += (op.weight_bytes() * 3  # w + grad + opt state
-                             + op.output_bytes()) / max(parts, 1)
-        if mem_per_chip > self.machine.hbm_bytes:
-            # 1 ms per MB over capacity (reference simulator.cc:612-617)
-            total += (mem_per_chip - self.machine.hbm_bytes) / 1e6 * 1e-3
+                c = self.resharding_time(pam, want, t)
+                ps, ns = blocks.get(src, (0, D))
+                if ps != pi:
+                    c += (t.volume() * self.dtype_bytes / max(ns, 1)
+                          / self.machine.ici_bw) + self.machine.ici_latency
+                if c > 0.0:
+                    start = finish.get(src, 0.0)
+                    for d in range(ps, ps + ns):
+                        start = max(start, dev_comm[d])
+                    for d in range(pi, pi + ni):
+                        start = max(start, dev_comm[d])
+                    end = start + c
+                    for d in range(ps, ps + ns):
+                        dev_comm[d] = end
+                    for d in range(pi, pi + ni):
+                        dev_comm[d] = end
+                    ready = max(ready, end)
+                else:
+                    ready = max(ready, finish.get(src, 0.0))
+            start = ready
+            for d in range(pi, pi + ni):
+                start = max(start, dev_compute[d])
+            end = start + self.op_compute_time(op, am)
+            for d in range(pi, pi + ni):
+                dev_compute[d] = end
+            finish[op.name] = end
+            sync = self.op_grad_sync_time(op, am)
+            if sync > 0.0:
+                cstart = end
+                for d in range(pi, pi + ni):
+                    cstart = max(cstart, dev_comm[d])
+                for d in range(pi, pi + ni):
+                    dev_comm[d] = cstart + sync
+            m = self.op_mem_bytes(op, am)
+            for d in range(pi, pi + ni):
+                dev_mem[d] += m
+
+        total = max(max(dev_compute), max(dev_comm)) if D else 0.0
+        for d in range(D):
+            over = dev_mem[d] - self.machine.hbm_bytes
+            if over > 0.0:
+                total += over * MEM_PENALTY_PER_BYTE
         return total

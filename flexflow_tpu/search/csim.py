@@ -1,9 +1,14 @@
 """ctypes bridge to the native search core (csrc/sim.cc).
 
 Builds the cost tables the C++ simulator consumes: per-op choice lists
-(legal axis maps) with compute + grad-sync costs from the Python CostModel,
-and per-edge resharding cost matrices. Compiles libffsim.so on first use
+(legal axis maps) with compute + grad-sync + per-device-memory costs and the
+device count each choice spans, plus per-edge resharding cost matrices and
+tensor sizes (for placement transfers). Compiles libffsim.so on first use
 (g++, no pybind11 in this environment — plain C ABI + ctypes).
+
+Strategies evaluated here are (choice, place) pairs per op: the axis map
+plus the contiguous aligned device block the op runs on (reference
+ParallelConfig.device_ids, config.h:47-69).
 """
 
 from __future__ import annotations
@@ -11,7 +16,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -37,17 +42,19 @@ def _load_lib():
     d, i32, i64 = (np.ctypeslib.ndpointer(dtype=np.float64, flags="C"),
                    np.ctypeslib.ndpointer(dtype=np.int32, flags="C"),
                    np.ctypeslib.ndpointer(dtype=np.int64, flags="C"))
-    lib.ff_simulate.restype = ctypes.c_double
-    lib.ff_simulate.argtypes = [ctypes.c_int, ctypes.c_int, i64, d, d,
-                                i32, i32, i64, d, i32]
-    lib.ff_mcmc.restype = ctypes.c_double
-    lib.ff_mcmc.argtypes = [ctypes.c_int, ctypes.c_int, i64, d, d,
-                            i32, i32, i64, d, i32,
-                            ctypes.c_int, ctypes.c_double, ctypes.c_uint64, i32]
-    lib.ff_simulate_timeline.restype = ctypes.c_double
-    lib.ff_simulate_timeline.argtypes = [ctypes.c_int, ctypes.c_int, i64, d, d,
-                                         i32, i32, i64, d, i32,
-                                         d, d, d, d, d, d]
+    cd = ctypes.c_double
+    tables = [ctypes.c_int, ctypes.c_int, ctypes.c_int,  # ops, edges, devices
+              i64, d, d, d, i32,                         # op tables
+              i32, i32, i64, d, d]                       # edge tables
+    lib.ff_simulate.restype = cd
+    lib.ff_simulate.argtypes = tables + [i32, i32, cd, cd, cd, cd]
+    lib.ff_simulate_timeline.restype = cd
+    lib.ff_simulate_timeline.argtypes = tables + [i32, i32, cd, cd, cd, cd,
+                                                  d, d, d, d, d, d]
+    lib.ff_mcmc.restype = cd
+    lib.ff_mcmc.argtypes = tables + [i32, i32, cd, cd, cd, cd,
+                                     ctypes.c_int, cd, ctypes.c_uint64,
+                                     i32, i32]
     _lib = lib
     return lib
 
@@ -62,20 +69,32 @@ class CompiledSearchProblem:
         self.ops = [op for op in model.ops if not isinstance(op, InputOp)]
         self.op_index = {op.name: i for i, op in enumerate(self.ops)}
         self.mesh_shape = mesh_shape
+        self.cost = cost
+        self.num_devices = 1
+        for v in mesh_shape.values():
+            self.num_devices *= v
         self.op_maps: List[List[dict]] = [
             legal_axis_maps(op, mesh_shape, epp, eap) for op in self.ops]
 
         # per-op cost tables
         offsets = [0]
-        compute, sync = [], []
+        compute, sync, mem, ndev = [], [], [], []
         for op, maps in zip(self.ops, self.op_maps):
             for am in maps:
                 compute.append(cost.op_compute_time(op, am))
                 sync.append(cost.op_grad_sync_time(op, am))
+                mem.append(cost.op_mem_bytes(op, am))
+                parts = 1
+                for ax, dd in am.items():
+                    if dd is not None:
+                        parts *= mesh_shape[ax]
+                ndev.append(max(1, min(parts, self.num_devices)))
             offsets.append(len(compute))
         self.op_cost_offsets = np.asarray(offsets, np.int64)
         self.op_compute_costs = np.asarray(compute, np.float64)
         self.op_sync_costs = np.asarray(sync, np.float64)
+        self.op_mem_bytes = np.asarray(mem, np.float64)
+        self.op_ndev = np.asarray(ndev, np.int32)
 
         # edges (sorted by consumer index — required by the C scheduler)
         edges = []  # (src_idx, dst_idx, input_idx, tensor)
@@ -88,6 +107,8 @@ class CompiledSearchProblem:
         edges.sort(key=lambda x: x[1])
         self.edge_src = np.asarray([e[0] for e in edges], np.int32)
         self.edge_dst = np.asarray([e[1] for e in edges], np.int32)
+        self.edge_bytes = np.asarray(
+            [e[3].volume() * cost.dtype_bytes for e in edges], np.float64)
         eoffsets = [0]
         ecosts: List[float] = []
         for src_idx, dst_idx, input_idx, t in edges:
@@ -102,6 +123,28 @@ class CompiledSearchProblem:
         self.edge_cost_offsets = np.asarray(eoffsets, np.int64)
         self.edge_costs = np.asarray(ecosts, np.float64)
         self.num_edges = len(edges)
+
+    def _table_args(self):
+        return (len(self.ops), self.num_edges, self.num_devices,
+                self.op_cost_offsets, self.op_compute_costs,
+                self.op_sync_costs, self.op_mem_bytes, self.op_ndev,
+                self.edge_src, self.edge_dst, self.edge_cost_offsets,
+                self.edge_costs, self.edge_bytes)
+
+    def _machine_args(self):
+        from flexflow_tpu.search.cost_model import MEM_PENALTY_PER_BYTE
+
+        m = self.cost.machine
+        return (float(m.hbm_bytes), float(m.ici_bw), float(m.ici_latency),
+                float(MEM_PENALTY_PER_BYTE))
+
+    def _places_arr(self, places) -> np.ndarray:
+        if places is None:
+            return np.zeros(len(self.ops), np.int32)
+        if isinstance(places, dict):
+            return np.asarray([int(places.get(op.name, 0))
+                               for op in self.ops], np.int32)
+        return np.ascontiguousarray(places, np.int32)
 
     def choices_for(self, strategy: Dict[str, dict]) -> np.ndarray:
         out = np.zeros(len(self.ops), np.int32)
@@ -119,15 +162,14 @@ class CompiledSearchProblem:
                     f"{self.mesh_shape} and the enable-*-parallel flags")
         return out
 
-    def simulate(self, choices: np.ndarray) -> float:
+    def simulate(self, choices: np.ndarray, places=None) -> float:
         lib = _load_lib()
         return lib.ff_simulate(
-            len(self.ops), self.num_edges, self.op_cost_offsets,
-            self.op_compute_costs, self.op_sync_costs, self.edge_src,
-            self.edge_dst, self.edge_cost_offsets, self.edge_costs,
-            np.ascontiguousarray(choices, np.int32))
+            *self._table_args(),
+            np.ascontiguousarray(choices, np.int32),
+            self._places_arr(places), *self._machine_args())
 
-    def simulate_timeline(self, choices: np.ndarray):
+    def simulate_timeline(self, choices: np.ndarray, places=None):
         """Per-task schedule under `choices` (reference: simulator DOT export
         with start/end times, --taskgraph). Returns (total_seconds, rows)
         where rows = [{kind, name, start, finish, src, dst}]."""
@@ -137,10 +179,10 @@ class CompiledSearchProblem:
         ss, sf = np.zeros(n), np.zeros(n)
         ms, mf = np.zeros(max(ne, 1)), np.zeros(max(ne, 1))
         total = lib.ff_simulate_timeline(
-            n, ne, self.op_cost_offsets, self.op_compute_costs,
-            self.op_sync_costs, self.edge_src, self.edge_dst,
-            self.edge_cost_offsets, self.edge_costs,
-            np.ascontiguousarray(choices, np.int32), cs, cf, ms, mf, ss, sf)
+            *self._table_args(),
+            np.ascontiguousarray(choices, np.int32),
+            self._places_arr(places), *self._machine_args(),
+            cs, cf, ms, mf, ss, sf)
         rows = []
         for i, op in enumerate(self.ops):
             rows.append({"kind": "compute", "name": op.name,
@@ -159,16 +201,17 @@ class CompiledSearchProblem:
         return total, rows
 
     def mcmc(self, init_choices: np.ndarray, budget: int, alpha: float,
-             seed: int):
+             seed: int, init_places=None
+             ) -> Tuple[np.ndarray, np.ndarray, float]:
         lib = _load_lib()
-        best = np.zeros(len(self.ops), np.int32)
+        best_c = np.zeros(len(self.ops), np.int32)
+        best_p = np.zeros(len(self.ops), np.int32)
         best_cost = lib.ff_mcmc(
-            len(self.ops), self.num_edges, self.op_cost_offsets,
-            self.op_compute_costs, self.op_sync_costs, self.edge_src,
-            self.edge_dst, self.edge_cost_offsets, self.edge_costs,
+            *self._table_args(),
             np.ascontiguousarray(init_choices, np.int32),
-            budget, alpha, seed, best)
-        return best, best_cost
+            self._places_arr(init_places), *self._machine_args(),
+            budget, alpha, seed, best_c, best_p)
+        return best_c, best_p, best_cost
 
 
 def get_search_problem(model, cost, mesh_shape: Dict[str, int],
@@ -202,15 +245,20 @@ def native_optimize(model, cost, mesh_shape: Dict[str, int], budget: int,
     prob = get_search_problem(model, cost, mesh_shape, epp, eap)
     init = prob.choices_for(data_parallel_strategy(model, mesh_shape))
     dp_cost = prob.simulate(init)
-    best, best_cost = prob.mcmc(init, budget, alpha, seed)
+    best_c, best_p, best_cost = prob.mcmc(init, budget, alpha, seed)
     if verbose:
         print(f"[search/native] best {best_cost * 1e3:.3f} ms vs DP "
               f"{dp_cost * 1e3:.3f} ms "
               f"({dp_cost / max(best_cost, 1e-12):.2f}x), "
-              f"{len(prob.ops)} ops, {prob.num_edges} edges")
+              f"{len(prob.ops)} ops, {prob.num_edges} edges, "
+              f"{prob.num_devices} devices")
     out = {}
     for i, op in enumerate(prob.ops):
-        am = prob.op_maps[i][int(best[i])]
-        out[op.name] = ParallelConfig.from_axis_map(
+        am = prob.op_maps[i][int(best_c[i])]
+        pc = ParallelConfig.from_axis_map(
             op.outputs[0].num_dims, mesh_shape, am)
+        ndev = int(prob.op_ndev[prob.op_cost_offsets[i] + int(best_c[i])])
+        start = int(best_p[i])
+        pc.device_ids = tuple(range(start, start + ndev))
+        out[op.name] = pc
     return out

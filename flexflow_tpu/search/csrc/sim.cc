@@ -1,156 +1,282 @@
 // Native search core: event-driven task-graph simulator + MCMC annealer.
 //
 // The TPU re-design of the reference's C++ search engine
-// (src/runtime/simulator.cc:93-621 TaskManager/SimTask event simulation and
-// src/runtime/model.cc:1652-1725 FFModel::optimize MCMC loop).
+// (src/runtime/simulator.cc:93-621 TaskManager/SimTask per-device event
+// simulation and src/runtime/model.cc:1652-1725 FFModel::optimize MCMC loop).
 //
 // Division of labor: Python (flexflow_tpu/search/cost_model.py) knows the
 // machine model and computes COST TABLES —
-//   * per op, per legal axis-map choice: compute seconds + gradient-sync
-//     comm seconds,
+//   * per op, per legal axis-map choice: compute seconds, gradient-sync comm
+//     seconds, per-device memory bytes, and the number of devices spanned,
 //   * per graph edge, per (producer choice, consumer choice) pair:
-//     resharding comm seconds.
-// This library evaluates a strategy's iteration time with a two-resource
-// (compute stream, ICI stream) list schedule — capturing compute/comm
-// overlap the way the reference's per-device timelines did — and runs the
-// Metropolis annealer over choice vectors (reference accept rule:
-// exp(-alpha*diff), reset-to-best every budget/100 iters).
+//     resharding comm seconds (GSPMD collectives within a device block).
+// This library evaluates a strategy — a (choice, placement) pair per op —
+// with PER-DEVICE compute and comm timelines (reference
+// simulator.cc:325-621): ops placed on disjoint device blocks overlap, ops
+// sharing devices serialize, per-device HBM footprints accumulate and
+// over-capacity is penalized at 1 ms/MB (reference simulator.cc:595-620),
+// and a block-start mismatch between producer and consumer adds a p2p
+// placement transfer (reference's inter-device task edges,
+// simulator.cc:252-285). The MCMC proposes both axis-map choices and
+// contiguous aligned device blocks (reference model.cc:496-525 random
+// contiguous device ranges).
 //
 // Exposed via a C ABI for ctypes (no pybind11 in this environment).
 
+#include <algorithm>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <random>
 #include <vector>
 
-extern "C" {
+namespace {
 
-// Graph + cost-table layout (all arrays owned by caller):
-//   num_ops, num_edges
-//   op_cost_offsets[num_ops+1]        : prefix offsets into op cost tables
-//   op_compute_costs[...]             : compute seconds per (op, choice)
-//   op_sync_costs[...]                : grad-sync comm seconds per (op, choice)
-//   edge_src[num_edges], edge_dst[num_edges] : op indices (topological: src<dst)
-//   edge_cost_offsets[num_edges+1]    : prefix offsets into edge_costs
-//   edge_costs[...]                   : row-major [src_choice][dst_choice]
-//   choices[num_ops]                  : the strategy being evaluated
-// One list-schedule implementation serves both entry points: timeline
-// pointers may be null (the hot MCMC path), or caller buffers for task-graph
-// export (reference: the simulator's DotFile dump with per-task times,
-// simulator.h:78-131 + --taskgraph). comm times are per edge; sync times per
-// op (0-width when no sync).
-static double schedule(int num_ops, int num_edges,
-                       const int64_t* op_cost_offsets,
-                       const double* op_compute_costs,
-                       const double* op_sync_costs,
-                       const int32_t* edge_src, const int32_t* edge_dst,
-                       const int64_t* edge_cost_offsets,
-                       const double* edge_costs,
-                       const int32_t* choices,
-                       double* compute_start, double* compute_finish,
-                       double* comm_start, double* comm_finish,
-                       double* sync_start, double* sync_finish) {
-  // finish time of each op's compute; streams advance monotonically
-  std::vector<double> finish(num_ops, 0.0);
-  std::vector<double> ready(num_ops, 0.0);
-  double compute_free = 0.0, comm_free = 0.0;
+struct Tables {
+  int num_ops, num_edges, num_devices;
+  const int64_t* op_cost_offsets;   // [num_ops+1]
+  const double* op_compute_costs;   // per (op, choice)
+  const double* op_sync_costs;      // per (op, choice)
+  const double* op_mem_bytes;       // per (op, choice): per-device HBM bytes
+  const int32_t* op_ndev;           // per (op, choice): devices spanned
+  const int32_t* edge_src;          // [num_edges], sorted by dst, src < dst
+  const int32_t* edge_dst;
+  const int64_t* edge_cost_offsets; // [num_edges+1]
+  const double* edge_costs;         // row-major [src_choice][dst_choice]
+  const double* edge_bytes;         // [num_edges]: full tensor bytes
+  double hbm_bytes, ici_bw, ici_latency, mem_penalty_per_byte;
+};
+
+struct Timeline {
+  double *compute_start, *compute_finish;   // [num_ops] or null
+  double *comm_start, *comm_finish;         // [num_edges] or null
+  double *sync_start, *sync_finish;         // [num_ops] or null
+};
+
+// Clamp a desired block start so [place, place+ndev) fits and is aligned to
+// the block grid (the GSPMD-expressible sub-meshes: ndev | num_devices and
+// place a multiple of ndev; otherwise everything collapses to block 0).
+int align_place(int place, int ndev, int num_devices) {
+  if (ndev <= 0 || ndev >= num_devices || num_devices % ndev != 0) return 0;
+  if (place < 0) place = 0;
+  if (place > num_devices - ndev) place = num_devices - ndev;
+  return place - place % ndev;
+}
+
+double schedule(const Tables& T, const int32_t* choices,
+                const int32_t* places, const Timeline* tl) {
+  const int D = T.num_devices;
+  std::vector<double> finish(T.num_ops, 0.0);
+  std::vector<double> dev_compute(D, 0.0);  // per-device compute stream
+  std::vector<double> dev_comm(D, 0.0);     // per-device comm (ICI) stream
+  std::vector<double> dev_mem(D, 0.0);      // per-device HBM footprint
+
+  auto block = [&](int op) {
+    int64_t off = T.op_cost_offsets[op];
+    int n = T.op_ndev ? T.op_ndev[off + choices[op]] : D;
+    if (n <= 0) n = 1;
+    if (n > D) n = D;
+    int p = places ? align_place(places[op], n, D) : 0;
+    return std::pair<int, int>(p, n);
+  };
+
   int e = 0;
-  for (int i = 0; i < num_ops; ++i) {
-    // schedule all incoming comm (edges are sorted by dst, topological)
-    while (e < num_edges && edge_dst[e] == i) {
-      int s = edge_src[e];
-      int64_t off = edge_cost_offsets[e];
-      int n_dst = (int)((edge_cost_offsets[e + 1] - off) /
-                        (op_cost_offsets[s + 1] - op_cost_offsets[s]));
-      double c = edge_costs[off + (int64_t)choices[s] * n_dst + choices[i]];
+  for (int i = 0; i < T.num_ops; ++i) {
+    auto [pi, ni] = block(i);
+    double ready = 0.0;
+    // incoming comm (edges sorted by dst, topological)
+    while (e < T.num_edges && T.edge_dst[e] == i) {
+      int s = T.edge_src[e];
+      auto [ps, ns] = block(s);
+      int64_t off = T.edge_cost_offsets[e];
+      int n_dst = (int)((T.edge_cost_offsets[e + 1] - off) /
+                        (T.op_cost_offsets[s + 1] - T.op_cost_offsets[s]));
+      double c = T.edge_costs[off + (int64_t)choices[s] * n_dst + choices[i]];
+      if (T.edge_bytes && ps != pi) {
+        // producer and consumer live on different device blocks: per-shard
+        // p2p push over ICI (reference inter-device transfer tasks)
+        c += T.edge_bytes[e] / std::max(ns, 1) / T.ici_bw + T.ici_latency;
+      }
       if (c > 0.0) {
-        double start = std::max(finish[s], comm_free);
-        if (comm_start) { comm_start[e] = start; }
-        comm_free = start + c;
-        if (comm_finish) { comm_finish[e] = comm_free; }
-        ready[i] = std::max(ready[i], comm_free);
+        // the transfer occupies the comm streams of both blocks
+        double start = finish[s];
+        for (int d = ps; d < ps + ns; ++d) start = std::max(start, dev_comm[d]);
+        for (int d = pi; d < pi + ni; ++d) start = std::max(start, dev_comm[d]);
+        double end = start + c;
+        for (int d = ps; d < ps + ns; ++d) dev_comm[d] = end;
+        for (int d = pi; d < pi + ni; ++d) dev_comm[d] = end;
+        if (tl && tl->comm_start) { tl->comm_start[e] = start; tl->comm_finish[e] = end; }
+        ready = std::max(ready, end);
       } else {
-        if (comm_start) { comm_start[e] = comm_finish[e] = finish[s]; }
-        ready[i] = std::max(ready[i], finish[s]);
+        if (tl && tl->comm_start) { tl->comm_start[e] = tl->comm_finish[e] = finish[s]; }
+        ready = std::max(ready, finish[s]);
       }
       ++e;
     }
-    int64_t off = op_cost_offsets[i];
-    double comp = op_compute_costs[off + choices[i]];
-    double start = std::max(ready[i], compute_free);
-    if (compute_start) { compute_start[i] = start; }
-    finish[i] = start + comp;
-    if (compute_finish) { compute_finish[i] = finish[i]; }
-    compute_free = finish[i];
-    // gradient sync rides the comm stream after this op's compute
-    double sync = op_sync_costs[off + choices[i]];
+    int64_t off = T.op_cost_offsets[i];
+    double comp = T.op_compute_costs[off + choices[i]];
+    double start = ready;
+    for (int d = pi; d < pi + ni; ++d) start = std::max(start, dev_compute[d]);
+    double end = start + comp;
+    for (int d = pi; d < pi + ni; ++d) dev_compute[d] = end;
+    finish[i] = end;
+    if (tl && tl->compute_start) { tl->compute_start[i] = start; tl->compute_finish[i] = end; }
+    // gradient sync rides this block's comm streams after the compute
+    double sync = T.op_sync_costs[off + choices[i]];
     if (sync > 0.0) {
-      double cstart = std::max(finish[i], comm_free);
-      if (sync_start) { sync_start[i] = cstart; }
-      comm_free = cstart + sync;
-      if (sync_finish) { sync_finish[i] = comm_free; }
-    } else if (sync_start) {
-      sync_start[i] = sync_finish[i] = finish[i];
+      double cstart = end;
+      for (int d = pi; d < pi + ni; ++d) cstart = std::max(cstart, dev_comm[d]);
+      double cend = cstart + sync;
+      for (int d = pi; d < pi + ni; ++d) dev_comm[d] = cend;
+      if (tl && tl->sync_start) { tl->sync_start[i] = cstart; tl->sync_finish[i] = cend; }
+    } else if (tl && tl->sync_start) {
+      tl->sync_start[i] = tl->sync_finish[i] = end;
+    }
+    if (T.op_mem_bytes) {
+      double m = T.op_mem_bytes[off + choices[i]];
+      for (int d = pi; d < pi + ni; ++d) dev_mem[d] += m;
     }
   }
-  return std::max(compute_free, comm_free);
+  double total = 0.0;
+  for (int d = 0; d < D; ++d)
+    total = std::max(total, std::max(dev_compute[d], dev_comm[d]));
+  // per-device over-HBM penalty (reference simulator.cc:595-620: 1 ms/MB)
+  if (T.op_mem_bytes && T.hbm_bytes > 0.0) {
+    for (int d = 0; d < D; ++d) {
+      double over = dev_mem[d] - T.hbm_bytes;
+      if (over > 0.0) total += over * T.mem_penalty_per_byte;
+    }
+  }
+  return total;
 }
 
-double ff_simulate(int num_ops, int num_edges,
+Tables make_tables(int num_ops, int num_edges, int num_devices,
                    const int64_t* op_cost_offsets,
                    const double* op_compute_costs,
                    const double* op_sync_costs,
+                   const double* op_mem_bytes,
+                   const int32_t* op_ndev,
                    const int32_t* edge_src, const int32_t* edge_dst,
                    const int64_t* edge_cost_offsets,
                    const double* edge_costs,
-                   const int32_t* choices) {
-  return schedule(num_ops, num_edges, op_cost_offsets, op_compute_costs,
-                  op_sync_costs, edge_src, edge_dst, edge_cost_offsets,
-                  edge_costs, choices, nullptr, nullptr, nullptr, nullptr,
-                  nullptr, nullptr);
+                   const double* edge_bytes,
+                   double hbm_bytes, double ici_bw, double ici_latency,
+                   double mem_penalty_per_byte) {
+  Tables T;
+  T.num_ops = num_ops; T.num_edges = num_edges;
+  T.num_devices = num_devices > 0 ? num_devices : 1;
+  T.op_cost_offsets = op_cost_offsets;
+  T.op_compute_costs = op_compute_costs;
+  T.op_sync_costs = op_sync_costs;
+  T.op_mem_bytes = op_mem_bytes;
+  T.op_ndev = op_ndev;
+  T.edge_src = edge_src; T.edge_dst = edge_dst;
+  T.edge_cost_offsets = edge_cost_offsets;
+  T.edge_costs = edge_costs;
+  T.edge_bytes = edge_bytes;
+  T.hbm_bytes = hbm_bytes;
+  T.ici_bw = ici_bw > 0 ? ici_bw : 4.5e10;
+  T.ici_latency = ici_latency;
+  // 1 ms per MB over capacity when the caller passes 0 (reference
+  // simulator.cc:612-617); cost_model.MEM_PENALTY_PER_BYTE feeds the real
+  // value so the Python objective and this scheduler cannot drift
+  T.mem_penalty_per_byte = mem_penalty_per_byte > 0.0 ? mem_penalty_per_byte
+                                                      : 1e-3 / 1e6;
+  return T;
 }
 
-double ff_simulate_timeline(int num_ops, int num_edges,
+}  // namespace
+
+extern "C" {
+
+double ff_simulate(int num_ops, int num_edges, int num_devices,
+                   const int64_t* op_cost_offsets,
+                   const double* op_compute_costs,
+                   const double* op_sync_costs,
+                   const double* op_mem_bytes,
+                   const int32_t* op_ndev,
+                   const int32_t* edge_src, const int32_t* edge_dst,
+                   const int64_t* edge_cost_offsets,
+                   const double* edge_costs,
+                   const double* edge_bytes,
+                   const int32_t* choices, const int32_t* places,
+                   double hbm_bytes, double ici_bw, double ici_latency,
+                   double mem_penalty_per_byte) {
+  Tables T = make_tables(num_ops, num_edges, num_devices, op_cost_offsets,
+                         op_compute_costs, op_sync_costs, op_mem_bytes,
+                         op_ndev, edge_src, edge_dst, edge_cost_offsets,
+                         edge_costs, edge_bytes, hbm_bytes, ici_bw,
+                         ici_latency, mem_penalty_per_byte);
+  return schedule(T, choices, places, nullptr);
+}
+
+double ff_simulate_timeline(int num_ops, int num_edges, int num_devices,
                             const int64_t* op_cost_offsets,
                             const double* op_compute_costs,
                             const double* op_sync_costs,
+                            const double* op_mem_bytes,
+                            const int32_t* op_ndev,
                             const int32_t* edge_src, const int32_t* edge_dst,
                             const int64_t* edge_cost_offsets,
                             const double* edge_costs,
-                            const int32_t* choices,
+                            const double* edge_bytes,
+                            const int32_t* choices, const int32_t* places,
+                            double hbm_bytes, double ici_bw,
+                            double ici_latency, double mem_penalty_per_byte,
                             double* compute_start, double* compute_finish,
                             double* comm_start, double* comm_finish,
                             double* sync_start, double* sync_finish) {
-  return schedule(num_ops, num_edges, op_cost_offsets, op_compute_costs,
-                  op_sync_costs, edge_src, edge_dst, edge_cost_offsets,
-                  edge_costs, choices, compute_start, compute_finish,
-                  comm_start, comm_finish, sync_start, sync_finish);
+  Tables T = make_tables(num_ops, num_edges, num_devices, op_cost_offsets,
+                         op_compute_costs, op_sync_costs, op_mem_bytes,
+                         op_ndev, edge_src, edge_dst, edge_cost_offsets,
+                         edge_costs, edge_bytes, hbm_bytes, ici_bw,
+                         ici_latency, mem_penalty_per_byte);
+  Timeline tl{compute_start, compute_finish, comm_start, comm_finish,
+              sync_start, sync_finish};
+  return schedule(T, choices, places, &tl);
 }
 
-// MCMC simulated annealing (reference: model.cc:1663-1725).
-// Returns the best cost; best_choices filled with the best strategy.
-double ff_mcmc(int num_ops, int num_edges,
+// MCMC simulated annealing (reference: model.cc:1663-1725). Proposals
+// re-randomize one op's axis-map choice or its device block (reference
+// rewrite model.cc:1652-1661 + random contiguous ranges model.cc:496-525).
+// Returns the best cost; best_choices/best_places filled with the best
+// strategy.
+double ff_mcmc(int num_ops, int num_edges, int num_devices,
                const int64_t* op_cost_offsets,
                const double* op_compute_costs,
                const double* op_sync_costs,
+               const double* op_mem_bytes,
+               const int32_t* op_ndev,
                const int32_t* edge_src, const int32_t* edge_dst,
                const int64_t* edge_cost_offsets,
                const double* edge_costs,
-               const int32_t* init_choices,
+               const double* edge_bytes,
+               const int32_t* init_choices, const int32_t* init_places,
+               double hbm_bytes, double ici_bw, double ici_latency,
+               double mem_penalty_per_byte,
                int budget, double alpha, uint64_t seed,
-               int32_t* best_choices) {
+               int32_t* best_choices, int32_t* best_places) {
+  Tables T = make_tables(num_ops, num_edges, num_devices, op_cost_offsets,
+                         op_compute_costs, op_sync_costs, op_mem_bytes,
+                         op_ndev, edge_src, edge_dst, edge_cost_offsets,
+                         edge_costs, edge_bytes, hbm_bytes, ici_bw,
+                         ici_latency, mem_penalty_per_byte);
+  const int D = T.num_devices;
   std::mt19937_64 rng(seed);
   std::uniform_real_distribution<double> unif(0.0, 1.0);
 
-  std::vector<int32_t> current(init_choices, init_choices + num_ops);
-  auto eval = [&](const std::vector<int32_t>& c) {
-    return ff_simulate(num_ops, num_edges, op_cost_offsets, op_compute_costs,
-                       op_sync_costs, edge_src, edge_dst, edge_cost_offsets,
-                       edge_costs, c.data());
+  std::vector<int32_t> cur_c(init_choices, init_choices + num_ops);
+  std::vector<int32_t> cur_p(num_ops, 0);
+  if (init_places) cur_p.assign(init_places, init_places + num_ops);
+
+  auto ndev_of = [&](int op, int choice) {
+    int n = op_ndev ? op_ndev[op_cost_offsets[op] + choice] : D;
+    return std::max(1, std::min(n, D));
   };
-  double cur_cost = eval(current);
-  std::vector<int32_t> best = current;
+  auto eval = [&]() { return schedule(T, cur_c.data(), cur_p.data(), nullptr); };
+
+  double cur_cost = eval();
+  std::vector<int32_t> best_c = cur_c, best_p = cur_p;
   double best_cost = cur_cost;
 
   int reset_span = budget / 100;
@@ -159,17 +285,28 @@ double ff_mcmc(int num_ops, int num_edges,
 
   for (int it = 0; it < budget; ++it) {
     if (it > 0 && it % reset_span == 0) {
-      current = best;
+      cur_c = best_c; cur_p = best_p;
       cur_cost = best_cost;
     }
     int op = (int)(rng() % (uint64_t)num_ops);
     int n_choices = (int)(op_cost_offsets[op + 1] - op_cost_offsets[op]);
-    if (n_choices <= 1) continue;
-    int old_choice = current[op];
-    int new_choice = (int)(rng() % (uint64_t)n_choices);
-    if (new_choice == old_choice) continue;
-    current[op] = new_choice;
-    double new_cost = eval(current);
+    int old_c = cur_c[op], old_p = cur_p[op];
+    // half the proposals move the device block, half the axis map
+    // (reference re-randomizes both at once; splitting mixes faster)
+    bool move_place = (rng() & 1) != 0;
+    int ndev = ndev_of(op, old_c);
+    int nblocks = (ndev < D && D % ndev == 0) ? D / ndev : 1;
+    if (move_place && nblocks > 1) {
+      cur_p[op] = (int)(rng() % (uint64_t)nblocks) * ndev;
+      if (cur_p[op] == old_p) continue;
+    } else {
+      if (n_choices <= 1) continue;
+      int new_c = (int)(rng() % (uint64_t)n_choices);
+      if (new_c == old_c) continue;
+      cur_c[op] = new_c;
+      cur_p[op] = align_place(old_p, ndev_of(op, new_c), D);
+    }
+    double new_cost = eval();
     double diff = new_cost - cur_cost;
     // reference accepts with prob exp(-alpha*diff) on simulated ms; our
     // costs are seconds, so scale to ms for comparable alpha semantics
@@ -177,13 +314,15 @@ double ff_mcmc(int num_ops, int num_edges,
       cur_cost = new_cost;
       if (new_cost < best_cost) {
         best_cost = new_cost;
-        best = current;
+        best_c = cur_c; best_p = cur_p;
       }
     } else {
-      current[op] = old_choice;
+      cur_c[op] = old_c; cur_p[op] = old_p;
     }
   }
-  std::memcpy(best_choices, best.data(), sizeof(int32_t) * num_ops);
+  std::memcpy(best_choices, best_c.data(), sizeof(int32_t) * num_ops);
+  if (best_places) std::memcpy(best_places, best_p.data(),
+                               sizeof(int32_t) * num_ops);
   return best_cost;
 }
 

@@ -5,11 +5,19 @@ inter-GPU 20 MB/ms, inter-node 12/numNodes, GPU<->DRAM 16) with TPU-class
 numbers. Defaults are v5e-ish; override per target. Collective costs use ring
 formulas over the mesh axis being reduced (scaling-book recipe) instead of
 the reference's flat volume/bw (simulator.cc:548-594).
+
+Two-tier topology (reference: intra-node 1-hop vs inter-node 3-hop transfers,
+simulator.cc:252-285): `dcn_axes` maps a mesh axis name to the number of
+hosts it spans. A collective over such an axis decomposes hierarchically —
+ring over ICI within the host, then ring over DCN across hosts — so a
+{data: 8} axis spanning 2 hosts is priced ICI(4) + DCN(2), not ICI(8).
+The axis->tier mapping comes from FFConfig.dcn_mesh_shape.
 """
 
 from __future__ import annotations
 
 import dataclasses
+from typing import Dict, Optional
 
 
 @dataclasses.dataclass
@@ -23,7 +31,10 @@ class MachineModel:
     ici_bw: float = 4.5e10  # bytes/s per link per direction (v5e ~45 GB/s)
     dcn_bw: float = 6.25e9  # bytes/s per host
     ici_latency: float = 1e-6  # seconds per hop
+    dcn_latency: float = 1e-5  # seconds per hop (host NIC + switch)
     mxu_efficiency: float = 0.5  # achievable fraction of peak on real shapes
+    # mesh axis name -> number of hosts the axis spans (1 = pure ICI)
+    dcn_axes: Dict[str, int] = dataclasses.field(default_factory=dict)
 
     def compute_time(self, flops: float, bytes_moved: float,
                      dtype_bytes: int = 4) -> float:
@@ -32,26 +43,67 @@ class MachineModel:
         return max(flops / (f * self.mxu_efficiency),
                    bytes_moved / self.hbm_bw)
 
-    def all_reduce_time(self, bytes_per_chip: float, axis_size: int) -> float:
-        """Bidirectional ring all-reduce over one mesh axis."""
+    # ---- tier decomposition -------------------------------------------------
+
+    def _tiers(self, axis_size: int, axis_name: Optional[str]):
+        """(intra_host_degree, cross_host_degree) for one mesh axis."""
+        hosts = self.dcn_axes.get(axis_name, 1) if axis_name else 1
+        hosts = max(1, min(hosts, axis_size))
+        while hosts > 1 and axis_size % hosts != 0:
+            hosts -= 1  # degenerate config: clamp to a divisor
+        return axis_size // hosts, hosts
+
+    @staticmethod
+    def _ring(bytes_per_chip: float, size: int, bw: float, lat: float) -> float:
+        """Bidirectional ring all-reduce over one tier."""
+        if size <= 1:
+            return 0.0
+        return (2.0 * (size - 1) / size * bytes_per_chip / (2 * bw)
+                + size * lat)
+
+    # ---- collectives --------------------------------------------------------
+
+    def all_reduce_time(self, bytes_per_chip: float, axis_size: int,
+                        axis_name: Optional[str] = None) -> float:
+        """Hierarchical ring all-reduce: ICI within the host, DCN across."""
         if axis_size <= 1:
             return 0.0
-        ring = 2.0 * (axis_size - 1) / axis_size
-        return ring * bytes_per_chip / (2 * self.ici_bw) \
-            + axis_size * self.ici_latency
+        intra, hosts = self._tiers(axis_size, axis_name)
+        t = self._ring(bytes_per_chip, intra, self.ici_bw, self.ici_latency)
+        t += self._ring(bytes_per_chip, hosts, self.dcn_bw, self.dcn_latency)
+        return t
 
-    def all_gather_time(self, bytes_per_chip: float, axis_size: int) -> float:
+    def all_gather_time(self, bytes_per_chip: float, axis_size: int,
+                        axis_name: Optional[str] = None) -> float:
         if axis_size <= 1:
             return 0.0
-        return (axis_size - 1) / axis_size * bytes_per_chip * axis_size \
-            / (2 * self.ici_bw) + axis_size * self.ici_latency
+        intra, hosts = self._tiers(axis_size, axis_name)
+        t = 0.0
+        if intra > 1:
+            t += ((intra - 1) / intra * bytes_per_chip * intra
+                  / (2 * self.ici_bw) + intra * self.ici_latency)
+        if hosts > 1:
+            # each host gathers the other hosts' (already intra-gathered) parts
+            t += ((hosts - 1) / hosts * bytes_per_chip * axis_size / hosts
+                  / self.dcn_bw + hosts * self.dcn_latency)
+        return t
 
-    def all_to_all_time(self, bytes_per_chip: float, axis_size: int) -> float:
+    def all_to_all_time(self, bytes_per_chip: float, axis_size: int,
+                        axis_name: Optional[str] = None) -> float:
         if axis_size <= 1:
             return 0.0
-        # each chip sends (size-1)/size of its shard, split across both ring dirs
-        return bytes_per_chip * (axis_size - 1) / axis_size / (2 * self.ici_bw) \
-            + axis_size * self.ici_latency
+        intra, hosts = self._tiers(axis_size, axis_name)
+        t = 0.0
+        if intra > 1:
+            # each chip sends (size-1)/size of its shard, both ring dirs
+            t += (bytes_per_chip * (intra - 1) / intra / (2 * self.ici_bw)
+                  + intra * self.ici_latency)
+        if hosts > 1:
+            t += (bytes_per_chip * (hosts - 1) / hosts / self.dcn_bw
+                  + hosts * self.dcn_latency)
+        return t
 
-    def p2p_time(self, nbytes: float) -> float:
+    def p2p_time(self, nbytes: float, cross_host: bool = False) -> float:
+        if cross_host:
+            return nbytes / self.dcn_bw + self.dcn_latency
         return nbytes / self.ici_bw + self.ici_latency

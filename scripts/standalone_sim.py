@@ -67,7 +67,9 @@ def main():
     best = optimize_strategies(ff, budget=args.budget, alpha=args.alpha,
                                mesh_shape=mesh_shape, verbose=True)
     best_am = {name: (pc.axis_map or {}) for name, pc in best.items()}
-    best_ms = cost.iteration_time(best_am) * 1e3
+    best_places = {name: (min(pc.device_ids) if pc.device_ids else 0)
+                   for name, pc in best.items()}
+    best_ms = cost.iteration_time(best_am, best_places) * 1e3
     print(f"[standalone_sim] {args.model} on {args.devices} devices: "
           f"DP {dp_ms:.3f} ms -> searched {best_ms:.3f} ms "
           f"({dp_ms / max(best_ms, 1e-9):.2f}x)")
