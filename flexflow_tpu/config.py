@@ -68,6 +68,10 @@ class FFConfig:
     simulator_workspace_size: int = 2 * 1024 * 1024 * 1024
     compute_dtype: str = "float32"  # "bfloat16" for MXU-native training
     use_flash_attention: bool = True  # Pallas flash kernel on the dense path
+    # keep datasets device-resident (next_batch = on-device slice, the
+    # reference's ZC-resident design) when they fit the budget
+    device_resident_data: bool = True
+    device_data_budget_bytes: int = 2 << 30
     seed: int = 0
 
     # populated at FFModel construction

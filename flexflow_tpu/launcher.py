@@ -44,6 +44,9 @@ def main(argv=None):
 
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        if args.num_processes and args.num_processes > 1:
+            # CPU cross-process collectives need an explicit backend
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
     # multi-host pod detection: require an actual multi-worker signal (a
     # single-chip dev box can still carry TPU env vars)

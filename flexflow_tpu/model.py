@@ -494,12 +494,15 @@ class FFModel:
             f"dataset smaller than batch_size "
             f"({min(dl.num_samples for dl in self._dataloaders)} samples < "
             f"{bs}); no full batch to train on")
-        # native threaded prefetch loader (csrc/dataloader.cc); None falls
-        # back to Python slicing
-        from flexflow_tpu.runtime.native_loader import group_loader_for
-        native_dl = group_loader_for(self)
-        if native_dl is not None:
-            num_batches = native_dl.num_batches
+        # loader preference: device-resident datasets (next_batch is an
+        # on-device slice — the reference's ZC-resident design) > native
+        # threaded host prefetch (csrc/dataloader.cc) > Python slicing
+        native_dl = None
+        if not all(dl._try_stage_on_device() for dl in self._dataloaders):
+            from flexflow_tpu.runtime.native_loader import group_loader_for
+            native_dl = group_loader_for(self)
+            if native_dl is not None:
+                num_batches = native_dl.num_batches
         warm = None
         for cb in callbacks:
             cb.set_model(self)

@@ -2,9 +2,11 @@
 
 Hand-tiled kernels for ops where XLA's default lowering leaves MXU/VMEM
 performance on the table (the role src/ops/*.cu kernels played in the
-reference). Currently: flash attention forward (online softmax, q-block grid,
-k-block inner loop in VMEM) with a recompute-based custom VJP that reuses the
-pure-JAX blockwise path for the backward.
+reference; role parity with the tuned cuDNN MHA kernel the reference calls
+at attention.cu:244). Currently: flash attention forward (online softmax,
+q-block grid, k-block inner loop in VMEM) and the FlashAttention-2 style
+backward (logsumexp saved from the forward; per-tile recompute of the probs;
+separate dq and dk/dv kernels so each output tile is written once).
 
 On CPU (tests/emulated meshes) kernels run with interpret=True.
 """
@@ -26,10 +28,15 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
-                      causal: bool, scale: float, q_block: int, seq_k: int):
+# ---------------------------------------------------------------- forward
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *,
+                      block_k: int, causal: bool, scale: float, q_block: int,
+                      seq_k: int, need_lse: bool = True):
     qi = pl.program_id(1)  # q block index
-    q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+    q = q_ref[0]  # (block_q, d) — native dtype into the MXU (bf16 fast path;
+    # accumulation stays f32 via preferred_element_type)
     bq, d = q.shape
     nk = seq_k // block_k
 
@@ -39,8 +46,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
 
     def body(j, carry):
         m, l, o = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = qi * q_block + jax.lax.broadcasted_iota(
@@ -52,17 +59,31 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[:, None])
         l_new = l * alpha + jnp.sum(p, axis=-1)
-        o_new = o * alpha[:, None] + jnp.dot(p, v,
+        o_new = o * alpha[:, None] + jnp.dot(p.astype(v.dtype), v,
                                              preferred_element_type=jnp.float32)
         return m_new, l_new, o_new
 
-    m, l, o = jax.lax.fori_loop(0, nk, body, (m0, l0, o0))
+    if causal:
+        # only k blocks at or before this q block contribute
+        nk_eff = jnp.minimum(nk, (qi + 1) * q_block // block_k
+                             + (1 if q_block % block_k else 0))
+    else:
+        nk_eff = nk
+    m, l, o = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, o0))
     o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
+    if need_lse:
+        # lse lives in a 128-lane padded layout (Mosaic wants the last two
+        # block dims divisible by (8, 128)); every lane carries one value
+        lse_ref[0] = jnp.broadcast_to((m + jnp.log(l))[:, None], (bq, 128))
 
 
 def flash_attention_fwd_pallas(q, k, v, causal: bool, scale: float,
-                               block_q: int = 128, block_k: int = 128):
-    """q,k,v: (B, S, H, D) -> (B, S, H, D). Grid: (B*H, S_q/block_q)."""
+                               block_q: int = 128, block_k: int = 128,
+                               need_lse: bool = True):
+    """q,k,v: (B, S, H, D) -> (out, lse|None). Grid: (B*H, S_q/block_q).
+    need_lse=False (inference) skips materializing the logsumexp residual —
+    it exists only for the VJP and costs more HBM writes than the output
+    itself at small head dims."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, sq)
@@ -76,8 +97,14 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool, scale: float,
 
     kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
                                causal=causal, scale=scale, q_block=block_q,
-                               seq_k=sk)
-    out = pl.pallas_call(
+                               seq_k=sk, need_lse=need_lse)
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))]
+    out_shape = [jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)]
+    if need_lse:
+        out_specs.append(pl.BlockSpec((1, block_q, 128),
+                                      lambda i, j: (i, j, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((b * h, sq, 128), jnp.float32))
+    outs = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q),
         in_specs=[
@@ -85,38 +112,186 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool, scale: float,
             pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
         ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=_interpret(),
+    )(qt, kt, vt)
+    return (outs[0], outs[1]) if need_lse else (outs[0], None)
+
+
+# ---------------------------------------------------------------- backward
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, causal: bool, scale: float,
+                         q_block: int, seq_k: int):
+    """One q tile: dq = scale * sum_j ds_j @ k_j,
+    ds = p * (do @ v^T - delta)."""
+    qi = pl.program_id(1)
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, :, 0]    # (block_q,) — lane-padded layout
+    delta = delta_ref[0, :, 0]
+    bq, d = q.shape
+    nk = seq_k // block_k
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * q_block + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                       # (bq, bk)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None])).astype(k.dtype)
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    if causal:
+        nk_eff = jnp.minimum(nk, (qi + 1) * q_block // block_k
+                             + (1 if q_block % block_k else 0))
+    else:
+        nk_eff = nk
+    dq = jax.lax.fori_loop(0, nk_eff, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, causal: bool,
+                          scale: float, k_block: int, seq_q: int):
+    """One k tile: dv = sum_i p_i^T @ do_i; dk = scale * sum_i ds_i^T @ q_i."""
+    ki = pl.program_id(1)
+    k = k_ref[0]   # (block_k, d)
+    v = v_ref[0]
+    bk, d = k.shape
+    nq = seq_q // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), 0]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), 0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            k_pos = ki * k_block + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                      # (bq, bk)
+        dv = dv + jnp.dot(p.astype(do.dtype).T, do,
+                          preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None])).astype(q.dtype)
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        # q blocks strictly before this k tile see nothing of it
+        i0 = (ki * k_block) // block_q
+    else:
+        i0 = 0
+    dk, dv = jax.lax.fori_loop(i0, nq, body,
+                               (jnp.zeros((bk, d), jnp.float32),
+                                jnp.zeros((bk, d), jnp.float32)))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def flash_attention_bwd_pallas(q, k, v, o, lse, do, causal: bool,
+                               scale: float, block_q: int = 128,
+                               block_k: int = 128):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    dot = do.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    ot = o.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    # delta_i = rowsum(do_i * o_i) — the softmax-normalization term of ds;
+    # broadcast into the same 128-lane padded layout as lse
+    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (b * h, sq, 128))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
+                          causal=causal, scale=scale, q_block=block_q,
+                          seq_k=sk),
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda i, j: (i, j, 0)),
+        ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
         interpret=_interpret(),
-    )(qt, kt, vt)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    )(qt, kt, vt, dot, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                          causal=causal, scale=scale, k_block=block_k,
+                          seq_q=sq),
+        grid=(b * h, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sq, 128), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sq, 128), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+                   pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0))],
+        out_shape=[jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)],
+        interpret=_interpret(),
+    )(qt, kt, vt, dot, lse, delta)
+
+    def back(x, s):
+        return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    return back(dq, sq), back(dk, sk), back(dv, sk)
+
+
+# ------------------------------------------------------------- public API
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None):
-    """Flash attention with Pallas forward and recompute backward.
-
-    The backward pass re-runs the memory-efficient blockwise recurrence under
-    jax.vjp (FLOPs-for-memory trade, same spirit as jax.checkpoint)."""
+    """Flash attention: Pallas forward + FlashAttention-2 Pallas backward
+    (logsumexp residual; per-tile prob recompute; no S x S materialization
+    in either direction)."""
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    return flash_attention_fwd_pallas(q, k, v, causal, s)
+    out, _ = flash_attention_fwd_pallas(q, k, v, causal, s, need_lse=False)
+    b, sq, h, d = q.shape
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
 
 
 def _flash_fwd_rule(q, k, v, causal, scale):
-    out = flash_attention(q, k, v, causal, scale)
-    return out, (q, k, v)
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    out, lse = flash_attention_fwd_pallas(q, k, v, causal, s)
+    b, sq, h, d = q.shape
+    o = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_bwd_rule(causal, scale, res, g):
-    from flexflow_tpu.parallel.ring_attention import blockwise_attention
-
-    q, k, v = res
+    q, k, v, o, lse = res
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal,
-                                               scale=s), q, k, v)
-    return vjp(g)
+    dq, dk, dv = flash_attention_bwd_pallas(q, k, v, o, lse, g, causal, s)
+    return dq, dk, dv
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

@@ -56,22 +56,31 @@ class PlacementGroup:
 def op_block(pc: Optional[ParallelConfig], axis_map, mesh_shape,
              num_devices: int) -> Tuple[int, int]:
     """(place, ndev) for an op: the contiguous aligned device block its
-    strategy assigns (mirror of sim.cc align_place)."""
+    strategy assigns. Alignment delegates to the simulator's rule
+    (cost_model.align_place — the C++ sim.cc mirror) so the executed block
+    always matches the block the search ranked."""
+    from flexflow_tpu.search.cost_model import align_place
+
     parts = 1
     for ax, d in (axis_map or {}).items():
         if d is not None:
             parts *= mesh_shape[ax]
-    ndev = max(1, min(parts, num_devices))
+    parts = max(1, min(parts, num_devices))
+    ndev = parts
     place = 0
     if pc is not None and pc.device_ids:
         place = min(pc.device_ids)
         n = len(pc.device_ids)
-        if n in range(1, num_devices + 1) and num_devices % max(n, 1) == 0:
+        if n < parts:
+            raise ValueError(
+                f"strategy places a {parts}-way sharded op on only {n} "
+                f"devices ({tuple(pc.device_ids)[:4]}...) — the device block "
+                f"must hold the sharding; fix the strategy entry")
+        if 1 <= n <= num_devices and num_devices % n == 0:
             ndev = n
     if ndev >= num_devices or num_devices % ndev != 0:
         return 0, num_devices
-    place = max(0, min(place, num_devices - ndev))
-    return place - place % ndev, ndev
+    return align_place(place, ndev, num_devices), ndev
 
 
 def has_placement(strategies: Dict[str, ParallelConfig],

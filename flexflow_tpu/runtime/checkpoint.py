@@ -27,51 +27,97 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
+def _is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
 def save_checkpoint(model, directory: str, step: Optional[int] = None) -> str:
     """Save model state. Returns the checkpoint path.
 
-    Arrays are gathered to host numpy before writing, so checkpoints are
-    topology-free: a restore re-shards onto whatever mesh the restoring
-    model compiled with. (Single-controller semantics; a true multi-host
-    pod should save through orbax's sharded path instead — planned.)
-    Saving the same step twice overwrites (idempotent)."""
+    Single-controller: arrays are gathered to host numpy before writing, so
+    checkpoints are topology-free — a restore re-shards onto whatever mesh
+    the restoring model compiled with.
+
+    Multi-controller (jax.process_count() > 1): arrays are handed to orbax
+    as sharded jax.Arrays and EVERY process participates in the save — each
+    host writes only its addressable shards (no host gather; a vocab-sharded
+    embedding never materializes on one host). All processes must call this
+    collectively. Saving the same step twice overwrites (idempotent)."""
     import shutil
 
     directory = os.path.abspath(directory)
     step = step if step is not None else model._step_count
     path = os.path.join(directory, f"step_{step}")
-    os.makedirs(directory, exist_ok=True)
-    if os.path.exists(path):
-        shutil.rmtree(path)  # orbax refuses to overwrite; make saves idempotent
+    multihost = _is_multihost()
+    if not multihost or jax.process_index() == 0:
+        os.makedirs(directory, exist_ok=True)
+        if os.path.exists(path):
+            # orbax refuses to overwrite; make saves idempotent
+            shutil.rmtree(path)
+    if multihost:
+        from jax.experimental import multihost_utils
 
-    to_np = lambda tree: jax.tree_util.tree_map(
-        lambda a: np.asarray(a), tree)
-    state = {"params": to_np(model.params)}
+        multihost_utils.sync_global_devices("ff_ckpt_clean")
+
+    if multihost:
+        prep = _strip_none  # keep sharded jax.Arrays; orbax writes per host
+    else:
+        prep = lambda tree: jax.tree_util.tree_map(
+            lambda a: np.asarray(a), _strip_none(tree))
+    state = {"params": prep(model.params)}
     if model.opt_state is not None:
-        state["opt_state"] = to_np(_strip_none(model.opt_state))
+        state["opt_state"] = prep(model.opt_state)
     if model.bn_state:
-        state["bn_state"] = to_np(model.bn_state)
+        state["bn_state"] = prep(model.bn_state)
     _checkpointer().save(path, state)
 
-    meta = {"step": int(step),
-            "mesh_shape": model.config.mesh_shape,
-            "loss_type": model.loss_type.name if model.loss_type else None}
-    with open(os.path.join(directory, "meta.json"), "w") as f:
-        json.dump(meta, f)
-    save_strategies_to_file(os.path.join(directory, "strategy.txt"),
-                            model.config.strategies)
+    if not multihost or jax.process_index() == 0:
+        meta = {"step": int(step),
+                "mesh_shape": model.config.mesh_shape,
+                "multihost": multihost,
+                "loss_type": model.loss_type.name if model.loss_type else None}
+        with open(os.path.join(directory, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        save_strategies_to_file(os.path.join(directory, "strategy.txt"),
+                                model.config.strategies)
+    if multihost:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("ff_ckpt_done")
     return path
 
 
 def restore_checkpoint(model, directory: str, step: Optional[int] = None):
-    """Restore into a compiled model. Checkpoints are stored as host numpy
-    (see save_checkpoint), so restore re-shards onto the restoring model's
-    own mesh regardless of the topology that saved them."""
+    """Restore into a compiled model. Single-controller checkpoints are
+    stored as host numpy (see save_checkpoint), so restore re-shards onto
+    the restoring model's own mesh regardless of the topology that saved
+    them. Under multi-controller, every process calls this collectively and
+    orbax restores each array directly into the model's current sharding
+    (each host reads only its shards)."""
     directory = os.path.abspath(directory)
     with open(os.path.join(directory, "meta.json")) as f:
         meta = json.load(f)
     step = step if step is not None else meta["step"]
     path = os.path.join(directory, f"step_{step}")
+
+    if _is_multihost():
+        import orbax.checkpoint as ocp
+
+        template = {"params": model.params}
+        if model.opt_state is not None:
+            template["opt_state"] = _strip_none(model.opt_state)
+        if model.bn_state:
+            template["bn_state"] = model.bn_state
+        restore_args = ocp.checkpoint_utils.construct_restore_args(template)
+        restored = _checkpointer().restore(path, restore_args=restore_args)
+        model.params = restored["params"]
+        if "opt_state" in restored and model.optimizer is not None:
+            fresh = model.optimizer.init_state(model.params)
+            model.opt_state = _merge_sharded(fresh, restored["opt_state"])
+        if "bn_state" in restored:
+            model.bn_state = restored["bn_state"]
+        model._step_count = step
+        return step
 
     restored = _checkpointer().restore(path)
     shardings = model.executor.param_shardings()
@@ -130,6 +176,17 @@ def _strip_none(tree):
     if isinstance(tree, dict):
         return {k: _strip_none(v) for k, v in tree.items() if v is not None}
     return tree
+
+
+def _merge_sharded(fresh, restored):
+    """Refill None leaves stripped before a sharded save (restored arrays
+    already carry the model's shardings via construct_restore_args)."""
+    if isinstance(fresh, dict):
+        return {k: _merge_sharded(v, restored[k]) if k in restored else v
+                for k, v in fresh.items()}
+    if fresh is None:
+        return None
+    return restored
 
 
 def _merge_restored(fresh, restored):

