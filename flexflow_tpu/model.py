@@ -157,14 +157,16 @@ class FFModel:
 
     def moe(self, input: Tensor, num_experts: int, hidden_dim: int,
             k: int = 2, capacity_factor: float = 1.25,
-            name: Optional[str] = None) -> Tensor:
+            dispatch: str = "auto", name: Optional[str] = None) -> Tensor:
         """Mixture-of-experts FFN (net-new vs reference; expert-parallel over
         the 'expert' mesh axis). Returns the main output; the load-balancing
-        aux loss is folded into the training loss automatically."""
+        aux loss is folded into the training loss automatically. dispatch:
+        "auto" (dense einsums when experts are mesh-sharded, else sort-based)
+        | "dense" | "sort"."""
         from flexflow_tpu.ops.moe import MoE
 
         op = MoE(self, self._name("moe", name), [input], num_experts,
-                 hidden_dim, k, capacity_factor)
+                 hidden_dim, k, capacity_factor, dispatch=dispatch)
         outs = self._add(op)
         self._aux_tensors.append(outs[1])
         return outs[0]
@@ -496,9 +498,13 @@ class FFModel:
             f"{bs}); no full batch to train on")
         # loader preference: device-resident datasets (next_batch is an
         # on-device slice — the reference's ZC-resident design) > native
-        # threaded host prefetch (csrc/dataloader.cc) > Python slicing
+        # threaded host prefetch (csrc/dataloader.cc) > Python slicing.
+        # Eligibility is decided for ALL loaders before any upload, so a
+        # mixed set never strands half-staged copies in HBM.
         native_dl = None
-        if not all(dl._try_stage_on_device() for dl in self._dataloaders):
+        if not (all(dl.device_eligible() for dl in self._dataloaders)
+                and all(dl._try_stage_on_device()
+                        for dl in self._dataloaders)):
             from flexflow_tpu.runtime.native_loader import group_loader_for
             native_dl = group_loader_for(self)
             if native_dl is not None:

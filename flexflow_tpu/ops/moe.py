@@ -1,11 +1,21 @@
 """Mixture-of-Experts op (expert parallelism over the 'expert' mesh axis).
 
 Net-new vs the reference (SURVEY §2.5: "EP — absent, no MoE ops"). GShard-
-style capacity-based top-k routing lowered as dense dispatch/combine einsums:
-under GSPMD, sharding the expert dim over the 'expert' axis turns the
-dispatch einsums into all-to-alls over ICI. Includes the standard load-
-balancing auxiliary loss (Shazeer et al.), surfaced through the op-aux
-mechanism so the executor folds it into the training loss.
+style capacity-based top-k routing with two dispatch lowerings:
+
+  * dense: (N, E, C) one-hot dispatch/combine einsums — under GSPMD,
+    sharding the expert dim over the 'expert' axis turns these into
+    all-to-alls over ICI; chosen whenever the mesh actually shards experts.
+  * sort: tokens sorted by expert id, gathered into the (E*C, D) expert
+    buffer and scatter-added back — O(N*k) routing state instead of the
+    dense path's O(N*E*C), the practical choice at real token counts when
+    experts are not mesh-sharded (single chip / pure dp).
+
+FFModel.moe(dispatch="auto"|"dense"|"sort") selects; both share the router
+and produce identical outputs when capacity does not bind (tested).
+Includes the standard load-balancing auxiliary loss (Shazeer et al.),
+surfaced through the op-aux mechanism so the executor folds it into the
+training loss.
 """
 
 from __future__ import annotations
@@ -26,13 +36,16 @@ class MoE(Op):
 
     def __init__(self, model, name, inputs, num_experts: int, hidden_dim: int,
                  k: int = 2, capacity_factor: float = 1.25,
-                 aux_weight: float = 1e-2):
+                 aux_weight: float = 1e-2, dispatch: str = "auto"):
         super().__init__(model, name, inputs)
         self.num_experts = num_experts
         self.hidden_dim = hidden_dim
         self.k = min(k, num_experts)
         self.capacity_factor = capacity_factor
         self.aux_weight = aux_weight
+        if dispatch not in ("auto", "dense", "sort"):
+            raise ValueError(f"dispatch must be auto|dense|sort, got {dispatch!r}")
+        self.dispatch = dispatch
         self.dim = inputs[0].dims[-1]
         ntokens = 1
         for s in inputs[0].dims[:-1]:
@@ -53,6 +66,14 @@ class MoE(Op):
             WeightSpec("w_out", (E, F, D), init="glorot", fan=(F, D)),
         ]
 
+    def _use_sort_dispatch(self) -> bool:
+        if self.dispatch != "auto":
+            return self.dispatch == "sort"
+        mesh = getattr(self.model, "mesh", None)
+        ep = (mesh is not None and "expert" in getattr(mesh, "axis_names", ())
+              and mesh.shape["expert"] > 1)
+        return not ep  # dense einsums lower to all-to-alls under EP sharding
+
     def forward(self, params, xs, *, training=False, rng=None):
         x = xs[0]
         orig_shape = x.shape
@@ -62,6 +83,9 @@ class MoE(Op):
 
         logits = t @ params["router"].astype(t.dtype)       # (N, E)
         gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+        if self._use_sort_dispatch():
+            return self._forward_sort(params, t, gates, orig_shape)
 
         # top-k routing with capacity (GShard): iteratively take the best
         # expert per token, mask, repeat k times
@@ -103,6 +127,49 @@ class MoE(Op):
 
         # load-balancing aux loss: E * sum(mean_gate * mean_assignment)
         aux = self.aux_weight * E * jnp.sum(aux_me * (ce / self.k))
+        return [y.reshape(orig_shape), aux.astype(jnp.float32)]
+
+    def _forward_sort(self, params, t, gates, orig_shape):
+        """Sort-based dispatch: O(N*k) routing state. Token assignments are
+        ordered round-major (all round-0 picks first, in token order) so
+        capacity drops match the dense path's position rule exactly."""
+        D, E, C, k = self.dim, self.num_experts, self.capacity, self.k
+        N = t.shape[0]
+
+        topk_gates, topk_idx = jax.lax.top_k(gates, k)      # (N, k)
+        flat_e = topk_idx.T.reshape(-1)                     # (k*N,) round-major
+        flat_g = topk_gates.T.reshape(-1)
+
+        order = jnp.argsort(flat_e)                         # stable
+        sorted_e = flat_e[order]
+        counts = jnp.bincount(flat_e, length=E)             # (E,)
+        starts = jnp.cumsum(counts) - counts
+        rank = jnp.arange(k * N) - starts[sorted_e]         # pos within expert
+        keep = (rank < C).astype(jnp.float32)
+        dest = sorted_e * C + jnp.clip(rank, 0, C - 1)      # (k*N,)
+        token = order % N                                   # round-major flatten
+        gate = flat_g[order] * keep
+
+        # renormalize kept gates over each token's surviving experts
+        denom = jnp.zeros((N,), jnp.float32).at[token].add(gate)
+        gate = gate / jnp.maximum(denom[token], 1e-9)
+
+        # gather tokens into the expert buffer (each kept assignment owns a
+        # distinct slot; dropped ones contribute zero to a clipped slot)
+        buf = jnp.zeros((E * C, D), t.dtype)
+        buf = buf.at[dest].add(t[token] * keep[:, None].astype(t.dtype))
+        expert_in = buf.reshape(E, C, D)
+        h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in,
+                                   params["w_in"].astype(t.dtype)))
+        expert_out = jnp.einsum("ecf,efd->ecd", h,
+                                params["w_out"].astype(t.dtype))
+        flat_out = expert_out.reshape(E * C, D)
+        y = jnp.zeros((N, D), t.dtype).at[token].add(
+            flat_out[dest] * gate[:, None].astype(t.dtype))
+
+        me = jnp.mean(gates, axis=0)
+        ce = counts.astype(jnp.float32) / N
+        aux = self.aux_weight * E * jnp.sum(me * (ce / k))
         return [y.reshape(orig_shape), aux.astype(jnp.float32)]
 
     def partitionable_output_dims(self):

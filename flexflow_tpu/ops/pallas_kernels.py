@@ -72,9 +72,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *,
     m, l, o = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, o0))
     o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
     if need_lse:
-        # lse lives in a 128-lane padded layout (Mosaic wants the last two
-        # block dims divisible by (8, 128)); every lane carries one value
-        lse_ref[0] = jnp.broadcast_to((m + jnp.log(l))[:, None], (bq, 128))
+        # lse lives in an 8-lane padded layout: Mosaic wants the last two
+        # block dims divisible by (8, 128) OR equal to the array dims, and
+        # a last dim of exactly 8 satisfies the 'equal' clause at 16x less
+        # HBM than padding to a full 128-lane tile
+        lse_ref[0] = jnp.broadcast_to((m + jnp.log(l))[:, None], (bq, 8))
 
 
 def flash_attention_fwd_pallas(q, k, v, causal: bool, scale: float,
@@ -101,9 +103,9 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool, scale: float,
     out_specs = [pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))]
     out_shape = [jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)]
     if need_lse:
-        out_specs.append(pl.BlockSpec((1, block_q, 128),
+        out_specs.append(pl.BlockSpec((1, block_q, 8),
                                       lambda i, j: (i, j, 0)))
-        out_shape.append(jax.ShapeDtypeStruct((b * h, sq, 128), jnp.float32))
+        out_shape.append(jax.ShapeDtypeStruct((b * h, sq, 8), jnp.float32))
     outs = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q),
@@ -216,9 +218,9 @@ def flash_attention_bwd_pallas(q, k, v, o, lse, do, causal: bool,
     dot = do.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     ot = o.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     # delta_i = rowsum(do_i * o_i) — the softmax-normalization term of ds;
-    # broadcast into the same 128-lane padded layout as lse
+    # broadcast into the same 8-lane padded layout as lse
     delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1)
-    delta = jnp.broadcast_to(delta[..., None], (b * h, sq, 128))
+    delta = jnp.broadcast_to(delta[..., None], (b * h, sq, 8))
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
@@ -230,8 +232,8 @@ def flash_attention_bwd_pallas(q, k, v, o, lse, do, causal: bool,
             pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda i, j: (i, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
@@ -248,8 +250,8 @@ def flash_attention_bwd_pallas(q, k, v, o, lse, do, causal: bool,
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sq, 128), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sq, 128), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sq, 8), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sq, 8), lambda i, j: (i, 0, 0)),
         ],
         out_specs=[pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
                    pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0))],

@@ -44,6 +44,21 @@ class SingleDataLoader:
 
     # ---- device-resident path ------------------------------------------------
 
+    def device_eligible(self) -> bool:
+        """Cheap check (no upload): may this dataset live on device?
+        Shuffling stays on the host prefetch loader, which reshuffles per
+        epoch (native_loader.py)."""
+        model = self.model
+        cfg = getattr(model, "config", None)
+        executor = getattr(model, "executor", None)
+        return (cfg is not None and executor is not None
+                and not self._dev_failed
+                and getattr(cfg, "device_resident_data", True)
+                and not getattr(cfg, "dataloader_shuffle", False)
+                and not getattr(executor, "jits_per_group", False)
+                and self.data.nbytes <= getattr(
+                    cfg, "device_data_budget_bytes", 2 << 30))
+
     def _try_stage_on_device(self) -> bool:
         """Upload the dataset once, batch-sharded over 'data'. Returns True
         when the device-resident path is usable."""
@@ -51,16 +66,7 @@ class SingleDataLoader:
             if self._staged_bs == self.batch_size:
                 return True
             self._dev_data = self._dev_slice = None  # batch size changed
-        if self._dev_failed:
-            return False
-        model = self.model
-        cfg = getattr(model, "config", None)
-        executor = getattr(model, "executor", None)
-        if (cfg is None or executor is None
-                or not getattr(cfg, "device_resident_data", True)
-                or getattr(executor, "jits_per_group", False)
-                or self.data.nbytes > getattr(cfg, "device_data_budget_bytes",
-                                              2 << 30)):
+        if not self.device_eligible():
             self._dev_failed = True
             return False
         try:

@@ -86,15 +86,38 @@ def test_flash_attention_matches_dense(causal):
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
 
 
-def test_flash_attention_grads():
+@pytest.mark.parametrize("causal,shape", [
+    (False, (2, 128, 2, 16)),
+    (True, (2, 128, 2, 16)),
+    (True, (1, 256, 4, 64)),
+])
+def test_flash_attention_grads_match_dense(causal, shape):
+    """The hand-written dq/dk/dv Pallas kernels must match autodiff through
+    a dense reference — finite-and-nonzero alone would not catch a sign,
+    scale, or masking regression."""
     from flexflow_tpu.ops.pallas_kernels import flash_attention
 
-    B, S, H, D = 1, 64, 2, 8
+    B, S, H, D = shape
     rs = np.random.RandomState(3)
-    q = jnp.asarray(rs.randn(B, S, H, D).astype(np.float32))
+    q, k, v, g = (jnp.asarray(rs.randn(B, S, H, D).astype(np.float32))
+                  for _ in range(4))
+    scale = 1.0 / np.sqrt(D)
 
-    g = jax.grad(lambda a: jnp.sum(flash_attention(a, a, a, True) ** 2))(q)
-    assert np.isfinite(np.asarray(g)).all()
+    def dense(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            mask = jnp.tril(jnp.ones((S, S), bool))
+            s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqs,bshd->bqhd", p, v)
+
+    gf = jax.grad(lambda *a: jnp.vdot(flash_attention(*a, causal, scale), g),
+                  (0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda *a: jnp.vdot(dense(*a), g), (0, 1, 2))(q, k, v)
+    for name, a, b in zip("dq dk dv".split(), gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=2e-5, err_msg=name)
 
 
 def test_mha_flash_path_matches_einsum(monkeypatch):
