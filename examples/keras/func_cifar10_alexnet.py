@@ -1,0 +1,31 @@
+"""CIFAR-10 AlexNet, functional API (reference:
+examples/python/keras/func_cifar10_alexnet.py)."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+from flexflow_tpu.keras import Input, Model
+from flexflow_tpu.keras.layers import Conv2D, Dense, Flatten, MaxPooling2D
+
+
+def main():
+    from flexflow_tpu.keras.datasets import cifar10
+    (x, y), _ = cifar10.load_data()
+    x = x.astype(np.float32) / 255.0
+    inp = Input((3, 32, 32))
+    t = Conv2D(64, 5, padding="same", activation="relu")(inp)
+    t = MaxPooling2D(2)(t)
+    t = Conv2D(192, 5, padding="same", activation="relu")(t)
+    t = MaxPooling2D(2)(t)
+    t = Conv2D(256, 3, padding="same", activation="relu")(t)
+    t = MaxPooling2D(2)(t)
+    t = Dense(512, activation="relu")(Flatten()(t))
+    out = Dense(10)(t)
+    model = Model(inp, out)
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, epochs=int(os.environ.get("EPOCHS", 2)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""CIFAR-10 CNN with concatenated conv branches (reference:
+examples/python/keras/func_cifar10_cnn_concat.py)."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+from flexflow_tpu.keras import Input, Model
+from flexflow_tpu.keras.layers import (Concatenate, Conv2D, Dense, Flatten,
+                                       MaxPooling2D)
+
+
+def main():
+    from flexflow_tpu.keras.datasets import cifar10
+    (x, y), _ = cifar10.load_data()
+    x = x.astype(np.float32) / 255.0
+    inp = Input((3, 32, 32))
+    a = Conv2D(32, 3, padding="same", activation="relu")(inp)
+    b = Conv2D(32, 5, padding="same", activation="relu")(inp)
+    t = Concatenate(axis=1)([a, b])
+    t = MaxPooling2D(2)(t)
+    t = Conv2D(64, 3, padding="same", activation="relu")(t)
+    t = MaxPooling2D(2)(t)
+    out = Dense(10)(Dense(256, activation="relu")(Flatten()(t)))
+    model = Model(inp, out)
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, epochs=int(os.environ.get("EPOCHS", 2)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""Functional-API MNIST CNN (reference: examples/python/keras/func_mnist_cnn.py)."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+from flexflow_tpu.keras import Input, Model
+from flexflow_tpu.keras.layers import Conv2D, Dense, Flatten, MaxPooling2D
+
+
+def main():
+    from flexflow_tpu.keras.datasets import mnist
+    (x, y), _ = mnist.load_data()
+    x = x.reshape(-1, 1, 28, 28).astype(np.float32) / 255.0
+    inp = Input((1, 28, 28))
+    t = Conv2D(32, 3, padding="same", activation="relu")(inp)
+    t = MaxPooling2D(2)(t)
+    t = Conv2D(64, 3, padding="same", activation="relu")(t)
+    t = MaxPooling2D(2)(t)
+    out = Dense(10)(Dense(128, activation="relu")(Flatten()(t)))
+    model = Model(inp, out)
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, epochs=int(os.environ.get("EPOCHS", 2)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+"""Functional-API MNIST MLP (reference: examples/python/keras/func_mnist_mlp.py)."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+from flexflow_tpu.keras import Input, Model
+from flexflow_tpu.keras.layers import Dense
+
+
+def main():
+    from flexflow_tpu.keras.datasets import mnist
+    (x, y), _ = mnist.load_data()
+    x = x.reshape(-1, 784).astype(np.float32) / 255.0
+    inp = Input((784,))
+    t = Dense(512, activation="relu")(inp)
+    t = Dense(512, activation="relu")(t)
+    out = Dense(10)(t)
+    model = Model(inp, out)
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, epochs=int(os.environ.get("EPOCHS", 2)))
+
+
+if __name__ == "__main__":
+    main()

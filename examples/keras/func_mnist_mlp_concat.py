@@ -1,0 +1,27 @@
+"""Two-branch MLP joined by Concatenate (reference:
+examples/python/keras/func_mnist_mlp_concat.py)."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+from flexflow_tpu.keras import Input, Model
+from flexflow_tpu.keras.layers import Concatenate, Dense
+
+
+def main():
+    from flexflow_tpu.keras.datasets import mnist
+    (x, y), _ = mnist.load_data()
+    x = x.reshape(-1, 784).astype(np.float32) / 255.0
+    inp = Input((784,))
+    a = Dense(256, activation="relu")(inp)
+    b = Dense(256, activation="relu")(inp)
+    t = Concatenate(axis=1)([a, b])
+    out = Dense(10)(Dense(256, activation="relu")(t))
+    model = Model(inp, out)
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, epochs=int(os.environ.get("EPOCHS", 2)))
+
+
+if __name__ == "__main__":
+    main()

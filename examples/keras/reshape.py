@@ -1,0 +1,25 @@
+"""Reshape layer exercise (reference: examples/python/keras/reshape.py)."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+from flexflow_tpu.keras import Input, Model
+from flexflow_tpu.keras.layers import Dense, Reshape
+
+
+def main():
+    rs = np.random.RandomState(0)
+    x = rs.randn(256, 784).astype(np.float32)
+    y = rs.randint(0, 10, (256,)).astype(np.int32)
+    inp = Input((784,))
+    t = Reshape((16, 49))(inp)
+    t = Reshape((784,))(t)
+    out = Dense(10)(Dense(64, activation="relu")(t))
+    model = Model(inp, out)
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, epochs=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""Reuters topic MLP, Sequential API (reference:
+examples/python/keras/seq_reuters_mlp.py)."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+from flexflow_tpu.keras import Sequential
+from flexflow_tpu.keras.callbacks import EpochVerifyMetrics, ModelAccuracy
+from flexflow_tpu.keras.layers import Dense
+
+
+def main():
+    from flexflow_tpu.keras.datasets import reuters
+    (x, y), _ = reuters.load_data(num_words=1000)
+    x = x.astype(np.float32)
+    num_classes = int(y.max()) + 1
+    model = Sequential([
+        Dense(512, activation="relu", input_shape=(x.shape[1],)),
+        Dense(num_classes),
+    ])
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    gates = ([EpochVerifyMetrics(ModelAccuracy.REUTERS_MLP)]
+             if os.environ.get("FF_ACCURACY_GATE") else [])
+    model.fit(x, y, epochs=int(os.environ.get("EPOCHS", 3)), callbacks=gates)
+
+
+if __name__ == "__main__":
+    main()

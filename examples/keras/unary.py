@@ -1,0 +1,26 @@
+"""Unary activation layers exercise (reference: examples/python/keras/unary.py)."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+from flexflow_tpu.keras import Input, Model
+from flexflow_tpu.keras.layers import Activation, Dense
+
+
+def main():
+    rs = np.random.RandomState(0)
+    x = rs.randn(256, 64).astype(np.float32)
+    y = rs.randint(0, 4, (256,)).astype(np.int32)
+    inp = Input((64,))
+    t = Activation("relu")(Dense(64)(inp))
+    t = Activation("sigmoid")(Dense(64)(t))
+    t = Activation("tanh")(Dense(64)(t))
+    out = Dense(4)(t)
+    model = Model(inp, out)
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, epochs=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""CIFAR-10 CNN via the native API (reference:
+examples/python/native/cifar10_cnn.py)."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+from flexflow_tpu import (ActiMode, FFConfig, FFModel, LossType, MetricsType,
+                          AdamOptimizer, SingleDataLoader)
+
+
+def main():
+    from flexflow_tpu.keras.datasets import cifar10
+    (x, y), _ = cifar10.load_data()
+    x = x.astype(np.float32) / 255.0
+    y = y.reshape(-1, 1).astype(np.int32)
+
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    t = inp = ff.create_tensor([cfg.batch_size, 3, 32, 32], name="input")
+    for ch in (32, 32):
+        t = ff.conv2d(t, ch, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    for ch in (64, 64):
+        t = ff.conv2d(t, ch, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ff.flat(t)
+    t = ff.dense(t, 512, ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 10)
+    ff.compile(AdamOptimizer(alpha=1e-3),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY])
+    SingleDataLoader(ff, inp, x)
+    SingleDataLoader(ff, ff.label_tensor, y)
+    ff.fit(epochs=int(os.environ.get("EPOCHS", 2)))
+
+
+if __name__ == "__main__":
+    main()

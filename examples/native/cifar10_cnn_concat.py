@@ -1,0 +1,39 @@
+"""Branchy CIFAR-10 CNN with concat (reference:
+examples/python/native/cifar10_cnn_concat.py) — the graph shape where the
+strategy search can discover op placement; pass --budget to search."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+from flexflow_tpu import (ActiMode, FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+
+
+def main():
+    from flexflow_tpu.keras.datasets import cifar10
+    (x, y), _ = cifar10.load_data()
+    x = x.astype(np.float32) / 255.0
+    y = y.reshape(-1, 1).astype(np.int32)
+
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    inp = ff.create_tensor([cfg.batch_size, 3, 32, 32], name="input")
+    a = ff.conv2d(inp, 32, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU, name="br_a")
+    b = ff.conv2d(inp, 32, 5, 5, 1, 1, 2, 2, ActiMode.AC_MODE_RELU, name="br_b")
+    t = ff.concat([a, b], axis=1)
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ff.conv2d(t, 64, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ff.flat(t)
+    t = ff.dense(t, 256, ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 10)
+    ff.compile(SGDOptimizer(lr=0.05),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY])
+    SingleDataLoader(ff, inp, x)
+    SingleDataLoader(ff, ff.label_tensor, y)
+    ff.fit(epochs=int(os.environ.get("EPOCHS", 2)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""MNIST CNN via the native API (reference: examples/python/native/mnist_cnn.py)."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+from flexflow_tpu import (ActiMode, FFConfig, FFModel, LossType, MetricsType,
+                          PoolType, SGDOptimizer, SingleDataLoader)
+
+
+def main():
+    from flexflow_tpu.keras.datasets import mnist
+    (x, y), _ = mnist.load_data()
+    x = x.reshape(-1, 1, 28, 28).astype(np.float32) / 255.0
+    y = y.reshape(-1, 1).astype(np.int32)
+
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    t = inp = ff.create_tensor([cfg.batch_size, 1, 28, 28], name="input")
+    t = ff.conv2d(t, 32, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ff.conv2d(t, 64, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ff.flat(t)
+    t = ff.dense(t, 128, ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 10)
+    ff.compile(SGDOptimizer(lr=0.05),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY])
+    SingleDataLoader(ff, inp, x)
+    SingleDataLoader(ff, ff.label_tensor, y)
+    ff.fit(epochs=int(os.environ.get("EPOCHS", 2)))
+
+
+if __name__ == "__main__":
+    main()

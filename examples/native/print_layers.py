@@ -1,0 +1,29 @@
+"""Graph inspection (reference: examples/python/native/print_layers.py +
+print_input.py): dump every op with shapes, weights, and the resolved
+strategy after compile."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+from flexflow_tpu import ActiMode, FFConfig, FFModel
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    t = ff.create_tensor([cfg.batch_size, 3, 32, 32], name="input")
+    t = ff.conv2d(t, 32, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ff.flat(t)
+    t = ff.dense(t, 10)
+    ff.compile(optimizer=None)
+    for op in ff.ops:
+        ws = {w.name: w.shape for w in op.weight_specs()}
+        outs = [o.dims for o in op.outputs]
+        am = ff.executor._op_axis_maps.get(op.name, {})
+        print(f"{op.name:14s} {type(op).__name__:12s} out={outs} "
+              f"weights={ws} axis_map={am}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""Split op exercise (reference: examples/python/native/split.py)."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+from flexflow_tpu import (ActiMode, FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+
+
+def main():
+    rs = np.random.RandomState(0)
+    x = rs.randn(256, 64).astype(np.float32)
+    y = rs.randint(0, 4, (256, 1)).astype(np.int32)
+
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    inp = ff.create_tensor([cfg.batch_size, 64], name="input")
+    parts = ff.split(inp, 2, axis=1)
+    a = ff.dense(parts[0], 32, ActiMode.AC_MODE_RELU)
+    b = ff.dense(parts[1], 32, ActiMode.AC_MODE_RELU)
+    t = ff.concat([a, b], axis=1)
+    t = ff.dense(t, 4)
+    ff.compile(SGDOptimizer(lr=0.05),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY])
+    SingleDataLoader(ff, inp, x)
+    SingleDataLoader(ff, ff.label_tensor, y)
+    ff.fit(epochs=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Numerical parity check torch vs flexflow_tpu (reference:
+examples/python/pytorch/mnist_mlp_torch.py — the torch-side twin used to
+compare losses): same MLP, same weights, one forward — outputs must agree."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import torch
+import torch.nn as nn
+
+from flexflow_tpu import FFConfig, FFModel
+from flexflow_tpu.torch import PyTorchModel, torch_to_flexflow
+
+
+def main():
+    net = nn.Sequential(nn.Linear(64, 32), nn.ReLU(), nn.Linear(32, 8))
+    torch_to_flexflow(net, "/tmp/mlp_cmp.ff")
+    cfg = FFConfig(batch_size=16)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([16, 64], name="x")
+    outs = PyTorchModel("/tmp/mlp_cmp.ff").apply(ff, [x])
+    ff.compile(optimizer=None, final_tensor=outs[0])
+    # copy torch weights in
+    for name, mod in [("_0", net[0]), ("_2", net[2])]:
+        ff.set_weights(name, "kernel", mod.weight.detach().numpy().T)
+        ff.set_weights(name, "bias", mod.bias.detach().numpy())
+    xd = np.random.RandomState(0).randn(16, 64).astype(np.float32)
+    got = np.asarray(ff.predict({"x": xd}))
+    with torch.no_grad():
+        want = net(torch.from_numpy(xd)).numpy()
+    # TPU default matmul precision runs f32 through bf16 passes (~1e-3)
+    np.testing.assert_allclose(got, want, atol=5e-3)
+    print("torch parity OK: max err", float(np.abs(got - want).max()))
+
+
+if __name__ == "__main__":
+    main()

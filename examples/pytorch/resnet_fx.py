@@ -1,0 +1,64 @@
+"""Small ResNet import via torch.fx (reference:
+examples/python/pytorch/resnet.py): trace a residual torch CNN, export the
+.ff IR, replay and train."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import torch.nn as nn
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.torch import PyTorchModel, torch_to_flexflow
+
+
+class Block(nn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv1 = nn.Conv2d(ch, ch, 3, padding=1)
+        self.relu1 = nn.ReLU()
+        self.conv2 = nn.Conv2d(ch, ch, 3, padding=1)
+        self.relu2 = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu2(x + self.conv2(self.relu1(self.conv1(x))))
+
+
+class MiniResNet(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.stem = nn.Conv2d(3, 32, 3, padding=1)
+        self.relu = nn.ReLU()
+        self.b1 = Block(32)
+        self.b2 = Block(32)
+        self.pool = nn.MaxPool2d(4)
+        self.flat = nn.Flatten()
+        self.fc = nn.Linear(32 * 8 * 8, 10)
+
+    def forward(self, x):
+        x = self.relu(self.stem(x))
+        x = self.b2(self.b1(x))
+        return self.fc(self.flat(self.pool(x)))
+
+
+def main():
+    torch_to_flexflow(MiniResNet(), "/tmp/mini_resnet.ff")
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 3, 32, 32], name="x")
+    outs = PyTorchModel("/tmp/mini_resnet.ff").apply(ff, [x])
+    ff.compile(SGDOptimizer(lr=0.05),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=outs[0])
+    rs = np.random.RandomState(0)
+    SingleDataLoader(ff, x, rs.randn(256, 3, 32, 32).astype(np.float32))
+    SingleDataLoader(ff, ff.label_tensor,
+                     rs.randint(0, 10, (256, 1)).astype(np.int32))
+    ff.fit(epochs=int(os.environ.get("EPOCHS", 1)))
+
+
+if __name__ == "__main__":
+    main()

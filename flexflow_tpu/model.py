@@ -499,12 +499,16 @@ class FFModel:
         # loader preference: device-resident datasets (next_batch is an
         # on-device slice — the reference's ZC-resident design) > native
         # threaded host prefetch (csrc/dataloader.cc) > Python slicing.
-        # Eligibility is decided for ALL loaders before any upload, so a
-        # mixed set never strands half-staged copies in HBM.
+        # Eligibility is decided for ALL loaders before any upload, and a
+        # failed upload unstages the others, so a mixed or OOM-ing set never
+        # strands half-staged copies in HBM.
         native_dl = None
-        if not (all(dl.device_eligible() for dl in self._dataloaders)
-                and all(dl._try_stage_on_device()
-                        for dl in self._dataloaders)):
+        staged = (all(dl.device_eligible() for dl in self._dataloaders)
+                  and all(dl._try_stage_on_device()
+                          for dl in self._dataloaders))
+        if not staged:
+            for dl in self._dataloaders:
+                dl.unstage()
             from flexflow_tpu.runtime.native_loader import group_loader_for
             native_dl = group_loader_for(self)
             if native_dl is not None:

@@ -70,9 +70,12 @@ class MoE(Op):
         if self.dispatch != "auto":
             return self.dispatch == "sort"
         mesh = getattr(self.model, "mesh", None)
+        # same condition as weight_partition: dense pays off only when the
+        # experts actually shard over the 'expert' axis (all-to-all lowering)
         ep = (mesh is not None and "expert" in getattr(mesh, "axis_names", ())
-              and mesh.shape["expert"] > 1)
-        return not ep  # dense einsums lower to all-to-alls under EP sharding
+              and mesh.shape["expert"] > 1
+              and self.num_experts % mesh.shape["expert"] == 0)
+        return not ep
 
     def forward(self, params, xs, *, training=False, rng=None):
         x = xs[0]

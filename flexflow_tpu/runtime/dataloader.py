@@ -42,6 +42,10 @@ class SingleDataLoader:
     def reset(self):
         self.next_index = 0
 
+    def unstage(self):
+        """Drop the device-resident copy (frees HBM)."""
+        self._dev_data = self._dev_slice = None
+
     # ---- device-resident path ------------------------------------------------
 
     def device_eligible(self) -> bool:
@@ -73,7 +77,7 @@ class SingleDataLoader:
             import jax
             from jax import lax
 
-            sharding = executor.input_sharding(self.tensor)
+            sharding = self.model.executor.input_sharding(self.tensor)
             data = self.data[:self.num_batches * self.batch_size]
             self._dev_data = jax.device_put(data, sharding)
             b = self.batch_size

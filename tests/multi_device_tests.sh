@@ -34,14 +34,33 @@ python examples/native/dlrm.py -e 1 -b "$BATCH" \
   --arch-embedding-size 1000 --num-tables 8 \
   --import /tmp/ff_dlrm_strategy.txt --mesh data=2,model=2
 
+# native API examples (round 2 additions)
+python examples/native/mnist_cnn.py -e 1 -b "$BATCH"
+python examples/native/cifar10_cnn.py -e 1 -b "$BATCH"
+python examples/native/cifar10_cnn_concat.py -e 1 -b "$BATCH"
+python examples/native/mnist_mlp_attach.py -e 1 -b "$BATCH"
+python examples/native/split.py -e 1 -b "$BATCH"
+python examples/native/print_layers.py -b "$BATCH"
+
 # keras frontend examples
 python examples/keras/mnist_mlp.py
 python examples/keras/mnist_cnn.py
 python examples/keras/candle_uno.py
+python examples/keras/cifar10_cnn.py
+python examples/keras/func_mnist_mlp.py
+python examples/keras/func_mnist_mlp_concat.py
+python examples/keras/func_mnist_cnn.py
+python examples/keras/func_cifar10_cnn_concat.py
+python examples/keras/func_cifar10_alexnet.py
+python examples/keras/seq_reuters_mlp.py
+python examples/keras/reshape.py
+python examples/keras/unary.py
 
 # importer frontends
 python examples/pytorch/mnist_mlp_fx.py -e 1 -b "$BATCH"
 python examples/pytorch/cnn_fx.py -e 1 -b "$BATCH"
+python examples/pytorch/resnet_fx.py -e 1 -b "$BATCH"
+python examples/pytorch/mlp_torch_compare.py
 python examples/onnx/mnist_mlp_onnx.py -e 1 -b "$BATCH"
 
 # bootcamp demo

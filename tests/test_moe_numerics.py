@@ -83,8 +83,7 @@ def test_sort_dispatch_matches_dense():
         ff = FFModel(cfg)
         xt = ff.create_tensor([B, S, D], name="x")
         out = ff.moe(xt, num_experts=4, hidden_dim=32, k=2,
-                     capacity_factor=8.0, name="moe")
-        ff.get_op_by_name("moe").dispatch = dispatch
+                     capacity_factor=8.0, dispatch=dispatch, name="moe")
         ff.compile(optimizer=None, final_tensor=out)
         return np.asarray(ff.predict({"x": x})), ff
 
@@ -110,8 +109,8 @@ def test_sort_dispatch_capacity_drops_match_dense():
         ff = FFModel(cfg)
         xt = ff.create_tensor([B, S, D], name="x")
         out = ff.moe(xt, num_experts=4, hidden_dim=16, k=2,
-                     capacity_factor=0.5, name="moe")  # capacity binds
-        ff.get_op_by_name("moe").dispatch = dispatch
+                     capacity_factor=0.5,  # capacity binds
+                     dispatch=dispatch, name="moe")
         ff.compile(optimizer=None, final_tensor=out)
         return np.asarray(ff.predict({"x": x}))
 
@@ -130,8 +129,8 @@ def test_sort_dispatch_grads_flow():
     cfg = FFConfig(batch_size=B, mesh_shape={"data": 1}, seed=6)
     ff = FFModel(cfg)
     xt = ff.create_tensor([B, S, D], name="x")
-    out = ff.moe(xt, num_experts=4, hidden_dim=16, k=2, name="moe")
-    ff.get_op_by_name("moe").dispatch = "sort"
+    out = ff.moe(xt, num_experts=4, hidden_dim=16, k=2, dispatch="sort",
+                 name="moe")
     ff.compile(optimizer=None, final_tensor=out)
 
     op = ff.get_op_by_name("moe")
