@@ -28,6 +28,16 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _pick_block(seq: int, want: int) -> int:
+    """Largest tile size <= want that divides seq (the guard in
+    attention._flash_ok only promises 128-divisibility, so a 512 default
+    must degrade for e.g. seq 640)."""
+    for b in (want, 256, 128, 64, 32, 16, 8):
+        if b <= seq and seq % b == 0:
+            return b
+    return seq
+
+
 # ---------------------------------------------------------------- forward
 
 
@@ -80,7 +90,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *,
 
 
 def flash_attention_fwd_pallas(q, k, v, causal: bool, scale: float,
-                               block_q: int = 128, block_k: int = 128,
+                               block_q: int = 512, block_k: int = 512,
                                need_lse: bool = True):
     """q,k,v: (B, S, H, D) -> (out, lse|None). Grid: (B*H, S_q/block_q).
     need_lse=False (inference) skips materializing the logsumexp residual —
@@ -88,8 +98,8 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool, scale: float,
     itself at small head dims."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
     assert sq % block_q == 0 and sk % block_k == 0
 
     # (B, S, H, D) -> (B*H, S, D)
@@ -205,12 +215,13 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def flash_attention_bwd_pallas(q, k, v, o, lse, do, causal: bool,
-                               scale: float, block_q: int = 128,
-                               block_k: int = 128):
+                               scale: float, block_q: int = 512,
+                               block_k: int = 512):
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+    assert sq % block_q == 0 and sk % block_k == 0
 
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)

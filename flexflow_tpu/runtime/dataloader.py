@@ -43,8 +43,11 @@ class SingleDataLoader:
         self.next_index = 0
 
     def unstage(self):
-        """Drop the device-resident copy (frees HBM)."""
+        """Drop the device-resident copy (frees HBM) and pin this loader to
+        the host path — next_batch must not silently re-upload what fit()
+        just evicted."""
         self._dev_data = self._dev_slice = None
+        self._dev_failed = True
 
     # ---- device-resident path ------------------------------------------------
 
