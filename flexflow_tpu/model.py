@@ -503,6 +503,10 @@ class FFModel:
         # failed upload unstages the others, so a mixed or OOM-ing set never
         # strands half-staged copies in HBM.
         native_dl = None
+        for dl in self._dataloaders:
+            # a pin from a previous fit() (unstage/OOM) is not permanent:
+            # re-attempt once per fit; genuine failures just re-fail
+            dl._dev_failed = False
         staged = (all(dl.device_eligible() for dl in self._dataloaders)
                   and all(dl._try_stage_on_device()
                           for dl in self._dataloaders))

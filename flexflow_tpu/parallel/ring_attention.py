@@ -71,15 +71,174 @@ def _block_attend(q, k, v, m, l, o, scale, mask=None, dropout_rng=None,
     return m_new, l_new, o_new
 
 
+def _flash_block(q, k, v, causal: bool, scale: float):
+    """One ring step through the Pallas flash kernel: the block's normalized
+    output (B,S,H,D) f32 and logsumexp (B,H,S)."""
+    from flexflow_tpu.ops.pallas_kernels import flash_attention_fwd_pallas
+
+    b, sq, h, d = q.shape
+    out, lse8 = flash_attention_fwd_pallas(q, k, v, causal, scale)
+    o = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return o.astype(jnp.float32), lse8[..., 0].reshape(b, h, sq)
+
+
+def _merge_blocks(o, lse, o_s, lse_s):
+    """Combine two normalized attention partials by their logsumexps.
+    (An all-masked partial carries lse = NEG_INF = -1e30; its weight
+    exp(NEG_INF - new_lse) underflows to exactly 0.)"""
+    new_lse = jnp.logaddexp(lse, lse_s)
+    o_new = (o * jnp.exp(lse - new_lse).transpose(0, 2, 1)[..., None]
+             + o_s * jnp.exp(lse_s - new_lse).transpose(0, 2, 1)[..., None])
+    return o_new, new_lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def ring_attention_flash(q, k, v, axis_name: str, causal: bool = False,
+                         scale: Optional[float] = None):
+    """Ring attention with the Pallas flash kernel as the per-step block
+    compute (VERDICT r1 #4: the kernel on the SP hot path). Forward: each
+    step attends the local Q shard against the visiting K/V shard entirely
+    in-kernel; partials merge by logsumexp; future shards are skipped (the
+    kernel never launches for fully-masked steps). Backward: the standard
+    memory-efficient ring trick — only (q, k, v, o, lse) per device is
+    saved (O(S/P)); K/V re-rotate around the ring while dk/dv buffers
+    counter-rotate back to their owners, each step running the
+    FlashAttention-2 block backward against the GLOBAL logsumexp."""
+    o, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale)
+    return o.astype(q.dtype)
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale):
+    p_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    o0 = jnp.zeros((b, sq, h, d), jnp.float32)
+    lse0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    o0, lse0 = (pvary(t, axis_name) for t in (o0, lse0))
+    perm = [(i, (i - 1) % p_size) for i in range(p_size)]
+
+    def step(carry, step_idx):
+        o, lse, k_cur, v_cur = carry
+        if causal:
+            src = (my_idx + step_idx) % p_size
+
+            def self_block(_):
+                return _flash_block(q, k_cur, v_cur, True, scale)
+
+            def full_block(_):
+                return _flash_block(q, k_cur, v_cur, False, scale)
+
+            def skip_block(_):  # future shard: no kernel launch at all
+                return (jnp.zeros((b, sq, h, d), jnp.float32),
+                        jnp.full((b, h, sq), NEG_INF, jnp.float32))
+
+            which = jnp.where(step_idx == 0, 0, jnp.where(src > my_idx, 2, 1))
+            o_s, lse_s = lax.switch(which, [self_block, full_block,
+                                            skip_block], operand=None)
+        else:
+            o_s, lse_s = _flash_block(q, k_cur, v_cur, False, scale)
+        o, lse = _merge_blocks(o, lse, o_s, lse_s)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (o, lse, k_nxt, v_nxt), None
+
+    (o, lse, _, _), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(p_size))
+    return o, lse
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale):
+    o, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale)
+    # O(S/P) residuals per device: local shards + local output + local lse
+    return o.astype(q.dtype), (q, k, v, o.astype(q.dtype), lse)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, res, do):
+    from flexflow_tpu.ops.pallas_kernels import flash_attention_bwd_pallas
+
+    q, k, v, o, lse = res
+    p_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale_v = scale if scale is not None else 1.0 / math.sqrt(d)
+    # the block backward consumes the GLOBAL logsumexp (p = exp(s - LSE) is
+    # the true global probability of each visiting block)
+    lse8 = jnp.broadcast_to(lse.reshape(b * h, sq)[..., None],
+                            (b * h, sq, 8))
+    do = do.astype(q.dtype)
+    perm = [(i, (i - 1) % p_size) for i in range(p_size)]
+
+    def block_bwd(k_cur, v_cur, causal_flag):
+        return flash_attention_bwd_pallas(q, k_cur, v_cur, o, lse8, do,
+                                          causal_flag, scale_v)
+
+    def body(carry, step_idx):
+        dq_acc, dk_buf, dv_buf, k_cur, v_cur = carry
+        if causal:
+            src = (my_idx + step_idx) % p_size
+
+            def self_block(_):
+                return block_bwd(k_cur, v_cur, True)
+
+            def full_block(_):
+                return block_bwd(k_cur, v_cur, False)
+
+            def skip_block(_):
+                return (jnp.zeros((b, sq, h, d), q.dtype),
+                        jnp.zeros((b, sk, h, d), k.dtype),
+                        jnp.zeros((b, sk, h, d), v.dtype))
+
+            which = jnp.where(step_idx == 0, 0, jnp.where(src > my_idx, 2, 1))
+            dq_s, dk_s, dv_s = lax.switch(which, [self_block, full_block,
+                                                  skip_block], operand=None)
+        else:
+            dq_s, dk_s, dv_s = block_bwd(k_cur, v_cur, False)
+        dq_acc = dq_acc + dq_s.astype(jnp.float32)
+        dk_buf = dk_buf + dk_s.astype(jnp.float32)
+        dv_buf = dv_buf + dv_s.astype(jnp.float32)
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        dk_buf = lax.ppermute(dk_buf, axis_name, perm)
+        dv_buf = lax.ppermute(dv_buf, axis_name, perm)
+        return (dq_acc, dk_buf, dv_buf, k_cur, v_cur), None
+
+    z = lambda shape: pvary(jnp.zeros(shape, jnp.float32), axis_name)
+    init = (z((b, sq, h, d)), z((b, sk, h, d)), z((b, sk, h, d)), k, v)
+    (dq_acc, dk_buf, dv_buf, _, _), _ = lax.scan(body, init,
+                                                 jnp.arange(p_size))
+    return (dq_acc.astype(q.dtype), dk_buf.astype(k.dtype),
+            dv_buf.astype(v.dtype))
+
+
+ring_attention_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
                    scale: Optional[float] = None, dropout_rate: float = 0.0,
-                   dropout_rng=None):
+                   dropout_rng=None, use_flash: Optional[bool] = None):
     """Ring self-attention inside shard_map.
 
     q, k, v: (B, S_local, H, D) — the local sequence shard.
     Rotates K/V left around `axis_name`; after P steps every Q shard has
-    attended to the full sequence.
+    attended to the full sequence. When the Pallas kernel applies (TPU or
+    forced, no dropout), the per-step block compute runs in-kernel
+    (ring_attention_flash); otherwise the pure-JAX online-softmax path.
     """
+    if use_flash and dropout_rate > 0.0:
+        raise ValueError(
+            "use_flash=True is incompatible with attention dropout (the "
+            "Pallas kernels have no dropout path); drop the flag to use the "
+            "pure-JAX ring")
+    if use_flash is None:
+        import os
+
+        use_flash = ((jax.default_backend() == "tpu"
+                      or os.environ.get("FF_FORCE_FLASH_ATTENTION") == "1")
+                     and dropout_rate == 0.0)
+    if use_flash:
+        return ring_attention_flash(q, k, v, axis_name, causal, scale)
     p_size = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
