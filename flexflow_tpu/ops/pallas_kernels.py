@@ -216,7 +216,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def flash_attention_bwd_pallas(q, k, v, o, lse, do, causal: bool,
                                scale: float, block_q: int = 512,
-                               block_k: int = 512, dlse=None):
+                               block_k: int = 512, dlse=None,
+                               delta_precomputed=None):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     block_q = _pick_block(sq, block_q)
@@ -229,9 +230,15 @@ def flash_attention_bwd_pallas(q, k, v, o, lse, do, causal: bool,
     dot = do.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     ot = o.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     # delta_i = rowsum(do_i * o_i) — the softmax-normalization term of ds;
-    # an lse cotangent (ring merge differentiates through the logsumexp
-    # weights) folds in as ds = p * (dp - delta + dlse), i.e. delta -= dlse
-    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1)
+    # an lse cotangent (if the lse output is ever differentiated) folds in
+    # as ds = p * (dp - delta + dlse), i.e. delta -= dlse. Loop callers
+    # (the ring backward) pass delta_precomputed to hoist this out of their
+    # scan body.
+    if delta_precomputed is not None:
+        delta = delta_precomputed.reshape(b * h, sq).astype(jnp.float32)
+    else:
+        delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32),
+                        axis=-1)
     if dlse is not None:
         delta = delta - dlse.reshape(b * h, sq).astype(jnp.float32)
     # broadcast into the same 8-lane padded layout as lse

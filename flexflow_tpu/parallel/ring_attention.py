@@ -168,11 +168,16 @@ def _ring_flash_bwd(axis_name, causal, scale, res, do):
     lse8 = jnp.broadcast_to(lse.reshape(b * h, sq)[..., None],
                             (b * h, sq, 8))
     do = do.astype(q.dtype)
+    # delta is loop-invariant (depends only on do and the final output);
+    # compute once so the scan body doesn't re-emit it every ring step
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = delta.transpose(0, 2, 1)  # (B, H, Sq)
     perm = [(i, (i - 1) % p_size) for i in range(p_size)]
 
     def block_bwd(k_cur, v_cur, causal_flag):
         return flash_attention_bwd_pallas(q, k_cur, v_cur, o, lse8, do,
-                                          causal_flag, scale_v)
+                                          causal_flag, scale_v,
+                                          delta_precomputed=delta)
 
     def body(carry, step_idx):
         dq_acc, dk_buf, dv_buf, k_cur, v_cur = carry

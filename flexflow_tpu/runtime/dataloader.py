@@ -79,13 +79,23 @@ class SingleDataLoader:
         try:
             import jax
             from jax import lax
+            from jax.sharding import NamedSharding, PartitionSpec
 
             sharding = self.model.executor.input_sharding(self.tensor)
-            data = self.data[:self.num_batches * self.batch_size]
-            self._dev_data = jax.device_put(data, sharding)
             b = self.batch_size
+            nb = self.num_batches
+            # stage PRE-BATCHED: (num_batches, batch, ...) with the batch
+            # dim sharded and the leading batch-index dim replicated, so
+            # next_batch is a purely local index — no collective per slice
+            # (slicing a sample-sharded flat array would all-gather across
+            # shard boundaries on every batch)
+            data = self.data[:nb * b].reshape((nb, b) + self.data.shape[1:])
+            staged_spec = PartitionSpec(None, *sharding.spec)
+            staged_sharding = NamedSharding(sharding.mesh, staged_spec)
+            self._dev_data = jax.device_put(data, staged_sharding)
             self._dev_slice = jax.jit(
-                lambda d, i: lax.dynamic_slice_in_dim(d, i, b, 0),
+                lambda d, i: lax.dynamic_index_in_dim(d, i, 0,
+                                                      keepdims=False),
                 out_shardings=sharding)
             self._staged_bs = b
         except Exception:
@@ -101,7 +111,7 @@ class SingleDataLoader:
             self.next_index = 0
         self.next_index = start + b
         if self._try_stage_on_device():
-            if start + b > self._dev_data.shape[0]:
-                start = 0
-            return self._dev_slice(self._dev_data, start)
+            # same wrap policy as the host path: past the end -> batch 0
+            bi = (start // b) % self._dev_data.shape[0]
+            return self._dev_slice(self._dev_data, bi)
         return self.data[start:start + b]

@@ -41,6 +41,7 @@ python examples/native/cifar10_cnn_concat.py -e 1 -b "$BATCH"
 python examples/native/mnist_mlp_attach.py -e 1 -b "$BATCH"
 python examples/native/split.py -e 1 -b "$BATCH"
 python examples/native/print_layers.py -b "$BATCH"
+python examples/native/nmt.py -b "$NDEV" --iters 2 --hidden 64 --vocab 500 --seq 10
 
 # keras frontend examples
 python examples/keras/mnist_mlp.py

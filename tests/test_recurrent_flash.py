@@ -141,3 +141,36 @@ def test_mha_flash_path_matches_einsum(monkeypatch):
     monkeypatch.setenv("FF_FORCE_FLASH_ATTENTION", "1")
     y_flash = run()
     np.testing.assert_allclose(y_flash, y_einsum, rtol=2e-4, atol=2e-5)
+
+
+def test_flash_bwd_dlse_term():
+    """The dlse slot of flash_attention_bwd_pallas (lse cotangent folded
+    into delta) must match autodiff of the dense logsumexp: grad of
+    sum(w * lse(q,k)) via the kernel equals the dense reference."""
+    from flexflow_tpu.ops.pallas_kernels import (flash_attention_bwd_pallas,
+                                                 flash_attention_fwd_pallas)
+
+    B, S, H, D = 1, 64, 2, 16
+    rs = np.random.RandomState(9)
+    q, k, v = (jnp.asarray(rs.randn(B, S, H, D).astype(np.float32))
+               for _ in range(3))
+    w = jnp.asarray(rs.randn(B, H, S).astype(np.float32))
+    scale = 1.0 / np.sqrt(D)
+
+    out8, lse8 = flash_attention_fwd_pallas(q, k, v, False, scale)
+    o = out8.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+    # cotangents: do = 0, dlse = w  ->  dq/dk from the lse path only
+    dq, dk, dv = flash_attention_bwd_pallas(
+        q, k, v, o, lse8, jnp.zeros_like(q), False, scale, dlse=w)
+
+    def dense_lse(q, k):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * scale
+        return jnp.sum(w * jax.scipy.special.logsumexp(s, axis=-1))
+
+    gd_q, gd_k = jax.grad(dense_lse, (0, 1))(q, k)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(gd_q), rtol=2e-4,
+                               atol=2e-5)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(gd_k), rtol=2e-4,
+                               atol=2e-5)
+    assert np.abs(np.asarray(dv)).max() == 0  # lse has no v dependence
