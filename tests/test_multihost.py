@@ -27,7 +27,6 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.timeout(420)
 def test_two_process_training_via_launcher(tmp_path):
     port = _free_port()
     ckpt = str(tmp_path / "ckpt")
