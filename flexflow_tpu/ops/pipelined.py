@@ -37,19 +37,31 @@ def _layer_norm(h, scale, bias, eps=1e-5):
 
 
 def _block(p: Dict[str, jnp.ndarray], h: jnp.ndarray, num_heads: int,
-           causal: bool) -> jnp.ndarray:
-    """Pre-LN transformer block: MHA + residual, FFN(gelu) + residual."""
+           causal: bool, use_flash: bool = True) -> jnp.ndarray:
+    """Pre-LN transformer block: MHA + residual, FFN(gelu) + residual.
+    Attention runs the Pallas flash kernel on TPU (same selection rule as
+    the MultiHeadAttention op; use_flash=False — the config opt-out — forces
+    the einsum softmax)."""
+    import os
+
     B, S, D = h.shape
     hd = D // num_heads
     a = _layer_norm(h, p["ln1_scale"], p["ln1_bias"])
     q = (a @ p["wq"] + p["bq"]).reshape(B, S, num_heads, hd)
     k = (a @ p["wk"] + p["bk"]).reshape(B, S, num_heads, hd)
     v = (a @ p["wv"] + p["bv"]).reshape(B, S, num_heads, hd)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
-    if causal:
-        mask = jnp.tril(jnp.ones((S, S), bool))
-        logits = jnp.where(mask[None, None], logits, -1e30)
-    ctx = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(logits, axis=-1), v)
+    if use_flash and (jax.default_backend() == "tpu"
+                      or os.environ.get("FF_FORCE_FLASH_ATTENTION") == "1") \
+            and S % min(128, S) == 0:
+        from flexflow_tpu.ops.pallas_kernels import flash_attention
+
+        ctx = flash_attention(q, k, v, causal, 1.0 / np.sqrt(hd))
+    else:
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+        if causal:
+            mask = jnp.tril(jnp.ones((S, S), bool))
+            logits = jnp.where(mask[None, None], logits, -1e30)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(logits, axis=-1), v)
     h = h + ctx.reshape(B, S, D) @ p["wo"] + p["bo"]
     f = _layer_norm(h, p["ln2_scale"], p["ln2_bias"])
     f = jax.nn.gelu(f @ p["w1"] + p["b1"])
@@ -143,6 +155,7 @@ class TransformerPipelineStack(Op):
     def forward(self, params, xs, *, training=False, rng=None, shard_ctx=None):
         x = xs[0]
         L, H, causal = self.num_layers, self.num_heads, self.causal
+        use_flash = getattr(self.model.config, "use_flash_attention", True)
         stages = self._pipe_stages()
         mesh = shard_ctx["mesh"] if shard_ctx else None
 
@@ -156,7 +169,7 @@ class TransformerPipelineStack(Op):
             def stage_fn(sp, h):
                 # this stage's per_stage layers, scanned
                 def body(hh, lp):
-                    return _block(lp, hh, H, causal), None
+                    return _block(lp, hh, H, causal, use_flash), None
 
                 out, _ = lax.scan(body, h, sp)
                 return out
@@ -174,7 +187,7 @@ class TransformerPipelineStack(Op):
                              num_microbatches=num_micro, data_axis=data_axis)]
 
         def body(hh, lp):
-            return _block(lp, hh, H, causal), None
+            return _block(lp, hh, H, causal, use_flash), None
 
         out, _ = lax.scan(body, x, params)
         return [out]

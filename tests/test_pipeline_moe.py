@@ -148,3 +148,41 @@ def test_transformer_pipeline_stack_trains_dp_x_pp():
     assert np.isfinite(float(loss))
     w1 = np.asarray(ff.get_weights("stack", "wq"))
     assert np.abs(w1 - w0).max() > 0  # grads flowed through the ring
+
+
+def test_pipeline_block_flash_matches_einsum(monkeypatch):
+    """The pipeline stack's in-block attention must produce the same
+    numerics whether the Pallas flash path or the einsum path runs."""
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.ops.pipelined import _block
+
+    B, S, D, H = 2, 128, 32, 4
+    rs = np.random.RandomState(11)
+    h = jnp.asarray(rs.randn(B, S, D).astype(np.float32))
+    p = {}
+    for n, shape in (("wq", (D, D)), ("wk", (D, D)), ("wv", (D, D)),
+                     ("wo", (D, D)), ("w1", (D, 4 * D)), ("w2", (4 * D, D))):
+        p[n] = jnp.asarray(rs.randn(*shape).astype(np.float32) * 0.05)
+    for n, shape in (("bq", (D,)), ("bk", (D,)), ("bv", (D,)), ("bo", (D,)),
+                     ("b1", (4 * D,)), ("b2", (D,))):
+        p[n] = jnp.zeros(shape, jnp.float32)
+    p["ln1_scale"] = p["ln2_scale"] = jnp.ones((D,), jnp.float32)
+    p["ln1_bias"] = p["ln2_bias"] = jnp.zeros((D,), jnp.float32)
+
+    # use_flash=False forces the einsum baseline on ANY backend (the
+    # config opt-out path), so this comparison is meaningful on real TPU too
+    y_einsum = np.asarray(_block(p, h, H, causal=True, use_flash=False))
+    monkeypatch.setenv("FF_FORCE_FLASH_ATTENTION", "1")
+    y_flash = np.asarray(_block(p, h, H, causal=True, use_flash=True))
+    np.testing.assert_allclose(y_flash, y_einsum, rtol=2e-4, atol=2e-5)
+    # and gradients through the block agree between the two paths
+    def loss(fn_flash):
+        return lambda hh: jnp.sum(
+            _block(p, hh, H, causal=True, use_flash=fn_flash) ** 2)
+
+    gf = jax.grad(loss(True))(h)
+    ge = jax.grad(loss(False))(h)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(ge), rtol=2e-4,
+                               atol=2e-5)
