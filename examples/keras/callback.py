@@ -1,0 +1,47 @@
+"""Custom callbacks demo (reference: examples/python/keras/callback.py):
+a user Callback subclass observing epoch metrics alongside the built-in
+gates."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.keras import Sequential
+from flexflow_tpu.keras.callbacks import Callback
+from flexflow_tpu.keras.datasets import mnist
+from flexflow_tpu.keras.layers import Dense
+
+
+class EpochLogger(Callback):
+    def __init__(self):
+        super().__init__()
+        self.history = []
+
+    def on_epoch_end(self, epoch):
+        perf = self.model._perf
+        loss = perf.sparse_cce_loss / max(perf.train_all, 1)
+        self.history.append((epoch, perf.accuracy, loss))
+        print(f"[EpochLogger] epoch {epoch}: acc={perf.accuracy:.4f} "
+              f"loss={loss:.4f}")
+
+
+def main():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+
+    model = Sequential([
+        Dense(256, activation="relu", input_shape=(784,)),
+        Dense(10),
+    ])
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    logger = EpochLogger()
+    model.fit(x_train, y_train, epochs=3, callbacks=[logger])
+    assert len(logger.history) == 3, logger.history
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Functional CIFAR10 CNN (reference:
+examples/python/keras/func_cifar10_cnn.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.keras import Model
+from flexflow_tpu.keras.callbacks import EpochVerifyMetrics, ModelAccuracy
+from flexflow_tpu.keras.datasets import cifar10
+from flexflow_tpu.keras.layers import (Conv2D, Dense, Flatten, Input,
+                                       MaxPooling2D)
+
+
+def main():
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+
+    inp = Input((3, 32, 32))
+    t = Conv2D(32, 3, padding=1, activation="relu")(inp)
+    t = Conv2D(32, 3, padding=1, activation="relu")(t)
+    t = MaxPooling2D(2)(t)
+    t = Conv2D(64, 3, padding=1, activation="relu")(t)
+    t = Conv2D(64, 3, padding=1, activation="relu")(t)
+    t = MaxPooling2D(2)(t)
+    t = Flatten()(t)
+    t = Dense(512, activation="relu")(t)
+    out = Dense(10)(t)
+
+    model = Model(inp, out)
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    gates = ([EpochVerifyMetrics(ModelAccuracy.CIFAR10_CNN)]
+             if os.environ.get("FF_ACCURACY_GATE") else [])
+    model.fit(x_train, y_train, epochs=2, callbacks=gates)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Concat of two nested functional models (reference:
+examples/python/keras/func_cifar10_cnn_concat_model.py): two conv-branch
+Models called on the same input, feature-concatenated into a shared head."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.keras import Model
+from flexflow_tpu.keras.layers import (Concatenate, Conv2D, Dense, Flatten,
+                                       Input, MaxPooling2D)
+from flexflow_tpu.keras.datasets import cifar10
+
+
+def branch():
+    cin = Input((3, 32, 32))
+    t = Conv2D(32, 3, padding=1, activation="relu")(cin)
+    t = MaxPooling2D(2)(t)
+    t = Flatten()(t)
+    return Model(cin, t)
+
+
+def main():
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+
+    inp = Input((3, 32, 32))
+    a = branch()(inp)
+    b = branch()(inp)
+    t = Concatenate(axis=1)([a, b])
+    t = Dense(256, activation="relu")(t)
+    out = Dense(10)(t)
+    model = Model(inp, out)
+
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x_train, y_train, epochs=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Concat of a Sequential and a functional Model (reference:
+examples/python/keras/func_cifar10_cnn_concat_seq_model.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.keras import Model, Sequential
+from flexflow_tpu.keras.layers import (Concatenate, Conv2D, Dense, Flatten,
+                                       Input, MaxPooling2D)
+from flexflow_tpu.keras.datasets import cifar10
+
+
+def main():
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+
+    seq_branch = Sequential([
+        Conv2D(32, 3, padding=1, activation="relu", input_shape=(3, 32, 32)),
+        MaxPooling2D(2),
+        Flatten(),
+    ])
+
+    fin = Input((3, 32, 32))
+    t = Conv2D(32, 5, padding=2, activation="relu")(fin)
+    t = MaxPooling2D(2)(t)
+    t = Flatten()(t)
+    func_branch = Model(fin, t)
+
+    inp = Input((3, 32, 32))
+    t = Concatenate(axis=1)([seq_branch(inp), func_branch(inp)])
+    t = Dense(256, activation="relu")(t)
+    out = Dense(10)(t)
+    model = Model(inp, out)
+
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x_train, y_train, epochs=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Functional nesting (reference:
+examples/python/keras/func_cifar10_cnn_nested.py): a conv-stack Model called
+as a layer inside an outer functional Model."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.keras import Model
+from flexflow_tpu.keras.layers import (Conv2D, Dense, Flatten, Input,
+                                       MaxPooling2D)
+from flexflow_tpu.keras.datasets import cifar10
+
+
+def main():
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+
+    cin = Input((3, 32, 32))
+    t = Conv2D(32, 3, padding=1, activation="relu")(cin)
+    t = Conv2D(64, 3, padding=1, activation="relu")(t)
+    t = MaxPooling2D(2)(t)
+    t = Flatten()(t)
+    conv_model = Model(cin, t)
+
+    inp = Input((3, 32, 32))
+    feats = conv_model(inp)  # nested call replays the conv graph
+    h = Dense(512, activation="relu")(feats)
+    out = Dense(10)(h)
+    model = Model(inp, out)
+
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x_train, y_train, epochs=2)
+
+
+if __name__ == "__main__":
+    main()

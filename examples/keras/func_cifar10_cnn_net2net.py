@@ -1,0 +1,55 @@
+"""Net2Net on the CIFAR10 CNN (reference:
+examples/python/keras/func_cifar10_cnn_net2net.py): teacher conv/dense
+weights seed the student before continued training."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.keras import Model
+from flexflow_tpu.keras.datasets import cifar10
+from flexflow_tpu.keras.layers import (Conv2D, Dense, Flatten, Input,
+                                       MaxPooling2D)
+
+
+def build(layers):
+    inp = Input((3, 32, 32))
+    c1, c2, d1, d2 = layers
+    t = c1(inp)
+    t = MaxPooling2D(2)(t)
+    t = c2(t)
+    t = MaxPooling2D(2)(t)
+    t = Flatten()(t)
+    t = d1(t)
+    out = d2(t)
+    return Model(inp, out)
+
+
+def main():
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+
+    t_layers = [Conv2D(32, 3, padding=1, activation="relu"),
+                Conv2D(64, 3, padding=1, activation="relu"),
+                Dense(256, activation="relu"), Dense(10)]
+    teacher = build(t_layers)
+    teacher.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+    teacher.fit(x_train, y_train, epochs=1)
+
+    s_layers = [Conv2D(32, 3, padding=1, activation="relu"),
+                Conv2D(64, 3, padding=1, activation="relu"),
+                Dense(256, activation="relu"), Dense(10)]
+    student = build(s_layers)
+    student.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+    for tl, sl in zip(t_layers, s_layers):
+        sl.set_weights(student.ffmodel, *tl.get_weights(teacher.ffmodel))
+    student.fit(x_train, y_train, epochs=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Functional MNIST CNN with a concat of two conv branches (reference:
+examples/python/keras/func_mnist_cnn_concat.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.keras import Model
+from flexflow_tpu.keras.layers import (Concatenate, Conv2D, Dense, Flatten,
+                                       Input, MaxPooling2D)
+from flexflow_tpu.keras.datasets import mnist
+
+
+def main():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 1, 28, 28).astype(np.float32) / 255.0
+
+    inp = Input((1, 28, 28))
+    a = Conv2D(32, 3, padding=1, activation="relu")(inp)
+    b = Conv2D(32, 3, padding=1, activation="relu")(inp)
+    t = Concatenate(axis=1)([a, b])  # channel concat
+    t = Conv2D(64, 3, padding=1, activation="relu")(t)
+    t = MaxPooling2D(2)(t)
+    t = Flatten()(t)
+    t = Dense(128, activation="relu")(t)
+    out = Dense(10)(t)
+
+    model = Model(inp, out)
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x_train, y_train, epochs=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""Two-level concat MLP (reference:
+examples/python/keras/func_mnist_mlp_concat2.py): four parallel dense
+branches over two inputs, concatenated pairwise then together."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.keras import Model
+from flexflow_tpu.keras.layers import Concatenate, Dense, Input
+from flexflow_tpu.keras.datasets import mnist
+
+
+def main():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+
+    in1, in2 = Input((784,)), Input((784,))
+    a = Dense(256, activation="relu")(in1)
+    b = Dense(256, activation="relu")(in1)
+    c = Dense(256, activation="relu")(in2)
+    d = Dense(256, activation="relu")(in2)
+    ab = Concatenate(axis=1)([a, b])
+    cd = Concatenate(axis=1)([c, d])
+    t = Concatenate(axis=1)([ab, cd])
+    t = Dense(512, activation="relu")(t)
+    out = Dense(10)(t)
+
+    model = Model([in1, in2], out)
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit([x_train, x_train], y_train, epochs=2)
+
+
+if __name__ == "__main__":
+    main()

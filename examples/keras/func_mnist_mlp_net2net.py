@@ -1,0 +1,55 @@
+"""Net2Net teacher->student MLP (reference:
+examples/python/keras/func_mnist_mlp_net2net.py): train a teacher, export its
+layer weights, seed an identically-shaped student, keep training under the
+accuracy gate."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.keras import Model
+from flexflow_tpu.keras.callbacks import EpochVerifyMetrics, ModelAccuracy
+from flexflow_tpu.keras.datasets import mnist
+from flexflow_tpu.keras.layers import Dense, Input
+
+
+def main():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+
+    # teacher
+    inp1 = Input((784,))
+    d1 = Dense(512, activation="relu")
+    d2 = Dense(512, activation="relu")
+    d3 = Dense(10)
+    teacher = Model(inp1, d3(d2(d1(inp1))))
+    teacher.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+    teacher.fit(x_train, y_train, epochs=2)
+
+    w1 = d1.get_weights(teacher.ffmodel)
+    w2 = d2.get_weights(teacher.ffmodel)
+    w3 = d3.get_weights(teacher.ffmodel)
+
+    # student: same shape, seeded from the teacher
+    inp2 = Input((784,))
+    s1 = Dense(512, activation="relu")
+    s2 = Dense(512, activation="relu")
+    s3 = Dense(10)
+    student = Model(inp2, s3(s2(s1(inp2))))
+    student.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+    s1.set_weights(student.ffmodel, *w1)
+    s2.set_weights(student.ffmodel, *w2)
+    s3.set_weights(student.ffmodel, *w3)
+
+    gates = ([EpochVerifyMetrics(ModelAccuracy.MNIST_MLP)]
+             if os.environ.get("FF_ACCURACY_GATE") else [])
+    student.fit(x_train, y_train, epochs=2, callbacks=gates)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Nested models (reference: examples/python/keras/seq_mnist_cnn_nested.py):
+a Sequential conv stack and a functional MLP head, composed by add()-ing the
+models themselves into an outer Sequential."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.keras import Model, Sequential
+from flexflow_tpu.keras.layers import (Conv2D, Dense, Flatten, Input,
+                                       MaxPooling2D)
+from flexflow_tpu.keras.datasets import mnist
+
+
+def main():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 1, 28, 28).astype(np.float32) / 255.0
+
+    conv_stack = Sequential([
+        Conv2D(32, 3, padding=1, activation="relu", input_shape=(1, 28, 28)),
+        Conv2D(64, 3, padding=1, activation="relu"),
+        MaxPooling2D(2),
+        Flatten(),
+    ])
+
+    inp = Input((12544,))
+    out = Dense(512, activation="relu")(inp)
+    out = Dense(10)(out)
+    head = Model(inp, out)
+
+    model = Sequential()
+    model.add(conv_stack)
+    model.add(head)
+    print(model.summary())
+
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x_train, y_train, epochs=2)
+
+
+if __name__ == "__main__":
+    main()

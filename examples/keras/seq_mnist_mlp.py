@@ -1,0 +1,34 @@
+"""Sequential MNIST MLP (reference:
+examples/python/keras/seq_mnist_mlp.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.keras import Sequential
+from flexflow_tpu.keras.callbacks import EpochVerifyMetrics, ModelAccuracy
+from flexflow_tpu.keras.datasets import mnist
+from flexflow_tpu.keras.layers import Dense
+
+
+def main():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+
+    model = Sequential([
+        Dense(512, activation="relu", input_shape=(784,)),
+        Dense(512, activation="relu"),
+        Dense(10),
+    ])
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    gates = ([EpochVerifyMetrics(ModelAccuracy.MNIST_MLP)]
+             if os.environ.get("FF_ACCURACY_GATE") else [])
+    model.fit(x_train, y_train, epochs=2, callbacks=gates)
+
+
+if __name__ == "__main__":
+    main()

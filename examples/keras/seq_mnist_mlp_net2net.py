@@ -1,0 +1,40 @@
+"""Sequential Net2Net MLP (reference:
+examples/python/keras/seq_mnist_mlp_net2net.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.keras import Sequential
+from flexflow_tpu.keras.datasets import mnist
+from flexflow_tpu.keras.layers import Dense
+
+
+def main():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+
+    t1 = Dense(512, activation="relu", input_shape=(784,))
+    t2 = Dense(512, activation="relu")
+    t3 = Dense(10)
+    teacher = Sequential([t1, t2, t3])
+    teacher.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+    teacher.fit(x_train, y_train, epochs=2)
+
+    s1 = Dense(512, activation="relu", input_shape=(784,))
+    s2 = Dense(512, activation="relu")
+    s3 = Dense(10)
+    student = Sequential([s1, s2, s3])
+    student.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+    for tl, sl in zip((t1, t2, t3), (s1, s2, s3)):
+        sl.set_weights(student.ffmodel, *tl.get_weights(teacher.ffmodel))
+    student.fit(x_train, y_train, epochs=2)
+
+
+if __name__ == "__main__":
+    main()

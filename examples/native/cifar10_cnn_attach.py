@@ -1,0 +1,44 @@
+"""CIFAR10 CNN with attached conv weights (reference:
+examples/python/native/cifar10_cnn_attach.py): seed the first conv layer from
+host arrays before training."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+from flexflow_tpu import (ActiMode, FFConfig, FFModel, LossType, MetricsType,
+                          PoolType, SGDOptimizer, SingleDataLoader)
+
+
+def main():
+    from flexflow_tpu.keras.datasets import cifar10
+    (x, y), _ = cifar10.load_data()
+    x = x.astype(np.float32) / 255.0
+    y = y.reshape(-1, 1).astype(np.int32)
+
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    inp = ff.create_tensor([cfg.batch_size, 3, 32, 32], name="input")
+    t = ff.conv2d(inp, 32, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU,
+                  name="conv1")
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ff.flat(t)
+    t = ff.dense(t, 128, ActiMode.AC_MODE_RELU, name="fc1")
+    out = ff.dense(t, 10, name="fc2")
+    ff.compile(SGDOptimizer(lr=0.02),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=out)
+
+    rs = np.random.RandomState(0)
+    k = ff.get_weights("conv1", "kernel")
+    seeded = rs.randn(*k.shape).astype(np.float32) * 0.05
+    ff.set_weights("conv1", "kernel", seeded)
+
+    SingleDataLoader(ff, inp, x)
+    SingleDataLoader(ff, ff.label_tensor, y)
+    ff.fit(epochs=int(os.environ.get("EPOCHS", 1)))
+    print("conv1 drift:",
+          float(np.abs(ff.get_weights("conv1", "kernel") - seeded).max()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Input/weight inspection (reference:
+examples/python/native/print_input.py + tensor_attach.py patterns): build a
+tiny model, attach a known input batch, run forward, and print/verify the
+tensors coming back from the device."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+from flexflow_tpu import ActiMode, FFConfig, FFModel
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    cfg.batch_size = 8
+    ff = FFModel(cfg)
+    inp = ff.create_tensor([8, 16], name="input")
+    out = ff.dense(inp, 4, ActiMode.AC_MODE_NONE, name="fc")
+    ff.compile(optimizer=None, final_tensor=out)
+
+    x = np.arange(8 * 16, dtype=np.float32).reshape(8, 16) / 100.0
+    y = np.asarray(ff.predict({"input": x}))
+    print("input[0,:5]  =", x[0, :5])
+    print("output[0]    =", y[0])
+    k = ff.get_weights("fc", "kernel")
+    b = ff.get_weights("fc", "bias")
+    np.testing.assert_allclose(y, x @ k + b, rtol=1e-4, atol=1e-5)
+    print("forward matches input @ kernel + bias OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Tensor attach round-trip (reference:
+examples/python/native/tensor_attach.py — numpy attach to Legion regions via
+Tensor::set_tensor/get_tensor, model.cu:314-437): set every weight of a model
+from host arrays, read them back, verify bit-exact round-trip, then train one
+epoch to confirm the attached weights are live."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+from flexflow_tpu import (ActiMode, FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    inp = ff.create_tensor([cfg.batch_size, 32], name="input")
+    t = ff.dense(inp, 64, ActiMode.AC_MODE_RELU, name="fc1")
+    out = ff.dense(t, 4, name="fc2")
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=out)
+
+    rs = np.random.RandomState(7)
+    attached = {}
+    for op_name in ("fc1", "fc2"):
+        for w in ff.get_op_by_name(op_name).weight_specs():
+            a = rs.randn(*w.shape).astype(np.float32) * 0.1
+            ff.set_weights(op_name, w.name, a)
+            attached[(op_name, w.name)] = a
+    for (op_name, wname), a in attached.items():
+        np.testing.assert_array_equal(ff.get_weights(op_name, wname), a)
+    print(f"attached + round-tripped {len(attached)} weights bit-exact")
+
+    n = cfg.batch_size * 4
+    SingleDataLoader(ff, inp, rs.randn(n, 32).astype(np.float32))
+    SingleDataLoader(ff, ff.label_tensor,
+                     rs.randint(0, 4, (n, 1)).astype(np.int32))
+    ff.fit(epochs=1)
+    drift = np.abs(ff.get_weights("fc1", "kernel")
+                   - attached[("fc1", "kernel")]).max()
+    assert drift > 0, "training did not update attached weights"
+    print("post-train drift:", float(drift))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""CIFAR10 CNN imported from torch via FX (reference:
+examples/python/pytorch/cifar10_cnn.py: torch module -> .ff file -> native
+training)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import torch.nn as nn
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.torch import PyTorchModel, torch_to_flexflow
+
+
+class CNN(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 32, 3, padding=1)
+        self.conv2 = nn.Conv2d(32, 64, 3, padding=1)
+        self.pool = nn.MaxPool2d(2, 2)
+        self.flat = nn.Flatten()
+        self.fc1 = nn.Linear(64 * 16 * 16, 256)
+        self.fc2 = nn.Linear(256, 10)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        x = self.relu(self.conv1(x))
+        x = self.relu(self.conv2(x))
+        x = self.pool(x)
+        x = self.flat(x)
+        x = self.relu(self.fc1(x))
+        return self.fc2(x)
+
+
+def main():
+    from flexflow_tpu.keras.datasets import cifar10
+    (x, y), _ = cifar10.load_data()
+    x = x.astype(np.float32) / 255.0
+    y = y.reshape(-1, 1).astype(np.int32)
+
+    ff_file = "/tmp/cifar10_cnn.ff"
+    torch_to_flexflow(CNN(), ff_file)
+
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    inp = ff.create_tensor([cfg.batch_size, 3, 32, 32], name="input")
+    outs = PyTorchModel(ff_file).apply(ff, [inp])
+    ff.compile(SGDOptimizer(lr=0.02),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=outs[0])
+    SingleDataLoader(ff, inp, x)
+    SingleDataLoader(ff, ff.label_tensor, y)
+    ff.fit(epochs=int(os.environ.get("EPOCHS", 1)))
+
+
+if __name__ == "__main__":
+    main()

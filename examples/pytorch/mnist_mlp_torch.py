@@ -1,0 +1,44 @@
+"""Pure-torch MNIST MLP twin (reference:
+examples/python/pytorch/mnist_mlp_torch.py): the torch-side baseline used to
+compare against the FX-imported run in mnist_mlp_fx.py."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import torch
+import torch.nn as nn
+
+
+def main():
+    from flexflow_tpu.keras.datasets import mnist
+    (x, y), _ = mnist.load_data()
+    x = torch.from_numpy(x.reshape(-1, 784).astype(np.float32) / 255.0)
+    y = torch.from_numpy(y.astype(np.int64).reshape(-1))
+
+    net = nn.Sequential(nn.Linear(784, 512), nn.ReLU(),
+                        nn.Linear(512, 512), nn.ReLU(),
+                        nn.Linear(512, 10))
+    opt = torch.optim.SGD(net.parameters(), lr=0.01)
+    loss_fn = nn.CrossEntropyLoss()
+    bs = 64
+    for epoch in range(int(os.environ.get("EPOCHS", 1))):
+        total, correct, lsum = 0, 0, 0.0
+        for i in range(0, len(x) - bs + 1, bs):
+            xb, yb = x[i:i + bs], y[i:i + bs]
+            opt.zero_grad()
+            logits = net(xb)
+            loss = loss_fn(logits, yb)
+            loss.backward()
+            opt.step()
+            total += bs
+            correct += int((logits.argmax(-1) == yb).sum())
+            lsum += float(loss) * bs
+        print(f"epoch {epoch}: accuracy={100.0 * correct / total:.2f}% "
+              f"loss={lsum / total:.4f}")
+
+
+if __name__ == "__main__":
+    main()

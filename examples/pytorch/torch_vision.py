@@ -1,0 +1,71 @@
+"""torchvision model import (reference:
+examples/python/pytorch/torch_vision.py: torchvision.models -> FX -> native).
+The torchvision package is not bundled in this image; falls back to the
+in-repo torch ResNet block so the FX path is still exercised."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import torch.nn as nn
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.torch import PyTorchModel, torch_to_flexflow
+
+
+def get_model():
+    try:
+        import torchvision.models as models
+        print("using torchvision.models.resnet18")
+        return models.resnet18(weights=None), (3, 224, 224), 1000
+    except ImportError:
+        print("torchvision not available; using in-repo torch CNN fallback")
+
+        class SmallNet(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.conv1 = nn.Conv2d(3, 64, 7, stride=2, padding=3)
+                self.pool = nn.MaxPool2d(2, 2)
+                self.conv2 = nn.Conv2d(64, 128, 3, padding=1)
+                self.flat = nn.Flatten()
+                self.fc = nn.Linear(128 * 16 * 16, 10)
+                self.relu = nn.ReLU()
+
+            def forward(self, x):
+                x = self.relu(self.conv1(x))
+                x = self.pool(x)
+                x = self.relu(self.conv2(x))
+                x = self.pool(x)
+                x = self.flat(x)
+                return self.fc(x)
+
+        return SmallNet(), (3, 128, 128), 10
+
+
+def main():
+    net, in_shape, num_classes = get_model()
+    ff_file = "/tmp/torch_vision.ff"
+    torch_to_flexflow(net, ff_file)
+
+    cfg = FFConfig.parse_args()
+    cfg.batch_size = min(cfg.batch_size, 16)
+    ff = FFModel(cfg)
+    inp = ff.create_tensor([cfg.batch_size] + list(in_shape), name="input")
+    outs = PyTorchModel(ff_file).apply(ff, [inp])
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=outs[0])
+
+    rs = np.random.RandomState(0)
+    n = cfg.batch_size * 2
+    SingleDataLoader(ff, inp, rs.randn(n, *in_shape).astype(np.float32))
+    SingleDataLoader(ff, ff.label_tensor,
+                     rs.randint(0, num_classes, (n, 1)).astype(np.int32))
+    ff.fit(epochs=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -57,6 +57,22 @@ class Layer:
         """Lower onto the FFModel builder; returns the output fftensor."""
         raise NotImplementedError
 
+    # -- weight access (reference: layer.get_weights(ffmodel) /
+    # layer.set_weights(ffmodel, kernel, bias) over Parameter regions,
+    # flexflow_cbinding.py Parameter:14-41; used by the net2net examples) --
+
+    def get_weights(self, ffmodel):
+        """Returns this layer's weights as numpy arrays (kernel[, bias])."""
+        specs = ffmodel.get_op_by_name(self.name).weight_specs()
+        return tuple(ffmodel.get_weights(self.name, s.name) for s in specs)
+
+    def set_weights(self, ffmodel, *arrays):
+        specs = ffmodel.get_op_by_name(self.name).weight_specs()
+        assert len(arrays) == len(specs), \
+            f"{self.name}: expected {len(specs)} arrays, got {len(arrays)}"
+        for spec, arr in zip(specs, arrays):
+            ffmodel.set_weights(self.name, spec.name, np.asarray(arr))
+
 
 class InputLayer(Layer):
     def __init__(self, shape=None, dtype="float32", name=None):

@@ -118,13 +118,37 @@ class BaseModel:
 
     def summary(self):
         lines = [f'Model: "{self.name or type(self).__name__}"', "_" * 60]
-        for op in (self.ffmodel.ops if self.ffmodel else []):
-            shape = op.outputs[0].dims if op.outputs else ()
-            lines.append(f"{op.name:30s} {type(op).__name__:20s} {shape}")
+        if self.ffmodel is not None and self.ffmodel.ops:
+            for op in self.ffmodel.ops:
+                shape = op.outputs[0].dims if op.outputs else ()
+                lines.append(f"{op.name:30s} {type(op).__name__:20s} {shape}")
+        elif self._output_kt is not None:  # pre-compile: keras graph walk
+            for kt in self._topo_layers():
+                lname = kt.layer.name if kt.layer else "input"
+                ltype = type(kt.layer).__name__ if kt.layer else "Input"
+                lines.append(f"{lname:30s} {ltype:20s} {kt.shape}")
         return "\n".join(lines)
 
     def get_weights(self, op_name, weight_name="kernel"):
         return self.ffmodel.get_weights(op_name, weight_name)
+
+    def __call__(self, x):
+        """Use a built (not necessarily compiled) model as a layer inside
+        another model: replay its layer graph onto new inputs (reference
+        nested models, e.g. seq_mnist_cnn_nested.py / Sequential.add(Model))."""
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        assert self._output_kt is not None, "model has no layers"
+        assert len(xs) == len(self._input_kts), \
+            f"model expects {len(self._input_kts)} inputs, got {len(xs)}"
+        mapping = {id(kt): v for kt, v in zip(self._input_kts, xs)}
+        for kt in self._topo_layers():
+            if id(kt) in mapping:
+                continue
+            if isinstance(kt.layer, InputLayer):
+                raise ValueError("nested model has an unbound input")
+            ins = [mapping[id(i)] for i in kt.inputs]
+            mapping[id(kt)] = kt.layer(ins if len(ins) > 1 else ins[0])
+        return mapping[id(self._output_kt)]
 
 
 class Model(BaseModel):
@@ -144,19 +168,26 @@ class Sequential(BaseModel):
         for l in layers:
             self.add(l)
 
-    def add(self, layer: Layer):
+    def add(self, layer):
         from flexflow_tpu.keras.layers import Input
 
         if self._kt is None:
-            shape = getattr(layer, "input_shape", None)
             if isinstance(layer, InputLayer):
                 self._kt = Input(layer.shape, layer.dtype, layer.name)
                 self._input_kts = [self._kt]
                 self._output_kt = self._kt
                 return
+            dtype = "float32"
+            if isinstance(layer, BaseModel):  # nested model as first "layer"
+                inner = layer._input_kts[0]
+                shape = inner.shape
+                if isinstance(inner.layer, InputLayer):
+                    dtype = inner.layer.dtype  # e.g. int32 embedding ids
+            else:
+                shape = getattr(layer, "input_shape", None)
             assert shape is not None, \
                 "first layer needs input_shape= (or add an InputLayer)"
-            self._kt = Input(shape)
+            self._kt = Input(shape, dtype)
             self._input_kts = [self._kt]
         self._layers.append(layer)
         self._kt = layer(self._kt)

@@ -85,6 +85,24 @@ class Op:
         only (the reference's conservative default for most ops)."""
         return [0]
 
+    def contract_size(self) -> Optional[int]:
+        """Size of the op's weight-contraction dim, if the op supports
+        CONTRACT (row-parallel) sharding: weight sharded on its input-feature
+        dim, input sharded on its last dim, output psum-replicated. None =
+        not contractable. Analog of the reference Linear's replica-dim
+        machinery (linear.cu:171-192,774-835)."""
+        return None
+
+    def output_axis_map(self, axis_map: Dict[str, Optional[int]]
+                        ) -> Dict[str, Optional[int]]:
+        """The sharding the op's OUTPUT actually has under `axis_map`:
+        CONTRACT axes produce a psum-replicated output, so consumers see
+        them as replicated."""
+        from flexflow_tpu.parallel.pconfig import CONTRACT
+
+        return {ax: (None if d == CONTRACT else d)
+                for ax, d in (axis_map or {}).items()}
+
     def weight_partition(self, axis_map: Dict[str, Optional[int]]):
         """Given the op's output axis_map (mesh axis -> output dim), return
         {weight_name: PartitionSpec}. Default: fully replicated weights
@@ -120,7 +138,7 @@ class Op:
         ndims = self.inputs[input_idx].num_dims
         nd_out = self.outputs[0].num_dims
         contracted = {(d % nd_out) for d in self._contracted_output_dims}
-        return {ax: (d if d is not None and d < ndims
+        return {ax: (d if d is not None and 0 <= d < ndims
                      and d not in contracted else None)
                 for ax, d in axis_map.items()}
 

@@ -83,12 +83,33 @@ class Linear(Op):
         nd = self.outputs[0].num_dims
         return list(range(nd))
 
+    def contract_size(self):
+        # row-parallel: kernel sharded on in_dim, input sharded on its last
+        # dim (a column-parallel producer's layout), output psum-replicated —
+        # the Megatron pair that makes TP resharding-free. Reference analog:
+        # replica-input Linear (linear.cu:171-192) + backward2 (:774-835).
+        return self.in_dim
+
     def weight_partition(self, axis_map):
+        from flexflow_tpu.parallel.pconfig import CONTRACT
+
         ax = self.axes_for_dim(axis_map, self.outputs[0].num_dims - 1)
-        out = {"kernel": P(None, ax)}
+        cax = self.axes_for_dim(axis_map, CONTRACT)
+        out = {"kernel": P(cax, ax)}
         if self.use_bias:
+            # bias adds after the psum; replicated over contract axes
             out["bias"] = P(ax)
         return out
+
+    def input_axis_map(self, axis_map, input_idx):
+        from flexflow_tpu.parallel.pconfig import CONTRACT
+
+        base = super().input_axis_map(axis_map, input_idx)
+        d_in = self.inputs[input_idx].num_dims - 1
+        for ax, d in (axis_map or {}).items():
+            if d == CONTRACT:
+                base[ax] = d_in
+        return base
 
     def flops(self):
         batch = int(np.prod(self.outputs[0].dims[:-1]))

@@ -21,6 +21,15 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Tuple
 
+# axis_map value meaning "shard the op's CONTRACTION dim over this mesh axis"
+# (row-parallel / Megatron-style tensor parallelism): the weight is sharded on
+# its input-feature dim, the input arrives sharded on its last dim (matching a
+# column-parallel producer), and the output is replicated over the axis after
+# an activation psum that GSPMD inserts automatically. The reference expresses
+# the same concept as Linear's NDIM+1 replica dimension
+# (linear.cu:171-192,774-835: replicated input + backward2 reduction).
+CONTRACT = -2
+
 
 @dataclasses.dataclass
 class ParallelConfig:
@@ -50,10 +59,18 @@ class ParallelConfig:
     def from_axis_map(ndims: int, mesh_shape: Dict[str, int],
                       axis_map: Dict[str, Optional[int]]) -> "ParallelConfig":
         dims = [1] * ndims
-        n = 1
+        contract_deg = 1
         for ax, d in axis_map.items():
-            if d is not None:
+            if d == CONTRACT:
+                contract_deg *= mesh_shape[ax]
+            elif d is not None:
                 dims[d] *= mesh_shape[ax]
+        if contract_deg > 1:
+            # serialized as an extra trailing degree — the reference's own
+            # convention for Linear's replica dim (an NDIM+1 tensor,
+            # linear.cu:171-192)
+            dims.append(contract_deg)
+        n = 1
         for v in dims:
             n *= v
         return ParallelConfig(dims=tuple(dims), device_ids=tuple(range(n)),
@@ -90,7 +107,9 @@ class ParallelConfig:
         order = mesh_axis_order or list(self.axis_map.keys())
         for ax in order:
             d = self.axis_map.get(ax)
-            if d is not None and d < ndims:
+            # CONTRACT axes do not shard the output (it is replicated over
+            # them after the psum) — only true output dims land in the spec
+            if d is not None and 0 <= d < ndims:
                 dim_axes[d].append(ax)
         entries = []
         for axes in dim_axes:

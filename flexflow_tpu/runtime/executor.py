@@ -35,21 +35,30 @@ def resolve_axis_map(pc: ParallelConfig, mesh_shape: Dict[str, int],
     """Fill in pc.axis_map from degrees when a strategy came from a file
     (degrees only). Greedy: each partitioned dim takes unused mesh axes whose
     sizes multiply to its degree; sample dim prefers 'data'."""
+    from flexflow_tpu.parallel.pconfig import CONTRACT
+
     if pc.axis_map is not None:
         return pc.axis_map
     remaining = dict(mesh_shape)
     axis_map: Dict[str, Optional[int]] = {}
-    order = sorted(range(min(ndims, len(pc.dims))),
-                   key=lambda d: (d != 0,))  # sample dim first
+    # a degree list one longer than the output rank carries a trailing
+    # CONTRACT (row-parallel) degree — the reference's replica-dim
+    # convention (linear.cu:171-192); resolved like any other dim but
+    # mapped to the CONTRACT sentinel
+    targets = list(range(min(ndims, len(pc.dims))))
+    if len(pc.dims) == ndims + 1 and pc.dims[ndims] > 1:
+        targets.append(ndims)
+    order = sorted(targets, key=lambda d: (d != 0,))  # sample dim first
     for d in order:
         deg = pc.dims[d]
+        logical = CONTRACT if d == ndims else d
         if deg == 1:
             continue
         # prefer canonical axis for the dim role
         prefs = (["data"] if d == 0 else []) + list(remaining.keys())
         single = [ax for ax in prefs if remaining.get(ax) == deg]
         if single:
-            axis_map[single[0]] = d
+            axis_map[single[0]] = logical
             del remaining[single[0]]
             continue
         # general case: smallest subset of remaining axes whose sizes
@@ -72,7 +81,7 @@ def resolve_axis_map(pc: ParallelConfig, mesh_shape: Dict[str, int],
                 f"product of unused mesh axes (mesh {mesh_shape}, "
                 f"remaining {remaining})")
         for ax in found:
-            axis_map[ax] = d
+            axis_map[ax] = logical
             del remaining[ax]
     return axis_map
 

@@ -47,6 +47,18 @@ def _shard_degree_on_dim(axis_map: AxisMap, mesh_shape: Dict[str, int],
     return n
 
 
+def _parts_out(axis_map: AxisMap, mesh_shape: Dict[str, int]) -> int:
+    """Partition count of the op's OUTPUT: CONTRACT axes shard inputs and
+    weights but deliver a psum-replicated output, so they are excluded."""
+    from flexflow_tpu.parallel.pconfig import CONTRACT
+
+    n = 1
+    for ax, d in (axis_map or {}).items():
+        if d is not None and d != CONTRACT:
+            n *= mesh_shape[ax]
+    return n
+
+
 def align_place(place: int, ndev: int, num_devices: int) -> int:
     """Mirror of sim.cc align_place: device blocks are GSPMD-expressible
     sub-meshes — ndev must divide the device count and the start must be a
@@ -78,25 +90,50 @@ class CostModel:
     # ---- per-op --------------------------------------------------------------
 
     def op_compute_time(self, op: Op, axis_map: AxisMap) -> float:
-        parts = _parts(axis_map, self.mesh_shape)
-        if self.measured:
-            # real-device measurement keyed by per-shard output shape
-            # (search/measure.py; reference cache simulator.cc:298-303),
-            # legacy fallback key: partition count
-            from flexflow_tpu.search.measure import shard_shape
+        from flexflow_tpu.parallel.pconfig import CONTRACT
 
-            key = (op.name, shard_shape(op.outputs[0].dims, axis_map,
-                                        self.mesh_shape))
+        parts = _parts(axis_map, self.mesh_shape)
+        contract_axes = [ax for ax, d in (axis_map or {}).items()
+                         if d == CONTRACT]
+        t = None
+        if self.measured:
+            # real-device measurement keyed by choice_key — per-shard output
+            # shape PLUS the contract degree, which the output shape alone
+            # cannot encode (search/measure.py; reference cache
+            # simulator.cc:298-303); legacy fallback key: partition count
+            from flexflow_tpu.search.measure import choice_key
+
+            key = choice_key(op.name, op.outputs[0].dims, axis_map,
+                             self.mesh_shape)
             if key in self.measured:
-                return self.measured[key]
-            if (op.name, parts) in self.measured:
-                return self.measured[(op.name, parts)]
-        flops = op.flops() / max(parts, 1)
-        io_bytes = (sum(t.volume() for t in op.inputs)
-                    + sum(t.volume() for t in op.outputs)) \
-            * self.dtype_bytes / max(parts, 1)
-        fwd = self.machine.compute_time(flops, io_bytes, self.dtype_bytes)
-        return 3.0 * fwd  # fwd + ~2x bwd (reference measures both separately)
+                t = self.measured[key]
+            elif not contract_axes and (op.name, parts) in self.measured:
+                t = self.measured[(op.name, parts)]
+        if t is None:
+            flops = op.flops() / max(parts, 1)
+            # inputs/weights are sharded over all axes incl. CONTRACT; the
+            # output is psum-replicated over CONTRACT axes, so its bytes
+            # divide only by the output partition count
+            io_bytes = (sum(t_.volume() for t_ in op.inputs)
+                        * self.dtype_bytes / max(parts, 1)
+                        + sum(t_.volume() for t_ in op.outputs)
+                        * self.dtype_bytes
+                        / max(_parts_out(axis_map, self.mesh_shape), 1))
+            fwd = self.machine.compute_time(flops, io_bytes, self.dtype_bytes)
+            t = 3.0 * fwd  # fwd + ~2x bwd (reference measures both)
+        # CONTRACT (row-parallel) axes psum the output activations: once in
+        # forward, once for the mirror collective in backward. Added on top
+        # of EITHER cost tier (the measured shard time excludes comm) and
+        # folded into the op's serial cost — it gates consumers exactly
+        # like compute.
+        if contract_axes:
+            out_bytes = (sum(t_.volume() for t_ in op.outputs)
+                         * self.dtype_bytes
+                         / max(_parts_out(axis_map, self.mesh_shape), 1))
+            for ax in contract_axes:
+                t += 2.0 * self.machine.all_reduce_time(
+                    out_bytes, self.mesh_shape[ax], ax)
+        return t
 
     def op_grad_sync_time(self, op: Op, axis_map: AxisMap) -> float:
         """All-reduce of weight grads over mesh axes that parallelize the op
@@ -131,9 +168,12 @@ class CostModel:
 
     def op_mem_bytes(self, op: Op, axis_map: AxisMap) -> float:
         """Per-device HBM bytes under this choice: weights + grads + opt
-        state (x3) plus activations, divided over the partition."""
+        state (x3) plus activations, divided over the partition. CONTRACT
+        axes shard the weight but leave the output replicated."""
         parts = _parts(axis_map, self.mesh_shape)
-        return (op.weight_bytes() * 3 + op.output_bytes()) / max(parts, 1)
+        return (op.weight_bytes() * 3 / max(parts, 1)
+                + op.output_bytes()
+                / max(_parts_out(axis_map, self.mesh_shape), 1))
 
     def resharding_time(self, producer_map: AxisMap, consumer_map: AxisMap,
                         tensor) -> float:
@@ -171,6 +211,11 @@ class CostModel:
         D = self.num_devices
         dev_compute = [0.0] * D
         dev_comm = [0.0] * D
+        # grad all-reduce rides its own per-device stream: XLA's latency
+        # hiding overlaps grad sync with backward compute, and the reference
+        # prices NCCL post-hoc (simulator.cc:548-594) — never interleaved
+        # with forward resharding traffic
+        dev_sync = [0.0] * D
         dev_mem = [0.0] * D
         finish: Dict[str, float] = {}
         blocks: Dict[str, tuple] = {}
@@ -191,7 +236,9 @@ class CostModel:
                 if t.owner_op is None or isinstance(t.owner_op, InputOp):
                     continue
                 src = t.owner_op.name
-                pam = strategy.get(src, {})
+                # consumers see the producer's OUTPUT sharding: CONTRACT
+                # axes deliver psum-replicated outputs
+                pam = t.owner_op.output_axis_map(strategy.get(src, {}))
                 try:
                     want = op.input_axis_map(am, input_idx)
                 except Exception:
@@ -226,14 +273,15 @@ class CostModel:
             if sync > 0.0:
                 cstart = end
                 for d in range(pi, pi + ni):
-                    cstart = max(cstart, dev_comm[d])
+                    cstart = max(cstart, dev_sync[d])
                 for d in range(pi, pi + ni):
-                    dev_comm[d] = cstart + sync
+                    dev_sync[d] = cstart + sync
             m = self.op_mem_bytes(op, am)
             for d in range(pi, pi + ni):
                 dev_mem[d] += m
 
-        total = max(max(dev_compute), max(dev_comm)) if D else 0.0
+        total = max(max(dev_compute), max(dev_comm), max(dev_sync)) \
+            if D else 0.0
         for d in range(D):
             over = dev_mem[d] - self.machine.hbm_bytes
             if over > 0.0:

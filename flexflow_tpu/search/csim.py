@@ -114,11 +114,15 @@ class CompiledSearchProblem:
         for src_idx, dst_idx, input_idx, t in edges:
             src_maps = self.op_maps[src_idx]
             dst_maps = self.op_maps[dst_idx]
+            src_op = self.ops[src_idx]
             dst_op = self.ops[dst_idx]
             for pm in src_maps:
+                # consumers see the producer's OUTPUT sharding (CONTRACT
+                # axes deliver psum-replicated outputs)
+                pm_out = src_op.output_axis_map(pm)
                 for cm in dst_maps:
                     want = dst_op.input_axis_map(cm, input_idx)
-                    ecosts.append(cost.resharding_time(pm, want, t))
+                    ecosts.append(cost.resharding_time(pm_out, want, t))
             eoffsets.append(len(ecosts))
         self.edge_cost_offsets = np.asarray(eoffsets, np.int64)
         self.edge_costs = np.asarray(ecosts, np.float64)

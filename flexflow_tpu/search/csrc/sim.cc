@@ -69,6 +69,13 @@ double schedule(const Tables& T, const int32_t* choices,
   std::vector<double> finish(T.num_ops, 0.0);
   std::vector<double> dev_compute(D, 0.0);  // per-device compute stream
   std::vector<double> dev_comm(D, 0.0);     // per-device comm (ICI) stream
+  // Gradient all-reduces ride a SEPARATE per-device stream: on TPU the
+  // XLA latency-hiding scheduler overlaps grad sync with backward compute,
+  // and the reference likewise prices NCCL cost post-hoc rather than
+  // interleaving it with forward transfers (simulator.cc:548-594).
+  // Interleaving syncs into dev_comm would stall every forward resharding
+  // edge behind queued grad traffic and poison the search landscape.
+  std::vector<double> dev_sync(D, 0.0);     // per-device grad-sync stream
   std::vector<double> dev_mem(D, 0.0);      // per-device HBM footprint
 
   auto block = [&](int op) {
@@ -121,13 +128,13 @@ double schedule(const Tables& T, const int32_t* choices,
     for (int d = pi; d < pi + ni; ++d) dev_compute[d] = end;
     finish[i] = end;
     if (tl && tl->compute_start) { tl->compute_start[i] = start; tl->compute_finish[i] = end; }
-    // gradient sync rides this block's comm streams after the compute
+    // gradient sync rides this block's sync streams after the compute
     double sync = T.op_sync_costs[off + choices[i]];
     if (sync > 0.0) {
       double cstart = end;
-      for (int d = pi; d < pi + ni; ++d) cstart = std::max(cstart, dev_comm[d]);
+      for (int d = pi; d < pi + ni; ++d) cstart = std::max(cstart, dev_sync[d]);
       double cend = cstart + sync;
-      for (int d = pi; d < pi + ni; ++d) dev_comm[d] = cend;
+      for (int d = pi; d < pi + ni; ++d) dev_sync[d] = cend;
       if (tl && tl->sync_start) { tl->sync_start[i] = cstart; tl->sync_finish[i] = cend; }
     } else if (tl && tl->sync_start) {
       tl->sync_start[i] = tl->sync_finish[i] = end;
@@ -139,7 +146,8 @@ double schedule(const Tables& T, const int32_t* choices,
   }
   double total = 0.0;
   for (int d = 0; d < D; ++d)
-    total = std::max(total, std::max(dev_compute[d], dev_comm[d]));
+    total = std::max(total, std::max(dev_sync[d],
+                     std::max(dev_compute[d], dev_comm[d])));
   // per-device over-HBM penalty (reference simulator.cc:595-620: 1 ms/MB)
   if (T.op_mem_bytes && T.hbm_bytes > 0.0) {
     for (int d = 0; d < D; ++d) {

@@ -36,6 +36,7 @@ def legal_axis_maps(op, mesh_shape: Dict[str, int],
     --enable-attribute-parallel for conv spatial dims, model.cc:2027 — minus
     the upstream bug where the latter sets the former)."""
     from flexflow_tpu.ffconst import OperatorType
+    from flexflow_tpu.parallel.pconfig import CONTRACT
 
     dims = list(op.partitionable_output_dims())
     out_shape = op.outputs[0].dims
@@ -49,6 +50,8 @@ def legal_axis_maps(op, mesh_shape: Dict[str, int],
     if not enable_attribute_parallel and op.op_type in (
             OperatorType.OP_CONV2D, OperatorType.OP_POOL2D):
         dims = [d for d in dims if d not in (2, 3)]
+    # CONTRACT (row-parallel) proposals, gated like parameter parallelism
+    csize = op.contract_size() if enable_parameter_parallel else None
     axes = [a for a in mesh_shape if mesh_shape[a] > 1]
     maps = [{}]
     for ax in axes:
@@ -63,6 +66,13 @@ def legal_axis_maps(op, mesh_shape: Dict[str, int],
                         deg *= mesh_shape[a2]
                 if d < len(out_shape) and out_shape[d] % deg == 0:
                     new_maps.append({**m, ax: d})
+            if csize is not None:
+                deg = size
+                for a2, d2 in m.items():
+                    if d2 == CONTRACT:
+                        deg *= mesh_shape[a2]
+                if csize % deg == 0:
+                    new_maps.append({**m, ax: CONTRACT})
         maps = new_maps
     return maps
 

@@ -32,10 +32,27 @@ def shard_shape(dims, axis_map, mesh_shape) -> Tuple[int, ...]:
     """Per-shard shape of a tensor partitioned by axis_map."""
     out = list(dims)
     for ax, d in (axis_map or {}).items():
-        if d is not None and d < len(out):
+        # negative sentinels (CONTRACT) do not shard the output shape
+        if d is not None and 0 <= d < len(out):
             deg = mesh_shape.get(ax, 1)
             out[d] = max(out[d] // deg, 1)
     return tuple(out)
+
+
+def choice_key(op_name: str, out_dims, axis_map,
+               mesh_shape: Dict[str, int]) -> Tuple:
+    """Cache key for one (op, sharding choice). The per-shard OUTPUT shape
+    alone cannot distinguish CONTRACT (row-parallel) from plain data
+    parallelism — contract axes shard the inputs and weights, not the
+    output — so the contract degree is appended when present."""
+    from flexflow_tpu.parallel.pconfig import CONTRACT
+
+    cdeg = 1
+    for ax, d in (axis_map or {}).items():
+        if d == CONTRACT:
+            cdeg *= mesh_shape.get(ax, 1)
+    key = (op_name, shard_shape(out_dims, axis_map, mesh_shape))
+    return key if cdeg == 1 else key + (("contract", cdeg),)
 
 
 def _op_signature(op: Op, in_shapes, w_shapes) -> Tuple:
@@ -167,13 +184,13 @@ def measure_op_costs(model, mesh_shape: Dict[str, int],
     for op in model.ops:
         if isinstance(op, InputOp):
             continue
-        seen_shapes = set()
+        seen_keys = set()
         for am in legal_axis_maps(op, mesh_shape, enable_parameter_parallel,
                                   enable_attribute_parallel):
-            out_s = shard_shape(op.outputs[0].dims, am, mesh_shape)
-            if out_s in seen_shapes:
+            key = choice_key(op.name, op.outputs[0].dims, am, mesh_shape)
+            if key in seen_keys:
                 continue
-            seen_shapes.add(out_s)
+            seen_keys.add(key)
             in_shapes = []
             for i, t in enumerate(op.inputs):
                 iam = op.input_axis_map(am, i)
@@ -199,10 +216,10 @@ def measure_op_costs(model, mesh_shape: Dict[str, int],
                 w_shapes.append(tuple(ws))
             dt = measure_one(op, in_shapes, w_shapes, iters=iters)
             if dt is not None:
-                measured[(op.name, out_s)] = dt
+                measured[key] = dt
                 n_timed += 1
                 if verbose:
-                    print(f"[measure] {op.name} shard{out_s}: "
+                    print(f"[measure] {op.name} {key[1:]}: "
                           f"{dt * 1e3:.3f} ms")
     if verbose:
         print(f"[measure] {n_timed} entries, "
@@ -260,13 +277,13 @@ def analyze_op_costs(model, mesh_shape: Dict[str, int],
     for op in model.ops:
         if isinstance(op, InputOp):
             continue
-        seen_shapes = set()
+        seen_keys = set()
         for am in legal_axis_maps(op, mesh_shape, enable_parameter_parallel,
                                   enable_attribute_parallel):
-            out_s = shard_shape(op.outputs[0].dims, am, mesh_shape)
-            if out_s in seen_shapes:
+            key = choice_key(op.name, op.outputs[0].dims, am, mesh_shape)
+            if key in seen_keys:
                 continue
-            seen_shapes.add(out_s)
+            seen_keys.add(key)
             in_shapes = []
             for i, t in enumerate(op.inputs):
                 iam = op.input_axis_map(am, i)
@@ -293,10 +310,9 @@ def analyze_op_costs(model, mesh_shape: Dict[str, int],
             fb = analyze_one(op, in_shapes, w_shapes)
             if fb is not None:
                 flops, nbytes = fb
-                table[(op.name, out_s)] = machine.compute_time(
-                    flops, nbytes, 4)
+                table[key] = machine.compute_time(flops, nbytes, 4)
                 if verbose:
-                    print(f"[analyze] {op.name} shard{out_s}: "
+                    print(f"[analyze] {op.name} {key[1:]}: "
                           f"{flops / 1e6:.2f} MF {nbytes / 1e6:.2f} MB "
-                          f"-> {table[(op.name, out_s)] * 1e6:.1f} us")
+                          f"-> {table[key] * 1e6:.1f} us")
     return table

@@ -42,6 +42,9 @@ python examples/native/mnist_mlp_attach.py -e 1 -b "$BATCH"
 python examples/native/split.py -e 1 -b "$BATCH"
 python examples/native/print_layers.py -b "$BATCH"
 python examples/native/nmt.py -b "$NDEV" --iters 2 --hidden 64 --vocab 500 --seq 10
+python examples/native/print_input.py
+python examples/native/tensor_attach.py -e 1 -b "$BATCH"
+python examples/native/cifar10_cnn_attach.py -e 1 -b "$BATCH"
 
 # keras frontend examples
 python examples/keras/mnist_mlp.py
@@ -57,11 +60,29 @@ python examples/keras/seq_reuters_mlp.py
 python examples/keras/reshape.py
 python examples/keras/unary.py
 
+# keras frontend examples (net2net / nested / concat / seq variants)
+python examples/keras/seq_mnist_mlp.py
+python examples/keras/seq_cifar10_cnn.py
+python examples/keras/func_cifar10_cnn.py
+python examples/keras/func_mnist_cnn_concat.py
+python examples/keras/func_mnist_mlp_concat2.py
+python examples/keras/func_mnist_mlp_net2net.py
+python examples/keras/seq_mnist_mlp_net2net.py
+python examples/keras/func_cifar10_cnn_net2net.py
+python examples/keras/seq_mnist_cnn_nested.py
+python examples/keras/func_cifar10_cnn_nested.py
+python examples/keras/func_cifar10_cnn_concat_model.py
+python examples/keras/func_cifar10_cnn_concat_seq_model.py
+python examples/keras/callback.py
+
 # importer frontends
 python examples/pytorch/mnist_mlp_fx.py -e 1 -b "$BATCH"
 python examples/pytorch/cnn_fx.py -e 1 -b "$BATCH"
 python examples/pytorch/resnet_fx.py -e 1 -b "$BATCH"
 python examples/pytorch/mlp_torch_compare.py
+python examples/pytorch/mnist_mlp_torch.py
+python examples/pytorch/cifar10_cnn_fx.py -e 1 -b "$BATCH"
+python examples/pytorch/torch_vision.py -e 1 -b "$BATCH"
 python examples/onnx/mnist_mlp_onnx.py -e 1 -b "$BATCH"
 
 # bootcamp demo

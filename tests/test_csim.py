@@ -128,7 +128,9 @@ def test_placement_search_beats_dp_on_branchy_graph():
     """Two fat parallel branches (InceptionV3-style): placing them on
     disjoint device blocks must simulate faster than running both
     full-mesh-serial, and the MCMC must find such a strategy (the SOAP 'O'
-    axis, reference config.h:47-69 + model.cc:496-525)."""
+    axis, reference config.h:47-69 + model.cc:496-525). Parameter parallel
+    is disabled — the reference's own default (model.cc:1935) — so sharding
+    the weights away is not an option and placement is the winning move."""
     mesh = {"data": 4, "model": 2}
     cfg = FFConfig(batch_size=64, mesh_shape=mesh)
     ff = FFModel(cfg)
@@ -141,11 +143,12 @@ def test_placement_search_beats_dp_on_branchy_graph():
     ff.dense(t, 16, name="head")
 
     cost = CostModel(ff, mesh)
-    prob = CompiledSearchProblem(ff, cost, mesh)
+    prob = CompiledSearchProblem(ff, cost, mesh, epp=False)
     dp = data_parallel_strategy(ff, mesh)
     dp_cost = prob.simulate(prob.choices_for(dp))
 
-    maps_a1 = legal_axis_maps(ff.get_op_by_name("branch_a1"), mesh)
+    maps_a1 = legal_axis_maps(ff.get_op_by_name("branch_a1"), mesh,
+                              enable_parameter_parallel=False)
     assert {"data": 0, "model": None} in maps_a1  # 4-way block is proposable
     best_c, best_p, best_cost = prob.mcmc(
         prob.choices_for(dp), 8000, 0.05, seed=1)

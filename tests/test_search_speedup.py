@@ -1,0 +1,44 @@
+"""North-star acceptance (BASELINE.md rebuild targets): the MCMC-discovered
+strategy must beat pure data parallelism by >=1.5x on the reference workload
+configs, simulated on a v5e-32 (4 hosts x 8 chips, two-tier ICI/DCN).
+
+The reference's own acceptance is the same experiment on its simulator: the
+search objective is simulated per-iteration runtime (model.cc:1687-1690),
+and the SysML'19 headline is the discovered-strategy speedup over DP. These
+tests run the full pipeline — graph build, cost tables, native C++ annealer,
+per-device timelines — at the reference's default configs (batch 64,
+model.cc:1917-1938; DLRM per run_summit.sh).
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts.northstar_search import run_one  # noqa: E402
+
+BUDGET = 60_000
+
+
+@pytest.mark.parametrize("workload,min_speedup", [
+    ("transformer", 1.5),
+    ("resnet50", 1.5),
+    ("inception", 1.5),
+    ("dlrm", 10.0),  # embedding-partitioned hybrid crushes DP (OOM + sync)
+])
+def test_search_beats_dp_on_reference_config(workload, min_speedup):
+    r = run_one(workload, BUDGET, seed=0, verbose=False)
+    assert r["speedup_vs_dp"] >= min_speedup, r
+    # the win must come from real strategy structure, not noise
+    assert r["ops_with_model_parallel_dims"] > 0 or \
+        r["ops_placed_off_block0"] > 0, r
+
+
+def test_large_batch_regime_is_honest():
+    """At 16 samples/chip the transformer is activation-dominated and DP is
+    near-optimal — the search must still never be WORSE than DP, and the
+    simulator should honestly show the win shrinking."""
+    r = run_one("transformer", 20_000, seed=0, verbose=False, batch=16 * 32)
+    assert 1.0 <= r["speedup_vs_dp"] < 1.5, r
