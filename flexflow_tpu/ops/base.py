@@ -127,6 +127,13 @@ class Op:
     # override this.
     _contracted_output_dims: Tuple[int, ...] = ()
 
+    def contract_input_dim(self, input_idx: int) -> Optional[int]:
+        """The input dim a CONTRACT axis shards for `input_idx` (e.g. the
+        last dim for Linear, the channel dim for Conv2D). None = CONTRACT
+        axes leave this input replicated. Only meaningful for ops whose
+        contract_size() is not None."""
+        return None
+
     def input_axis_map(self, axis_map: Dict[str, Optional[int]], input_idx: int
                        ) -> Dict[str, Optional[int]]:
         """Propagate the op's output axis_map to the sharding it implies for
@@ -134,13 +141,22 @@ class Op:
         reference model.cc:128-205). Default: same map truncated to input
         rank, with weight-contracted dims dropped (their axes need the input
         replicated — e.g. a column-parallel Linear all-gathers its input over
-        the 'model' axis; the cost model must see that)."""
+        the 'model' axis; the cost model must see that) and CONTRACT axes
+        mapped to contract_input_dim()."""
+        from flexflow_tpu.parallel.pconfig import CONTRACT
+
         ndims = self.inputs[input_idx].num_dims
         nd_out = self.outputs[0].num_dims
         contracted = {(d % nd_out) for d in self._contracted_output_dims}
-        return {ax: (d if d is not None and 0 <= d < ndims
-                     and d not in contracted else None)
-                for ax, d in axis_map.items()}
+        cdim = self.contract_input_dim(input_idx)
+        out = {}
+        for ax, d in axis_map.items():
+            if d == CONTRACT and cdim is not None:
+                out[ax] = cdim
+            else:
+                out[ax] = (d if d is not None and 0 <= d < ndims
+                           and d not in contracted else None)
+        return out
 
     # -- cost model ------------------------------------------------------------
 

@@ -82,12 +82,24 @@ class Conv2D(Op):
     def partitionable_output_dims(self):
         return [0, 1, 2, 3]  # sample, out-channel(param), H, W (attribute)
 
+    def contract_size(self):
+        # row-parallel conv: kernel sharded on its INPUT-channel dim, input
+        # sharded on C, output psum-replicated (the Megatron pair for CNNs:
+        # an out-channel-sharded producer feeds this with no resharding)
+        return self.in_channels if self.groups == 1 else None
+
     def weight_partition(self, axis_map):
+        from flexflow_tpu.parallel.pconfig import CONTRACT
+
         ax = self.axes_for_dim(axis_map, 1)
-        out = {"kernel": P(ax, None, None, None)}
+        cax = self.axes_for_dim(axis_map, CONTRACT)
+        out = {"kernel": P(ax, cax, None, None)}
         if self.use_bias:
             out["bias"] = P(ax)
         return out
+
+    def contract_input_dim(self, input_idx):
+        return 1  # input channel dim
 
     def flops(self):
         n, c, oh, ow = self.outputs[0].dims

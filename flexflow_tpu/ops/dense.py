@@ -101,15 +101,8 @@ class Linear(Op):
             out["bias"] = P(ax)
         return out
 
-    def input_axis_map(self, axis_map, input_idx):
-        from flexflow_tpu.parallel.pconfig import CONTRACT
-
-        base = super().input_axis_map(axis_map, input_idx)
-        d_in = self.inputs[input_idx].num_dims - 1
-        for ax, d in (axis_map or {}).items():
-            if d == CONTRACT:
-                base[ax] = d_in
-        return base
+    def contract_input_dim(self, input_idx):
+        return self.inputs[input_idx].num_dims - 1
 
     def flops(self):
         batch = int(np.prod(self.outputs[0].dims[:-1]))

@@ -205,17 +205,35 @@ class CompiledSearchProblem:
         return total, rows
 
     def mcmc(self, init_choices: np.ndarray, budget: int, alpha: float,
-             seed: int, init_places=None
+             seed: int, init_places=None, restarts: int = 1
              ) -> Tuple[np.ndarray, np.ndarray, float]:
+        """Run `restarts` independent annealing chains and keep the best.
+        The reference runs one chain with periodic reset-to-best
+        (model.cc:1673-1677); independent restarts cut the across-seed
+        variance that grows with the choice space. Chains run concurrently
+        (the C call releases the GIL), so K restarts cost ~1 chain of
+        wall-clock; chain seeds are spaced by a large stride so different
+        base seeds never share chains."""
+        from concurrent.futures import ThreadPoolExecutor
+
         lib = _load_lib()
-        best_c = np.zeros(len(self.ops), np.int32)
-        best_p = np.zeros(len(self.ops), np.int32)
-        best_cost = lib.ff_mcmc(
-            *self._table_args(),
-            np.ascontiguousarray(init_choices, np.int32),
-            self._places_arr(init_places), *self._machine_args(),
-            budget, alpha, seed, best_c, best_p)
-        return best_c, best_p, best_cost
+        init = np.ascontiguousarray(init_choices, np.int32)
+        places = self._places_arr(init_places)
+        K = max(1, restarts)
+
+        def chain(k):
+            c = np.zeros(len(self.ops), np.int32)
+            p = np.zeros(len(self.ops), np.int32)
+            cost = lib.ff_mcmc(
+                *self._table_args(), init, places, *self._machine_args(),
+                budget, alpha, seed * 0x9E3779B1 + k, c, p)
+            return c, p, cost
+
+        if K == 1:
+            return chain(0)
+        with ThreadPoolExecutor(max_workers=min(K, 8)) as ex:
+            results = list(ex.map(chain, range(K)))
+        return min(results, key=lambda r: r[2])
 
 
 def get_search_problem(model, cost, mesh_shape: Dict[str, int],
@@ -240,7 +258,8 @@ def get_search_problem(model, cost, mesh_shape: Dict[str, int],
 
 def native_optimize(model, cost, mesh_shape: Dict[str, int], budget: int,
                     alpha: float, seed: int,
-                    verbose: bool = False) -> Dict[str, ParallelConfig]:
+                    verbose: bool = False,
+                    restarts: int = 4) -> Dict[str, ParallelConfig]:
     from flexflow_tpu.search.driver import data_parallel_strategy
 
     cfg = getattr(model, "config", None)
@@ -249,7 +268,8 @@ def native_optimize(model, cost, mesh_shape: Dict[str, int], budget: int,
     prob = get_search_problem(model, cost, mesh_shape, epp, eap)
     init = prob.choices_for(data_parallel_strategy(model, mesh_shape))
     dp_cost = prob.simulate(init)
-    best_c, best_p, best_cost = prob.mcmc(init, budget, alpha, seed)
+    best_c, best_p, best_cost = prob.mcmc(init, budget, alpha, seed,
+                                          restarts=restarts)
     if verbose:
         print(f"[search/native] best {best_cost * 1e3:.3f} ms vs DP "
               f"{dp_cost * 1e3:.3f} ms "

@@ -119,7 +119,8 @@ def run_one(name: str, budget: int, seed: int = 0, verbose: bool = True,
     dp_cost = prob.simulate(dp_choices)
 
     t0 = time.time()
-    best_c, best_p, best_cost = prob.mcmc(dp_choices, budget, 0.05, seed)
+    best_c, best_p, best_cost = prob.mcmc(dp_choices, budget, 0.05, seed,
+                                          restarts=4)
     search_s = time.time() - t0
     speedup = dp_cost / max(best_cost, 1e-12)
 

@@ -154,6 +154,47 @@ def test_measured_table_distinguishes_contract_from_dp():
     assert cost2.op_compute_time(row, {"data": 0, "model": CONTRACT}) > base
 
 
+def test_conv_contract_matches_dp_numerics():
+    """Conv2D row-parallel pair (c1 out-channel-sharded -> c2 CONTRACT on
+    input channels) trains identically to DP."""
+    def build(strategies):
+        cfg = FFConfig(batch_size=8, mesh_shape=dict(MESH))
+        cfg.strategies = dict(strategies)
+        ff = FFModel(cfg)
+        from flexflow_tpu.ffconst import ActiMode as AM
+        x = ff.create_tensor([8, 8, 16, 16], name="x")
+        t = ff.conv2d(x, 16, 3, 3, 1, 1, 1, 1, AM.AC_MODE_RELU, name="c1")
+        t = ff.conv2d(t, 8, 3, 3, 1, 1, 1, 1, name="c2")
+        t = ff.flat(t)
+        ff.dense(t, 4, name="head")
+        ff.compile(SGDOptimizer(lr=0.05),
+                   LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                   [MetricsType.METRICS_ACCURACY])
+        return ff
+
+    meg = {
+        "c1": ParallelConfig.from_axis_map(4, MESH, {"data": 0, "model": 1}),
+        "c2": ParallelConfig.from_axis_map(
+            4, MESH, {"data": 0, "model": CONTRACT}),
+    }
+    rs = np.random.RandomState(0)
+    xd = rs.randn(16, 8, 16, 16).astype(np.float32)
+    yd = rs.randint(0, 4, (16, 1)).astype(np.int32)
+    out = {}
+    for name, s in (("dp", {}), ("meg", meg)):
+        ff = build(s)
+        SingleDataLoader(ff, ff.ops[0].outputs[0], xd)
+        SingleDataLoader(ff, ff.label_tensor, yd)
+        ls = []
+        for _ in range(3):
+            loss, _ = ff._run_train_step(ff._stage_batch())
+            ls.append(float(loss))
+        out[name] = ls
+    np.testing.assert_allclose(out["dp"], out["meg"], rtol=1e-4, atol=1e-5)
+    # kernel sharded on its input-channel dim
+    assert ff.params["c2"]["kernel"].sharding.spec[1] == "model"
+
+
 def test_contract_output_not_sharded():
     """CONTRACT axes never appear in the output PartitionSpec, and the
     per-shard output shape ignores them."""

@@ -124,13 +124,19 @@ def test_search_to_placement_execution_chain(tmp_path):
     training step executes under it."""
     from flexflow_tpu.search.cost_model import CostModel
     from flexflow_tpu.search.csim import native_optimize
+    from flexflow_tpu.search.machine import MachineModel
     from flexflow_tpu.parallel.strategy import (load_strategies_from_file,
                                                 save_strategies_to_file)
 
     cfg = FFConfig(batch_size=32, mesh_shape=MESH)
     ff, x = build_branchy(cfg)
 
-    cost = CostModel(ff, MESH)
+    # a tight per-chip HBM makes piling every op onto few devices pay the
+    # over-capacity penalty (reference simulator.cc:595-620), so the
+    # discovered optimum must spread ops across device blocks — the
+    # placement regime this test exists to cover end to end
+    machine = MachineModel(hbm_bytes=400e3)
+    cost = CostModel(ff, MESH, machine=machine)
     best = native_optimize(ff, cost, MESH, budget=6000, alpha=0.05, seed=1)
     assert set(best) == {"a1", "a2", "b1", "b2", "join", "head"}
     assert has_placement(best, 8), \
