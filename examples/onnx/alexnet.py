@@ -1,0 +1,77 @@
+"""AlexNet-CIFAR10 from an ONNX graph (reference:
+examples/python/onnx/alexnet.py), built with the in-repo minimal ONNX codec."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.onnx import ONNXModel
+from flexflow_tpu.onnx import minionnx as mo
+
+
+def export_alexnet(path, batch):
+    rs = np.random.RandomState(0)
+
+    def conv(name, cin, cout, k):
+        return mo.from_array(rs.randn(cout, cin, k, k).astype(np.float32), name)
+
+    inits = [conv("k1", 3, 64, 11), conv("k2", 64, 192, 5),
+             conv("k3", 192, 384, 3), conv("k4", 384, 256, 3),
+             conv("k5", 256, 256, 3),
+             mo.from_array(rs.randn(10, 256).astype(np.float32), "wfc")]
+    nodes = [
+        mo.make_node("Conv", ["input", "k1"], ["c1"], kernel_shape=[11, 11],
+                     strides=[4, 4], pads=[2, 2, 2, 2]),
+        mo.make_node("Relu", ["c1"], ["r1"]),
+        mo.make_node("MaxPool", ["r1"], ["p1"], kernel_shape=[2, 2],
+                     strides=[2, 2], pads=[0, 0, 0, 0]),
+        mo.make_node("Conv", ["p1", "k2"], ["c2"], kernel_shape=[5, 5],
+                     strides=[1, 1], pads=[2, 2, 2, 2]),
+        mo.make_node("Relu", ["c2"], ["r2"]),
+        mo.make_node("MaxPool", ["r2"], ["p2"], kernel_shape=[2, 2],
+                     strides=[2, 2], pads=[0, 0, 0, 0]),
+        mo.make_node("Conv", ["p2", "k3"], ["c3"], kernel_shape=[3, 3],
+                     strides=[1, 1], pads=[1, 1, 1, 1]),
+        mo.make_node("Relu", ["c3"], ["r3"]),
+        mo.make_node("Conv", ["r3", "k4"], ["c4"], kernel_shape=[3, 3],
+                     strides=[1, 1], pads=[1, 1, 1, 1]),
+        mo.make_node("Relu", ["c4"], ["r4"]),
+        mo.make_node("Conv", ["r4", "k5"], ["c5"], kernel_shape=[3, 3],
+                     strides=[1, 1], pads=[1, 1, 1, 1]),
+        mo.make_node("Relu", ["c5"], ["r5"]),
+        mo.make_node("GlobalAveragePool", ["r5"], ["g"]),
+        mo.make_node("Flatten", ["g"], ["f"]),
+        mo.make_node("Gemm", ["f", "wfc"], ["logits"], name="fc"),
+    ]
+    g = mo.make_graph(
+        nodes, "alexnet",
+        [mo.make_tensor_value_info("input", mo.DT_FLOAT, [batch, 3, 224, 224])],
+        [mo.make_tensor_value_info("logits", mo.DT_FLOAT, [batch, 10])],
+        initializer=inits)
+    mo.save(mo.make_model(g), path)
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    cfg.batch_size = min(cfg.batch_size, 16)
+    path = "/tmp/alexnet_mini.onnx"
+    export_alexnet(path, cfg.batch_size)
+
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 3, 224, 224], name="input")
+    out = ONNXModel(path).apply(ff, {"input": x})
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=out)
+
+    rs = np.random.RandomState(0)
+    n = cfg.batch_size * 2
+    SingleDataLoader(ff, x, rs.randn(n, 3, 224, 224).astype(np.float32))
+    SingleDataLoader(ff, ff.label_tensor,
+                     rs.randint(0, 10, (n, 1)).astype(np.int32))
+    ff.fit(epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""CIFAR10 CNN from an ONNX graph (reference:
+examples/python/onnx/cifar10_cnn.py), built with the in-repo minimal ONNX
+codec — runs without the onnx package."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.onnx import ONNXModel
+from flexflow_tpu.onnx import minionnx as mo
+
+
+def export_cnn(path, batch):
+    rs = np.random.RandomState(0)
+    k1 = mo.from_array(rs.randn(32, 3, 3, 3).astype(np.float32), "k1")
+    k2 = mo.from_array(rs.randn(64, 32, 3, 3).astype(np.float32), "k2")
+    wd1 = mo.from_array(rs.randn(256, 64 * 16 * 16).astype(np.float32), "wd1")
+    wd2 = mo.from_array(rs.randn(10, 256).astype(np.float32), "wd2")
+    nodes = [
+        mo.make_node("Conv", ["input", "k1"], ["c1"], name="conv1",
+                     kernel_shape=[3, 3], strides=[1, 1], pads=[1, 1, 1, 1]),
+        mo.make_node("Relu", ["c1"], ["r1"]),
+        mo.make_node("Conv", ["r1", "k2"], ["c2"], name="conv2",
+                     kernel_shape=[3, 3], strides=[1, 1], pads=[1, 1, 1, 1]),
+        mo.make_node("Relu", ["c2"], ["r2"]),
+        mo.make_node("MaxPool", ["r2"], ["p1"], kernel_shape=[2, 2],
+                     strides=[2, 2], pads=[0, 0, 0, 0]),
+        mo.make_node("Flatten", ["p1"], ["f"]),
+        mo.make_node("Gemm", ["f", "wd1"], ["h"], name="fc1"),
+        mo.make_node("Relu", ["h"], ["hr"]),
+        mo.make_node("Gemm", ["hr", "wd2"], ["logits"], name="fc2"),
+    ]
+    g = mo.make_graph(
+        nodes, "cifar10_cnn",
+        [mo.make_tensor_value_info("input", mo.DT_FLOAT, [batch, 3, 32, 32])],
+        [mo.make_tensor_value_info("logits", mo.DT_FLOAT, [batch, 10])],
+        initializer=[k1, k2, wd1, wd2])
+    mo.save(mo.make_model(g), path)
+
+
+def main():
+    from flexflow_tpu.keras.datasets import cifar10
+    cfg = FFConfig.parse_args()
+    path = "/tmp/cifar10_cnn_mini.onnx"
+    export_cnn(path, cfg.batch_size)
+
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 3, 32, 32], name="input")
+    out = ONNXModel(path).apply(ff, {"input": x})
+    ff.compile(SGDOptimizer(lr=0.02),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=out)
+
+    (x_train, y_train), _ = cifar10.load_data()
+    SingleDataLoader(ff, x, x_train.astype(np.float32) / 255.0)
+    SingleDataLoader(ff, ff.label_tensor,
+                     y_train.reshape(-1, 1).astype(np.int32))
+    ff.fit(epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

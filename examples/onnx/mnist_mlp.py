@@ -1,0 +1,56 @@
+"""MNIST MLP from an ONNX graph (reference:
+examples/python/onnx/mnist_mlp.py). The graph is built and serialized with
+the in-repo minimal ONNX codec (flexflow_tpu/onnx/minionnx.py), so this runs
+without the onnx package."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.onnx import ONNXModel
+from flexflow_tpu.onnx import minionnx as mo
+
+
+def export_mlp(path):
+    rs = np.random.RandomState(0)
+    w1 = mo.from_array(rs.randn(512, 784).astype(np.float32), "w1")
+    w2 = mo.from_array(rs.randn(512, 512).astype(np.float32), "w2")
+    w3 = mo.from_array(rs.randn(10, 512).astype(np.float32), "w3")
+    nodes = [
+        mo.make_node("Gemm", ["input", "w1"], ["h1"], name="fc1"),
+        mo.make_node("Relu", ["h1"], ["a1"]),
+        mo.make_node("Gemm", ["a1", "w2"], ["h2"], name="fc2"),
+        mo.make_node("Relu", ["h2"], ["a2"]),
+        mo.make_node("Gemm", ["a2", "w3"], ["logits"], name="fc3"),
+    ]
+    g = mo.make_graph(
+        nodes, "mnist_mlp",
+        [mo.make_tensor_value_info("input", mo.DT_FLOAT, [64, 784])],
+        [mo.make_tensor_value_info("logits", mo.DT_FLOAT, [64, 10])],
+        initializer=[w1, w2, w3])
+    mo.save(mo.make_model(g), path)
+
+
+def main():
+    from flexflow_tpu.keras.datasets import mnist
+    path = "/tmp/mnist_mlp_mini.onnx"
+    export_mlp(path)
+
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 784], name="input")
+    out = ONNXModel(path).apply(ff, {"input": x})
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=out)
+
+    (x_train, y_train), _ = mnist.load_data()
+    SingleDataLoader(ff, x, x_train.reshape(-1, 784).astype(np.float32) / 255.0)
+    SingleDataLoader(ff, ff.label_tensor,
+                     y_train.astype(np.int32).reshape(-1, 1))
+    ff.fit(epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

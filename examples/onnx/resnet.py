@@ -1,0 +1,74 @@
+"""Small residual network from an ONNX graph with BatchNormalization + Add
+skip connections (reference: examples/python/onnx/resnet.py), built with the
+in-repo minimal ONNX codec."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.onnx import ONNXModel
+from flexflow_tpu.onnx import minionnx as mo
+
+
+def export_resnet(path, batch):
+    rs = np.random.RandomState(0)
+    C = 32
+    inits = [mo.from_array(rs.randn(C, 3, 3, 3).astype(np.float32), "k0")]
+    nodes = [
+        mo.make_node("Conv", ["input", "k0"], ["s0"], kernel_shape=[3, 3],
+                     strides=[1, 1], pads=[1, 1, 1, 1]),
+        mo.make_node("Relu", ["s0"], ["t0"]),
+    ]
+    prev = "t0"
+    for i in range(2):  # two residual blocks
+        ka, kb = f"ka{i}", f"kb{i}"
+        inits += [mo.from_array(rs.randn(C, C, 3, 3).astype(np.float32), ka),
+                  mo.from_array(rs.randn(C, C, 3, 3).astype(np.float32), kb)]
+        nodes += [
+            mo.make_node("Conv", [prev, ka], [f"a{i}"], kernel_shape=[3, 3],
+                         strides=[1, 1], pads=[1, 1, 1, 1]),
+            mo.make_node("BatchNormalization", [f"a{i}"], [f"bn{i}"]),
+            mo.make_node("Relu", [f"bn{i}"], [f"ar{i}"]),
+            mo.make_node("Conv", [f"ar{i}", kb], [f"b{i}"], kernel_shape=[3, 3],
+                         strides=[1, 1], pads=[1, 1, 1, 1]),
+            mo.make_node("Add", [f"b{i}", prev], [f"res{i}"]),
+            mo.make_node("Relu", [f"res{i}"], [f"t{i + 1}"]),
+        ]
+        prev = f"t{i + 1}"
+    inits.append(mo.from_array(rs.randn(10, C).astype(np.float32), "wfc"))
+    nodes += [
+        mo.make_node("GlobalAveragePool", [prev], ["g"]),
+        mo.make_node("Flatten", ["g"], ["f"]),
+        mo.make_node("Gemm", ["f", "wfc"], ["logits"], name="fc"),
+    ]
+    g = mo.make_graph(
+        nodes, "mini_resnet",
+        [mo.make_tensor_value_info("input", mo.DT_FLOAT, [batch, 3, 32, 32])],
+        [mo.make_tensor_value_info("logits", mo.DT_FLOAT, [batch, 10])],
+        initializer=inits)
+    mo.save(mo.make_model(g), path)
+
+
+def main():
+    from flexflow_tpu.keras.datasets import cifar10
+    cfg = FFConfig.parse_args()
+    path = "/tmp/resnet_mini.onnx"
+    export_resnet(path, cfg.batch_size)
+
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 3, 32, 32], name="input")
+    out = ONNXModel(path).apply(ff, {"input": x})
+    ff.compile(SGDOptimizer(lr=0.02),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=out)
+
+    (x_train, y_train), _ = cifar10.load_data()
+    SingleDataLoader(ff, x, x_train.astype(np.float32) / 255.0)
+    SingleDataLoader(ff, ff.label_tensor,
+                     y_train.reshape(-1, 1).astype(np.int32))
+    ff.fit(epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

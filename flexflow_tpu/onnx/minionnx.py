@@ -1,0 +1,470 @@
+"""Minimal self-contained ONNX protobuf codec.
+
+The `onnx` pip package is not bundled in this environment, but the ONNX wire
+format is plain protobuf with a small, frozen schema (the field numbers below
+are fixed by the public onnx.proto3 spec). This module implements just enough
+of it — ModelProto / GraphProto / NodeProto / AttributeProto / TensorProto /
+ValueInfoProto — to (a) parse real .onnx files produced elsewhere and
+(b) construct + serialize models offline, so the ONNX frontend
+(flexflow_tpu/onnx/model.py, reference python/flexflow/onnx/model.py) and its
+examples run without the package. Objects are duck-type compatible with the
+subset of the onnx package API the importer uses (`model.graph.node`,
+`node.attribute`, `tensor.dims`, ...), plus `helper`-style constructors
+(make_node / make_tensor / make_graph / make_model) and numpy conversion
+(to_array / from_array).
+
+No code here derives from the onnx project; it is a from-scratch protobuf
+reader/writer for the documented message layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+# ---- AttributeProto.AttributeType / TensorProto.DataType enums (spec) ------
+FLOAT, INT, STRING, TENSOR, FLOATS, INTS, STRINGS = 1, 2, 3, 4, 6, 7, 8
+DT_FLOAT, DT_INT32, DT_INT64 = 1, 6, 7
+
+_NP_TO_DT = {np.dtype(np.float32): DT_FLOAT, np.dtype(np.int32): DT_INT32,
+             np.dtype(np.int64): DT_INT64}
+_DT_TO_NP = {v: k for k, v in _NP_TO_DT.items()}
+
+
+# ---- message objects --------------------------------------------------------
+
+@dataclasses.dataclass
+class AttributeProto:
+    name: str = ""
+    type: int = 0
+    f: float = 0.0
+    i: int = 0
+    s: bytes = b""
+    t: Optional["TensorProto"] = None
+    floats: List[float] = dataclasses.field(default_factory=list)
+    ints: List[int] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class TensorProto:
+    name: str = ""
+    dims: List[int] = dataclasses.field(default_factory=list)
+    data_type: int = DT_FLOAT
+    raw_data: bytes = b""
+    float_data: List[float] = dataclasses.field(default_factory=list)
+    int32_data: List[int] = dataclasses.field(default_factory=list)
+    int64_data: List[int] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class _TensorTypeProto:
+    elem_type: int = DT_FLOAT
+    shape_dims: List[int] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ValueInfoProto:
+    name: str = ""
+    type: _TensorTypeProto = dataclasses.field(default_factory=_TensorTypeProto)
+
+
+@dataclasses.dataclass
+class NodeProto:
+    op_type: str = ""
+    name: str = ""
+    input: List[str] = dataclasses.field(default_factory=list)
+    output: List[str] = dataclasses.field(default_factory=list)
+    attribute: List[AttributeProto] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class GraphProto:
+    name: str = ""
+    node: List[NodeProto] = dataclasses.field(default_factory=list)
+    initializer: List[TensorProto] = dataclasses.field(default_factory=list)
+    input: List[ValueInfoProto] = dataclasses.field(default_factory=list)
+    output: List[ValueInfoProto] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ModelProto:
+    ir_version: int = 8
+    producer_name: str = "flexflow_tpu.minionnx"
+    opset_version: int = 13
+    graph: GraphProto = dataclasses.field(default_factory=GraphProto)
+
+
+# ---- protobuf wire primitives ----------------------------------------------
+
+def _w_varint(out: bytearray, v: int) -> None:
+    v &= (1 << 64) - 1
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _w_tag(out: bytearray, field: int, wire: int) -> None:
+    _w_varint(out, (field << 3) | wire)
+
+
+def _w_len(out: bytearray, field: int, payload: bytes) -> None:
+    _w_tag(out, field, 2)
+    _w_varint(out, len(payload))
+    out.extend(payload)
+
+
+def _w_str(out: bytearray, field: int, s) -> None:
+    _w_len(out, field, s if isinstance(s, bytes) else s.encode())
+
+
+def _w_int(out: bytearray, field: int, v: int) -> None:
+    _w_tag(out, field, 0)
+    _w_varint(out, v)
+
+
+def _w_f32(out: bytearray, field: int, v: float) -> None:
+    _w_tag(out, field, 5)
+    out.extend(struct.pack("<f", v))
+
+
+def _r_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    shift = result = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _scan(buf: bytes) -> Dict[int, List[Tuple[int, object]]]:
+    """Parse one message's fields into {field_num: [(wire, value), ...]}."""
+    fields: Dict[int, List[Tuple[int, object]]] = {}
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _r_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            v, pos = _r_varint(buf, pos)
+        elif wire == 2:
+            n, pos = _r_varint(buf, pos)
+            v = buf[pos:pos + n]
+            pos += n
+        elif wire == 5:
+            v = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        elif wire == 1:
+            v = struct.unpack("<d", buf[pos:pos + 8])[0]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        fields.setdefault(field, []).append((wire, v))
+    return fields
+
+
+def _ints_of(fields, num) -> List[int]:
+    """A repeated int64 field: packed (one length-delimited blob) or not."""
+    out: List[int] = []
+    for wire, v in fields.get(num, []):
+        if wire == 0:
+            out.append(_signed64(v))
+        else:  # packed
+            pos = 0
+            while pos < len(v):
+                x, pos = _r_varint(v, pos)
+                out.append(_signed64(x))
+    return out
+
+
+def _signed64(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _floats_of(fields, num) -> List[float]:
+    out: List[float] = []
+    for wire, v in fields.get(num, []):
+        if wire == 5:
+            out.append(v)
+        else:  # packed f32
+            out.extend(struct.unpack(f"<{len(v) // 4}f", v))
+    return out
+
+
+def _str_of(fields, num, default="") -> str:
+    vals = fields.get(num)
+    if not vals:
+        return default
+    v = vals[-1][1]
+    return v.decode() if isinstance(v, (bytes, bytearray)) else str(v)
+
+
+def _int_of(fields, num, default=0) -> int:
+    vals = fields.get(num)
+    return _signed64(vals[-1][1]) if vals else default
+
+
+# ---- per-message encode -----------------------------------------------------
+
+def _enc_tensor(t: TensorProto) -> bytes:
+    out = bytearray()
+    for d in t.dims:
+        _w_int(out, 1, d)
+    _w_int(out, 2, t.data_type)
+    for v in t.float_data:
+        _w_f32(out, 4, v)
+    for v in t.int64_data:
+        _w_int(out, 7, v)
+    if t.name:
+        _w_str(out, 8, t.name)
+    if t.raw_data:
+        _w_len(out, 9, t.raw_data)
+    return bytes(out)
+
+
+def _enc_attr(a: AttributeProto) -> bytes:
+    out = bytearray()
+    _w_str(out, 1, a.name)
+    if a.type == FLOAT:
+        _w_f32(out, 2, a.f)
+    elif a.type == INT:
+        _w_int(out, 3, a.i)
+    elif a.type == STRING:
+        _w_str(out, 4, a.s)
+    elif a.type == TENSOR and a.t is not None:
+        _w_len(out, 5, _enc_tensor(a.t))
+    elif a.type == FLOATS:
+        for v in a.floats:
+            _w_f32(out, 7, v)
+    elif a.type == INTS:
+        for v in a.ints:
+            _w_int(out, 8, v)
+    _w_int(out, 20, a.type)
+    return bytes(out)
+
+
+def _enc_node(n: NodeProto) -> bytes:
+    out = bytearray()
+    for s in n.input:
+        _w_str(out, 1, s)
+    for s in n.output:
+        _w_str(out, 2, s)
+    if n.name:
+        _w_str(out, 3, n.name)
+    _w_str(out, 4, n.op_type)
+    for a in n.attribute:
+        _w_len(out, 5, _enc_attr(a))
+    return bytes(out)
+
+
+def _enc_value_info(vi: ValueInfoProto) -> bytes:
+    shape = bytearray()
+    for d in vi.type.shape_dims:
+        dim = bytearray()
+        _w_int(dim, 1, d)  # Dimension.dim_value
+        _w_len(shape, 1, bytes(dim))  # TensorShapeProto.dim
+    tt = bytearray()
+    _w_int(tt, 1, vi.type.elem_type)  # Tensor.elem_type
+    _w_len(tt, 2, bytes(shape))  # Tensor.shape
+    tp = bytearray()
+    _w_len(tp, 1, bytes(tt))  # TypeProto.tensor_type
+    out = bytearray()
+    _w_str(out, 1, vi.name)
+    _w_len(out, 2, bytes(tp))
+    return bytes(out)
+
+
+def _enc_graph(g: GraphProto) -> bytes:
+    out = bytearray()
+    for n in g.node:
+        _w_len(out, 1, _enc_node(n))
+    if g.name:
+        _w_str(out, 2, g.name)
+    for t in g.initializer:
+        _w_len(out, 5, _enc_tensor(t))
+    for vi in g.input:
+        _w_len(out, 11, _enc_value_info(vi))
+    for vi in g.output:
+        _w_len(out, 12, _enc_value_info(vi))
+    return bytes(out)
+
+
+def serialize(m: ModelProto) -> bytes:
+    out = bytearray()
+    _w_int(out, 1, m.ir_version)
+    _w_str(out, 2, m.producer_name)
+    _w_len(out, 7, _enc_graph(m.graph))
+    opset = bytearray()
+    _w_str(opset, 1, "")  # default domain
+    _w_int(opset, 2, m.opset_version)
+    _w_len(out, 8, bytes(opset))
+    return bytes(out)
+
+
+# ---- per-message decode -----------------------------------------------------
+
+def _dec_tensor(buf: bytes) -> TensorProto:
+    f = _scan(buf)
+    return TensorProto(
+        name=_str_of(f, 8),
+        dims=_ints_of(f, 1),
+        data_type=_int_of(f, 2, DT_FLOAT),
+        raw_data=bytes(f[9][-1][1]) if 9 in f else b"",
+        float_data=_floats_of(f, 4),
+        int32_data=_ints_of(f, 5),
+        int64_data=_ints_of(f, 7),
+    )
+
+
+def _dec_attr(buf: bytes) -> AttributeProto:
+    f = _scan(buf)
+    a = AttributeProto(name=_str_of(f, 1), type=_int_of(f, 20))
+    if 2 in f:
+        a.f = float(f[2][-1][1])
+        a.type = a.type or FLOAT
+    if 3 in f:
+        a.i = _int_of(f, 3)
+        a.type = a.type or INT
+    if 4 in f:
+        a.s = bytes(f[4][-1][1])
+        a.type = a.type or STRING
+    if 5 in f:
+        a.t = _dec_tensor(f[5][-1][1])
+        a.type = a.type or TENSOR
+    if 7 in f:
+        a.floats = _floats_of(f, 7)
+        a.type = a.type or FLOATS
+    if 8 in f:
+        a.ints = _ints_of(f, 8)
+        a.type = a.type or INTS
+    return a
+
+
+def _dec_node(buf: bytes) -> NodeProto:
+    f = _scan(buf)
+    return NodeProto(
+        op_type=_str_of(f, 4),
+        name=_str_of(f, 3),
+        input=[v.decode() for _, v in f.get(1, [])],
+        output=[v.decode() for _, v in f.get(2, [])],
+        attribute=[_dec_attr(v) for _, v in f.get(5, [])],
+    )
+
+
+def _dec_value_info(buf: bytes) -> ValueInfoProto:
+    f = _scan(buf)
+    vi = ValueInfoProto(name=_str_of(f, 1))
+    if 2 in f:
+        tf = _scan(f[2][-1][1])
+        if 1 in tf:  # tensor_type
+            tt = _scan(tf[1][-1][1])
+            vi.type.elem_type = _int_of(tt, 1, DT_FLOAT)
+            if 2 in tt:  # shape
+                sh = _scan(tt[2][-1][1])
+                for _, dimbuf in sh.get(1, []):
+                    df = _scan(dimbuf)
+                    vi.type.shape_dims.append(_int_of(df, 1, 0))
+    return vi
+
+
+def _dec_graph(buf: bytes) -> GraphProto:
+    f = _scan(buf)
+    return GraphProto(
+        name=_str_of(f, 2),
+        node=[_dec_node(v) for _, v in f.get(1, [])],
+        initializer=[_dec_tensor(v) for _, v in f.get(5, [])],
+        input=[_dec_value_info(v) for _, v in f.get(11, [])],
+        output=[_dec_value_info(v) for _, v in f.get(12, [])],
+    )
+
+
+def parse(buf: bytes) -> ModelProto:
+    f = _scan(buf)
+    m = ModelProto(ir_version=_int_of(f, 1, 8), producer_name=_str_of(f, 2))
+    if 7 in f:
+        m.graph = _dec_graph(f[7][-1][1])
+    return m
+
+
+def load(path: str) -> ModelProto:
+    with open(path, "rb") as fh:
+        return parse(fh.read())
+
+
+def save(model: ModelProto, path: str) -> None:
+    with open(path, "wb") as fh:
+        fh.write(serialize(model))
+
+
+# ---- helper constructors (onnx.helper-style surface) ------------------------
+
+def make_tensor_value_info(name: str, elem_type: int,
+                           shape) -> ValueInfoProto:
+    return ValueInfoProto(name=name, type=_TensorTypeProto(
+        elem_type=elem_type, shape_dims=[int(d) for d in shape]))
+
+
+def make_node(op_type: str, inputs, outputs, name: str = "",
+              **attrs) -> NodeProto:
+    alist = []
+    for k, v in attrs.items():
+        if isinstance(v, float):
+            alist.append(AttributeProto(name=k, type=FLOAT, f=v))
+        elif isinstance(v, bool) or isinstance(v, int):
+            alist.append(AttributeProto(name=k, type=INT, i=int(v)))
+        elif isinstance(v, str):
+            alist.append(AttributeProto(name=k, type=STRING, s=v.encode()))
+        elif isinstance(v, TensorProto):
+            alist.append(AttributeProto(name=k, type=TENSOR, t=v))
+        elif isinstance(v, (list, tuple)) and v and isinstance(v[0], float):
+            alist.append(AttributeProto(name=k, type=FLOATS,
+                                        floats=[float(x) for x in v]))
+        elif isinstance(v, (list, tuple)):
+            alist.append(AttributeProto(name=k, type=INTS,
+                                        ints=[int(x) for x in v]))
+        else:
+            raise TypeError(f"unsupported attribute {k}={v!r}")
+    return NodeProto(op_type=op_type, name=name, input=list(inputs),
+                     output=list(outputs), attribute=alist)
+
+
+def from_array(arr: np.ndarray, name: str = "") -> TensorProto:
+    arr = np.asarray(arr)
+    dt = _NP_TO_DT.get(arr.dtype)
+    if dt is None:
+        raise TypeError(f"unsupported dtype {arr.dtype}")
+    return TensorProto(name=name, dims=list(arr.shape), data_type=dt,
+                       raw_data=arr.tobytes())
+
+
+def to_array(t: TensorProto) -> np.ndarray:
+    np_dt = _DT_TO_NP.get(t.data_type)
+    if np_dt is None:
+        raise TypeError(
+            f"tensor {t.name!r}: unsupported ONNX data_type {t.data_type} "
+            f"(supported: float32/int32/int64)")
+    if t.raw_data:
+        return np.frombuffer(t.raw_data, dtype=np_dt).reshape(t.dims)
+    if t.float_data:
+        return np.asarray(t.float_data, np.float32).reshape(t.dims)
+    if t.int32_data:
+        return np.asarray(t.int32_data, np.int32).reshape(t.dims)
+    return np.asarray(t.int64_data, np.int64).reshape(t.dims)
+
+
+def make_graph(nodes, name, inputs, outputs,
+               initializer=()) -> GraphProto:
+    return GraphProto(name=name, node=list(nodes), input=list(inputs),
+                      output=list(outputs), initializer=list(initializer))
+
+
+def make_model(graph: GraphProto, opset_version: int = 13) -> ModelProto:
+    return ModelProto(graph=graph, opset_version=opset_version)

@@ -36,12 +36,13 @@ class ONNXModel:
         if isinstance(filename, str):
             try:
                 import onnx
-            except ImportError as e:
-                raise ImportError(
-                    "the 'onnx' package is required to load .onnx files; "
-                    "install it or pass a ModelProto-like object directly"
-                ) from e
-            self.model = onnx.load(filename)
+                self.model = onnx.load(filename)
+            except ImportError:
+                # the in-repo minimal codec parses the same wire format, so
+                # .onnx files load without the package (minionnx.py)
+                from flexflow_tpu.onnx import minionnx
+
+                self.model = minionnx.load(filename)
         else:
             self.model = filename  # ModelProto (or any duck-typed equivalent)
         self.symbol_table: Dict[str, object] = {}
@@ -165,9 +166,14 @@ class ONNXModel:
         shape_t = self.initializer.get(node.input[1])
         if shape_t is None:
             return self.symbol_table[node.input[0]]
-        import onnx.numpy_helper as nph
+        from flexflow_tpu.onnx import minionnx
 
-        shape = [int(v) for v in nph.to_array(shape_t)]
+        if isinstance(shape_t, minionnx.TensorProto):
+            to_array = minionnx.to_array  # minionnx-built model object
+        else:
+            import onnx.numpy_helper as nph
+            to_array = nph.to_array
+        shape = [int(v) for v in to_array(shape_t)]
         return ff.reshape(self.symbol_table[node.input[0]], shape,
                           name=node.name or None)
 

@@ -84,6 +84,10 @@ python examples/pytorch/mnist_mlp_torch.py
 python examples/pytorch/cifar10_cnn_fx.py -e 1 -b "$BATCH"
 python examples/pytorch/torch_vision.py -e 1 -b "$BATCH"
 python examples/onnx/mnist_mlp_onnx.py -e 1 -b "$BATCH"
+python examples/onnx/mnist_mlp.py -e 1 -b "$BATCH"
+python examples/onnx/cifar10_cnn.py -e 1 -b "$BATCH"
+python examples/onnx/alexnet.py -e 1 -b 16
+python examples/onnx/resnet.py -e 1 -b "$BATCH"
 
 # bootcamp demo
 python bootcamp_demo/native_alexnet.py -e 1 -b "$BATCH"
