@@ -221,6 +221,8 @@ def _enc_tensor(t: TensorProto) -> bytes:
     _w_int(out, 2, t.data_type)
     for v in t.float_data:
         _w_f32(out, 4, v)
+    for v in t.int32_data:
+        _w_int(out, 5, v)
     for v in t.int64_data:
         _w_int(out, 7, v)
     if t.name:
@@ -424,12 +426,17 @@ def make_node(op_type: str, inputs, outputs, name: str = "",
             alist.append(AttributeProto(name=k, type=STRING, s=v.encode()))
         elif isinstance(v, TensorProto):
             alist.append(AttributeProto(name=k, type=TENSOR, t=v))
-        elif isinstance(v, (list, tuple)) and v and isinstance(v[0], float):
-            alist.append(AttributeProto(name=k, type=FLOATS,
-                                        floats=[float(x) for x in v]))
         elif isinstance(v, (list, tuple)):
-            alist.append(AttributeProto(name=k, type=INTS,
-                                        ints=[int(x) for x in v]))
+            if all(isinstance(x, int) and not isinstance(x, bool) for x in v):
+                alist.append(AttributeProto(name=k, type=INTS,
+                                            ints=[int(x) for x in v]))
+            elif all(isinstance(x, (int, float)) for x in v):
+                alist.append(AttributeProto(name=k, type=FLOATS,
+                                            floats=[float(x) for x in v]))
+            else:
+                raise TypeError(
+                    f"attribute {k}: list must be all ints or all numeric, "
+                    f"got {v!r}")
         else:
             raise TypeError(f"unsupported attribute {k}={v!r}")
     return NodeProto(op_type=op_type, name=name, input=list(inputs),

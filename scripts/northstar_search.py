@@ -105,12 +105,27 @@ def build_workload(name: str, batch: Optional[int] = None):
 
 
 def run_one(name: str, budget: int, seed: int = 0, verbose: bool = True,
-            batch: Optional[int] = None):
+            batch: Optional[int] = None, costs: str = "analytic"):
     ff, mesh = build_workload(name, batch)
     machine = v5e32_machine()
+    measured = None
+    if costs == "analyze":
+        # compile-only XLA cost analysis per shard signature on the attached
+        # device (the middle fidelity tier, SURVEY §7 hard part 1)
+        from flexflow_tpu.search.measure import analyze_op_costs
+
+        measured = analyze_op_costs(ff, mesh, machine=machine)
+    elif costs == "measure":
+        # real per-shard fwd+bwd timings on the attached chip — the
+        # reference's design: measure on device 0, simulate the cluster
+        # (simulator.cc:296-316)
+        from flexflow_tpu.search.measure import measure_op_costs
+
+        measured = measure_op_costs(ff, mesh)
     # dtype_bytes=2: the flagship trains bf16 on the MXU (bench.py config),
     # so strategies are priced at bf16 compute + bf16 activations
-    cost = CostModel(ff, mesh, machine=machine, dtype_bytes=2)
+    cost = CostModel(ff, mesh, machine=machine, dtype_bytes=2,
+                     measured=measured)
     t0 = time.time()
     prob = get_search_problem(ff, cost, mesh)
     build_s = time.time() - t0
@@ -135,6 +150,7 @@ def run_one(name: str, budget: int, seed: int = 0, verbose: bool = True,
 
     result = {
         "workload": name,
+        "costs": costs,
         "global_batch": ff.config.batch_size,
         "machine": "simulated v5e-32 (4 hosts x 8 chips, ICI+DCN)",
         "num_ops": len(prob.ops),
@@ -163,16 +179,22 @@ def main():
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--batch", type=int, default=None,
                     help="override global batch (default: reference configs)")
+    ap.add_argument("--costs", default="analytic",
+                    choices=["analytic", "analyze", "measure"],
+                    help="per-op cost tier: analytic roofline, compile-only "
+                         "XLA cost analysis, or real-device timing")
     ap.add_argument("--large-batch", action="store_true",
                     help="also run the 16-samples/chip large-batch regime")
     args = ap.parse_args()
 
     names = (["transformer", "resnet50", "inception", "dlrm"]
              if args.workload == "all" else [args.workload])
-    results = [run_one(n, args.budget, args.seed, batch=args.batch)
+    results = [run_one(n, args.budget, args.seed, batch=args.batch,
+                       costs=args.costs)
                for n in names]
     if args.large_batch:
-        results += [run_one(n, args.budget, args.seed, batch=16 * 32)
+        results += [run_one(n, args.budget, args.seed, batch=16 * 32,
+                            costs=args.costs)
                     for n in names if n != "dlrm"]
     print("\n== north-star summary (simulated v5e-32) ==")
     for r in results:
