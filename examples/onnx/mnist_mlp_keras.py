@@ -1,0 +1,56 @@
+"""keras_exp flow: a Keras-exported-style ONNX graph replayed through
+ONNXModelKeras (reference: examples/python/onnx/mnist_mlp_keras.py +
+python/flexflow/keras_exp/models/model.py — tf.keras -> keras2onnx ->
+ONNXModelKeras). Built offline with the in-repo minimal codec; Keras
+exporters emit Dense nodes, which ONNXModelKeras maps like Gemm."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.onnx import ONNXModelKeras
+from flexflow_tpu.onnx import minionnx as mo
+
+
+def export_keras_style(path):
+    rs = np.random.RandomState(0)
+    w1 = mo.from_array(rs.randn(512, 784).astype(np.float32), "dense/kernel")
+    w2 = mo.from_array(rs.randn(10, 512).astype(np.float32), "dense_1/kernel")
+    nodes = [
+        mo.make_node("Dense", ["input", "dense/kernel"], ["d1"], name="dense"),
+        mo.make_node("Relu", ["d1"], ["a1"]),
+        mo.make_node("Dense", ["a1", "dense_1/kernel"], ["logits"],
+                     name="dense_1"),
+    ]
+    g = mo.make_graph(
+        nodes, "keras_mlp",
+        [mo.make_tensor_value_info("input", mo.DT_FLOAT, [64, 784])],
+        [mo.make_tensor_value_info("logits", mo.DT_FLOAT, [64, 10])],
+        initializer=[w1, w2])
+    mo.save(mo.make_model(g), path)
+
+
+def main():
+    from flexflow_tpu.keras.datasets import mnist
+    path = "/tmp/mnist_mlp_keras.onnx"
+    export_keras_style(path)
+
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 784], name="input")
+    out = ONNXModelKeras(path).apply(ff, {"input": x})
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=out)
+
+    (x_train, y_train), _ = mnist.load_data()
+    SingleDataLoader(ff, x,
+                     x_train.reshape(-1, 784).astype(np.float32) / 255.0)
+    SingleDataLoader(ff, ff.label_tensor,
+                     y_train.astype(np.int32).reshape(-1, 1))
+    ff.fit(epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -427,16 +427,23 @@ def make_node(op_type: str, inputs, outputs, name: str = "",
         elif isinstance(v, TensorProto):
             alist.append(AttributeProto(name=k, type=TENSOR, t=v))
         elif isinstance(v, (list, tuple)):
-            if all(isinstance(x, int) and not isinstance(x, bool) for x in v):
+            def is_int(x):
+                return (isinstance(x, (int, np.integer))
+                        and not isinstance(x, bool))
+
+            def is_num(x):
+                return is_int(x) or isinstance(x, (float, np.floating))
+
+            if all(is_int(x) for x in v):
                 alist.append(AttributeProto(name=k, type=INTS,
                                             ints=[int(x) for x in v]))
-            elif all(isinstance(x, (int, float)) for x in v):
+            elif all(is_num(x) for x in v):
                 alist.append(AttributeProto(name=k, type=FLOATS,
                                             floats=[float(x) for x in v]))
             else:
                 raise TypeError(
-                    f"attribute {k}: list must be all ints or all numeric, "
-                    f"got {v!r}")
+                    f"attribute {k}: list must be all ints or all numeric "
+                    f"(bools not allowed), got {v!r}")
         else:
             raise TypeError(f"unsupported attribute {k}={v!r}")
     return NodeProto(op_type=op_type, name=name, input=list(inputs),

@@ -22,7 +22,9 @@ def _attrs(node) -> Dict[str, object]:
             out[a.name] = a.f
         elif a.type == 2:
             out[a.name] = a.i
-        elif a.type == 7:
+        elif a.type == 6:  # FLOATS
+            out[a.name] = list(a.floats)
+        elif a.type == 7:  # INTS
             out[a.name] = list(a.ints)
         elif a.type == 3:
             out[a.name] = a.s.decode()

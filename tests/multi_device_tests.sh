@@ -88,6 +88,7 @@ python examples/onnx/mnist_mlp.py -e 1 -b "$BATCH"
 python examples/onnx/cifar10_cnn.py -e 1 -b "$BATCH"
 python examples/onnx/alexnet.py -e 1 -b 16
 python examples/onnx/resnet.py -e 1 -b "$BATCH"
+python examples/onnx/mnist_mlp_keras.py -e 1 -b "$BATCH"
 
 # bootcamp demo
 python bootcamp_demo/native_alexnet.py -e 1 -b "$BATCH"
