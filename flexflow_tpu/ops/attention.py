@@ -17,6 +17,7 @@ flexflow_model_add_multihead_attention signature.
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import List
 
@@ -27,6 +28,13 @@ from jax.sharding import PartitionSpec as P
 
 from flexflow_tpu.ffconst import OperatorType
 from flexflow_tpu.ops.base import Op, WeightSpec
+
+# longest sequence the Pallas flash kernels handle on the dense path: the
+# backward stages the full opposing sequence in VMEM, and past 4k the TPU
+# compiler rejects it (scoped-vmem overflow at 512-tiles, compile failure at
+# 8k even with 128-tiles). Longer dense sequences route to the pure-JAX
+# blockwise scan; sequence parallelism (ring/Ulysses) is the scale-out path.
+FLASH_MAX_SEQ = 4096
 
 
 class MultiHeadAttention(Op):
@@ -128,6 +136,12 @@ class MultiHeadAttention(Op):
             return False
         if self.causal and sq != sk:
             return False  # kernel's causal mask has no cross-attn diag offset
+        if max(sq, sk) > FLASH_MAX_SEQ:
+            # the backward kernels stage the full opposing sequence in VMEM;
+            # past 4k the TPU compiler rejects them (scoped-vmem overflow /
+            # compile failure at 8k even with 128-tiles) — the blockwise
+            # lax.scan path takes over on the dense path
+            return False
         for s in (sq, sk):
             if s % min(128, s) != 0:
                 return False
@@ -139,6 +153,25 @@ class MultiHeadAttention(Op):
             from flexflow_tpu.ops.pallas_kernels import flash_attention
 
             return flash_attention(qh, kh, vh, self.causal, scale)
+        sq, sk = qh.shape[1], kh.shape[1]
+        if max(sq, sk) > FLASH_MAX_SEQ \
+                and self.qk_head_dim == self.v_head_dim:
+            # long-context dense fallback: pure-JAX blockwise online-softmax
+            # scan (O(block) working set) with rematerialized backward — an
+            # einsum here would materialize the S x S probability tensor.
+            # Mirrors the flash size-rejection exactly (max of both seqs) so
+            # a flash-refused sequence never lands on the einsum path; the
+            # block size degrades to any divisor of sk like _pick_block.
+            from flexflow_tpu.parallel.ring_attention import blockwise_attention
+
+            block = next((b for b in (512, 256, 128, 64, 32, 16, 8)
+                          if sk % b == 0), sk)
+            blk = functools.partial(blockwise_attention, causal=self.causal,
+                                    scale=scale, block_size=block,
+                                    dropout_rate=self.dropout if use_dropout
+                                    else 0.0,
+                                    dropout_rng=rng if use_dropout else None)
+            return jax.checkpoint(blk)(qh, kh, vh)
         logits = jnp.einsum("bqhk,bshk->bhqs", qh, kh,
                             preferred_element_type=jnp.float32) * scale
         if self.causal:

@@ -31,7 +31,15 @@ def _interpret() -> bool:
 def _pick_block(seq: int, want: int) -> int:
     """Largest tile size <= want that divides seq (the guard in
     attention._flash_ok only promises 128-divisibility, so a 512 default
-    must degrade for e.g. seq 640)."""
+    must degrade for e.g. seq 640). Long sequences also shrink the tile to
+    reduce the block_q x block_k fp32 intermediates — a partial mitigation
+    only: the backward kernels stage the FULL opposing sequence in VMEM
+    regardless of tile size, so the hard sequence cap lives in
+    attention.FLASH_MAX_SEQ (dense path) and in ring_attention's per-shard
+    use_flash gate, both of which route oversized sequences to the pure-JAX
+    blockwise path instead."""
+    if seq > 4096:
+        want = min(want, 256)
     for b in (want, 256, 128, 64, 32, 16, 8):
         if b <= seq and seq % b == 0:
             return b

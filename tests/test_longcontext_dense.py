@@ -1,0 +1,62 @@
+"""Long-context dense attention routing: flash is capped at FLASH_MAX_SEQ
+(the Pallas backward stages the full opposing sequence in VMEM), and longer
+dense sequences fall back to the blockwise online-softmax scan with a
+rematerialized backward — numerically equivalent to the einsum reference."""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu.ops.attention as attention_mod
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.models.transformer import build_encoder_classifier
+
+
+def _losses(seq, steps=2):
+    batch, hidden, layers, heads = 2, 64, 1, 4
+    cfg = FFConfig(batch_size=batch, mesh_shape={"data": 1})
+    ff = FFModel(cfg)
+    x, out = build_encoder_classifier(ff, batch, seq, hidden, layers, heads)
+    ff.compile(SGDOptimizer(lr=0.05),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=out)
+    rs = np.random.RandomState(0)
+    SingleDataLoader(ff, x, rs.randn(batch * 2, seq, hidden)
+                     .astype(np.float32))
+    SingleDataLoader(ff, ff.label_tensor,
+                     rs.randint(0, 16, (batch * 2, 1)).astype(np.int32))
+    losses = []
+    for _ in range(steps):
+        loss, _ = ff._run_train_step(ff._stage_batch())
+        losses.append(float(loss))
+    return losses
+
+
+def test_flash_refused_beyond_max_seq():
+    cfg = FFConfig(batch_size=2, mesh_shape={"data": 1})
+    ff = FFModel(cfg)
+    x, out = build_encoder_classifier(ff, 2, 256, 64, 1, 4)
+    attn = next(op for op in ff.ops
+                if op.op_type.name == "OP_MULTIHEAD_ATTENTION")
+
+    class FakeArr:
+        def __init__(self, s):
+            self.shape = (2, s, 4, 16)
+
+    ok_small = attn._flash_ok(FakeArr(attention_mod.FLASH_MAX_SEQ),
+                              FakeArr(attention_mod.FLASH_MAX_SEQ))
+    refused = attn._flash_ok(FakeArr(attention_mod.FLASH_MAX_SEQ * 2),
+                             FakeArr(attention_mod.FLASH_MAX_SEQ * 2))
+    assert refused is False
+    # small-seq verdict depends on backend (TPU-only kernel) — just type-check
+    assert ok_small in (True, False)
+
+
+def test_blockwise_dense_fallback_matches_einsum(monkeypatch):
+    """Lower the flash cap so a CPU-sized sequence takes the blockwise
+    branch; training losses must match the einsum path."""
+    seq = 1024  # > patched cap, % 512 == 0 -> blockwise branch
+    baseline = _losses(seq)
+    monkeypatch.setattr(attention_mod, "FLASH_MAX_SEQ", 512)
+    blockwise = _losses(seq)
+    np.testing.assert_allclose(baseline, blockwise, rtol=2e-4, atol=1e-5)
