@@ -78,6 +78,13 @@ class Op:
     def init_state(self) -> Dict[str, Any]:
         return {}
 
+    def init_state_for_shapes(self, in_shapes) -> Dict[str, Any]:
+        """State sized for PER-SHARD input shapes (the measurement harness
+        runs one shard standalone; channel-sharded BatchNorm needs its
+        running stats sliced to the shard's channel count). Default: the
+        full-size state."""
+        return self.init_state()
+
     # -- parallelization metadata ---------------------------------------------
 
     def partitionable_output_dims(self) -> List[int]:

@@ -177,6 +177,11 @@ class BatchNorm(Op):
         return {"mean": np.zeros((self.channels,), np.float32),
                 "var": np.ones((self.channels,), np.float32)}
 
+    def init_state_for_shapes(self, in_shapes):
+        c = in_shapes[0][1]  # per-shard channel count
+        return {"mean": np.zeros((c,), np.float32),
+                "var": np.ones((c,), np.float32)}
+
     def forward_stateful(self, params, state, xs, *, training=False, rng=None):
         x = xs[0]
         if training:
@@ -199,7 +204,14 @@ class BatchNorm(Op):
         return [y], new_state
 
     def partitionable_output_dims(self):
-        return [0, 2, 3]
+        # channel (dim 1) shards cleanly: BN statistics reduce over N,H,W
+        # only, so per-channel mean/var/scale/bias stay local to the shard —
+        # this lets a channel-sharded conv feed BN without an all-gather
+        return [0, 1, 2, 3]
+
+    def weight_partition(self, axis_map):
+        ax = self.axes_for_dim(axis_map, 1)
+        return {"scale": P(ax), "bias": P(ax)}
 
 
 class Flat(Op):

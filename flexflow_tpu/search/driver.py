@@ -43,9 +43,11 @@ def legal_axis_maps(op, mesh_shape: Dict[str, int],
     nd = len(out_shape)
     if not enable_parameter_parallel:
         weighted = {OperatorType.OP_LINEAR, OperatorType.OP_EMBEDDING,
-                    OperatorType.OP_CONV2D, OperatorType.OP_MULTIHEAD_ATTENTION}
+                    OperatorType.OP_CONV2D, OperatorType.OP_MULTIHEAD_ATTENTION,
+                    OperatorType.OP_BATCHNORM}  # channel dim shards scale/bias
         if op.op_type in weighted:
-            param_dim = 1 if op.op_type == OperatorType.OP_CONV2D else nd - 1
+            param_dim = 1 if op.op_type in (
+                OperatorType.OP_CONV2D, OperatorType.OP_BATCHNORM) else nd - 1
             dims = [d for d in dims if d != param_dim]
     if not enable_attribute_parallel and op.op_type in (
             OperatorType.OP_CONV2D, OperatorType.OP_POOL2D):

@@ -93,7 +93,11 @@ def _build_fwd_bwd(op: Op, params, xs, rng):
     kwargs = {}
     if getattr(op, "wants_shard_ctx", False):
         kwargs["shard_ctx"] = _single_device_ctx()
-    state0 = {k: jnp.asarray(v) for k, v in op.init_state().items()} \
+    # per-shard state: channel-sharded BatchNorm's running stats must match
+    # the shard's channel count or the stat update fails to trace and the
+    # choice silently falls back to analytic cost
+    state0 = {k: jnp.asarray(v) for k, v in
+              op.init_state_for_shapes([x.shape for x in xs]).items()} \
         if op.stateful else None
 
     def fwd_bwd(p, fxs):

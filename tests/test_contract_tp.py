@@ -195,6 +195,55 @@ def test_conv_contract_matches_dp_numerics():
     assert ff.params["c2"]["kernel"].sharding.spec[1] == "model"
 
 
+def test_channel_sharded_batchnorm_matches_dp():
+    """BN statistics reduce over N,H,W only, so sharding the channel dim
+    (with scale/bias sharded alongside) must train identically to DP — this
+    is what lets a channel-sharded conv feed BN without an all-gather."""
+    def build(strategies):
+        cfg = FFConfig(batch_size=8, mesh_shape=dict(MESH))
+        cfg.strategies = dict(strategies)
+        ff = FFModel(cfg)
+        x = ff.create_tensor([8, 8, 16, 16], name="x")
+        t = ff.conv2d(x, 16, 3, 3, 1, 1, 1, 1, name="c1")
+        t = ff.batch_norm(t, relu=True, name="bn1")
+        t = ff.conv2d(t, 8, 3, 3, 1, 1, 1, 1, name="c2")
+        t = ff.flat(t)
+        ff.dense(t, 4, name="head")
+        ff.compile(SGDOptimizer(lr=0.05),
+                   LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                   [MetricsType.METRICS_ACCURACY])
+        return ff
+
+    ch = {
+        "c1": ParallelConfig.from_axis_map(4, MESH, {"data": 0, "model": 1}),
+        "bn1": ParallelConfig.from_axis_map(4, MESH, {"data": 0, "model": 1}),
+    }
+    rs = np.random.RandomState(0)
+    xd = rs.randn(16, 8, 16, 16).astype(np.float32)
+    yd = rs.randint(0, 4, (16, 1)).astype(np.int32)
+    out, states = {}, {}
+    for name, s in (("dp", {}), ("chan", ch)):
+        ff = build(s)
+        SingleDataLoader(ff, ff.ops[0].outputs[0], xd)
+        SingleDataLoader(ff, ff.label_tensor, yd)
+        ls = []
+        for _ in range(3):
+            loss, _ = ff._run_train_step(ff._stage_batch())
+            ls.append(float(loss))
+        out[name] = ls
+        states[name] = {k: np.asarray(v)
+                        for k, v in ff.bn_state["bn1"].items()}
+    np.testing.assert_allclose(out["dp"], out["chan"], rtol=1e-4, atol=1e-5)
+    assert ff.params["bn1"]["scale"].sharding.spec[0] == "model"
+    # running statistics (the eval-path state) must also match DP
+    np.testing.assert_allclose(
+        np.asarray(states["dp"]["mean"]), np.asarray(states["chan"]["mean"]),
+        rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(states["dp"]["var"]), np.asarray(states["chan"]["var"]),
+        rtol=1e-5, atol=1e-6)
+
+
 def test_contract_output_not_sharded():
     """CONTRACT axes never appear in the output PartitionSpec, and the
     per-shard output shape ignores them."""
