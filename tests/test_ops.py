@@ -287,3 +287,49 @@ def test_sgd_momentum_step_matches_torch():
                                tw.detach().numpy(), rtol=2e-4, atol=2e-5)
     np.testing.assert_allclose(np.asarray(ff.get_weights("fc", "bias")),
                                tb.detach().numpy(), rtol=2e-4, atol=2e-5)
+
+
+def test_conv2d_gradients_match_torch():
+    """Conv backward golden test (reference tests/ops cover conv grads via
+    the same harness): kernel/bias grads of conv+MSE match torch autograd."""
+    import torch
+
+    from flexflow_tpu import LossType, MetricsType, SGDOptimizer
+
+    B, C, HW, O = 4, 3, 8, 6
+    rs = np.random.RandomState(0)
+    xd = rs.randn(B, C, HW, HW).astype(np.float32)
+    yd = rs.randn(B, O, HW, HW).astype(np.float32)
+
+    cfg = FFConfig(batch_size=B, mesh_shape={"data": 1}, seed=0)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([B, C, HW, HW], name="x")
+    out = ff.conv2d(x, O, 3, 3, 1, 1, 1, 1, name="conv")
+    ff.compile(SGDOptimizer(lr=0.0),
+               LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+               [MetricsType.METRICS_MEAN_SQUARED_ERROR], final_tensor=out)
+
+    k = np.asarray(ff.get_weights("conv", "kernel"))
+    b = np.asarray(ff.get_weights("conv", "bias"))
+
+    import jax as _jax
+
+    def loss_fn(params):
+        from flexflow_tpu.runtime.loss import compute_loss
+
+        fwd = ff.executor.make_forward([out], training=True)
+        logits = fwd(params, ff.bn_state, {"x": xd})[0]
+        return compute_loss(ff.loss_type, logits, yd)
+
+    grads = _jax.grad(loss_fn)(ff.params)
+
+    tk = torch.tensor(k, requires_grad=True)
+    tb = torch.tensor(b, requires_grad=True)
+    ty = torch.nn.functional.conv2d(torch.tensor(xd), tk, tb, padding=1)
+    loss = torch.nn.functional.mse_loss(ty, torch.tensor(yd))
+    loss.backward()
+
+    np.testing.assert_allclose(np.asarray(grads["conv"]["kernel"]),
+                               tk.grad.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads["conv"]["bias"]),
+                               tb.grad.numpy(), rtol=1e-4, atol=1e-5)
