@@ -29,12 +29,13 @@ from jax.sharding import PartitionSpec as P
 from flexflow_tpu.ffconst import OperatorType
 from flexflow_tpu.ops.base import Op, WeightSpec
 
-# longest sequence the Pallas flash kernels handle on the dense path: the
-# backward stages the full opposing sequence in VMEM, and past 4k the TPU
-# compiler rejects it (scoped-vmem overflow at 512-tiles, compile failure at
-# 8k even with 128-tiles). Longer dense sequences route to the pure-JAX
-# blockwise scan; sequence parallelism (ring/Ulysses) is the scale-out path.
-FLASH_MAX_SEQ = 4096
+# past this sequence length, the non-flash dense path (CPU backend, attention
+# dropout, mismatched head dims) switches from the fused einsum to the
+# pure-JAX blockwise online-softmax scan — an einsum would materialize the
+# S x S probability tensor. The Pallas flash kernels themselves stream K/V
+# tiles through the grid (round-3 rework) and have NO sequence cap: VMEM use
+# is O(block^2) regardless of S.
+BLOCKWISE_SEQ_THRESHOLD = 4096
 
 
 class MultiHeadAttention(Op):
@@ -136,11 +137,12 @@ class MultiHeadAttention(Op):
             return False
         if self.causal and sq != sk:
             return False  # kernel's causal mask has no cross-attn diag offset
-        if max(sq, sk) > FLASH_MAX_SEQ:
-            # the backward kernels stage the full opposing sequence in VMEM;
-            # past 4k the TPU compiler rejects them (scoped-vmem overflow /
-            # compile failure at 8k even with 128-tiles) — the blockwise
-            # lax.scan path takes over on the dense path
+        # escape hatch: the streaming kernels carry no architectural length
+        # cap, but if a deployment's Mosaic build rejects some long-sequence
+        # compile, FF_FLASH_MAX_SEQ routes those shapes to the blockwise
+        # fallback without a code change (unset/0 = unlimited)
+        cap = int(os.environ.get("FF_FLASH_MAX_SEQ", "0"))
+        if cap and max(sq, sk) > cap:
             return False
         for s in (sq, sk):
             if s % min(128, s) != 0:
@@ -154,14 +156,14 @@ class MultiHeadAttention(Op):
 
             return flash_attention(qh, kh, vh, self.causal, scale)
         sq, sk = qh.shape[1], kh.shape[1]
-        if max(sq, sk) > FLASH_MAX_SEQ \
+        if max(sq, sk) > BLOCKWISE_SEQ_THRESHOLD \
                 and self.qk_head_dim == self.v_head_dim:
-            # long-context dense fallback: pure-JAX blockwise online-softmax
-            # scan (O(block) working set) with rematerialized backward — an
-            # einsum here would materialize the S x S probability tensor.
-            # Mirrors the flash size-rejection exactly (max of both seqs) so
-            # a flash-refused sequence never lands on the einsum path; the
-            # block size degrades to any divisor of sk like _pick_block.
+            # long-context dense fallback for flash-refused shapes (CPU
+            # backend, dropout, cross-attn causal): pure-JAX blockwise
+            # online-softmax scan (O(block) working set) with rematerialized
+            # backward — an einsum here would materialize the S x S
+            # probability tensor. Block size degrades to any divisor of sk
+            # like _pick_block.
             from flexflow_tpu.parallel.ring_attention import blockwise_attention
 
             block = next((b for b in (512, 256, 128, 64, 32, 16, 8)

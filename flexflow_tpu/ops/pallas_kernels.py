@@ -3,10 +3,18 @@
 Hand-tiled kernels for ops where XLA's default lowering leaves MXU/VMEM
 performance on the table (the role src/ops/*.cu kernels played in the
 reference; role parity with the tuned cuDNN MHA kernel the reference calls
-at attention.cu:244). Currently: flash attention forward (online softmax,
-q-block grid, k-block inner loop in VMEM) and the FlashAttention-2 style
-backward (logsumexp saved from the forward; per-tile recompute of the probs;
-separate dq and dk/dv kernels so each output tile is written once).
+at attention.cu:244). Currently: flash attention forward (online softmax)
+and the FlashAttention-2 style backward (logsumexp saved from the forward;
+per-tile recompute of the probs; separate dq and dk/dv kernels so each
+output tile is written once).
+
+Streaming design (round-3 rework): the opposing sequence is NOT staged in
+VMEM. Every kernel runs on a 3-D grid (batch*heads, own-side blocks,
+opposing-side blocks) whose innermost axis streams opposing-side tiles
+through VMEM while f32 scratch accumulators (persistent across the
+sequential inner grid axis) carry the online-softmax / gradient state.
+VMEM use is therefore O(block^2) regardless of sequence length — the 4k
+sequence cap of the staged round-2 kernels is gone.
 
 On CPU (tests/emulated meshes) kernels run with interpret=True.
 """
@@ -20,90 +28,119 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+# m/l scratch rows are stored broadcast across one f32 lane tile
+LANES = 128
 
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _compiler_params():
+    """Outer grid axes are parallel (independent (bh, own-block) tiles); the
+    innermost axis streams opposing-side tiles and must run sequentially —
+    the scratch accumulators carry state across it."""
+    if _interpret():
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+
 def _pick_block(seq: int, want: int) -> int:
     """Largest tile size <= want that divides seq (the guard in
     attention._flash_ok only promises 128-divisibility, so a 512 default
-    must degrade for e.g. seq 640). Long sequences also shrink the tile to
-    reduce the block_q x block_k fp32 intermediates — a partial mitigation
-    only: the backward kernels stage the FULL opposing sequence in VMEM
-    regardless of tile size, so the hard sequence cap lives in
-    attention.FLASH_MAX_SEQ (dense path) and in ring_attention's per-shard
-    use_flash gate, both of which route oversized sequences to the pure-JAX
-    blockwise path instead."""
-    if seq > 4096:
-        want = min(want, 256)
+    must degrade for e.g. seq 640)."""
     for b in (want, 256, 128, 64, 32, 16, 8):
         if b <= seq and seq % b == 0:
             return b
     return seq
 
 
+def _maybe_when(cond, fn):
+    """Run fn under pl.when(cond), or directly when the guard is statically
+    always-true (non-causal paths) — no branch emitted in the kernel."""
+    if cond is None:
+        fn()
+    else:
+        pl.when(cond)(fn)
+
+
+def _causal_mask(s, qi, ki, block_q, block_k):
+    bq, bk = s.shape
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
 # ---------------------------------------------------------------- forward
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *,
-                      block_k: int, causal: bool, scale: float, q_block: int,
-                      seq_k: int, need_lse: bool = True):
-    qi = pl.program_id(1)  # q block index
-    q = q_ref[0]  # (block_q, d) — native dtype into the MXU (bf16 fast path;
-    # accumulation stays f32 via preferred_element_type)
-    bq, d = q.shape
-    nk = seq_k // block_k
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q: int,
+                      block_k: int, causal: bool, scale: float,
+                      need_lse: bool):
+    if need_lse:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        m_scr, l_scr, acc_scr = rest
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
 
-    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    o0 = jnp.zeros((bq, d), jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    def body(j, carry):
-        m, l, o = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+    # causal: a k tile strictly after the last row of this q tile is dead
+    live = (qi + 1) * block_q > ki * block_k if causal else None
+
+    def _step():
+        q = q_ref[0]  # (block_q, d) — native dtype into the MXU (bf16 fast
+        # path; accumulation stays f32 via preferred_element_type)
+        k = k_ref[0]
+        v = v_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qi * q_block + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        o_new = o * alpha[:, None] + jnp.dot(p.astype(v.dtype), v,
-                                             preferred_element_type=jnp.float32)
-        return m_new, l_new, o_new
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        m_prev = m_scr[:, 0:1]                      # (bq, 1)
+        l_prev = l_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                      # (bq, bk)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    if causal:
-        # only k blocks at or before this q block contribute
-        nk_eff = jnp.minimum(nk, (qi + 1) * q_block // block_k
-                             + (1 if q_block % block_k else 0))
-    else:
-        nk_eff = nk
-    m, l, o = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, o0))
-    o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
-    if need_lse:
-        # lse lives in an 8-lane padded layout: Mosaic wants the last two
-        # block dims divisible by (8, 128) OR equal to the array dims, and
-        # a last dim of exactly 8 satisfies the 'equal' clause at 16x less
-        # HBM than padding to a full 128-lane tile
-        lse_ref[0] = jnp.broadcast_to((m + jnp.log(l))[:, None], (bq, 8))
+    _maybe_when(live, _step)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[:, 0:1]
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        if need_lse:
+            # lse lives in an 8-lane padded layout: Mosaic wants the last two
+            # block dims divisible by (8, 128) OR equal to the array dims, and
+            # a last dim of exactly 8 satisfies the 'equal' clause at 16x less
+            # HBM than padding to a full 128-lane tile
+            m = m_scr[:, 0:1]
+            lse_ref[0] = jnp.broadcast_to(m + jnp.log(l),
+                                          (q_ref.shape[1], 8))
 
 
 def flash_attention_fwd_pallas(q, k, v, causal: bool, scale: float,
                                block_q: int = 512, block_k: int = 512,
                                need_lse: bool = True):
-    """q,k,v: (B, S, H, D) -> (out, lse|None). Grid: (B*H, S_q/block_q).
-    need_lse=False (inference) skips materializing the logsumexp residual —
-    it exists only for the VJP and costs more HBM writes than the output
-    itself at small head dims."""
+    """q,k,v: (B, S, H, D) -> (out, lse|None).
+    Grid: (B*H, S_q/block_q, S_k/block_k) — K/V tiles stream through the
+    innermost axis. need_lse=False (inference) skips materializing the
+    logsumexp residual — it exists only for the VJP and costs more HBM
+    writes than the output itself at small head dims."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
     block_q = _pick_block(sq, block_q)
@@ -115,25 +152,31 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool, scale: float,
     kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
 
-    kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
-                               causal=causal, scale=scale, q_block=block_q,
-                               seq_k=sk, need_lse=need_lse)
-    out_specs = [pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))]
+    kernel = functools.partial(_flash_fwd_kernel, block_q=block_q,
+                               block_k=block_k, causal=causal, scale=scale,
+                               need_lse=need_lse)
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0))]
     out_shape = [jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)]
     if need_lse:
         out_specs.append(pl.BlockSpec((1, block_q, 8),
-                                      lambda i, j: (i, j, 0)))
+                                      lambda i, j, t: (i, j, 0)))
         out_shape.append(jax.ShapeDtypeStruct((b * h, sq, 8), jnp.float32))
     outs = pl.pallas_call(
         kernel,
-        grid=(b * h, sq // block_q),
+        grid=(b * h, sq // block_q, sk // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, t, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, t, 0)),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),   # running max
+            pltpu.VMEM((block_q, LANES), jnp.float32),   # running sum
+            pltpu.VMEM((block_q, d), jnp.float32),       # output accumulator
+        ],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(qt, kt, vt)
     return (outs[0], outs[1]) if need_lse else (outs[0], None)
@@ -143,83 +186,85 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool, scale: float,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, block_k: int, causal: bool, scale: float,
-                         q_block: int, seq_k: int):
-    """One q tile: dq = scale * sum_j ds_j @ k_j,
+                         dq_ref, dq_scr, *, block_q: int, block_k: int,
+                         causal: bool, scale: float):
+    """One q tile, k/v tiles streaming: dq = scale * sum_j ds_j @ k_j,
     ds = p * (do @ v^T - delta)."""
     qi = pl.program_id(1)
-    q = q_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0, :, 0]    # (block_q,) — lane-padded layout
-    delta = delta_ref[0, :, 0]
-    bq, d = q.shape
-    nk = seq_k // block_k
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
 
-    def body(j, dq):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    live = (qi + 1) * block_q > ki * block_k if causal else None
+
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, :, 0:1]     # (block_q, 1) — lane-padded layout
+        delta = delta_ref[0, :, 0:1]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qi * q_block + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])                       # (bq, bk)
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse)                                # (bq, bk)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None])).astype(k.dtype)
-        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(k.dtype)
+        dq_scr[...] = dq_scr[...] + jnp.dot(
+            ds, k, preferred_element_type=jnp.float32)
 
-    if causal:
-        nk_eff = jnp.minimum(nk, (qi + 1) * q_block // block_k
-                             + (1 if q_block % block_k else 0))
-    else:
-        nk_eff = nk
-    dq = jax.lax.fori_loop(0, nk_eff, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    _maybe_when(live, _step)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, block_q: int, causal: bool,
-                          scale: float, k_block: int, seq_q: int):
-    """One k tile: dv = sum_i p_i^T @ do_i; dk = scale * sum_i ds_i^T @ q_i."""
+                          dk_ref, dv_ref, dk_scr, dv_scr, *, block_q: int,
+                          block_k: int, causal: bool, scale: float):
+    """One k tile, q/do tiles streaming:
+    dv = sum_i p_i^T @ do_i; dk = scale * sum_i ds_i^T @ q_i."""
     ki = pl.program_id(1)
-    k = k_ref[0]   # (block_k, d)
-    v = v_ref[0]
-    bk, d = k.shape
-    nq = seq_q // block_q
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :]
-        do = do_ref[0, pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(i * block_q, block_q), 0]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q), 0]
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    # causal: a q tile strictly before the first row of this k tile sees
+    # nothing of it
+    live = (qi + 1) * block_q > ki * block_k if causal else None
+
+    def _step():
+        k = k_ref[0]   # (block_k, d)
+        v = v_ref[0]
+        q = q_ref[0]   # (block_q, d)
+        do = do_ref[0]
+        lse = lse_ref[0, :, 0:1]
+        delta = delta_ref[0, :, 0:1]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 0)
-            k_pos = ki * k_block + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])                      # (bq, bk)
-        dv = dv + jnp.dot(p.astype(do.dtype).T, do,
-                          preferred_element_type=jnp.float32)
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse)                               # (bq, bk)
+        dv_scr[...] = dv_scr[...] + jnp.dot(
+            p.astype(do.dtype).T, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None])).astype(q.dtype)
-        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
-        return dk, dv
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk_scr[...] = dk_scr[...] + jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32)
 
-    if causal:
-        # q blocks strictly before this k tile see nothing of it
-        i0 = (ki * k_block) // block_q
-    else:
-        i0 = 0
-    dk, dv = jax.lax.fori_loop(i0, nq, body,
-                               (jnp.zeros((bk, d), jnp.float32),
-                                jnp.zeros((bk, d), jnp.float32)))
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    _maybe_when(live, _step)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def flash_attention_bwd_pallas(q, k, v, o, lse, do, causal: bool,
@@ -253,40 +298,43 @@ def flash_attention_bwd_pallas(q, k, v, o, lse, do, causal: bool,
     delta = jnp.broadcast_to(delta[..., None], (b * h, sq, 8))
 
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
-                          causal=causal, scale=scale, q_block=block_q,
-                          seq_k=sk),
-        grid=(b * h, sq // block_q),
+        functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
+                          block_k=block_k, causal=causal, scale=scale),
+        grid=(b * h, sq // block_q, sk // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q, 8), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q, 8), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, t, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, t, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda i, j, t: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda i, j, t: (i, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(qt, kt, vt, dot, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
-                          causal=causal, scale=scale, k_block=block_k,
-                          seq_q=sq),
-        grid=(b * h, sk // block_k),
+                          block_k=block_k, causal=causal, scale=scale),
+        grid=(b * h, sk // block_k, sq // block_q),
         in_specs=[
-            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sq, 8), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sq, 8), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, t, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, t, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda i, j, t: (i, t, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda i, j, t: (i, t, 0)),
         ],
-        out_specs=[pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-                   pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0))],
+        out_specs=[pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, j, 0)),
+                   pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, j, 0))],
         out_shape=[jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
                    jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(qt, kt, vt, dot, lse, delta)
 

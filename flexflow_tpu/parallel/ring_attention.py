@@ -239,15 +239,9 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     if use_flash is None:
         import os
 
-        from flexflow_tpu.ops.attention import FLASH_MAX_SEQ
-
         use_flash = ((jax.default_backend() == "tpu"
                       or os.environ.get("FF_FORCE_FLASH_ATTENTION") == "1")
-                     and dropout_rate == 0.0
-                     # per-shard cap: the Pallas backward stages the full
-                     # opposing shard in VMEM (see ops/attention.FLASH_MAX_SEQ)
-                     # — oversized local shards take the pure-JAX ring instead
-                     and max(q.shape[1], k.shape[1]) <= FLASH_MAX_SEQ)
+                     and dropout_rate == 0.0)
     if use_flash:
         return ring_attention_flash(q, k, v, axis_name, causal, scale)
     p_size = lax.axis_size(axis_name)

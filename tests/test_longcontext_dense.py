@@ -1,7 +1,9 @@
-"""Long-context dense attention routing: flash is capped at FLASH_MAX_SEQ
-(the Pallas backward stages the full opposing sequence in VMEM), and longer
-dense sequences fall back to the blockwise online-softmax scan with a
-rematerialized backward — numerically equivalent to the einsum reference."""
+"""Long-context dense attention routing. The streaming Pallas flash kernels
+have no sequence cap (K/V tiles stream through the grid; VMEM is O(block^2)),
+so flash eligibility no longer depends on sequence length. Flash-refused
+shapes (CPU backend, dropout, odd head dims) past BLOCKWISE_SEQ_THRESHOLD
+fall back to the blockwise online-softmax scan with a rematerialized
+backward — numerically equivalent to the einsum reference."""
 
 import numpy as np
 import pytest
@@ -32,7 +34,9 @@ def _losses(seq, steps=2):
     return losses
 
 
-def test_flash_refused_beyond_max_seq():
+def test_flash_has_no_sequence_cap(monkeypatch):
+    """Streaming kernels: a 16k sequence must NOT be refused for length
+    (it may still be refused for backend — check shape-gates only)."""
     cfg = FFConfig(batch_size=2, mesh_shape={"data": 1})
     ff = FFModel(cfg)
     x, out = build_encoder_classifier(ff, 2, 256, 64, 1, 4)
@@ -43,20 +47,58 @@ def test_flash_refused_beyond_max_seq():
         def __init__(self, s):
             self.shape = (2, s, 4, 16)
 
-    ok_small = attn._flash_ok(FakeArr(attention_mod.FLASH_MAX_SEQ),
-                              FakeArr(attention_mod.FLASH_MAX_SEQ))
-    refused = attn._flash_ok(FakeArr(attention_mod.FLASH_MAX_SEQ * 2),
-                             FakeArr(attention_mod.FLASH_MAX_SEQ * 2))
-    assert refused is False
-    # small-seq verdict depends on backend (TPU-only kernel) — just type-check
-    assert ok_small in (True, False)
+    monkeypatch.setenv("FF_FORCE_FLASH_ATTENTION", "1")
+    assert attn._flash_ok(FakeArr(4096), FakeArr(4096)) is True
+    assert attn._flash_ok(FakeArr(16384), FakeArr(16384)) is True
+    # non-128-divisible (above 128) still refused
+    assert attn._flash_ok(FakeArr(129), FakeArr(129)) is False
+    # deployment escape hatch still works
+    monkeypatch.setenv("FF_FLASH_MAX_SEQ", "4096")
+    assert attn._flash_ok(FakeArr(8192), FakeArr(8192)) is False
 
 
 def test_blockwise_dense_fallback_matches_einsum(monkeypatch):
-    """Lower the flash cap so a CPU-sized sequence takes the blockwise
-    branch; training losses must match the einsum path."""
-    seq = 1024  # > patched cap, % 512 == 0 -> blockwise branch
+    """Lower the blockwise threshold so a CPU-sized sequence takes the
+    blockwise branch; training losses must match the einsum path."""
+    seq = 1024  # > patched threshold, % 512 == 0 -> blockwise branch
     baseline = _losses(seq)
-    monkeypatch.setattr(attention_mod, "FLASH_MAX_SEQ", 512)
+    monkeypatch.setattr(attention_mod, "BLOCKWISE_SEQ_THRESHOLD", 512)
     blockwise = _losses(seq)
     np.testing.assert_allclose(baseline, blockwise, rtol=2e-4, atol=1e-5)
+
+
+def test_flash_streaming_parity_long_seq():
+    """Interpret-mode grad parity of the streaming flash kernels at a
+    sequence length past the old 4k cap (VERDICT r2 #2 acceptance)."""
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.ops.pallas_kernels import flash_attention
+
+    def ref_attn(q, k, v, causal, scale):
+        logits = jnp.einsum("bqhk,bshk->bhqs", q, k).astype(jnp.float32)
+        logits = logits * scale
+        if causal:
+            sq, sk = logits.shape[-2], logits.shape[-1]
+            mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+            logits = jnp.where(mask, logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqs,bshk->bqhk", p, v)
+
+    rs = np.random.RandomState(1)
+    b, s, h, d = 1, 6144, 1, 32  # > old 4k cap, small enough for CI
+    q = jnp.asarray(rs.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rs.randn(b, s, h, d), jnp.float32)
+    v = jnp.asarray(rs.randn(b, s, h, d), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+    o1 = flash_attention(q, k, v, True, scale)
+    o2 = ref_attn(q, k, v, True, scale)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                               rtol=1e-4, atol=1e-5)
+    g1 = jax.grad(lambda a, b_, c: jnp.sum(jnp.sin(
+        flash_attention(a, b_, c, True, scale))), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda a, b_, c: jnp.sum(jnp.sin(
+        ref_attn(a, b_, c, True, scale))), argnums=(0, 1, 2))(q, k, v)
+    for x, y in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=1e-3, atol=5e-5)
