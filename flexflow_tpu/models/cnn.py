@@ -231,11 +231,13 @@ def candle_uno(ff: FFModel, batch_size: int,
     return inputs, out
 
 
-def inception_v3_stem(ff: FFModel, batch_size: int, num_classes: int = 1000):
+def inception_v3_stem(ff: FFModel, batch_size: int, num_classes: int = 1000,
+                      image_size: int = 299):
     """InceptionV3 stem + 3x InceptionA + head (abridged but faithfully
     branchy — the op-parallel benefit shows in the A-blocks; reference
     inception.cc builds the full tower the same way)."""
-    x = ff.create_tensor([batch_size, 3, 299, 299], name="input")
+    x = ff.create_tensor([batch_size, 3, image_size, image_size],
+                         name="input")
     t = ff.conv2d(x, 32, 3, 3, 2, 2, 0, 0, ActiMode.AC_MODE_RELU, name="c1")
     t = ff.conv2d(t, 32, 3, 3, 1, 1, 0, 0, ActiMode.AC_MODE_RELU, name="c2")
     t = ff.conv2d(t, 64, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU, name="c3")

@@ -38,6 +38,18 @@ from flexflow_tpu.ops.base import Op, WeightSpec
 BLOCKWISE_SEQ_THRESHOLD = 4096
 
 
+def flash_seq_cap() -> int:
+    """FF_FLASH_MAX_SEQ: deployment escape hatch capping flash-kernel
+    sequence length (0/unset/garbage = unlimited). Consulted by the dense
+    path (_flash_ok) and the ring/sequence-parallel per-shard gate."""
+    import os
+
+    try:
+        return int(os.environ.get("FF_FLASH_MAX_SEQ", "0") or 0)
+    except ValueError:
+        return 0
+
+
 class MultiHeadAttention(Op):
     op_type = OperatorType.OP_MULTIHEAD_ATTENTION
     needs_rng = True
@@ -141,7 +153,7 @@ class MultiHeadAttention(Op):
         # cap, but if a deployment's Mosaic build rejects some long-sequence
         # compile, FF_FLASH_MAX_SEQ routes those shapes to the blockwise
         # fallback without a code change (unset/0 = unlimited)
-        cap = int(os.environ.get("FF_FLASH_MAX_SEQ", "0"))
+        cap = flash_seq_cap()
         if cap and max(sq, sk) > cap:
             return False
         for s in (sq, sk):

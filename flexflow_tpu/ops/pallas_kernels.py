@@ -155,6 +155,15 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool, scale: float,
     kernel = functools.partial(_flash_fwd_kernel, block_q=block_q,
                                block_k=block_k, causal=causal, scale=scale,
                                need_lse=need_lse)
+    if causal:
+        # clamp dead (fully-masked) inner steps to the last live tile: the
+        # revisited block is already VMEM-resident, so masked steps cost no
+        # DMA (pl.when(live) already skips their compute)
+        def kv_map(i, j, t):
+            return (i, jnp.minimum(t, ((j + 1) * block_q - 1) // block_k), 0)
+    else:
+        def kv_map(i, j, t):
+            return (i, t, 0)
     out_specs = [pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0))]
     out_shape = [jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)]
     if need_lse:
@@ -166,8 +175,8 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool, scale: float,
         grid=(b * h, sq // block_q, sk // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, t, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, t, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
@@ -297,14 +306,28 @@ def flash_attention_bwd_pallas(q, k, v, o, lse, do, causal: bool,
     # broadcast into the same 8-lane padded layout as lse
     delta = jnp.broadcast_to(delta[..., None], (b * h, sq, 8))
 
+    if causal:
+        # dead-tile clamps (see forward): masked inner steps re-reference a
+        # resident block instead of fetching one
+        def kv_map(i, j, t):
+            return (i, jnp.minimum(t, ((j + 1) * block_q - 1) // block_k), 0)
+
+        def q_map(i, j, t):
+            return (i, jnp.maximum(t, (j * block_k) // block_q), 0)
+    else:
+        def kv_map(i, j, t):
+            return (i, t, 0)
+
+        q_map = kv_map
+
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
                           block_k=block_k, causal=causal, scale=scale),
         grid=(b * h, sq // block_q, sk // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, t, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, t, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
             pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0)),
             pl.BlockSpec((1, block_q, 8), lambda i, j, t: (i, j, 0)),
             pl.BlockSpec((1, block_q, 8), lambda i, j, t: (i, j, 0)),
@@ -321,12 +344,12 @@ def flash_attention_bwd_pallas(q, k, v, o, lse, do, causal: bool,
                           block_k=block_k, causal=causal, scale=scale),
         grid=(b * h, sk // block_k, sq // block_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, t, 0)),
+            pl.BlockSpec((1, block_q, d), q_map),
             pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, t, 0)),
-            pl.BlockSpec((1, block_q, 8), lambda i, j, t: (i, t, 0)),
-            pl.BlockSpec((1, block_q, 8), lambda i, j, t: (i, t, 0)),
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, block_q, 8), q_map),
+            pl.BlockSpec((1, block_q, 8), q_map),
         ],
         out_specs=[pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, j, 0)),
                    pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, j, 0))],

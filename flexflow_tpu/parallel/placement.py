@@ -38,7 +38,10 @@ from flexflow_tpu.runtime.metrics import batch_metrics
 
 
 class PlacementGroup:
-    """A maximal run of consecutive ops sharing one device block."""
+    """Ops sharing one device block, packed dependency-safely (an op may
+    join any group at or after all its producers' groups — branchy graphs
+    interleave ops from parallel branches in insertion order, and strictly
+    consecutive runs would fragment them into many tiny programs)."""
 
     def __init__(self, index: int, place: int, ndev: int, mesh: Mesh):
         self.index = index
@@ -146,9 +149,15 @@ class PlacementExecutor:
         return Mesh(devs, tuple(names))
 
     def _build_groups(self):
+        """Greedy dependency-safe packing: each op joins the earliest
+        existing group that (a) sits on the same device block, (b) has index
+        >= every producer's group (groups dispatch in index order), and
+        (c) can still host the op's mesh axes (e.g. a 'data'-sharded op and
+        a 'model'-sharded op both 2-way on a 2-device block need separate
+        programs). Parallel branches on the same block therefore share one
+        program; branches on disjoint blocks become separate groups that
+        overlap via async dispatch."""
         strategies = self.model.config.strategies
-        current: Optional[PlacementGroup] = None
-        merged: Dict[str, Optional[int]] = {}
 
         def coverage(axes: Dict[str, Optional[int]]) -> int:
             n = 1
@@ -157,37 +166,40 @@ class PlacementExecutor:
                     n *= self.mesh_shape[ax]
             return n
 
+        group_axes: List[Dict[str, int]] = []  # merged used-axes per group
         for op in self.model.ops:
             if isinstance(op, InputOp):
                 continue
             am = self.base._op_axis_maps.get(op.name, {})
             place, ndev = op_block(strategies.get(op.name), am,
                                    self.mesh_shape, self.num_devices)
-            candidate = dict(merged)
-            for ax, d in am.items():
-                if d is not None:
-                    candidate[ax] = d
-            # start a new group on a block change, or when this op's mesh
-            # axes can't share one sub-mesh with the group's (e.g. a
-            # 'data'-sharded op and a 'model'-sharded op both 2-way on a
-            # 2-device block need separate programs)
-            if (current is None or current.place != place
-                    or current.ndev != ndev or coverage(candidate) > ndev):
-                merged = {ax: d for ax, d in am.items() if d is not None}
-                current = PlacementGroup(len(self.groups), place, ndev,
-                                         self._submesh(place, ndev, merged))
-                self.groups.append(current)
-            else:
-                merged = candidate
-            current.ops.append(op)
-            self._op_group[op.name] = current
-        # (re)build each group's mesh to cover all axes its member ops use
-        for g in self.groups:
-            axes: Dict[str, Optional[int]] = {}
-            for op in g.ops:
-                for ax, d in self.base._op_axis_maps.get(op.name, {}).items():
-                    if d is not None:
-                        axes[ax] = d
+            op_axes = {ax: d for ax, d in am.items() if d is not None}
+            g_min = 0
+            for t in op.inputs:
+                if t.owner_op is not None \
+                        and not isinstance(t.owner_op, InputOp):
+                    pg = self._op_group.get(t.owner_op.name)
+                    if pg is not None:
+                        g_min = max(g_min, pg.index)
+            target = None
+            for gi in range(g_min, len(self.groups)):
+                g = self.groups[gi]
+                if g.place != place or g.ndev != ndev:
+                    continue
+                cand = dict(group_axes[gi])
+                cand.update(op_axes)
+                if coverage(cand) <= ndev:
+                    target = g
+                    group_axes[gi] = cand
+                    break
+            if target is None:
+                target = PlacementGroup(len(self.groups), place, ndev, None)
+                self.groups.append(target)
+                group_axes.append(dict(op_axes))
+            target.ops.append(op)
+            self._op_group[op.name] = target
+        # build each group's mesh to cover all axes its member ops use
+        for g, axes in zip(self.groups, group_axes):
             g.mesh = self._submesh(g.place, g.ndev, axes)
 
     # ---- per-group forward --------------------------------------------------

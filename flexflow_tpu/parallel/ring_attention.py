@@ -239,9 +239,16 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     if use_flash is None:
         import os
 
+        from flexflow_tpu.ops.attention import flash_seq_cap
+
+        cap = flash_seq_cap()
         use_flash = ((jax.default_backend() == "tpu"
                       or os.environ.get("FF_FORCE_FLASH_ATTENTION") == "1")
-                     and dropout_rate == 0.0)
+                     and dropout_rate == 0.0
+                     # deployment escape hatch (FF_FLASH_MAX_SEQ): oversized
+                     # local shards take the pure-JAX ring instead
+                     and (not cap
+                          or max(q.shape[1], k.shape[1]) <= cap))
     if use_flash:
         return ring_attention_flash(q, k, v, axis_name, causal, scale)
     p_size = lax.axis_size(axis_name)
