@@ -1,0 +1,40 @@
+"""REAL-data accuracy gate: CNN on the bundled UCI handwritten digits
+(data/digits.npz). Role parity with the reference's real-MNIST CNN gate
+(examples/python/keras/mnist_cnn.py + accuracy.py MNIST_CNN=90)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.keras import Sequential
+from flexflow_tpu.keras.callbacks import EpochVerifyMetrics, ModelAccuracy
+from flexflow_tpu.keras.datasets import digits
+from flexflow_tpu.keras.layers import Conv2D, Dense, Flatten, MaxPooling2D
+
+
+def main():
+    (x_train, y_train), _ = digits.load_data()
+    x_train = x_train.reshape(-1, 1, 8, 8).astype(np.float32) / 16.0
+
+    model = Sequential([
+        Conv2D(32, 3, padding="same", activation="relu",
+               input_shape=(1, 8, 8)),
+        Conv2D(64, 3, padding="same", activation="relu"),
+        MaxPooling2D(2),
+        Flatten(),
+        Dense(128, activation="relu"),
+        Dense(10),
+    ])
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    gates = ([EpochVerifyMetrics(ModelAccuracy.MNIST_CNN)]
+             if os.environ.get("FF_ACCURACY_GATE") else [])
+    model.fit(x_train, y_train, epochs=int(os.environ.get("EPOCHS", 8)),
+              callbacks=gates)
+
+
+if __name__ == "__main__":
+    main()

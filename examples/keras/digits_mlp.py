@@ -1,0 +1,37 @@
+"""REAL-data accuracy gate: MLP on the bundled UCI handwritten digits
+(data/digits.npz — the real-image stand-in for MNIST in this zero-egress
+environment). Role parity with the reference's real-MNIST MLP gate
+(examples/python/keras/mnist_mlp.py + accuracy.py MNIST_MLP=90)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.keras import Sequential
+from flexflow_tpu.keras.callbacks import EpochVerifyMetrics, ModelAccuracy
+from flexflow_tpu.keras.datasets import digits
+from flexflow_tpu.keras.layers import Dense
+
+
+def main():
+    (x_train, y_train), (x_test, y_test) = digits.load_data()
+    x_train = x_train.reshape(-1, 64).astype(np.float32) / 16.0
+
+    model = Sequential([
+        Dense(256, activation="relu", input_shape=(64,)),
+        Dense(128, activation="relu"),
+        Dense(10),
+    ])
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    gates = ([EpochVerifyMetrics(ModelAccuracy.MNIST_MLP)]
+             if os.environ.get("FF_ACCURACY_GATE") else [])
+    model.fit(x_train, y_train, epochs=int(os.environ.get("EPOCHS", 8)),
+              callbacks=gates)
+
+
+if __name__ == "__main__":
+    main()

@@ -11,8 +11,13 @@ from flexflow_tpu.keras.layers import Dense
 
 def main():
     from flexflow_tpu.keras.datasets import reuters
-    (x, y), _ = reuters.load_data(num_words=1000)
-    x = x.astype(np.float32)
+    from flexflow_tpu.keras.preprocessing.text import Tokenizer
+    max_words = 1000
+    (x, y), _ = reuters.load_data(num_words=max_words)
+    # bag-of-words vectorization, as the reference does before its Dense
+    # stack (seq_reuters_mlp.py: tokenizer.sequences_to_matrix 'binary')
+    tokenizer = Tokenizer(num_words=max_words)
+    x = tokenizer.sequences_to_matrix(x, mode="binary")
     num_classes = int(y.max()) + 1
     model = Sequential([
         Dense(512, activation="relu", input_shape=(x.shape[1],)),

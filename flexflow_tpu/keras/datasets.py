@@ -35,6 +35,23 @@ def _synthetic_images(n, shape, num_classes, seed):
     return x.astype(np.uint8), y
 
 
+class digits:
+    """REAL data, bundled in-repo: the UCI ML optical handwritten digits
+    (1797 8x8 grayscale images, sklearn's load_digits source), committed as
+    data/digits.npz (~47 KB). The only real image dataset obtainable in this
+    zero-egress image — the accuracy tier's real-data gates train on it
+    (reference gates train real MNIST the same way, accuracy.py:18-24)."""
+
+    @staticmethod
+    def load_data():
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        full = os.path.join(repo, "data", "digits.npz")
+        with np.load(full) as f:
+            return _limit((f["x_train"], f["y_train"]),
+                          (f["x_test"], f["y_test"]))
+
+
 class mnist:
     @staticmethod
     def load_data(path="mnist.npz"):
@@ -79,7 +96,15 @@ class cifar10:
 
 class reuters:
     @staticmethod
-    def load_data(num_words=1000, maxlen=200):
+    def load_data(num_words=1000, maxlen=200, test_split=0.2):
+        full = os.path.join(_KERAS_CACHE, "reuters.npz")
+        if os.path.exists(full):
+            with np.load(full, allow_pickle=True) as f:
+                xs, ys = f["x"], f["y"]
+            xs = [[w for w in seq if w < num_words] for seq in xs]
+            n_test = int(len(xs) * test_split)
+            return _limit((xs[n_test:], ys[n_test:].astype(np.int32)),
+                          (xs[:n_test], ys[:n_test].astype(np.int32)))
         print("[flexflow_tpu.keras.datasets] reuters: synthetic fallback",
               file=sys.stderr)
         rs = np.random.RandomState(4)

@@ -1,0 +1,1 @@
+from flexflow_tpu.keras.preprocessing import sequence, text  # noqa: F401

@@ -1,0 +1,25 @@
+"""Sequence preprocessing (role parity with the reference's re-export of
+keras_preprocessing.sequence, python/flexflow/keras/preprocessing/
+sequence.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def pad_sequences(sequences, maxlen=None, dtype="int32", padding="pre",
+                  truncating="pre", value=0):
+    if maxlen is None:
+        maxlen = max((len(s) for s in sequences), default=0)
+    out = np.full((len(sequences), maxlen), value, dtype=dtype)
+    for i, seq in enumerate(sequences):
+        seq = list(seq)
+        if len(seq) > maxlen:
+            seq = seq[-maxlen:] if truncating == "pre" else seq[:maxlen]
+        if not seq:
+            continue
+        if padding == "pre":
+            out[i, -len(seq):] = seq
+        else:
+            out[i, :len(seq)] = seq
+    return out

@@ -1,0 +1,84 @@
+"""Text preprocessing (role parity with the reference's re-export of
+keras_preprocessing.text, python/flexflow/keras/preprocessing/text.py —
+this environment has no keras_preprocessing, so the subset the examples
+use is implemented from scratch)."""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+_SPLIT_RE = re.compile(r"[\s!\"#$%&()*+,\-./:;<=>?@\[\\\]^_`{|}~\t\n]+")
+
+
+def text_to_word_sequence(text: str, lower: bool = True) -> List[str]:
+    if lower:
+        text = text.lower()
+    return [w for w in _SPLIT_RE.split(text) if w]
+
+
+class Tokenizer:
+    """Word-index tokenizer. Index 0 is reserved (padding), matching the
+    keras convention; `num_words` caps the vocabulary to the most frequent
+    words at transform time."""
+
+    def __init__(self, num_words: Optional[int] = None, lower: bool = True,
+                 oov_token: Optional[str] = None):
+        self.num_words = num_words
+        self.lower = lower
+        self.oov_token = oov_token
+        self.word_counts: Dict[str, int] = {}
+        self.word_index: Dict[str, int] = {}
+
+    def fit_on_texts(self, texts: Iterable[str]):
+        for t in texts:
+            for w in text_to_word_sequence(t, self.lower):
+                self.word_counts[w] = self.word_counts.get(w, 0) + 1
+        ranked = sorted(self.word_counts, key=self.word_counts.get,
+                        reverse=True)
+        offset = 1
+        self.word_index = {}
+        if self.oov_token is not None:
+            self.word_index[self.oov_token] = offset
+            offset += 1
+        for i, w in enumerate(ranked):
+            self.word_index[w] = i + offset
+
+    def texts_to_sequences(self, texts: Iterable[str]) -> List[List[int]]:
+        out = []
+        oov = self.word_index.get(self.oov_token) \
+            if self.oov_token is not None else None
+        for t in texts:
+            seq = []
+            for w in text_to_word_sequence(t, self.lower):
+                idx = self.word_index.get(w)
+                if idx is not None and (self.num_words is None
+                                        or idx < self.num_words):
+                    seq.append(idx)
+                elif oov is not None:
+                    seq.append(oov)
+            out.append(seq)
+        return out
+
+    def sequences_to_matrix(self, sequences, mode: str = "binary"):
+        """Vectorize integer sequences to a (n, num_words) matrix — the
+        bag-of-words step the reference's reuters examples run before their
+        Dense stack (seq_reuters_mlp.py)."""
+        if self.num_words is None:
+            raise ValueError("sequences_to_matrix needs num_words")
+        n = len(sequences)
+        m = np.zeros((n, self.num_words), dtype=np.float32)
+        for i, seq in enumerate(sequences):
+            seq = np.asarray(seq).reshape(-1)
+            seq = seq[(seq >= 0) & (seq < self.num_words)]
+            if mode == "binary":
+                m[i, seq] = 1.0
+            elif mode in ("count", "freq"):
+                np.add.at(m[i], seq, 1.0)
+                if mode == "freq" and len(seq):
+                    m[i] /= len(seq)
+            else:
+                raise ValueError(f"unknown mode {mode!r}")
+        return m

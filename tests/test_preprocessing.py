@@ -1,0 +1,75 @@
+"""Keras preprocessing clone (reference re-exports keras_preprocessing at
+python/flexflow/keras/preprocessing/{text,sequence}.py; here implemented
+from scratch for the offline image)."""
+
+import numpy as np
+
+from flexflow_tpu.keras.preprocessing.sequence import pad_sequences
+from flexflow_tpu.keras.preprocessing.text import (Tokenizer,
+                                                   text_to_word_sequence)
+
+
+def test_text_to_word_sequence():
+    assert text_to_word_sequence("Hello, world! foo_bar") == \
+        ["hello", "world", "foo", "bar"]
+    assert text_to_word_sequence("Keep CASE", lower=False) == \
+        ["Keep", "CASE"]
+
+
+def test_tokenizer_fit_and_transform():
+    tok = Tokenizer(num_words=4)
+    tok.fit_on_texts(["the cat sat", "the cat ran", "the dog"])
+    # most-frequent word gets index 1
+    assert tok.word_index["the"] == 1
+    assert tok.word_index["cat"] == 2
+    seqs = tok.texts_to_sequences(["the cat", "the emu"])
+    assert seqs[0] == [1, 2]
+    assert seqs[1] == [1]  # unknown word dropped without oov_token
+
+
+def test_tokenizer_oov():
+    tok = Tokenizer(num_words=10, oov_token="<oov>")
+    tok.fit_on_texts(["a b"])
+    assert tok.texts_to_sequences(["a z"])[0] == \
+        [tok.word_index["a"], tok.word_index["<oov>"]]
+
+
+def test_sequences_to_matrix_modes():
+    tok = Tokenizer(num_words=5)
+    m = tok.sequences_to_matrix([[1, 2, 2], [4]], mode="binary")
+    np.testing.assert_array_equal(m, [[0, 1, 1, 0, 0], [0, 0, 0, 0, 1]])
+    m = tok.sequences_to_matrix([[1, 2, 2]], mode="count")
+    np.testing.assert_array_equal(m, [[0, 1, 2, 0, 0]])
+    m = tok.sequences_to_matrix([[1, 2, 2]], mode="freq")
+    np.testing.assert_allclose(m, [[0, 1 / 3, 2 / 3, 0, 0]])
+    # out-of-range ids ignored
+    m = tok.sequences_to_matrix([[1, 7, -2]], mode="binary")
+    np.testing.assert_array_equal(m, [[0, 1, 0, 0, 0]])
+
+
+def test_pad_sequences():
+    out = pad_sequences([[1, 2], [3]], maxlen=3)
+    np.testing.assert_array_equal(out, [[0, 1, 2], [0, 0, 3]])
+    out = pad_sequences([[1, 2, 3, 4]], maxlen=2)          # pre-truncate
+    np.testing.assert_array_equal(out, [[3, 4]])
+    out = pad_sequences([[1, 2, 3, 4]], maxlen=2, truncating="post")
+    np.testing.assert_array_equal(out, [[1, 2]])
+    out = pad_sequences([[1], []], maxlen=2, padding="post", value=9)
+    np.testing.assert_array_equal(out, [[1, 9], [9, 9]])
+    # maxlen inferred
+    np.testing.assert_array_equal(pad_sequences([[5], [6, 7]]),
+                                  [[0, 5], [6, 7]])
+
+
+def test_digits_dataset_is_real():
+    """The bundled digits npz: right shapes/ranges and non-trivially
+    learnable structure (class means differ)."""
+    from flexflow_tpu.keras.datasets import digits
+
+    (xtr, ytr), (xte, yte) = digits.load_data()
+    assert xtr.shape[1:] == (8, 8) and xte.shape[1:] == (8, 8)
+    assert xtr.max() <= 16 and xtr.min() >= 0
+    assert set(np.unique(ytr)) == set(range(10))
+    m0 = xtr[ytr == 0].mean(axis=0)
+    m1 = xtr[ytr == 1].mean(axis=0)
+    assert np.abs(m0 - m1).max() > 2.0
