@@ -1,5 +1,5 @@
 """REAL-data accuracy gate: CNN on the bundled UCI handwritten digits
-(data/digits.npz). Role parity with the reference's real-MNIST CNN gate
+(flexflow_tpu/data/digits.npz). Role parity with the reference's real-MNIST CNN gate
 (examples/python/keras/mnist_cnn.py + accuracy.py MNIST_CNN=90)."""
 
 import os

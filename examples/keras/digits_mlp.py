@@ -1,6 +1,6 @@
 """REAL-data accuracy gate: MLP on the bundled UCI handwritten digits
-(data/digits.npz — the real-image stand-in for MNIST in this zero-egress
-environment). Role parity with the reference's real-MNIST MLP gate
+(flexflow_tpu/data/digits.npz — the real-image stand-in for MNIST in this
+zero-egress environment). Role parity with the reference's real-MNIST MLP gate
 (examples/python/keras/mnist_mlp.py + accuracy.py MNIST_MLP=90)."""
 
 import os

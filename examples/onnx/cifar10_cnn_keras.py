@@ -1,0 +1,78 @@
+"""keras_exp flow for CIFAR-10 (reference:
+examples/python/onnx/cifar10_cnn_keras.py — tf.keras -> keras2onnx ->
+ONNXModelKeras). Built offline with the in-repo minimal codec; Keras
+exporters emit Dense nodes plus standard Conv/MaxPool."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.onnx import ONNXModelKeras
+from flexflow_tpu.onnx import minionnx as mo
+
+
+def export_keras_style(path):
+    rs = np.random.RandomState(0)
+
+    def conv_w(cout, cin, k, name):
+        return mo.from_array(
+            rs.randn(cout, cin, k, k).astype(np.float32) * 0.05, name)
+
+    ws = [
+        conv_w(32, 3, 3, "conv2d/kernel"),
+        conv_w(64, 32, 3, "conv2d_1/kernel"),
+        mo.from_array(rs.randn(512, 64 * 8 * 8).astype(np.float32) * 0.01,
+                      "dense/kernel"),
+        mo.from_array(rs.randn(10, 512).astype(np.float32) * 0.05,
+                      "dense_1/kernel"),
+    ]
+    nodes = [
+        mo.make_node("Conv", ["input", "conv2d/kernel"], ["c1"],
+                     name="conv2d", kernel_shape=[3, 3], pads=[1, 1, 1, 1],
+                     strides=[1, 1]),
+        mo.make_node("Relu", ["c1"], ["a1"]),
+        mo.make_node("MaxPool", ["a1"], ["p1"], kernel_shape=[2, 2],
+                     strides=[2, 2]),
+        mo.make_node("Conv", ["p1", "conv2d_1/kernel"], ["c2"],
+                     name="conv2d_1", kernel_shape=[3, 3], pads=[1, 1, 1, 1],
+                     strides=[1, 1]),
+        mo.make_node("Relu", ["c2"], ["a2"]),
+        mo.make_node("MaxPool", ["a2"], ["p2"], kernel_shape=[2, 2],
+                     strides=[2, 2]),
+        mo.make_node("Flatten", ["p2"], ["f"]),
+        mo.make_node("Dense", ["f", "dense/kernel"], ["d1"], name="dense"),
+        mo.make_node("Relu", ["d1"], ["a3"]),
+        mo.make_node("Dense", ["a3", "dense_1/kernel"], ["logits"],
+                     name="dense_1"),
+    ]
+    g = mo.make_graph(
+        nodes, "keras_cifar10_cnn",
+        [mo.make_tensor_value_info("input", mo.DT_FLOAT, [64, 3, 32, 32])],
+        [mo.make_tensor_value_info("logits", mo.DT_FLOAT, [64, 10])],
+        initializer=ws)
+    mo.save(mo.make_model(g), path)
+
+
+def main():
+    from flexflow_tpu.keras.datasets import cifar10
+    path = "/tmp/cifar10_cnn_keras.onnx"
+    export_keras_style(path)
+
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 3, 32, 32], name="input")
+    out = ONNXModelKeras(path).apply(ff, {"input": x})
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=out)
+
+    (x_train, y_train), _ = cifar10.load_data()
+    SingleDataLoader(ff, x, x_train.astype(np.float32) / 255.0)
+    SingleDataLoader(ff, ff.label_tensor,
+                     y_train.astype(np.int32).reshape(-1, 1))
+    ff.fit(epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

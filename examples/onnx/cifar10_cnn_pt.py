@@ -1,0 +1,59 @@
+"""PyTorch -> ONNX -> import round trip for the CIFAR-10 CNN (reference:
+examples/python/onnx/cifar10_cnn_pt.py)."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+import torch
+import torch.nn as nn
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.onnx import ONNXModel
+from flexflow_tpu.onnx.torch_export import export
+
+
+class CNN(nn.Module):
+    """Matches the reference cifar10_cnn topology (2x[conv,conv,pool] +
+    dense)."""
+
+    def __init__(self):
+        super().__init__()
+        self.c1 = nn.Conv2d(3, 32, 3, padding=1)
+        self.c2 = nn.Conv2d(32, 32, 3, padding=1)
+        self.p1 = nn.MaxPool2d(2)
+        self.c3 = nn.Conv2d(32, 64, 3, padding=1)
+        self.c4 = nn.Conv2d(64, 64, 3, padding=1)
+        self.p2 = nn.MaxPool2d(2)
+        self.flat = nn.Flatten()
+        self.d1 = nn.Linear(64 * 8 * 8, 512)
+        self.d2 = nn.Linear(512, 10)
+
+    def forward(self, x):
+        x = self.p1(torch.relu(self.c2(torch.relu(self.c1(x)))))
+        x = self.p2(torch.relu(self.c4(torch.relu(self.c3(x)))))
+        return self.d2(torch.relu(self.d1(self.flat(x))))
+
+
+def main():
+    from flexflow_tpu.keras.datasets import cifar10
+    path = "/tmp/cifar10_cnn_pt.onnx"
+    export(CNN(), torch.randn(8, 3, 32, 32), path,
+           input_names=["input"], output_names=["logits"])
+
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 3, 32, 32], name="input")
+    out = ONNXModel(path).apply(ff, {"input": x})
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=out)
+
+    (x_train, y_train), _ = cifar10.load_data()
+    SingleDataLoader(ff, x, x_train.astype(np.float32) / 255.0)
+    SingleDataLoader(ff, ff.label_tensor,
+                     y_train.astype(np.int32).reshape(-1, 1))
+    ff.fit(epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

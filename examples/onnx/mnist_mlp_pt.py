@@ -1,0 +1,51 @@
+"""PyTorch -> ONNX -> import round trip for the MNIST MLP (reference:
+examples/python/onnx/mnist_mlp_pt.py, which runs torch.onnx.export then
+replays the file). Works without the onnx package: the export goes through
+flexflow_tpu.onnx.torch_export and the import through the minionnx codec."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+import torch
+import torch.nn as nn
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.onnx import ONNXModel
+from flexflow_tpu.onnx.torch_export import export
+
+
+class MLP(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(784, 512)
+        self.fc2 = nn.Linear(512, 512)
+        self.fc3 = nn.Linear(512, 10)
+
+    def forward(self, x):
+        return self.fc3(torch.relu(self.fc2(torch.relu(self.fc1(x)))))
+
+
+def main():
+    from flexflow_tpu.keras.datasets import mnist
+    path = "/tmp/mnist_mlp_pt.onnx"
+    export(MLP(), torch.randn(64, 784), path,
+           input_names=["input"], output_names=["logits"])
+
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 784], name="input")
+    out = ONNXModel(path).apply(ff, {"input": x})
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=out)
+
+    (x_train, y_train), _ = mnist.load_data()
+    SingleDataLoader(ff, x,
+                     x_train.reshape(-1, 784).astype(np.float32) / 255.0)
+    SingleDataLoader(ff, ff.label_tensor,
+                     y_train.astype(np.int32).reshape(-1, 1))
+    ff.fit(epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

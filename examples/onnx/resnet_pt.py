@@ -1,0 +1,75 @@
+"""PyTorch -> ONNX -> import round trip for a residual network (reference:
+examples/python/onnx/resnet_pt.py). Exercises the BatchNormalization and
+residual-Add import paths."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+import torch
+import torch.nn as nn
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.onnx import ONNXModel
+from flexflow_tpu.onnx.torch_export import export
+
+
+class BasicBlock(nn.Module):
+    def __init__(self, cin, cout, stride=1):
+        super().__init__()
+        self.c1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+        self.b1 = nn.BatchNorm2d(cout)
+        self.c2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+        self.b2 = nn.BatchNorm2d(cout)
+        self.down = None
+        if stride != 1 or cin != cout:
+            self.down = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride, bias=False),
+                nn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idt = x if self.down is None else self.down(x)
+        y = self.b2(self.c2(torch.relu(self.b1(self.c1(x)))))
+        return torch.relu(y + idt)
+
+
+class ResNet(nn.Module):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.stem = nn.Sequential(nn.Conv2d(3, 16, 3, 1, 1, bias=False),
+                                  nn.BatchNorm2d(16), nn.ReLU())
+        self.layer1 = nn.Sequential(BasicBlock(16, 16), BasicBlock(16, 16))
+        self.layer2 = nn.Sequential(BasicBlock(16, 32, 2),
+                                    BasicBlock(32, 32))
+        self.pool = nn.AvgPool2d(16)
+        self.flat = nn.Flatten()
+        self.fc = nn.Linear(32, num_classes)
+
+    def forward(self, x):
+        x = self.layer2(self.layer1(self.stem(x)))
+        return self.fc(self.flat(self.pool(x)))
+
+
+def main():
+    from flexflow_tpu.keras.datasets import cifar10
+    path = "/tmp/resnet_pt.onnx"
+    m = ResNet().eval()  # fold BN to inference form for a stable export
+    export(m, torch.randn(4, 3, 32, 32), path,
+           input_names=["input"], output_names=["logits"])
+
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 3, 32, 32], name="input")
+    out = ONNXModel(path).apply(ff, {"input": x})
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=out)
+
+    (x_train, y_train), _ = cifar10.load_data()
+    SingleDataLoader(ff, x, x_train.astype(np.float32) / 255.0)
+    SingleDataLoader(ff, ff.label_tensor,
+                     y_train.astype(np.int32).reshape(-1, 1))
+    ff.fit(epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

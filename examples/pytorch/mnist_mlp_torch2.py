@@ -1,0 +1,51 @@
+"""MNIST MLP via the in-memory FX flow (reference:
+examples/python/pytorch/mnist_mlp_torch2.py — the 'torch2' variant drives
+the importer without an intermediate .ff file). Functional ops
+(torch.relu, torch.flatten) exercise the FunctionNode path of the
+tracer."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+import torch
+import torch.nn as nn
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.keras.datasets import mnist
+from flexflow_tpu.torch import PyTorchModel
+
+
+class MLP(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(784, 512)
+        self.fc2 = nn.Linear(512, 512)
+        self.fc3 = nn.Linear(512, 10)
+
+    def forward(self, x):
+        x = torch.flatten(x, 1)
+        x = torch.relu(self.fc1(x))
+        x = torch.relu(self.fc2(x))
+        return self.fc3(x)
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 784], name="x")
+    # no .ff file on disk: trace straight from the live module
+    outs = PyTorchModel(model=MLP()).apply(ff, [x])
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=outs[0])
+
+    (x_train, y_train), _ = mnist.load_data()
+    SingleDataLoader(ff, x,
+                     x_train.reshape(-1, 784).astype(np.float32) / 255.0)
+    SingleDataLoader(ff, ff.label_tensor,
+                     y_train.astype(np.int32).reshape(-1, 1))
+    ff.fit(epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

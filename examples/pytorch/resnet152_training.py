@@ -1,0 +1,98 @@
+"""Deep bottleneck ResNet (torchvision resnet50/101/152 architecture)
+imported through torch.fx and trained (reference:
+examples/python/pytorch/resnet152_training.py, which imports torchvision's
+resnet152 — torchvision is absent from this image, so the identical
+bottleneck architecture is defined locally; --depth picks the standard
+[3,4,6,3]/[3,4,23,3]/[3,8,36,3] stage configs)."""
+import argparse
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+import torch
+import torch.nn as nn
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.torch import PyTorchModel
+
+DEPTHS = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+
+
+class Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, cin, width, stride=1):
+        super().__init__()
+        cout = width * self.expansion
+        self.c1 = nn.Conv2d(cin, width, 1, bias=False)
+        self.b1 = nn.BatchNorm2d(width)
+        self.c2 = nn.Conv2d(width, width, 3, stride, 1, bias=False)
+        self.b2 = nn.BatchNorm2d(width)
+        self.c3 = nn.Conv2d(width, cout, 1, bias=False)
+        self.b3 = nn.BatchNorm2d(cout)
+        self.relu = nn.ReLU()
+        self.down = None
+        if stride != 1 or cin != cout:
+            self.down = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride, bias=False),
+                nn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idt = x if self.down is None else self.down(x)
+        y = self.relu(self.b1(self.c1(x)))
+        y = self.relu(self.b2(self.c2(y)))
+        y = self.b3(self.c3(y))
+        return self.relu(y + idt)
+
+
+class ResNet(nn.Module):
+    def __init__(self, depth=152, num_classes=10, width=64):
+        super().__init__()
+        stages = DEPTHS[depth]
+        layers = [nn.Conv2d(3, width, 7, 2, 3, bias=False),
+                  nn.BatchNorm2d(width), nn.ReLU(), nn.MaxPool2d(3, 2, 1)]
+        cin = width
+        for si, blocks in enumerate(stages):
+            w = width * (2 ** si)
+            for bi in range(blocks):
+                layers.append(Bottleneck(cin, w, stride=2
+                                         if bi == 0 and si > 0 else 1))
+                cin = w * Bottleneck.expansion
+        self.trunk = nn.Sequential(*layers)
+        self.pool = nn.AdaptiveAvgPool2d((1, 1))
+        self.flat = nn.Flatten()
+        self.fc = nn.Linear(cin, num_classes)
+
+    def forward(self, x):
+        return self.fc(self.flat(self.pool(self.trunk(x))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-b", "--batch-size", type=int, default=8)
+    ap.add_argument("--depth", type=int, default=152,
+                    choices=sorted(DEPTHS))
+    ap.add_argument("--image-size", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=2)
+    args, _ = ap.parse_known_args()
+
+    b, im = args.batch_size, args.image_size
+    cfg = FFConfig(batch_size=b)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([b, 3, im, im], name="x")
+    outs = PyTorchModel(model=ResNet(args.depth)).apply(ff, [x])
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=outs[0])
+
+    rs = np.random.RandomState(0)
+    SingleDataLoader(ff, x, rs.randn(b * 2, 3, im, im).astype(np.float32))
+    SingleDataLoader(ff, ff.label_tensor,
+                     rs.randint(0, 10, (b * 2, 1)).astype(np.int32))
+    for _ in range(args.iters):
+        loss, _ = ff._run_train_step(ff._stage_batch())
+    print(f"resnet{args.depth}: final loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

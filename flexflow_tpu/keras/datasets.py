@@ -36,17 +36,17 @@ def _synthetic_images(n, shape, num_classes, seed):
 
 
 class digits:
-    """REAL data, bundled in-repo: the UCI ML optical handwritten digits
-    (1797 8x8 grayscale images, sklearn's load_digits source), committed as
-    data/digits.npz (~47 KB). The only real image dataset obtainable in this
-    zero-egress image — the accuracy tier's real-data gates train on it
-    (reference gates train real MNIST the same way, accuracy.py:18-24)."""
+    """REAL data, bundled in the package: the UCI ML optical handwritten
+    digits (1797 8x8 grayscale images, sklearn's load_digits source),
+    shipped as flexflow_tpu/data/digits.npz (~47 KB). The only real image
+    dataset obtainable in this zero-egress image — the accuracy tier's
+    real-data gates train on it (reference gates train real MNIST the same
+    way, accuracy.py:18-24)."""
 
     @staticmethod
     def load_data():
-        repo = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
-        full = os.path.join(repo, "data", "digits.npz")
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        full = os.path.join(pkg, "data", "digits.npz")
         with np.load(full) as f:
             return _limit((f["x_train"], f["y_train"]),
                           (f["x_test"], f["y_test"]))

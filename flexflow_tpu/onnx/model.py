@@ -38,6 +38,10 @@ class ONNXModel:
         if isinstance(filename, str):
             try:
                 import onnx
+                if getattr(onnx, "__flexflow_tpu_stub__", False):
+                    # torch_export installed its scan-only stand-in; it
+                    # cannot parse files
+                    raise ImportError("onnx is the torch-export stub")
                 self.model = onnx.load(filename)
             except ImportError:
                 # the in-repo minimal codec parses the same wire format, so
@@ -207,6 +211,14 @@ class ONNXModel:
         self.symbol_table = dict(input_dict)
         outputs = None
         for node in self.model.graph.node:
+            # torch eval-mode exports route shared/folded weights through
+            # Identity nodes whose input is an initializer, not a symbol —
+            # alias the initializer under the output name and move on
+            if node.op_type == "Identity" \
+                    and node.input[0] in self.initializer:
+                self.initializer[node.output[0]] = \
+                    self.initializer[node.input[0]]
+                continue
             handler = getattr(self, "handle" + node.op_type, None)
             if handler is None:
                 raise AssertionError(f"unsupported ONNX op {node.op_type}")

@@ -1,0 +1,63 @@
+"""torch.onnx.export without the `onnx` package.
+
+The torch TorchScript exporter serializes the ModelProto itself (C++
+protobuf writer) but unconditionally imports the `onnx` python package for
+one post-pass, `_add_onnxscript_fn`, which deserializes the model only to
+scan for custom onnx-script functions and returns the bytes UNCHANGED when
+there are none (torch/onnx/_internal/torchscript_exporter/
+onnx_proto_utils.py). Standard nn.Module exports carry no such functions,
+so in this offline image we satisfy that import with a stub whose parsed
+model reports zero nodes — the scan no-ops and the exporter writes the
+exact bytes it produced. The resulting file is a normal ONNX protobuf that
+flexflow_tpu.onnx.ONNXModel parses with the in-repo minionnx codec.
+
+Role parity: the reference's *_pt.py onnx examples run torch.onnx.export
+with the real onnx package installed (examples/python/onnx/mnist_mlp_pt.py).
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+
+class _StubGraph:
+    node = ()
+
+
+class _StubModel:
+    graph = _StubGraph()
+    functions: list = []
+
+
+def _install_onnx_stub() -> None:
+    mod = types.ModuleType("onnx")
+    mod.__doc__ = ("flexflow_tpu minimal stand-in for the onnx package "
+                   "(torch export custom-function scan only)")
+    mod.load_model_from_string = lambda b: _StubModel()
+    mod.__flexflow_tpu_stub__ = True
+    sys.modules["onnx"] = mod
+
+
+def export(model, args, path: str, input_names=None, output_names=None,
+           **kwargs) -> None:
+    """Drop-in for torch.onnx.export that works with or without the real
+    onnx package. Forces the TorchScript exporter (dynamo=False): the
+    dynamo exporter needs onnxscript, absent from this image. The stub is
+    confined to this call — it is removed from sys.modules afterwards so a
+    later `import onnx` elsewhere fails cleanly instead of hitting a
+    two-attribute stand-in."""
+    stub_installed = False
+    try:
+        import onnx  # noqa: F401 — real package present, nothing to do
+    except ImportError:
+        _install_onnx_stub()
+        stub_installed = True
+    import torch
+
+    try:
+        torch.onnx.export(model, args, path, input_names=input_names,
+                          output_names=output_names, dynamo=False, **kwargs)
+    finally:
+        if stub_installed:
+            sys.modules.pop("onnx", None)

@@ -126,6 +126,12 @@ class Pool2D(Op):
         n, c, h, w = self.inputs[0].dims
         oh = (h + 2 * self.padding[0] - self.kernel[0]) // self.stride[0] + 1
         ow = (w + 2 * self.padding[1] - self.kernel[1]) // self.stride[1] + 1
+        if oh < 1 or ow < 1:
+            raise ValueError(
+                f"{self.name}: pool2d kernel {self.kernel} stride "
+                f"{self.stride} padding {self.padding} on a {h}x{w} input "
+                f"yields an empty {oh}x{ow} output — shrink the kernel or "
+                f"the stride")
         return [(n, c, oh, ow)], [self.inputs[0].dtype]
 
     def forward(self, params, xs, *, training=False, rng=None):

@@ -51,6 +51,9 @@ def _symbolic_trace(model):
 
     assert isinstance(model, torch.nn.Module)
     traced = torch.fx.symbolic_trace(model)
+    # tuple unpacks like `out, _ = self.attn(x, x, x)` leave a dead
+    # getitem[1] in the trace; drop it before emission
+    traced.graph.eliminate_dead_code()
     modules_by_name = dict(model.named_modules())
     graph: List[Node] = []
     for node in traced.graph.nodes:
@@ -137,8 +140,18 @@ def _emit(node) -> str:
         if isinstance(m, (nn.AdaptiveMaxPool2d, nn.AdaptiveAvgPool2d)):
             pt = PoolType.POOL_MAX if isinstance(m, nn.AdaptiveMaxPool2d) \
                 else PoolType.POOL_AVG
-            # reference FIXME kept: emit 3/1/0 (fx.py parse_adaptivepool2d)
-            return s + (f"{enum_to_str(OpType, OpType.POOL2D)}, 3, 1, 0, "
+            out_sz = m.output_size
+            if not isinstance(out_sz, (tuple, list)):
+                out_sz = (out_sz, out_sz)
+            if any(v != 1 for v in out_sz):
+                raise AssertionError(
+                    f"adaptive pool with output_size {m.output_size}: only "
+                    f"global (1x1) pooling is expressible in the .ff IR")
+            # kernel 0 = 'global': the replayer resolves it to the input's
+            # spatial size at graph build, where shapes are known (the
+            # reference emitted a fixed 3/1/0 here — a latent FIXME,
+            # fx.py parse_adaptivepool2d — that breaks small feature maps)
+            return s + (f"{enum_to_str(OpType, OpType.POOL2D)}, 0, 1, 0, "
                         f"{enum_to_int(PoolType, pt)}, "
                         f"{enum_to_int(ActiMode, ActiMode.AC_MODE_NONE)}\n")
         if isinstance(m, nn.BatchNorm2d):

@@ -33,12 +33,14 @@ class PyTorchModel:
     def apply(self, ffmodel, input_tensors: List) -> List:
         output_tensors = []
         input_idx = 0
+        kinds: Dict[str, OpType] = {}  # op name -> IR op type (for GETITEM)
         for line in self.lines:
             items = [i.strip() for i in line.strip().split(",")]
             assert len(items) >= 3, f"wrong format: {line!r}"
             op_name = items[0]
             ins = [i for i in (s.strip() for s in items[1].split(":")) if i]
             op_type = str_to_enum(OpType, items[3])
+            kinds[op_name] = op_type
             T = self.tensor_dict
 
             if op_type == OpType.INPUT:
@@ -66,7 +68,11 @@ class PyTorchModel:
                 k, s_, p = int(items[4]), int(items[5]), int(items[6])
                 pool = int_to_enum(PoolType, int(items[7]))
                 activ = int_to_enum(ActiMode, int(items[8]))
-                T[op_name] = ffmodel.pool2d(T[ins[0]], k, k, s_, s_, p, p,
+                if k == 0:  # global (adaptive 1x1) pool marker
+                    kh, kw = T[ins[0]].dims[2], T[ins[0]].dims[3]
+                else:
+                    kh = kw = k
+                T[op_name] = ffmodel.pool2d(T[ins[0]], kh, kw, s_, s_, p, p,
                                             pool_type=pool, activation=activ,
                                             name=op_name)
             elif op_type == OpType.BATCH_NORM:
@@ -115,7 +121,21 @@ class PyTorchModel:
                                            name=op_name)
             elif op_type == OpType.GETITEM:
                 idx = int(items[4])
-                T[op_name] = T[ins[0]][idx]
+                src = T[ins[0]]
+                if isinstance(src, (list, tuple)):
+                    T[op_name] = src[idx]
+                elif idx == 0 and \
+                        kinds.get(ins[0]) == OpType.MULTIHEAD_ATTENTION:
+                    # nn.MultiheadAttention returns (output, weights); here
+                    # only the output tensor is materialized, so [0] is it.
+                    # Restricted to MHA sources: getitem[0] on an ordinary
+                    # tensor is real indexing and must not silently alias
+                    T[op_name] = src
+                else:
+                    raise ValueError(
+                        f"{op_name}: getitem[{idx}] on {ins[0]} "
+                        f"({kinds.get(ins[0])}) is not supported — tensor "
+                        f"indexing has no .ff IR lowering")
             elif op_type == OpType.RESHAPE:
                 shape = [int(v) for v in items[4].split(":") if v]
                 T[op_name] = ffmodel.reshape(T[ins[0]], shape, name=op_name)

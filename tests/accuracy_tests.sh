@@ -5,7 +5,8 @@
 #
 # Data tiers:
 #  * REAL data, always: digits_mlp / digits_cnn train the bundled UCI
-#    handwritten digits (data/digits.npz) to >=90% — the real-data gate the
+#    handwritten digits (flexflow_tpu/data/digits.npz) to >=90% — the
+#    real-data gate the
 #    reference gets from MNIST (accuracy.py:18-24). This zero-egress image
 #    ships no MNIST/CIFAR/Reuters files and no network, so the bundled
 #    digits set is the only real image data available.

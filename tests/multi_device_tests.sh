@@ -83,12 +83,21 @@ python examples/pytorch/mlp_torch_compare.py
 python examples/pytorch/mnist_mlp_torch.py
 python examples/pytorch/cifar10_cnn_fx.py -e 1 -b "$BATCH"
 python examples/pytorch/torch_vision.py -e 1 -b "$BATCH"
+python examples/pytorch/mnist_mlp_torch2.py -e 1 -b "$BATCH"
+python examples/pytorch/bert_fx.py -b "$NDEV" --iters 2
+python examples/pytorch/regnet_fx.py -b "$NDEV" --iters 2
+python examples/pytorch/resnet152_training.py -b "$NDEV" --depth 50 --iters 1 --image-size 32
 python examples/onnx/mnist_mlp_onnx.py -e 1 -b "$BATCH"
 python examples/onnx/mnist_mlp.py -e 1 -b "$BATCH"
 python examples/onnx/cifar10_cnn.py -e 1 -b "$BATCH"
 python examples/onnx/alexnet.py -e 1 -b 16
 python examples/onnx/resnet.py -e 1 -b "$BATCH"
 python examples/onnx/mnist_mlp_keras.py -e 1 -b "$BATCH"
+python examples/onnx/mnist_mlp_pt.py -e 1 -b "$BATCH"
+python examples/onnx/cifar10_cnn_pt.py -e 1 -b "$BATCH"
+python examples/onnx/alexnet_pt.py -e 1 -b 16
+python examples/onnx/resnet_pt.py -e 1 -b "$BATCH"
+python examples/onnx/cifar10_cnn_keras.py -e 1 -b "$BATCH"
 
 # bootcamp demo
 python bootcamp_demo/native_alexnet.py -e 1 -b "$BATCH"
