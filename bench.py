@@ -105,7 +105,13 @@ def _run_tier(tier, n_dev, compute, peak, peak_src, backend, dev_kind):
     _phase(f"build_{name}")
 
     cfg = FFConfig(batch_size=batch, mesh_shape={"data": n_dev},
-                   compute_dtype=compute)
+                   compute_dtype=compute,
+                   # MFU ablation knobs (VERDICT r2 #4): bf16 master weights
+                   # halve optimizer HBM traffic; fused add+layernorm saves
+                   # an HBM pass per residual hop
+                   master_dtype=os.environ.get("FF_BENCH_MASTER_DTYPE",
+                                               "float32"),
+                   use_fused_ln=os.environ.get("FF_BENCH_FUSED_LN") == "1")
     ff = FFModel(cfg)
     x, out = build_encoder_classifier(ff, batch, seq, hidden, layers, heads)
     ff.compile(SGDOptimizer(lr=0.01),

@@ -67,6 +67,14 @@ class FFConfig:
     perform_fusion: bool = False
     simulator_workspace_size: int = 2 * 1024 * 1024 * 1024
     compute_dtype: str = "float32"  # "bfloat16" for MXU-native training
+    # storage dtype of master weights/optimizer state. "bfloat16" halves the
+    # optimizer's HBM traffic and removes the per-step f32->bf16 cast pass
+    # (the measured ~7ms/step non-layer overhead, round-2 notes); update
+    # MATH stays f32 inside the optimizer regardless
+    master_dtype: str = "float32"
+    # fuse residual-add + layernorm into one Pallas kernel in models that
+    # opt in (models/transformer.py encoder blocks)
+    use_fused_ln: bool = False
     use_flash_attention: bool = True  # Pallas flash kernel on the dense path
     # keep datasets device-resident (next_batch = on-device slice, the
     # reference's ZC-resident design) when they fit the budget
@@ -78,6 +86,13 @@ class FFConfig:
     strategies: Dict[str, "ParallelConfig"] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
+        for field in ("compute_dtype", "master_dtype"):
+            v = getattr(self, field)
+            if v not in ("float32", "bfloat16"):
+                raise ValueError(
+                    f"{field}={v!r}: must be 'float32' or 'bfloat16' "
+                    f"(exact spelling — a typo here would silently run the "
+                    f"wrong precision)")
         if self.num_devices is None:
             if self.mesh_shape is not None:
                 # derive from the mesh without touching the backend (keeps

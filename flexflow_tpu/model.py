@@ -29,7 +29,8 @@ from flexflow_tpu.ops.base import InputOp, Op
 from flexflow_tpu.ops.conv import BatchNorm, Conv2D, Flat, Pool2D
 from flexflow_tpu.ops.dense import BatchMatmul, Embedding, Linear
 from flexflow_tpu.ops.elementwise import Cast, ElementBinary, ElementUnary, Mean
-from flexflow_tpu.ops.norm import Dropout, LayerNorm, RMSNorm, Softmax
+from flexflow_tpu.ops.norm import (AddLayerNorm, Dropout, LayerNorm, RMSNorm,
+                                   Softmax)
 from flexflow_tpu.ops.tensor_ops import (Concat, Gather, Pad, Reshape, Reverse,
                                          Split, TopK, Transpose)
 from flexflow_tpu.parallel.mesh import make_mesh
@@ -136,6 +137,15 @@ class FFModel:
                    name: Optional[str] = None) -> Tensor:
         return self._add(LayerNorm(self, self._name("layer_norm", name),
                                    [input], eps, elementwise_affine))
+
+    def add_layer_norm(self, input: Tensor, residual: Tensor,
+                       eps: float = 1e-5,
+                       name: Optional[str] = None) -> List[Tensor]:
+        """Fused (input + residual, LN(input + residual)); returns
+        [sum, normed]."""
+        out = self._add(AddLayerNorm(self, self._name("add_ln", name),
+                                     [input, residual], eps))
+        return out if isinstance(out, list) else [out]
 
     def rms_norm(self, input: Tensor, eps: float = 1e-6,
                  name: Optional[str] = None) -> Tensor:

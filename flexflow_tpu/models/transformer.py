@@ -76,9 +76,24 @@ def build_encoder_classifier(ff: FFModel, batch_size: int, seq_len: int = 128,
                              causal: bool = False):
     x = ff.create_tensor([batch_size, seq_len, hidden], name="input")
     t = x
+    fused = getattr(ff.config, "use_fused_ln", False)
+    # one graph, two lowerings of each residual-add + following layernorm
+    # pair: fused (one Pallas pass, FFConfig.use_fused_ln) or separate ops.
+    # Same math, same norm-parameter count (2L+1) either way; in the fused
+    # form the last add_ln's normed output IS ln_f.
+    n = ff.layer_norm(t, name="ln1_0") if fused else None
     for i in range(layers):
-        t = encoder_block(ff, t, hidden, heads, ffn_mult, i, causal)
-    t = ff.layer_norm(t, name="ln_f")
+        if fused:
+            a = ff.multihead_attention(n, n, n, hidden, heads, causal=causal,
+                                       name=f"attn_{i}")
+            t, n = ff.add_layer_norm(t, a, name=f"res1_ln2_{i}")
+            f = ff.dense(n, hidden * ffn_mult, ActiMode.AC_MODE_GELU,
+                         name=f"ffn1_{i}")
+            f = ff.dense(f, hidden, name=f"ffn2_{i}")
+            t, n = ff.add_layer_norm(t, f, name=f"res2_ln1_{i}")
+        else:
+            t = encoder_block(ff, t, hidden, heads, ffn_mult, i, causal)
+    t = n if fused else ff.layer_norm(t, name="ln_f")
     t = ff.mean(t, dims=[1], name="pool")
     out = ff.dense(t, num_classes, name="head")
     return x, out

@@ -102,6 +102,74 @@ class LayerNorm(Op):
         return 8 * self.outputs[0].volume()
 
 
+class AddLayerNorm(Op):
+    """Fused residual-add + LayerNorm: (s, y) = (x + r, LN(x + r)).
+
+    The unfused graph writes the sum to HBM, re-reads it for the norm, and
+    re-reads it again on the next residual hop; the fused op makes one pass
+    (Pallas kernel on TPU, plain JAX elsewhere — XLA fuses the fallback
+    too, so numerics are identical everywhere). Net-new op, same rationale
+    as LayerNorm; enabled in the transformer blocks by
+    FFConfig.use_fused_ln."""
+
+    op_type = OperatorType.OP_LAYERNORM
+
+    def __init__(self, model, name, inputs, eps: float = 1e-5):
+        super().__init__(model, name, inputs)
+        self.eps = eps
+        self.dim = inputs[0].dims[-1]
+        assert inputs[0].dims == inputs[1].dims, \
+            f"{name}: add_layer_norm inputs must agree, got " \
+            f"{inputs[0].dims} vs {inputs[1].dims}"
+        self.finalize()
+
+    def output_shapes(self):
+        d = self.inputs[0].dims
+        t = self.inputs[0].dtype
+        return [d, d], [t, t]
+
+    def weights(self):
+        return [WeightSpec("scale", (self.dim,), init="one"),
+                WeightSpec("bias", (self.dim,), init="zero")]
+
+    def _fused_ok(self) -> bool:
+        """Kernel eligibility, mirroring attention._flash_ok: lane-aligned
+        hidden dim, kill switch (FF_FUSED_LN_DISABLE=1) for deployments
+        whose Mosaic build rejects a shape — ineligible shapes fall back to
+        the plain-JAX branch, never fail to compile."""
+        import os
+
+        if os.environ.get("FF_FUSED_LN_DISABLE") == "1":
+            return False
+        if self.dim % 128 != 0:
+            return False
+        return (jax.default_backend() == "tpu"
+                or os.environ.get("FF_FORCE_FLASH_ATTENTION") == "1")
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        x, r = xs[0], xs[1]
+        scale, bias = params["scale"], params["bias"]
+        if self._fused_ok():
+            from flexflow_tpu.ops.pallas_kernels import fused_add_layernorm
+
+            shape = x.shape
+            s2, y2 = fused_add_layernorm(
+                x.reshape(-1, self.dim), r.reshape(-1, self.dim),
+                scale, bias, self.eps)
+            return [s2.reshape(shape), y2.reshape(shape)]
+        s = x + r
+        mean = jnp.mean(s, axis=-1, keepdims=True)
+        var = jnp.var(s, axis=-1, keepdims=True)
+        y = (s - mean) * jax.lax.rsqrt(var + self.eps) * scale + bias
+        return [s, y]
+
+    def partitionable_output_dims(self):
+        return list(range(self.outputs[0].num_dims - 1))
+
+    def flops(self):
+        return 9 * self.outputs[0].volume()
+
+
 class RMSNorm(Op):
     op_type = OperatorType.OP_RMSNORM
 

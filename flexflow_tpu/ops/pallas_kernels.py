@@ -367,6 +367,105 @@ def flash_attention_bwd_pallas(q, k, v, o, lse, do, causal: bool,
     return back(dq, sq), back(dk, sk), back(dv, sk)
 
 
+# ----------------------------------------------------- fused add+layernorm
+
+
+def _add_ln_fwd_kernel(x_ref, r_ref, scale_ref, bias_ref, s_ref, y_ref,
+                       *stat_refs, eps: float, need_stats: bool):
+    x = x_ref[...]
+    r = r_ref[...]
+    s = x + r                                   # residual stream out
+    sf = s.astype(jnp.float32)
+    mean = jnp.mean(sf, axis=-1, keepdims=True)          # (bn, 1)
+    # one-pass E[s^2]-mean^2 can cancel slightly negative in f32 when the
+    # row mean dwarfs its spread — clamp before rsqrt or the row NaNs
+    var = jnp.maximum(
+        jnp.mean(sf * sf, axis=-1, keepdims=True) - mean * mean, 0.0)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = (sf - mean) * rstd * scale_ref[...] + bias_ref[...]
+    s_ref[...] = s
+    y_ref[...] = y.astype(y_ref.dtype)
+    if need_stats:
+        mean_ref, rstd_ref = stat_refs
+        bn = x.shape[0]
+        mean_ref[...] = jnp.broadcast_to(mean, (bn, 8))
+        rstd_ref[...] = jnp.broadcast_to(rstd, (bn, 8))
+
+
+def fused_add_layernorm_fwd_pallas(x, r, scale, bias, eps: float,
+                                   block_n: int = 256,
+                                   need_stats: bool = True):
+    """(N, D) x + r -> (s, ln(s)) in ONE HBM pass (the unfused graph writes
+    s, re-reads it for the norm, and re-reads it again on the next block's
+    residual path). need_stats=False (inference / no-grad primal) skips
+    materializing the (N, 8) mean/rstd residuals, which exist only for the
+    VJP — same pattern as the flash kernel's need_lse."""
+    n, d = x.shape
+    block_n = _pick_block(n, block_n)
+    grid = (n // block_n,)
+    scale2 = scale.reshape(1, d)
+    bias2 = bias.reshape(1, d)
+    out_specs = [pl.BlockSpec((block_n, d), lambda i: (i, 0)),
+                 pl.BlockSpec((block_n, d), lambda i: (i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((n, d), x.dtype),
+                 jax.ShapeDtypeStruct((n, d), x.dtype)]
+    if need_stats:
+        out_specs += [pl.BlockSpec((block_n, 8), lambda i: (i, 0)),
+                      pl.BlockSpec((block_n, 8), lambda i: (i, 0))]
+        out_shape += [jax.ShapeDtypeStruct((n, 8), jnp.float32),
+                      jax.ShapeDtypeStruct((n, 8), jnp.float32)]
+    outs = pl.pallas_call(
+        functools.partial(_add_ln_fwd_kernel, eps=eps,
+                          need_stats=need_stats),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_n, d), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=_interpret(),
+    )(x, r, scale2, bias2)
+    if need_stats:
+        return outs
+    return outs[0], outs[1], None, None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def fused_add_layernorm(x, r, scale, bias, eps: float = 1e-5):
+    """(s, y) = (x + r, layernorm(x + r) * scale + bias) fused: the residual
+    add never round-trips HBM before the norm reads it. Backward is pure
+    JAX (bandwidth-bound elementwise+reduce; XLA fuses it well)."""
+    s, y, _, _ = fused_add_layernorm_fwd_pallas(x, r, scale, bias, eps,
+                                                need_stats=False)
+    return s, y
+
+
+def _add_ln_fwd_rule(x, r, scale, bias, eps):
+    s, y, mean, rstd = fused_add_layernorm_fwd_pallas(x, r, scale, bias, eps)
+    return (s, y), (s, mean[:, 0:1], rstd[:, 0:1], scale)
+
+
+def _add_ln_bwd_rule(eps, res, g):
+    s, mean, rstd, scale = res
+    gs, gy = g
+    sf = s.astype(jnp.float32)
+    gyf = gy.astype(jnp.float32)
+    xhat = (sf - mean) * rstd
+    dbias = jnp.sum(gyf, axis=0).astype(scale.dtype)
+    dscale = jnp.sum(gyf * xhat, axis=0).astype(scale.dtype)
+    t = gyf * scale.astype(jnp.float32)
+    dsn = (t - jnp.mean(t, axis=-1, keepdims=True)
+           - xhat * jnp.mean(t * xhat, axis=-1, keepdims=True)) * rstd
+    d = (dsn + gs.astype(jnp.float32)).astype(s.dtype)
+    return d, d, dscale, dbias
+
+
+fused_add_layernorm.defvjp(_add_ln_fwd_rule, _add_ln_bwd_rule)
+
+
 # ------------------------------------------------------------- public API
 
 

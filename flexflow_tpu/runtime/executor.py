@@ -149,15 +149,23 @@ class GraphExecutor:
             if not specs:
                 continue
             op_params = {}
+            master_bf16 = self.model.config.master_dtype == "bfloat16"
             for i, spec in enumerate(specs):
                 key = jax.random.fold_in(
                     jax.random.fold_in(rng_key, _stable_hash(op.name)), i)
                 sharding = shardings[op.name].get(spec.name)
                 init_fn = functools.partial(init_weight, spec)
                 dtype = dtype_to_np(spec.dtype)
+
+                def _init(k, f=init_fn, d=dtype):
+                    w = f(k, dtype=d)
+                    # bf16 master weights: storage halves, init stays f32
+                    if master_bf16 and w.dtype == jnp.float32:
+                        w = w.astype(jnp.bfloat16)
+                    return w
+
                 op_params[spec.name] = jax.jit(
-                    lambda k, f=init_fn, d=dtype: f(k, dtype=d),
-                    out_shardings=sharding)(key)
+                    _init, out_shardings=sharding)(key)
             params[op.name] = op_params
         return params
 

@@ -29,6 +29,14 @@ class Optimizer:
         raise NotImplementedError
 
 
+def _f32_view(*arrays):
+    """Upcast update operands to f32: with bf16 master weights
+    (FFConfig.master_dtype) storage halves but update MATH stays f32 —
+    the casts trace away entirely for f32 storage."""
+    return tuple(None if a is None else a.astype(jnp.float32)
+                 for a in arrays)
+
+
 class SGDOptimizer(Optimizer):
     def __init__(self, model=None, lr: float = 0.01, momentum: float = 0.0,
                  nesterov: bool = False, weight_decay: float = 0.0):
@@ -49,10 +57,12 @@ class SGDOptimizer(Optimizer):
 
         if mom > 0.0:
             def upd(w, g, v):
+                wt, vt = w.dtype, v.dtype
+                w, g, v = _f32_view(w, g, v)
                 g = g + wd * w
                 v = mom * v + g
                 step = g + mom * v if self.nesterov else v
-                return w - lr * step, v
+                return (w - lr * step).astype(wt), v.astype(vt)
 
             flat = jax.tree_util.tree_map(upd, params, grads, state["v"])
             new_params = jax.tree_util.tree_map(lambda t: t[0], flat,
@@ -62,7 +72,9 @@ class SGDOptimizer(Optimizer):
             return new_params, {"v": new_v, "t": state["t"] + 1}
 
         def upd_plain(w, g):
-            return w - lr * (g + wd * w)
+            wt = w.dtype
+            w, g = _f32_view(w, g)
+            return (w - lr * (g + wd * w)).astype(wt)
 
         new_params = jax.tree_util.tree_map(upd_plain, params, grads)
         return new_params, {"v": None, "t": state["t"] + 1}
@@ -91,11 +103,13 @@ class AdamOptimizer(Optimizer):
             / (1.0 - jnp.power(b1, t))
 
         def upd(w, g, m, v):
+            wt, mt, vt = w.dtype, m.dtype, v.dtype
+            w, g, m, v = _f32_view(w, g, m, v)
             g = g + wd * w
             m = b1 * m + (1 - b1) * g
             v = b2 * v + (1 - b2) * g * g
             w = w - alpha_t * m / (jnp.sqrt(v) + eps)
-            return w, m, v
+            return w.astype(wt), m.astype(mt), v.astype(vt)
 
         flat = jax.tree_util.tree_map(upd, params, grads, state["m"], state["v"])
         is_triple = lambda t_: isinstance(t_, tuple)
