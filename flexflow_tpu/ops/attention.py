@@ -260,6 +260,12 @@ class MultiHeadAttention(Op):
         # batch, seq (ring attention), hidden (head split)
         return [0, 1, 2]
 
+    def single_axis_dims(self):
+        # the ring/Ulysses lowering rotates around ONE named mesh axis; a
+        # seq dim sharded over two axes is rejected at execution
+        # (_sp_attention), so the search must not propose it
+        return [1]
+
     def weight_partition(self, axis_map):
         # hidden-dim sharding => split heads (Megatron): shard the H dim of
         # wq/wk/wv and of wo's input side.

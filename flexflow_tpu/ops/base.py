@@ -92,6 +92,13 @@ class Op:
         only (the reference's conservative default for most ops)."""
         return [0]
 
+    def single_axis_dims(self) -> List[int]:
+        """Output dims the executor can shard over at most ONE mesh axis
+        (the search must not propose multi-axis products for them). Default
+        none; MultiHeadAttention's seq dim is the known case — the
+        ring/Ulysses lowering needs a single named 'seq' axis."""
+        return []
+
     def contract_size(self) -> Optional[int]:
         """Size of the op's weight-contraction dim, if the op supports
         CONTRACT (row-parallel) sharding: weight sharded on its input-feature

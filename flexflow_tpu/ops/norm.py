@@ -158,10 +158,14 @@ class AddLayerNorm(Op):
                 scale, bias, self.eps)
             return [s2.reshape(shape), y2.reshape(shape)]
         s = x + r
-        mean = jnp.mean(s, axis=-1, keepdims=True)
-        var = jnp.var(s, axis=-1, keepdims=True)
-        y = (s - mean) * jax.lax.rsqrt(var + self.eps) * scale + bias
-        return [s, y]
+        # f32 stats like the Pallas kernel, so bf16 numerics validated on
+        # the fallback transfer to the TPU path
+        sf = s.astype(jnp.float32)
+        mean = jnp.mean(sf, axis=-1, keepdims=True)
+        var = jnp.var(sf, axis=-1, keepdims=True)
+        y = ((sf - mean) * jax.lax.rsqrt(var + self.eps)
+             * scale.astype(jnp.float32) + bias.astype(jnp.float32))
+        return [s, y.astype(s.dtype)]
 
     def partitionable_output_dims(self):
         return list(range(self.outputs[0].num_dims - 1))

@@ -377,12 +377,14 @@ def _add_ln_fwd_kernel(x_ref, r_ref, scale_ref, bias_ref, s_ref, y_ref,
     s = x + r                                   # residual stream out
     sf = s.astype(jnp.float32)
     mean = jnp.mean(sf, axis=-1, keepdims=True)          # (bn, 1)
-    # one-pass E[s^2]-mean^2 can cancel slightly negative in f32 when the
-    # row mean dwarfs its spread — clamp before rsqrt or the row NaNs
-    var = jnp.maximum(
-        jnp.mean(sf * sf, axis=-1, keepdims=True) - mean * mean, 0.0)
+    # two-pass variance: E[(s-mean)^2], not E[s^2]-mean^2 — the one-pass
+    # form catastrophically cancels in f32 when the row mean dwarfs its
+    # spread (large residual streams in deep nets). The row is already in
+    # registers, so the second pass costs no HBM traffic
+    centered = sf - mean
+    var = jnp.mean(centered * centered, axis=-1, keepdims=True)
     rstd = jax.lax.rsqrt(var + eps)
-    y = (sf - mean) * rstd * scale_ref[...] + bias_ref[...]
+    y = centered * rstd * scale_ref[...] + bias_ref[...]
     s_ref[...] = s
     y_ref[...] = y.astype(y_ref.dtype)
     if need_stats:

@@ -250,14 +250,17 @@ class PlacementExecutor:
                         "sp_mode": getattr(self.model.config, "sp_mode",
                                            "ring"),
                     }
-                if op.stateful:
-                    outs, ns = op.forward_stateful(
-                        p, state_g.get(op.name, {}), xs,
-                        training=training, rng=op_rng)
-                    new_state[op.name] = ns
-                else:
-                    outs = op.forward(p, xs, training=training, rng=op_rng,
-                                      **kwargs)
+                # op-name HLO metadata for trace attribution (see
+                # GraphExecutor.apply_graph)
+                with jax.named_scope(op.name):
+                    if op.stateful:
+                        outs, ns = op.forward_stateful(
+                            p, state_g.get(op.name, {}), xs,
+                            training=training, rng=op_rng)
+                        new_state[op.name] = ns
+                    else:
+                        outs = op.forward(p, xs, training=training,
+                                          rng=op_rng, **kwargs)
                 sharding = self._group_sharding(g, op)
                 for i, t in enumerate(op.outputs):
                     v = outs[i]

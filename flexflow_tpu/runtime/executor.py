@@ -217,12 +217,21 @@ class GraphExecutor:
                     "axis_map": self._op_axis_maps.get(op.name, {}),
                     "sp_mode": getattr(self.model.config, "sp_mode", "ring"),
                 }
-            if op.stateful:
-                outs, ns = op.forward_stateful(p, state.get(op.name, {}), xs,
-                                               training=training, rng=op_rng)
-                new_state[op.name] = ns
-            else:
-                outs = op.forward(p, xs, training=training, rng=op_rng, **kwargs)
+            # named_scope stamps the op name into the HLO metadata of every
+            # instruction it traces, so xla_trace/Perfetto spans of the
+            # PRODUCTION jitted program attribute back to graph ops — the
+            # in-situ analog of the reference's --profiling per-op events
+            # (linear.cu:526-553); profiler.profile_step stays the unfused
+            # wall-timer variant
+            with jax.named_scope(op.name):
+                if op.stateful:
+                    outs, ns = op.forward_stateful(
+                        p, state.get(op.name, {}), xs,
+                        training=training, rng=op_rng)
+                    new_state[op.name] = ns
+                else:
+                    outs = op.forward(p, xs, training=training, rng=op_rng,
+                                      **kwargs)
             sharding = self.op_output_sharding(op)
             for i, t in enumerate(op.outputs):
                 v = outs[i]

@@ -4,11 +4,16 @@ Reference observability (SURVEY §5.1): per-op cudaEvent timing behind
 --profiling (linear.cu:526-553), simulator DOT export (--taskgraph), Legion
 -lg:prof logs. TPU equivalents:
 
+  * IN-SITU attribution: the executors trace every op under
+    jax.named_scope(op.name), so each instruction of the PRODUCTION jitted
+    program carries the op name in its HLO metadata — Perfetto spans from
+    xla_trace attribute back to graph ops, and in_situ_op_summary reads the
+    optimized program's per-op instruction breakdown without running
+    anything unfused
   * profile_step: op-by-op eager execution with wall timers — the analog of
-    the per-op printf path (the jitted program can't be timed per-op, so this
-    deliberately runs unfused)
+    the per-op printf path, for wall-clock per op at the price of fusion
   * xla_trace: jax.profiler context writing a Perfetto/TensorBoard trace dir
-    (the -lg:prof analog)
+    (the -lg:prof analog; spans carry the named_scope op names)
   * export_taskgraph: the op graph + strategy as Graphviz DOT (the
     simulator's DotFile analog, simulator.h:78-131)
 """
@@ -70,6 +75,43 @@ def profile_step(model, batch: Dict, iters: int = 3) -> List[dict]:
         rows.append({"op": op.name, "type": type(op).__name__, "ms": ms,
                      "output_shape": op.outputs[0].dims})
     rows.sort(key=lambda r: -r["ms"])
+    return rows
+
+
+def in_situ_op_summary(model, batch: Dict) -> List[dict]:
+    """Per-op breakdown of the PRODUCTION train-step program: lowers and
+    compiles the exact jitted step the training loop runs, then attributes
+    every optimized-HLO instruction to its graph op via the named_scope
+    metadata (`jvp(op)` = forward, `transpose(jvp(op))` = backward).
+    Returns [{op, fwd_instructions, bwd_instructions}], heaviest first —
+    the in-situ analog of the reference's --profiling per-op event timers
+    (linear.cu:526-553), without de-fusing the program.
+
+    Requires a compiled model with a train step (model.compile + loaders).
+    """
+    import re
+
+    import jax as _jax
+
+    step = model._train_step
+    lowered = step.lower(model.params, model.opt_state, model.bn_state,
+                         batch, _jax.random.PRNGKey(0))
+    txt = lowered.compile().as_text()
+    op_names = sorted((op.name for op in model.ops), key=len, reverse=True)
+    fwd: Dict[str, int] = {}
+    bwd: Dict[str, int] = {}
+    for path in re.findall(r'op_name="([^"]+)"', txt):
+        for name in op_names:
+            if f"jvp({name})" in path or f"/{name}/" in path \
+                    or path.endswith(f"/{name}"):
+                side = bwd if "transpose(" in path else fwd
+                side[name] = side.get(name, 0) + 1
+                break
+    rows = [{"op": n,
+             "fwd_instructions": fwd.get(n, 0),
+             "bwd_instructions": bwd.get(n, 0)}
+            for n in {**fwd, **bwd}]
+    rows.sort(key=lambda r: -(r["fwd_instructions"] + r["bwd_instructions"]))
     return rows
 
 

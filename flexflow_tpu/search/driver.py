@@ -55,6 +55,7 @@ def legal_axis_maps(op, mesh_shape: Dict[str, int],
     # CONTRACT (row-parallel) proposals, gated like parameter parallelism
     csize = op.contract_size() if enable_parameter_parallel else None
     axes = [a for a in mesh_shape if mesh_shape[a] > 1]
+    single_axis = set(op.single_axis_dims())
     maps = [{}]
     for ax in axes:
         new_maps = []
@@ -62,6 +63,8 @@ def legal_axis_maps(op, mesh_shape: Dict[str, int],
         for m in maps:
             new_maps.append({**m, ax: None})
             for d in dims:
+                if d in single_axis and any(d2 == d for d2 in m.values()):
+                    continue  # executor takes one mesh axis max for this dim
                 deg = size
                 for a2, d2 in m.items():
                     if d2 == d:

@@ -114,3 +114,20 @@ def test_analyze_costs_end_to_end(tmp_path):
         {"x": rs.randn(32, 64).astype(np.float32),
          "label": rs.randint(0, 8, (32, 1)).astype(np.int32)})
     assert np.isfinite(float(loss))
+
+
+def test_attention_seq_dim_never_multi_axis():
+    """single_axis_dims: the proposal space must not shard MHA's seq dim
+    over two mesh axes — the ring/Ulysses lowering takes exactly one
+    (VERDICT r3 validation-script fallout fix)."""
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.search.driver import legal_axis_maps
+
+    cfg = FFConfig(batch_size=8, mesh_shape={"data": 2, "model": 2})
+    ff = FFModel(cfg)
+    x = ff.create_tensor([8, 16, 64], name="x")
+    ff.multihead_attention(x, x, x, 64, 4, name="mha")
+    op = next(o for o in ff.ops if o.name == "mha")
+    for m in legal_axis_maps(op, {"data": 2, "model": 2}):
+        seq_axes = [a for a, d in m.items() if d == 1]
+        assert len(seq_axes) <= 1, m
