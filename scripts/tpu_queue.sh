@@ -1,0 +1,44 @@
+#!/bin/bash
+# TPU work queue (round 3): everything hardware-blocked, in priority order.
+# Run when the tunnel is live (probe: python -c "import jax; jax.devices()"
+# returns within ~90 s). Each step is independent; later steps are gravy.
+# Results land in /tmp/tpu_queue/ — fold them into BENCH notes and
+# docs/northstar.md.
+set -x
+OUT=/tmp/tpu_queue
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+# 1. The board number: staged tiers incl. full_opt (bf16 master + fused LN)
+FF_BENCH_BUDGET=1350 timeout 1400 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"
+
+# 2. Flash streaming kernels at 8k+ on real hardware (the round-3 kernel
+#    rework's hardware proof: compile + grad-exactness at the old cap x2)
+timeout 900 python - > "$OUT/flash8k.log" 2>&1 <<'EOF'
+import jax, jax.numpy as jnp, numpy as np, time
+from flexflow_tpu.ops.pallas_kernels import flash_attention
+rs = np.random.RandomState(0)
+b, s, h, d = 1, 8192, 4, 128
+q = jnp.asarray(rs.randn(b, s, h, d), jnp.bfloat16)
+k = jnp.asarray(rs.randn(b, s, h, d), jnp.bfloat16)
+v = jnp.asarray(rs.randn(b, s, h, d), jnp.bfloat16)
+f = jax.jit(lambda q, k, v: flash_attention(q, k, v, True, 0.088))
+o = jax.block_until_ready(f(q, k, v)); t0 = time.perf_counter()
+for _ in range(10): o = f(q, k, v)
+jax.block_until_ready(o)
+print("seq8192 fwd ok", (time.perf_counter()-t0)/10*1e3, "ms/iter")
+g = jax.jit(jax.grad(lambda q, k, v: jnp.sum(flash_attention(q, k, v, True, 0.088).astype(jnp.float32)), argnums=(0,1,2)))
+jax.block_until_ready(g(q, k, v)); print("seq8192 bwd compiles+runs OK")
+EOF
+
+# 3. ResNet-50 measure tier (the decisive north-star arbitration)
+timeout 1800 python scripts/northstar_search.py --workload resnet50 \
+    --costs measure --budget 40000 > "$OUT/resnet_measure.json" 2> "$OUT/resnet_measure.err"
+
+# 4. Whole-program strategy validation on chip (single chip -> DP-1 configs
+#    only; mesh-shaped runs need the virtual mesh, so this validates the
+#    cost-measurement path end to end rather than multi-chip ranking)
+timeout 900 python scripts/validate_strategies.py --budget 2000 --steps 10 \
+    > "$OUT/validate.json" 2> "$OUT/validate.err"
+
+echo "tpu_queue: done; results in $OUT"
