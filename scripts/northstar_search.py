@@ -82,6 +82,25 @@ def build_workload(name: str, batch: Optional[int] = None):
         cfg = FFConfig(batch_size=batch or 64, mesh_shape=mesh)
         ff = FFModel(cfg)
         build_reference_transformer(ff, cfg.batch_size, TransformerConfig())
+    elif name == "bert_fx":
+        # BASELINE target table names "BERT-base via FX import" as a
+        # transformer-throughput config: import the BERT-base-shaped torch
+        # encoder (hidden 768, 12 layers, 12 heads, seq 128) through the
+        # FX frontend, then search THAT graph
+        pt_examples = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..", "examples",
+            "pytorch")
+        if pt_examples not in sys.path:
+            sys.path.append(pt_examples)  # append: don't shadow stdlib/pkgs
+        from bert_fx import BertEncoder
+
+        from flexflow_tpu.torch import PyTorchModel
+
+        cfg = FFConfig(batch_size=batch or 64, mesh_shape=mesh)
+        ff = FFModel(cfg)
+        x = ff.create_tensor([cfg.batch_size, 128, 768], name="x")
+        PyTorchModel(model=BertEncoder(hidden=768, heads=12, layers=12,
+                                       seq=128, classes=2)).apply(ff, [x])
     elif name == "resnet50":
         # reference examples/cpp/ResNet, default batch 64
         cfg = FFConfig(batch_size=batch or 64, mesh_shape=mesh)
@@ -174,7 +193,7 @@ def main():
     ap.add_argument("--budget", type=int, default=50_000,
                     help="MCMC iterations (reference --budget)")
     ap.add_argument("--workload", default="all",
-                    choices=["all", "transformer", "resnet50", "inception",
+                    choices=["all", "transformer", "bert_fx", "resnet50", "inception",
                              "dlrm"])
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--batch", type=int, default=None,
@@ -187,7 +206,7 @@ def main():
                     help="also run the 16-samples/chip large-batch regime")
     args = ap.parse_args()
 
-    names = (["transformer", "resnet50", "inception", "dlrm"]
+    names = (["transformer", "bert_fx", "resnet50", "inception", "dlrm"]
              if args.workload == "all" else [args.workload])
     results = [run_one(n, args.budget, args.seed, batch=args.batch,
                        costs=args.costs)

@@ -24,6 +24,7 @@ BUDGET = 60_000
 
 @pytest.mark.parametrize("workload,min_speedup", [
     ("transformer", 1.5),
+    ("bert_fx", 1.5),  # BASELINE names "BERT-base via FX import" explicitly
     ("resnet50", 1.5),
     ("inception", 1.5),
     ("dlrm", 10.0),  # embedding-partitioned hybrid crushes DP (OOM + sync)
