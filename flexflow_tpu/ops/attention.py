@@ -50,6 +50,25 @@ def flash_seq_cap() -> int:
         return 0
 
 
+def _mesh_axes_for_dim(mesh, axis_map, dim):
+    """Mesh axes (>1-sized) the strategy maps onto tensor dim `dim`."""
+    return [ax for ax, d in (axis_map or {}).items()
+            if d == dim and mesh.shape[ax] > 1]
+
+
+def _spec_entry(axes):
+    if not axes:
+        return None
+    return axes[0] if len(axes) == 1 else tuple(axes)
+
+
+def _axes_degree(mesh, axes):
+    deg = 1
+    for ax in axes:
+        deg *= mesh.shape[ax]
+    return deg
+
+
 class MultiHeadAttention(Op):
     op_type = OperatorType.OP_MULTIHEAD_ATTENTION
     needs_rng = True
@@ -125,7 +144,8 @@ class MultiHeadAttention(Op):
             ctx = self._sp_attention(qh, kh, vh, shard_ctx, seq_axes, scale,
                                      training, rng)
         else:
-            ctx = self._dense_attention(qh, kh, vh, scale, training, rng)
+            ctx = self._dense_attention(qh, kh, vh, scale, training, rng,
+                                        shard_ctx)
         out = jnp.einsum("bqhk,hkd->bqd", ctx, params["wo"])
         if self.bias:
             out = out + params["bias_o"]
@@ -161,12 +181,11 @@ class MultiHeadAttention(Op):
                 return False
         return True
 
-    def _dense_attention(self, qh, kh, vh, scale, training, rng):
+    def _dense_attention(self, qh, kh, vh, scale, training, rng,
+                         shard_ctx=None):
         use_dropout = training and self.dropout > 0.0 and rng is not None
         if not use_dropout and self._flash_ok(qh, kh):
-            from flexflow_tpu.ops.pallas_kernels import flash_attention
-
-            return flash_attention(qh, kh, vh, self.causal, scale)
+            return self._flash_dense(qh, kh, vh, scale, shard_ctx)
         sq, sk = qh.shape[1], kh.shape[1]
         if max(sq, sk) > BLOCKWISE_SEQ_THRESHOLD \
                 and self.qk_head_dim == self.v_head_dim:
@@ -199,6 +218,42 @@ class MultiHeadAttention(Op):
                               probs / keep, 0.0)
         return jnp.einsum("bhqs,bshk->bqhk", probs, vh)
 
+    def _flash_dense(self, qh, kh, vh, scale, shard_ctx):
+        """Dense flash with multi-chip awareness. A pallas_call is a Mosaic
+        custom call the XLA SPMD partitioner cannot split: left inside the
+        GSPMD-partitioned program it would be replicated (all-gathers around
+        attention — silent loss of data/tensor-parallel scaling). When the
+        strategy shards the batch or head dim over a >1 mesh axis, run the
+        kernel per-shard inside shard_map (embarrassingly parallel — no
+        collectives), the same pattern the ring path uses for seq."""
+        from flexflow_tpu.ops.pallas_kernels import flash_attention
+
+        mesh = (shard_ctx or {}).get("mesh")
+        if mesh is None:
+            return flash_attention(qh, kh, vh, self.causal, scale)
+        axis_map = (shard_ctx or {}).get("axis_map") or {}
+        batch_axes = _mesh_axes_for_dim(mesh, axis_map, 0)
+        head_axes = _mesh_axes_for_dim(mesh, axis_map, 2)
+        # each axis group must divide its dim to shard_map over it; an
+        # indivisible group drops out alone (GSPMD pads that dim instead),
+        # keeping whatever parallelism remains valid
+        if qh.shape[0] % _axes_degree(mesh, batch_axes) != 0:
+            batch_axes = []
+        if self.num_heads % _axes_degree(mesh, head_axes) != 0:
+            head_axes = []
+        if not (batch_axes or head_axes):
+            return flash_attention(qh, kh, vh, self.causal, scale)
+
+        from flexflow_tpu.parallel import shard_map_compat
+
+        spec = P(_spec_entry(batch_axes), None, _spec_entry(head_axes), None)
+
+        def inner(q, k, v):
+            return flash_attention(q, k, v, self.causal, scale)
+
+        return shard_map_compat(inner, mesh, (spec, spec, spec), spec)(
+            qh, kh, vh)
+
     def _sp_attention(self, qh, kh, vh, shard_ctx, seq_axes, scale,
                       training=False, rng=None):
         """Sequence-parallel lowering: ring attention (default) or Ulysses
@@ -222,17 +277,11 @@ class MultiHeadAttention(Op):
                 f"sequence dim sharded over multiple mesh axes {seq_axes}; "
                 f"ring/ulysses attention needs a single 'seq' axis — merge "
                 f"them in the mesh or adjust the strategy")
-        batch_axes = [ax for ax, d in axis_map.items()
-                      if d == 0 and mesh.shape[ax] > 1]
-        head_axes = [ax for ax, d in axis_map.items()
-                     if d == 2 and mesh.shape[ax] > 1]
+        batch_axes = _mesh_axes_for_dim(mesh, axis_map, 0)
+        head_axes = _mesh_axes_for_dim(mesh, axis_map, 2)
 
-        def entry(axes):
-            if not axes:
-                return None
-            return axes[0] if len(axes) == 1 else tuple(axes)
-
-        spec = P(entry(batch_axes), entry(seq_axes), entry(head_axes), None)
+        spec = P(_spec_entry(batch_axes), _spec_entry(seq_axes),
+                 _spec_entry(head_axes), None)
         seq_axis = seq_axes[0]
         fn = ring_attention if mode == "ring" else ulysses_attention
         dropout_rate = self.dropout if (training and rng is not None) else 0.0
