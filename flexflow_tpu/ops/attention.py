@@ -50,25 +50,6 @@ def flash_seq_cap() -> int:
         return 0
 
 
-def _mesh_axes_for_dim(mesh, axis_map, dim):
-    """Mesh axes (>1-sized) the strategy maps onto tensor dim `dim`."""
-    return [ax for ax, d in (axis_map or {}).items()
-            if d == dim and mesh.shape[ax] > 1]
-
-
-def _spec_entry(axes):
-    if not axes:
-        return None
-    return axes[0] if len(axes) == 1 else tuple(axes)
-
-
-def _axes_degree(mesh, axes):
-    deg = 1
-    for ax in axes:
-        deg *= mesh.shape[ax]
-    return deg
-
-
 class MultiHeadAttention(Op):
     op_type = OperatorType.OP_MULTIHEAD_ATTENTION
     needs_rng = True
@@ -231,22 +212,16 @@ class MultiHeadAttention(Op):
         mesh = (shard_ctx or {}).get("mesh")
         if mesh is None:
             return flash_attention(qh, kh, vh, self.causal, scale)
+        from flexflow_tpu.parallel import shard_entries, shard_map_compat
+
         axis_map = (shard_ctx or {}).get("axis_map") or {}
-        batch_axes = _mesh_axes_for_dim(mesh, axis_map, 0)
-        head_axes = _mesh_axes_for_dim(mesh, axis_map, 2)
-        # each axis group must divide its dim to shard_map over it; an
-        # indivisible group drops out alone (GSPMD pads that dim instead),
+        # indivisible groups drop out alone (GSPMD pads that dim instead),
         # keeping whatever parallelism remains valid
-        if qh.shape[0] % _axes_degree(mesh, batch_axes) != 0:
-            batch_axes = []
-        if self.num_heads % _axes_degree(mesh, head_axes) != 0:
-            head_axes = []
-        if not (batch_axes or head_axes):
+        ent = shard_entries(mesh, axis_map, qh.shape, (0, 2))
+        if ent[0] is None and ent[2] is None:
             return flash_attention(qh, kh, vh, self.causal, scale)
 
-        from flexflow_tpu.parallel import shard_map_compat
-
-        spec = P(_spec_entry(batch_axes), None, _spec_entry(head_axes), None)
+        spec = P(ent[0], None, ent[2], None)
 
         def inner(q, k, v):
             return flash_attention(q, k, v, self.causal, scale)
@@ -277,11 +252,13 @@ class MultiHeadAttention(Op):
                 f"sequence dim sharded over multiple mesh axes {seq_axes}; "
                 f"ring/ulysses attention needs a single 'seq' axis — merge "
                 f"them in the mesh or adjust the strategy")
-        batch_axes = _mesh_axes_for_dim(mesh, axis_map, 0)
-        head_axes = _mesh_axes_for_dim(mesh, axis_map, 2)
+        from flexflow_tpu.parallel import shard_entries
 
-        spec = P(_spec_entry(batch_axes), _spec_entry(seq_axes),
-                 _spec_entry(head_axes), None)
+        # batch/head groups degrade alone when indivisible, like the dense
+        # path; the seq axis is the SP lowering itself and stays
+        ent = shard_entries(mesh, axis_map, qh.shape, (0, 2))
+        seq_entry = seq_axes[0] if len(seq_axes) == 1 else tuple(seq_axes)
+        spec = P(ent[0], seq_entry, ent[2], None)
         seq_axis = seq_axes[0]
         fn = ring_attention if mode == "ring" else ulysses_attention
         dropout_rate = self.dropout if (training and rng is not None) else 0.0

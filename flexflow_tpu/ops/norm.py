@@ -113,6 +113,7 @@ class AddLayerNorm(Op):
     FFConfig.use_fused_ln."""
 
     op_type = OperatorType.OP_LAYERNORM
+    wants_shard_ctx = True  # per-shard kernel under sharding (see forward)
 
     def __init__(self, model, name, inputs, eps: float = 1e-5):
         super().__init__(model, name, inputs)
@@ -146,17 +147,45 @@ class AddLayerNorm(Op):
         return (jax.default_backend() == "tpu"
                 or os.environ.get("FF_FORCE_FLASH_ATTENTION") == "1")
 
-    def forward(self, params, xs, *, training=False, rng=None):
+    def forward(self, params, xs, *, training=False, rng=None,
+                shard_ctx=None):
         x, r = xs[0], xs[1]
         scale, bias = params["scale"], params["bias"]
         if self._fused_ok():
             from flexflow_tpu.ops.pallas_kernels import fused_add_layernorm
 
-            shape = x.shape
-            s2, y2 = fused_add_layernorm(
-                x.reshape(-1, self.dim), r.reshape(-1, self.dim),
-                scale, bias, self.eps)
-            return [s2.reshape(shape), y2.reshape(shape)]
+            def run(x_, r_, scale_, bias_):
+                shape = x_.shape
+                s2, y2 = fused_add_layernorm(
+                    x_.reshape(-1, self.dim), r_.reshape(-1, self.dim),
+                    scale_, bias_, self.eps)
+                return s2.reshape(shape), y2.reshape(shape)
+
+            # a pallas_call is a Mosaic custom call GSPMD cannot partition:
+            # under a sharded strategy run the kernel per-shard inside
+            # shard_map over whichever sharded non-last dims divide evenly
+            # (same pattern as attention._flash_dense); the op is row-wise,
+            # so shards need no collectives
+            mesh = (shard_ctx or {}).get("mesh")
+            if mesh is not None:
+                from jax.sharding import PartitionSpec as P
+
+                from flexflow_tpu.parallel import (shard_entries,
+                                                   shard_map_compat)
+
+                axis_map = (shard_ctx or {}).get("axis_map") or {}
+                ent = shard_entries(mesh, axis_map, x.shape,
+                                    range(x.ndim - 1))
+                entries = [ent[d] for d in range(x.ndim - 1)]
+                if any(e is not None for e in entries):
+                    spec = P(*entries, None)
+                    w_spec = P(None)
+                    s2, y2 = shard_map_compat(
+                        run, mesh, (spec, spec, w_spec, w_spec),
+                        (spec, spec))(x, r, scale, bias)
+                    return [s2, y2]
+            s2, y2 = run(x, r, scale, bias)
+            return [s2, y2]
         s = x + r
         # f32 stats like the Pallas kernel, so bf16 numerics validated on
         # the fallback transfer to the TPU path

@@ -93,3 +93,28 @@ def test_fused_ln_transformer_trains():
     n_ref = sum(1 for op in ff_ref.params.values() for k in op
                 if k in ("scale",))
     assert n_norm_params == n_ref
+
+
+def test_fused_ln_shard_mapped_under_dp(monkeypatch):
+    """Multi-chip fused LN: the Pallas kernel runs per-shard inside
+    shard_map under a sharded strategy (GSPMD cannot partition a Mosaic
+    custom call); losses must match the single-device fused run exactly."""
+    monkeypatch.setenv("FF_FORCE_FLASH_ATTENTION", "1")
+
+    def losses(mesh):
+        cfg = FFConfig(batch_size=8, mesh_shape=mesh, seed=4,
+                       use_fused_ln=True)
+        ff = FFModel(cfg)
+        x, out = build_encoder_classifier(ff, 8, 32, 128, 1, 4)
+        ff.compile(SGDOptimizer(lr=0.05),
+                   LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                   [MetricsType.METRICS_ACCURACY], final_tensor=out)
+        rs = np.random.RandomState(0)
+        SingleDataLoader(ff, x, rs.randn(16, 32, 128).astype(np.float32))
+        SingleDataLoader(ff, ff.label_tensor,
+                         rs.randint(0, 16, (16, 1)).astype(np.int32))
+        return [float(ff._run_train_step(ff._stage_batch())[0])
+                for _ in range(3)]
+
+    np.testing.assert_allclose(losses({"data": 1}), losses({"data": 4}),
+                               rtol=2e-4)
