@@ -257,8 +257,7 @@ class MultiHeadAttention(Op):
         # batch/head groups degrade alone when indivisible, like the dense
         # path; the seq axis is the SP lowering itself and stays
         ent = shard_entries(mesh, axis_map, qh.shape, (0, 2))
-        seq_entry = seq_axes[0] if len(seq_axes) == 1 else tuple(seq_axes)
-        spec = P(ent[0], seq_entry, ent[2], None)
+        spec = P(ent[0], seq_axes[0], ent[2], None)
         seq_axis = seq_axes[0]
         fn = ring_attention if mode == "ring" else ulysses_attention
         dropout_rate = self.dropout if (training and rng is not None) else 0.0
