@@ -24,6 +24,7 @@ backend init and the board recorded a CPU fallback):
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -226,8 +227,14 @@ def child():
         compute = "float32"
         tiers = [("cpu_smoke", 8, 128, 256, 2, 4, 5, None)]
 
+    skip = {t for t in os.environ.get("FF_BENCH_SKIP_TIERS", "").split(",")
+            if t}
     for tier in tiers:
         name = tier[0]
+        if name in skip:
+            print(f"[bench] skipping tier {name}: done in earlier attempt",
+                  file=sys.stderr, flush=True)
+            continue
         if deadline is not None:
             left = deadline - time.time()
             if left < TIER_COST_S.get(name, 120):
@@ -243,7 +250,10 @@ def child():
 class _Child:
     """Popen wrapper with line-buffered stdout/stderr reader threads."""
 
+    live = None  # the one in-flight child, for the parent's SIGTERM handler
+
     def __init__(self, env):
+        _Child.live = self
         self.proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__)], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
@@ -283,11 +293,12 @@ class _Child:
         self.proc.wait()
 
 
-def _run_attempt(force_cpu, budget, backend_timeout):
+def _run_attempt(force_cpu, budget, backend_timeout, skip_tiers=()):
     """Run one child; return (results, error_or_None)."""
     env = dict(os.environ)
     env["FF_BENCH_CHILD"] = "1"
     env["FF_BENCH_DEADLINE"] = str(time.time() + budget)
+    env["FF_BENCH_SKIP_TIERS"] = ",".join(skip_tiers)
     if force_cpu:
         env["FF_BENCH_FORCE_CPU"] = "1"
     else:
@@ -298,7 +309,11 @@ def _run_attempt(force_cpu, budget, backend_timeout):
     while True:
         rc = c.proc.poll()
         if rc is not None:
-            if rc != 0 and not c.results:
+            if rc != 0:
+                # record even when earlier tiers completed: a child that
+                # dies between tiers is otherwise indistinguishable from
+                # one that ran out of tiers (round-3 finding: the full
+                # tier crashed silently after mid completed)
                 error = f"rc={rc} " + " | ".join(c.stderr_tail[-3:])
             break
         elapsed = time.time() - t0
@@ -320,48 +335,88 @@ def _run_attempt(force_cpu, budget, backend_timeout):
     return c.results, error
 
 
+def _terminate(signum, frame):
+    # an outer `timeout` signals only this parent — without this handler
+    # the jax child would be orphaned still holding the TPU tunnel,
+    # wedging every later jax process (one-jax-process-at-a-time rule)
+    if _Child.live is not None:
+        _Child.live.kill()
+    sys.exit(128 + signum)
+
+
 def main():
+    signal.signal(signal.SIGTERM, _terminate)
     total = float(os.environ.get("FF_BENCH_BUDGET", "1350"))
     backend_timeout = float(os.environ.get("FF_BENCH_BACKEND_TIMEOUT", "150"))
     t_end = time.time() + total
     errors = []
     best = None
 
-    # up to two TPU attempts (backend-init hangs are transient), then CPU.
+    # TPU attempts: backend-init hangs are transient, and a child can die
+    # between tiers (round-3: the full tier crashed after mid completed) —
+    # so completed tiers accumulate across attempts and a retry resumes
+    # from the first missing tier instead of redoing finished work.
     # a retry only makes sense if there is still time for backend init plus
     # at least the tiny tier; otherwise go straight to the CPU fallback
-    min_useful = backend_timeout + TIER_COST_S["tiny"] + 30
-    for attempt in range(2):
+    tpu_done = {}  # tier name -> result, in completion order (py3.7+ dicts)
+    # an operator-set FF_BENCH_SKIP_TIERS (e.g. a manual rerun after some
+    # tiers already landed) seeds the skip set; those tiers count as done
+    # for scheduling but contribute no result rows
+    pre_skip = {t for t in os.environ.get("FF_BENCH_SKIP_TIERS", "").split(",")
+                if t}
+    no_progress = 0
+    for attempt in range(4):
+        # enough time for backend init + the cheapest tier still missing?
+        missing = [t[0] for t in TPU_TIERS
+                   if t[0] not in tpu_done and t[0] not in pre_skip]
+        if not missing:
+            break
+        cheapest = min((TIER_COST_S.get(n, 120) for n in missing),
+                       default=TIER_COST_S["tiny"])
+        min_useful = backend_timeout + cheapest + 30
         left = t_end - time.time()
         # always keep enough tail for the CPU fallback to land a number
         if left < min_useful + 90:
             break
         try:
-            results, err = _run_attempt(False, left - 60, backend_timeout)
+            results, err = _run_attempt(False, left - 60, backend_timeout,
+                                        skip_tiers=pre_skip | set(tpu_done))
         except Exception as e:  # noqa: BLE001 — never die without JSON
             results, err = [], f"{type(e).__name__}: {e}"
         if err:
             errors.append(f"tpu[{attempt}]: {err}")
-        tpu_results = [r for r in results if r.get("backend") == "tpu"]
-        if tpu_results:
-            # headline = largest completed model config; between tiers of
-            # the same config (full vs full_opt) the faster one wins
-            def tier_key(r):
-                c = r["config"]
-                size = c["batch"] * c["seq"] * c["hidden"] * c["layers"]
-                return (size, r["value"])
-
-            best = max(tpu_results, key=tier_key)
-            best["tiers_completed"] = [r["tier"] for r in tpu_results]
-            best["all_tiers"] = [
-                {"tier": r["tier"], "value": r["value"], "mfu": r["mfu"]}
-                for r in tpu_results]
+        new = [r for r in results if r.get("backend") == "tpu"
+               and r["tier"] not in tpu_done]
+        for r in new:
+            tpu_done[r["tier"]] = r
+        no_progress = 0 if new else no_progress + 1
+        if len(tpu_done) == len(TPU_TIERS):
             break
-        if not err:  # child ran fine but on a non-TPU backend
-            if results:
+        if not err and not new:
+            # child ran fine but produced nothing new: either a non-TPU
+            # backend (fall back below) or it skipped the remaining tiers
+            # for lack of time (stop retrying — the budget is spent)
+            if not tpu_done and results:
                 best = results[-1]
                 errors.append("tpu attempt fell back to non-tpu backend")
             break
+        if no_progress >= 2:
+            break  # two attempts in a row died without progress
+
+    if tpu_done:
+        # headline = largest completed model config; between tiers of
+        # the same config (full vs full_opt) the faster one wins
+        def tier_key(r):
+            c = r["config"]
+            size = c["batch"] * c["seq"] * c["hidden"] * c["layers"]
+            return (size, r["value"])
+
+        tpu_results = list(tpu_done.values())
+        best = max(tpu_results, key=tier_key)
+        best["tiers_completed"] = [r["tier"] for r in tpu_results]
+        best["all_tiers"] = [
+            {"tier": r["tier"], "value": r["value"], "mfu": r["mfu"]}
+            for r in tpu_results]
 
     if best is None:
         # hard-capped to the remaining budget: overshooting FF_BENCH_BUDGET

@@ -16,6 +16,14 @@ ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 cd "$ROOT"
 TIER="${1:-all}"
 
+# All CI tiers are CPU-only. In the axon environment, sitecustomize dials
+# the TPU tunnel at EVERY interpreter start when PALLAS_AXON_POOL_IPS is
+# set, and a half-open tunnel hangs that call (round-3 finding) — so CI
+# must never depend on tunnel state. Unset it and pin the CPU platform
+# for every child process in this script.
+export -n PALLAS_AXON_POOL_IPS 2>/dev/null || unset PALLAS_AXON_POOL_IPS
+export JAX_PLATFORMS=cpu
+
 run_unit()     { python -m pytest tests/ -x -q; }
 run_sweep()    { bash tests/multi_device_tests.sh "${NDEV:-8}"; }
 # accuracy tier defaults to 2 virtual devices: XLA CPU collectives need all

@@ -1,0 +1,32 @@
+#!/bin/bash
+# MFU-lever ablation on the bench `full` config (VERDICT r2 #4).
+# Runs the bench CHILD directly, one lever combination per process, all
+# other tiers skipped. Strictly serialized: the axon tunnel wedges a
+# second jax process at `import jax`, so never run this while any other
+# jax process (bench, tests, search) is alive.
+#
+# Rows: base (both off) and full_opt (both on) come from the main staged
+# bench; this script fills in the two single-lever rows.
+set -x
+OUT=${1:-/tmp/mfu_ablation}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+run_combo() { # name master_dtype fused_ln
+  # deadline via shell arithmetic — spawning python here would dial the
+  # tunnel through sitecustomize and can hang if it is half-open
+  FF_BENCH_CHILD=1 FF_BENCH_SKIP_TIERS=tiny,mid,full \
+  FF_BENCH_MASTER_DTYPE="$2" FF_BENCH_FUSED_LN="$3" \
+  FF_BENCH_DEADLINE=$(($(date +%s) + 540)) \
+  timeout 560 python bench.py > "$OUT/$1.json" 2> "$OUT/$1.err"
+  # a tunnel drop makes the child fall back to a CPU cpu_smoke run that
+  # would masquerade as an ablation row — quarantine anything non-TPU
+  if ! grep -q '"backend": "tpu"' "$OUT/$1.json"; then
+    mv "$OUT/$1.json" "$OUT/$1.json.not-tpu"
+    echo "ablation row $1: NOT a TPU run, quarantined"
+  fi
+}
+
+run_combo bf16_master_only bfloat16 0
+run_combo fused_ln_only float32 1
+echo "mfu_ablation: done; results in $OUT"
