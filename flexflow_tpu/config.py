@@ -76,6 +76,12 @@ class FFConfig:
     # opt in (models/transformer.py encoder blocks)
     use_fused_ln: bool = False
     use_flash_attention: bool = True  # Pallas flash kernel on the dense path
+    # multi-step scanned training (executor.make_train_scan): fit() runs up
+    # to this many steps per device dispatch via lax.scan — the TPU-native
+    # analog of the reference's Legion tracing replay around each iteration
+    # (base_model.py:408-418). 0 = one dispatch per step (per-step verbs
+    # keep working either way). Requires device-resident data.
+    scan_steps: int = 0
     # keep datasets device-resident (next_batch = on-device slice, the
     # reference's ZC-resident design) when they fit the budget
     device_resident_data: bool = True

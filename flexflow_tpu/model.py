@@ -61,6 +61,7 @@ class FFModel:
         self._rng = jax.random.PRNGKey(self.config.seed)
         self._step_count = 0
         self._train_step = None
+        self._train_scan = None
         self._eval_step = None
         self._predict_fn = None
         self._current_batch: Dict[str, np.ndarray] = {}
@@ -488,6 +489,49 @@ class FFModel:
         self._last_metrics = mets
         return loss, mets
 
+    def _scan_eligible(self) -> bool:
+        """Scanned multi-step training needs one program over one mesh
+        (PlacementExecutor jits per sub-mesh group) and every dataset
+        device-resident in the pre-batched (num_batches, batch, ...) layout."""
+        return (self._train_step is not None
+                and self._dataloaders
+                # unequal loader lengths wrap per-loader on the per-step
+                # path; the scanned program has one batch index, so the
+                # two paths would diverge — fall back to per-step
+                and len({dl.num_batches for dl in self._dataloaders}) == 1
+                and not getattr(self.executor, "jits_per_group", False)
+                and all(dl._try_stage_on_device() for dl in self._dataloaders))
+
+    def train_scanned(self, n_steps: int):
+        """Run `n_steps` training steps as ONE device program (lax.scan over
+        the device-resident dataset — executor.make_train_scan). Per-step
+        host dispatch disappears; losses/metrics come back stacked, shape
+        (n_steps,). Batch order and wrap policy match the per-step path.
+        """
+        if not self._scan_eligible():  # NB: eligibility check also stages
+            # the loaders on device — must run even under python -O
+            raise RuntimeError(
+                "train_scanned needs compile() with an optimizer, "
+                "device-resident dataloaders, and a single-mesh executor")
+        if self._train_scan is None:
+            self._train_scan = self.executor.make_train_scan(
+                self.optimizer, self.loss_type, self.metric_types,
+                self._final_tensor)
+        staged = {dl.name: dl._dev_data for dl in self._dataloaders}
+        nb = min(dl.num_batches for dl in self._dataloaders)
+        start = (self._dataloaders[0].next_index
+                 // self._dataloaders[0].batch_size) % nb
+        self._rng, scan_key = jax.random.split(self._rng)
+        (self.params, self.opt_state, self.bn_state, losses, mets) = \
+            self._train_scan(self.params, self.opt_state, self.bn_state,
+                             staged, scan_key, start, n_steps)
+        for dl in self._dataloaders:  # keep per-step verbs in sync
+            dl.next_index = ((start + n_steps) % nb) * dl.batch_size
+        self._step_count += n_steps
+        self._last_loss = losses[-1]
+        self._last_metrics = {k: v[-1] for k, v in mets.items()}
+        return losses, mets
+
     # ---------------------------------------------------------------- fit
 
     def fit(self, epochs: Optional[int] = None, batch_size: Optional[int] = None,
@@ -527,6 +571,11 @@ class FFModel:
             native_dl = group_loader_for(self)
             if native_dl is not None:
                 num_batches = native_dl.num_batches
+        # multi-step scanned epochs (config.scan_steps chunks per dispatch);
+        # callbacks only observe epoch boundaries, so chunking inside an
+        # epoch is observationally identical
+        use_scan = (self.config.scan_steps > 0 and native_dl is None
+                    and staged and self._scan_eligible())
         warm = None
         for cb in callbacks:
             cb.set_model(self)
@@ -546,18 +595,48 @@ class FFModel:
                         dl.reset()
                 epoch_mets = []  # device scalars; converted once per epoch so
                 # the host never blocks mid-epoch (keeps XLA dispatch async)
-                for it in range(num_batches):
-                    batch = (native_dl.next_batch() if native_dl is not None
-                             else self._stage_batch())
-                    loss, mets = self._run_train_step(batch)
-                    epoch_mets.append((mets, bs))
-                    total += bs
-                    if warm is None:
-                        jax.block_until_ready(self.params)
-                        warm = time.time()  # exclude first-step compile
-                        total = 0
-                for mets, bs in epoch_mets:
-                    self._perf.update({k: float(v) for k, v in mets.items()}, bs)
+                if use_scan:
+                    it = 0
+                    while it < num_batches:
+                        if num_batches - it >= self.config.scan_steps:
+                            chunk = self.config.scan_steps
+                            _, smets = self.train_scanned(chunk)
+                            epoch_mets.append((smets, bs, chunk))
+                        else:
+                            # ragged epoch tail: n_steps is static to the
+                            # scanned program, so a tail-sized scan would
+                            # compile the whole model a second time — the
+                            # per-step program is the cheaper spelling
+                            chunk = 1
+                            _, smets = self._run_train_step(
+                                self._stage_batch())
+                            epoch_mets.append((smets, bs, 1))
+                        total += bs * chunk
+                        it += chunk
+                        if warm is None:
+                            jax.block_until_ready(self.params)
+                            warm = time.time()  # exclude first-chunk compile
+                            total = 0
+                else:
+                    for it in range(num_batches):
+                        batch = (native_dl.next_batch()
+                                 if native_dl is not None
+                                 else self._stage_batch())
+                        loss, mets = self._run_train_step(batch)
+                        epoch_mets.append((mets, bs, 1))
+                        total += bs
+                        if warm is None:
+                            jax.block_until_ready(self.params)
+                            warm = time.time()  # exclude first-step compile
+                            total = 0
+                for mets, bs, n in epoch_mets:
+                    # per-step entries hold scalars (n=1); scanned chunks
+                    # hold stacked (n,) arrays — np.asarray unifies both
+                    arrs = {k: np.asarray(v) for k, v in mets.items()}
+                    for j in range(n):
+                        self._perf.update(
+                            {k: float(a[j] if a.ndim else a)
+                             for k, a in arrs.items()}, bs)
                 if verbose:
                     print(f"epoch {epoch}: loss={float(self._last_loss):.4f} "
                           + self._perf.report(self.loss_type, self.metric_types))

@@ -25,11 +25,16 @@ k = jnp.asarray(rs.randn(b, s, h, d), jnp.bfloat16)
 v = jnp.asarray(rs.randn(b, s, h, d), jnp.bfloat16)
 
 f = jax.jit(lambda q, k, v: flash_attention(q, k, v, True, 0.088))
-o = jax.block_until_ready(f(q, k, v))
+# scalar fetch = real sync: block_until_ready is advisory through the
+# device tunnel (same finding as bench.py's timed loop); warmed OUTSIDE
+# the timed window so its compile doesn't pollute the ms/iter
+sync = jax.jit(lambda a: a.astype(jnp.float32).sum())
+o = f(q, k, v)
+float(sync(o))
 t0 = time.perf_counter()
 for _ in range(10):
     o = f(q, k, v)
-jax.block_until_ready(o)
+float(sync(o))
 print("seq8192 fwd ok", (time.perf_counter() - t0) / 10 * 1e3, "ms/iter")
 
 g = jax.jit(jax.grad(
@@ -37,9 +42,9 @@ g = jax.jit(jax.grad(
         flash_attention(q, k, v, True, 0.088).astype(jnp.float32)),
     argnums=(0, 1, 2)))
 gq, gk, gv = g(q, k, v)
-jax.block_until_ready(gq)
+float(sync(gq))  # warm the bwd program AND the sync fetch
 t0 = time.perf_counter()
 for _ in range(5):
     gq, gk, gv = g(q, k, v)
-jax.block_until_ready(gq)
+float(sync(gq))  # scalar fetch = real sync (see above)
 print("seq8192 bwd ok", (time.perf_counter() - t0) / 5 * 1e3, "ms/iter")

@@ -1,12 +1,15 @@
 #!/bin/bash
 # MFU-lever ablation on the bench `full` config (VERDICT r2 #4).
-# Runs the bench CHILD directly, one lever combination per process, all
-# other tiers skipped. Strictly serialized: the axon tunnel wedges a
-# second jax process at `import jax`, so never run this while any other
-# jax process (bench, tests, search) is alive.
+# Runs the bench CHILD directly, one lever combination per process, on the
+# full_scan_opt tier with env-overridden levers: the scanned tier runs all
+# iters inside ONE device program, so the rows are free of the tunnel's
+# per-dispatch latency and isolate the levers themselves.
+# Strictly serialized: the axon tunnel wedges a second jax process at
+# `import jax`, so never run this while any other jax process (bench,
+# tests, search) is alive.
 #
-# Rows: base (both off) and full_opt (both on) come from the main staged
-# bench; this script fills in the two single-lever rows.
+# Rows: base (both off) = the staged bench's full_scan tier; both on =
+# its full_scan_opt tier; this script fills in the two single-lever rows.
 set -x
 OUT=${1:-/tmp/mfu_ablation}
 mkdir -p "$OUT"
@@ -15,7 +18,8 @@ cd "$(dirname "$0")/.."
 run_combo() { # name master_dtype fused_ln
   # deadline via shell arithmetic — spawning python here would dial the
   # tunnel through sitecustomize and can hang if it is half-open
-  FF_BENCH_CHILD=1 FF_BENCH_SKIP_TIERS=tiny,mid,full \
+  FF_BENCH_CHILD=1 \
+  FF_BENCH_SKIP_TIERS=tiny,mid,full,full_scan,full_opt \
   FF_BENCH_MASTER_DTYPE="$2" FF_BENCH_FUSED_LN="$3" \
   FF_BENCH_DEADLINE=$(($(date +%s) + 540)) \
   timeout 560 python bench.py > "$OUT/$1.json" 2> "$OUT/$1.err"
