@@ -65,13 +65,19 @@ TPU_TIERS = [
     # tiers carry ONLY the lever that measured as a win
     ("full_scan_opt", 16, 512, 1024, 8, 16, 20,
      {"scan": True, "master_dtype": "bfloat16"}),
-    ("full_opt", 16, 512, 1024, 8, 16, 20,
-     {"master_dtype": "bfloat16"}),
+    # headline: same depth at hidden 2048 / head_dim 128. The on-chip
+    # probe sweep (scripts/mfu_probe.py, round-3 notes) showed head_dim
+    # is the dominant MFU lever — QK^T/AV contract over head_dim, so
+    # d=64 runs the MXU half-empty (0.573 MFU) while d=128 fills it
+    # (0.704 same size, 0.804 at hidden 2048 where dense matmuls
+    # dominate the mix) — the standard TPU-native design choice
+    ("xl_scan", 16, 512, 2048, 8, 16, 15,
+     {"scan": True, "master_dtype": "bfloat16"}),
 ]
 # rough wall-clock needed per tier (compile + run), used by the child to
 # decide whether to start the next tier with the time it has left
 TIER_COST_S = {"tiny": 90, "mid": 150, "full": 240, "full_scan": 180,
-               "full_scan_opt": 180, "full_opt": 240, "cpu_smoke": 30,
+               "full_scan_opt": 180, "xl_scan": 260, "cpu_smoke": 30,
                "cpu_smoke_scan": 30}
 
 

@@ -1,0 +1,58 @@
+"""Llama-family decoder LM (models/llama.py): RMSNorm + RoPE + grouped-query
+attention + SwiGLU, causal next-token training on synthetic tokens.
+
+Net-new vs the reference model zoo (its newest workload is the cuDNN-MHA
+encoder, examples/cpp/Transformer) — the modern decoder family the TPU
+rebuild targets, deliberately head_dim-128-friendly for the MXU.
+
+Run: python examples/native/llama.py [--hidden H] [--num-layers N]
+     [--num-heads A] [--num-kv-heads G] [--sequence-length S] [-b BATCH]
+     [-e EPOCHS] [--budget N]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.models.llama import llama_lm
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--hidden", type=int, default=256)
+    p.add_argument("--num-layers", type=int, default=4)
+    p.add_argument("--num-heads", type=int, default=4)
+    p.add_argument("--num-kv-heads", type=int, default=2)
+    p.add_argument("--sequence-length", type=int, default=128)
+    p.add_argument("--vocab", type=int, default=1024)
+    args, _ = p.parse_known_args()
+    cfg = FFConfig.parse_args()
+
+    ff = FFModel(cfg)
+    tokens, logits = llama_lm(ff, cfg.batch_size,
+                              seq_len=args.sequence_length,
+                              hidden=args.hidden, layers=args.num_layers,
+                              heads=args.num_heads,
+                              kv_heads=args.num_kv_heads,
+                              vocab_size=args.vocab)
+    ff.compile(SGDOptimizer(lr=0.1),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=logits)
+
+    rs = np.random.RandomState(0)
+    n = cfg.batch_size * 4
+    x = rs.randint(0, args.vocab, (n, args.sequence_length)).astype(np.int32)
+    y = ((x + 1) % args.vocab)[..., None].astype(np.int32)  # successor task
+    SingleDataLoader(ff, tokens, x)
+    SingleDataLoader(ff, ff.label_tensor, y)
+    ff.fit(epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

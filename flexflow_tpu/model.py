@@ -203,11 +203,14 @@ class FFModel:
                             vdim: int = 0, dropout: float = 0.0,
                             bias: bool = True, add_bias_kv: bool = False,
                             add_zero_attn: bool = False, causal: bool = False,
+                            num_kv_heads: int = 0, rope: bool = False,
+                            rope_theta: float = 10000.0,
                             name: Optional[str] = None, **kw) -> Tensor:
         return self._add(MultiHeadAttention(
             self, self._name("multihead_attention", name), [query, key, value],
             embed_dim, num_heads, kdim, vdim, dropout, bias, add_bias_kv,
-            add_zero_attn, causal))
+            add_zero_attn, causal, num_kv_heads=num_kv_heads, rope=rope,
+            rope_theta=rope_theta))
 
     def transformer_pipeline_stack(self, input: Tensor, num_layers: int,
                                    num_heads: int, ffn_mult: int = 4,

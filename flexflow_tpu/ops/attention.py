@@ -50,6 +50,24 @@ def flash_seq_cap() -> int:
         return 0
 
 
+def _apply_rope(x, theta: float):
+    """Rotary position embedding (rotate-half convention) on (B,S,H,Dh).
+    Angles are computed from absolute positions in f32 and the rotation is
+    applied in f32 regardless of compute dtype (bf16 angles at position
+    ~1000+ would lose the low-order bits that distinguish neighbors)."""
+    s, d = x.shape[1], x.shape[-1]
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = jnp.arange(s, dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
 class MultiHeadAttention(Op):
     op_type = OperatorType.OP_MULTIHEAD_ATTENTION
     needs_rng = True
@@ -58,7 +76,9 @@ class MultiHeadAttention(Op):
     def __init__(self, model, name, inputs, embed_dim: int, num_heads: int,
                  kdim: int = 0, vdim: int = 0, dropout: float = 0.0,
                  bias: bool = True, add_bias_kv: bool = False,
-                 add_zero_attn: bool = False, causal: bool = False):
+                 add_zero_attn: bool = False, causal: bool = False,
+                 num_kv_heads: int = 0, rope: bool = False,
+                 rope_theta: float = 10000.0):
         super().__init__(model, name, inputs)
         if add_bias_kv or add_zero_attn:
             raise NotImplementedError(
@@ -66,6 +86,20 @@ class MultiHeadAttention(Op):
                 "(reference cuDNN MHA also lacked them)")
         self.embed_dim = embed_dim
         self.num_heads = num_heads
+        # grouped-query attention (net-new vs the reference's cuDNN MHA):
+        # k/v project to num_kv_heads and are broadcast to num_heads query
+        # groups before the score matmul — k/v params and gradient-sync
+        # volume shrink by heads/kv_heads
+        self.num_kv_heads = num_kv_heads or num_heads
+        assert num_heads % self.num_kv_heads == 0, (
+            f"num_heads {num_heads} must be a multiple of num_kv_heads "
+            f"{self.num_kv_heads}")
+        # rotary position embedding, applied to q/k after projection and
+        # BEFORE the attention-path dispatch: the op sees GLOBAL (B,S,H,D)
+        # tensors, so positions are absolute even when a strategy shards
+        # the sequence dim (ring/Ulysses lowering happens further down)
+        self.rope = rope
+        self.rope_theta = rope_theta
         # kdim/vdim are total projection sizes (reference kProjSize*num_heads
         # semantics via cudnnSetAttnDescriptor, attention.cu:533-570)
         self.kdim = kdim if kdim > 0 else embed_dim
@@ -78,6 +112,8 @@ class MultiHeadAttention(Op):
         self.head_dim = embed_dim // num_heads
         self.qk_head_dim = self.kdim // num_heads
         self.v_head_dim = self.vdim // num_heads
+        if rope:
+            assert self.qk_head_dim % 2 == 0, "RoPE needs an even head dim"
         self.q_in = inputs[0].dims[-1]
         self.k_in = inputs[1].dims[-1]
         self.v_in = inputs[2].dims[-1]
@@ -88,20 +124,23 @@ class MultiHeadAttention(Op):
         return [tuple(q[:-1]) + (self.embed_dim,)], [self.inputs[0].dtype]
 
     def weights(self) -> List[WeightSpec]:
+        kvh = self.num_kv_heads
         ws = [
             WeightSpec("wq", (self.q_in, self.num_heads, self.qk_head_dim),
                        init="glorot", fan=(self.q_in, self.kdim)),
-            WeightSpec("wk", (self.k_in, self.num_heads, self.qk_head_dim),
-                       init="glorot", fan=(self.k_in, self.kdim)),
-            WeightSpec("wv", (self.v_in, self.num_heads, self.v_head_dim),
-                       init="glorot", fan=(self.v_in, self.vdim)),
+            WeightSpec("wk", (self.k_in, kvh, self.qk_head_dim),
+                       init="glorot",
+                       fan=(self.k_in, kvh * self.qk_head_dim)),
+            WeightSpec("wv", (self.v_in, kvh, self.v_head_dim),
+                       init="glorot",
+                       fan=(self.v_in, kvh * self.v_head_dim)),
             WeightSpec("wo", (self.num_heads, self.v_head_dim, self.embed_dim),
                        init="glorot", fan=(self.vdim, self.embed_dim)),
         ]
         if self.bias:
             ws += [WeightSpec("bias_q", (self.num_heads, self.qk_head_dim), init="zero"),
-                   WeightSpec("bias_k", (self.num_heads, self.qk_head_dim), init="zero"),
-                   WeightSpec("bias_v", (self.num_heads, self.v_head_dim), init="zero"),
+                   WeightSpec("bias_k", (kvh, self.qk_head_dim), init="zero"),
+                   WeightSpec("bias_v", (kvh, self.v_head_dim), init="zero"),
                    WeightSpec("bias_o", (self.embed_dim,), init="zero")]
         return ws
 
@@ -115,6 +154,15 @@ class MultiHeadAttention(Op):
             qh = qh + params["bias_q"]
             kh = kh + params["bias_k"]
             vh = vh + params["bias_v"]
+        if self.rope:
+            qh = _apply_rope(qh, self.rope_theta)
+            kh = _apply_rope(kh, self.rope_theta)
+        if self.num_kv_heads != self.num_heads:
+            # GQA: broadcast each kv head to its query group; downstream
+            # paths (flash / ring / einsum) then see plain MHA shapes
+            rep = self.num_heads // self.num_kv_heads
+            kh = jnp.repeat(kh, rep, axis=2)
+            vh = jnp.repeat(vh, rep, axis=2)
         scale = 1.0 / math.sqrt(self.qk_head_dim)
 
         seq_axes = []
@@ -297,16 +345,30 @@ class MultiHeadAttention(Op):
         ax = self.axes_for_dim(axis_map, 2)
         if ax is None:
             return super().weight_partition(axis_map)
+        # GQA: k/v weights have num_kv_heads on their head dim; when the
+        # head-shard degree does not divide it, those weights stay
+        # replicated (their kv heads are broadcast to query groups in
+        # forward anyway) while q/o still shard
+        kv_ax = ax
+        if self.num_kv_heads != self.num_heads and self.model.mesh is not None:
+            from flexflow_tpu.parallel.mesh import mesh_shape_dict
+
+            shape = mesh_shape_dict(self.model.mesh)
+            deg = 1
+            for a in (ax if isinstance(ax, tuple) else (ax,)):
+                deg *= shape.get(a, 1)
+            if self.num_kv_heads % deg != 0:
+                kv_ax = None
         out = {
             "wq": P(None, ax, None),
-            "wk": P(None, ax, None),
-            "wv": P(None, ax, None),
+            "wk": P(None, kv_ax, None),
+            "wv": P(None, kv_ax, None),
             "wo": P(ax, None, None),
         }
         if self.bias:
             out["bias_q"] = P(ax, None)
-            out["bias_k"] = P(ax, None)
-            out["bias_v"] = P(ax, None)
+            out["bias_k"] = P(kv_ax, None)
+            out["bias_v"] = P(kv_ax, None)
             out["bias_o"] = P(None)
         return out
 
@@ -314,7 +376,9 @@ class MultiHeadAttention(Op):
         b, sq = self.inputs[0].dims[0], self.inputs[0].dims[1]
         sk = self.inputs[1].dims[1]
         d = self.embed_dim
-        proj = 2 * b * (sq * self.q_in + sk * self.k_in + sk * self.v_in) * d \
+        kv_frac = self.num_kv_heads / self.num_heads  # GQA shrinks k/v proj
+        proj = 2 * b * sq * self.q_in * d \
+            + int(2 * b * sk * (self.k_in + self.v_in) * d * kv_frac) \
             + 2 * b * sq * d * d
         attn = 2 * b * self.num_heads * sq * sk * self.head_dim * 2
         return proj + attn

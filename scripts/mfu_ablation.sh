@@ -19,7 +19,7 @@ run_combo() { # name master_dtype fused_ln
   # deadline via shell arithmetic — spawning python here would dial the
   # tunnel through sitecustomize and can hang if it is half-open
   FF_BENCH_CHILD=1 \
-  FF_BENCH_SKIP_TIERS=tiny,mid,full,full_scan,full_opt \
+  FF_BENCH_SKIP_TIERS=tiny,mid,full,full_scan,xl_scan \
   FF_BENCH_MASTER_DTYPE="$2" FF_BENCH_FUSED_LN="$3" \
   FF_BENCH_DEADLINE=$(($(date +%s) + 540)) \
   timeout 560 python bench.py > "$OUT/$1.json" 2> "$OUT/$1.err"

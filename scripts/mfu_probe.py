@@ -1,0 +1,99 @@
+"""On-chip MFU probe: time the bench `full` transformer config under one
+configuration knob per run, via the scanned multi-step trainer (so the
+numbers are free of the tunnel's per-dispatch latency).
+
+Usage (one jax process at a time — tunnel rule):
+    python scripts/mfu_probe.py --no-flash          # XLA einsum attention
+    python scripts/mfu_probe.py --heads 8           # head_dim 128
+    python scripts/mfu_probe.py --master bfloat16
+    python scripts/mfu_probe.py --seq 1024 --layers 4
+
+Prints one JSON line comparable with the bench full_scan tier.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--seq", type=int, default=512)
+    p.add_argument("--hidden", type=int, default=1024)
+    p.add_argument("--layers", type=int, default=8)
+    p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--master", default="float32")
+    p.add_argument("--no-flash", action="store_true")
+    p.add_argument("--fused-ln", action="store_true")
+    p.add_argument("--dtype", default="bfloat16")
+    args = p.parse_args()
+
+    import jax
+
+    cache_dir = os.path.join(REPO, ".xla_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    import numpy as np
+
+    from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                              SGDOptimizer, SingleDataLoader)
+    from flexflow_tpu.models.transformer import build_encoder_classifier
+    from flexflow_tpu.ops.base import InputOp
+
+    dev = jax.devices()[0]
+    cfg = FFConfig(batch_size=args.batch, mesh_shape={"data": 1},
+                   compute_dtype=args.dtype, master_dtype=args.master,
+                   use_fused_ln=args.fused_ln,
+                   use_flash_attention=not args.no_flash)
+    ff = FFModel(cfg)
+    x, out = build_encoder_classifier(ff, args.batch, args.seq, args.hidden,
+                                      args.layers, args.heads)
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=out)
+    rs = np.random.RandomState(0)
+    n = args.batch * 4
+    SingleDataLoader(ff, x, rs.randn(n, args.seq, args.hidden)
+                     .astype(np.float32))
+    SingleDataLoader(ff, ff.label_tensor,
+                     rs.randint(0, 16, (n, 1)).astype(np.int32))
+
+    losses, _ = ff.train_scanned(args.iters)  # compile + warm
+    float(losses[-1])
+    dts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        losses, _ = ff.train_scanned(args.iters)
+        float(losses[-1])
+        dts.append((time.perf_counter() - t0) / args.iters)
+    dt = min(dts)
+
+    fwd = sum(op.flops() for op in ff.ops if not isinstance(op, InputOp))
+    # same roofline denominator as the bench rows this probe is compared
+    # against (device_kind lookup + measured-matmul fallback)
+    from bench import _peak_flops_per_chip
+
+    peak, _ = _peak_flops_per_chip(dev, dev.platform)
+    print(json.dumps({
+        "knobs": {"flash": not args.no_flash, "heads": args.heads,
+                  "master": args.master, "fused_ln": args.fused_ln,
+                  "seq": args.seq, "layers": args.layers,
+                  "hidden": args.hidden, "batch": args.batch},
+        "backend": dev.platform,
+        "samples_per_s": round(args.batch / dt, 2),
+        "step_time_ms": round(dt * 1e3, 3),
+        "mfu": round(3 * fwd / dt / peak, 4),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -110,6 +110,17 @@ def build_workload(name: str, batch: Optional[int] = None):
         cfg = FFConfig(batch_size=batch or 64, mesh_shape=mesh)
         ff = FFModel(cfg)
         inception_v3(ff, cfg.batch_size, num_classes=1000)
+    elif name == "llama":
+        # modern decoder (RMSNorm + RoPE + GQA + SwiGLU, models/llama.py) —
+        # the Llama-3-8B-class family BASELINE.json's north star names,
+        # at a searchable proxy size (hidden 1024, 8 layers, 16 heads /
+        # 4 kv heads, seq 512)
+        from flexflow_tpu.models.llama import llama_lm
+
+        cfg = FFConfig(batch_size=batch or 64, mesh_shape=mesh)
+        ff = FFModel(cfg)
+        llama_lm(ff, cfg.batch_size, seq_len=512, hidden=1024, layers=8,
+                 heads=16, kv_heads=4, vocab_size=32_000)
     elif name == "dlrm":
         # reference run_summit.sh: 512 samples/device batch, 1M-row x 64-dim
         # tables, mlp-bot 64-512-512-64, mlp-top 576-1024-1024-1024-1
@@ -193,7 +204,8 @@ def main():
     ap.add_argument("--budget", type=int, default=50_000,
                     help="MCMC iterations (reference --budget)")
     ap.add_argument("--workload", default="all",
-                    choices=["all", "transformer", "bert_fx", "resnet50", "inception",
+                    choices=["all", "transformer", "bert_fx", "llama",
+                             "resnet50", "inception",
                              "dlrm"])
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--batch", type=int, default=None,
@@ -206,7 +218,8 @@ def main():
                     help="also run the 16-samples/chip large-batch regime")
     args = ap.parse_args()
 
-    names = (["transformer", "bert_fx", "resnet50", "inception", "dlrm"]
+    names = (["transformer", "bert_fx", "llama", "resnet50", "inception",
+              "dlrm"]
              if args.workload == "all" else [args.workload])
     results = [run_one(n, args.budget, args.seed, batch=args.batch,
                        costs=args.costs)

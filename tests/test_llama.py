@@ -1,0 +1,158 @@
+"""Llama-family decoder pieces: RoPE, grouped-query attention, SwiGLU.
+
+RoPE is pinned against an independently-written reference rotation; GQA is
+pinned against plain MHA with the kv weights explicitly repeated; the full
+llama_lm trains on a synthetic next-token task.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          SGDOptimizer, SingleDataLoader)
+from flexflow_tpu.models.llama import llama_lm
+from flexflow_tpu.ops.attention import _apply_rope
+
+
+def _rope_reference(x, theta):
+    """Independent spelling: complex-number rotation per (position, pair)."""
+    b, s, h, d = x.shape
+    half = d // 2
+    inv = theta ** (-np.arange(half) / half)
+    ang = np.arange(s)[:, None] * inv[None, :]  # (s, half)
+    zc = np.exp(1j * ang)  # (s, half)
+    x1 = x[..., :half].astype(np.float64)
+    x2 = x[..., half:].astype(np.float64)
+    z = (x1 + 1j * x2) * zc[None, :, None, :]
+    return np.concatenate([z.real, z.imag], axis=-1)
+
+
+def test_rope_matches_complex_rotation():
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 16, 3, 8).astype(np.float32)
+    got = np.asarray(_apply_rope(jnp.asarray(x), 10000.0))
+    want = _rope_reference(x, 10000.0)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_rope_preserves_norm():
+    # rotation is orthogonal: per-(b,s,h) vector norms are unchanged
+    rs = np.random.RandomState(1)
+    x = rs.randn(1, 64, 2, 16).astype(np.float32)
+    y = np.asarray(_apply_rope(jnp.asarray(x), 10000.0))
+    np.testing.assert_allclose(np.linalg.norm(y, axis=-1),
+                               np.linalg.norm(x, axis=-1), rtol=1e-4)
+
+
+def _attn_forward(num_kv_heads, weights=None):
+    cfg = FFConfig(batch_size=2, mesh_shape={"data": 1})
+    ff = FFModel(cfg)
+    x = ff.create_tensor([2, 8, 32], name="x")
+    out = ff.multihead_attention(x, x, x, 32, 4, causal=True, bias=False,
+                                 num_kv_heads=num_kv_heads, name="attn")
+    ff.compile(SGDOptimizer(lr=0.0),
+               LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+               [], final_tensor=out)
+    if weights is not None:
+        for k, v in weights.items():
+            ff.params["attn"][k] = jnp.asarray(v)
+    rs = np.random.RandomState(2)
+    batch = {"x": rs.randn(2, 8, 32).astype(np.float32)}
+    return ff, ff.predict(batch)
+
+
+def test_gqa_matches_mha_with_repeated_kv():
+    # kv_heads=2 of 4 -> each kv head serves 2 query heads; explicitly
+    # repeating the kv projections in a plain MHA must give the same output
+    ff_g, out_g = _attn_forward(2)
+    p = ff_g.params["attn"]
+    rep = {
+        "wq": np.asarray(p["wq"]),
+        "wk": np.repeat(np.asarray(p["wk"]), 2, axis=1),
+        "wv": np.repeat(np.asarray(p["wv"]), 2, axis=1),
+        "wo": np.asarray(p["wo"]),
+    }
+    _, out_m = _attn_forward(4, weights=rep)
+    np.testing.assert_allclose(np.asarray(out_g), np.asarray(out_m),
+                               rtol=2e-5, atol=2e-5)
+    # and the GQA projections really are smaller
+    assert np.asarray(p["wk"]).shape == (32, 2, 8)
+
+
+def test_gqa_rope_under_ring_sp_matches_dense():
+    """GQA + RoPE are applied at the op level BEFORE the attention-path
+    dispatch, so they must compose with the ring sequence-parallel
+    lowering: seq-sharded output == single-device dense output."""
+    from flexflow_tpu.parallel.pconfig import ParallelConfig
+
+    B, S, D, H = 2, 32, 16, 4
+    rs = np.random.RandomState(7)
+    x = rs.randn(B, S, D).astype(np.float32)
+
+    def build(mesh_shape, strategies):
+        cfg = FFConfig(batch_size=B, mesh_shape=mesh_shape, seed=5)
+        cfg.strategies.update(strategies)
+        ff = FFModel(cfg)
+        xt = ff.create_tensor([B, S, D], name="x")
+        out = ff.multihead_attention(xt, xt, xt, D, H, causal=True,
+                                     bias=False, num_kv_heads=2, rope=True,
+                                     name="mha")
+        ff.compile(optimizer=None, final_tensor=out)
+        return ff
+
+    ff1 = build({"data": 1}, {})
+    y_dense = np.asarray(ff1.predict({"x": x}))
+    sp = ParallelConfig.from_axis_map(3, {"data": 2, "seq": 4},
+                                      {"data": 0, "seq": 1})
+    ff2 = build({"data": 2, "seq": 4}, {"mha": sp})
+    for w in ("wq", "wk", "wv", "wo"):
+        ff2.set_weights("mha", w, ff1.get_weights("mha", w))
+    y_sp = np.asarray(ff2.predict({"x": x}))
+    np.testing.assert_allclose(y_sp, y_dense, rtol=3e-4, atol=3e-5)
+
+
+def test_gqa_tp_degree_exceeding_kv_heads_replicates_kv():
+    """Head-shard degree 4 with only 2 kv heads: q/o shard, k/v weights
+    stay replicated (their heads broadcast to query groups in forward),
+    and the model still trains."""
+    from flexflow_tpu.parallel.pconfig import ParallelConfig
+
+    mesh = {"data": 2, "model": 4}
+    cfg = FFConfig(batch_size=8, mesh_shape=mesh)
+    cfg.strategies["attn"] = ParallelConfig.from_axis_map(
+        3, mesh, {"data": 0, "model": 2})
+    ff = FFModel(cfg)
+    x = ff.create_tensor([8, 16, 32], name="x")
+    out = ff.multihead_attention(x, x, x, 32, 8, causal=True, bias=False,
+                                 num_kv_heads=2, rope=True, name="attn")
+    ff.compile(SGDOptimizer(lr=0.1),
+               LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+               [], final_tensor=out)
+    assert ff.params["attn"]["wq"].sharding.spec[1] == "model"
+    assert ff.params["attn"]["wk"].sharding.spec == (None, None, None) \
+        or all(e is None for e in ff.params["attn"]["wk"].sharding.spec)
+    rs = np.random.RandomState(4)
+    SingleDataLoader(ff, x, rs.randn(16, 16, 32).astype(np.float32))
+    SingleDataLoader(ff, ff.label_tensor,
+                     rs.randn(16, 16, 32).astype(np.float32))
+    losses, _ = ff.train_scanned(2)
+    assert np.isfinite(np.asarray(losses)).all()
+
+
+def test_llama_lm_trains():
+    # tiny next-token task: constant successor mapping is learnable
+    vocab, seq, batch = 64, 16, 8
+    cfg = FFConfig(batch_size=batch, epochs=30)
+    ff = FFModel(cfg)
+    tokens, logits = llama_lm(ff, batch, seq_len=seq, hidden=64, layers=2,
+                              heads=4, kv_heads=2, vocab_size=vocab)
+    ff.compile(SGDOptimizer(lr=0.5),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=logits)
+    rs = np.random.RandomState(3)
+    x = rs.randint(0, vocab, (64, seq)).astype(np.int32)
+    y = ((x + 1) % vocab)[..., None].astype(np.int32)  # successor token
+    SingleDataLoader(ff, tokens, x)
+    SingleDataLoader(ff, ff.label_tensor, y)
+    perf = ff.fit(verbose=False)
+    assert perf.accuracy > 0.9, f"accuracy {perf.accuracy}"
