@@ -53,7 +53,8 @@ TPU_PEAK_BF16 = {
 #   (FFModel.train_scanned) instead of one dispatch per step — the
 #   production multi-step path (config.scan_steps); on this tunnel it is
 #   also the measurement free of per-dispatch latency.
-#   full_opt = round-3 MFU levers (bf16 master + fused add+layernorm).
+#   full_scan_opt = the round-3 MFU lever that measured as a win on chip
+#   (bf16 master weights); xl_scan = the head_dim-128 headline.
 TPU_TIERS = [
     ("tiny", 8, 256, 512, 2, 8, 5, None),
     ("mid", 16, 512, 1024, 4, 16, 10, None),
@@ -461,7 +462,7 @@ def main():
 
     if tpu_done:
         # headline = largest completed model config; between tiers of
-        # the same config (full vs full_opt) the faster one wins
+        # the same config (full vs full_scan_opt) the faster one wins
         def tier_key(r):
             c = r["config"]
             size = c["batch"] * c["seq"] * c["hidden"] * c["layers"]

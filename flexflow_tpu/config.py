@@ -82,6 +82,11 @@ class FFConfig:
     # (base_model.py:408-418). 0 = one dispatch per step (per-step verbs
     # keep working either way). Requires device-resident data.
     scan_steps: int = 0
+    # gradient accumulation: split each global batch into this many equal
+    # microbatches scanned through fwd+bwd with ONE optimizer update —
+    # numerically the full-batch step (losses are batch means), at a
+    # microbatch's activation memory. 1 = off.
+    grad_accum_steps: int = 1
     # keep datasets device-resident (next_batch = on-device slice, the
     # reference's ZC-resident design) when they fit the budget
     device_resident_data: bool = True
@@ -92,6 +97,13 @@ class FFConfig:
     strategies: Dict[str, "ParallelConfig"] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
+        if self.grad_accum_steps < 1:
+            raise ValueError(
+                f"grad_accum_steps={self.grad_accum_steps}: must be >= 1")
+        if self.batch_size % max(1, self.grad_accum_steps):
+            raise ValueError(
+                f"batch_size {self.batch_size} not divisible by "
+                f"grad_accum_steps {self.grad_accum_steps}")
         for field in ("compute_dtype", "master_dtype"):
             v = getattr(self, field)
             if v not in ("float32", "bfloat16"):

@@ -64,6 +64,7 @@ class FFModel:
         self._train_scan = None
         self._eval_step = None
         self._predict_fn = None
+        self._generators = {}
         self._current_batch: Dict[str, np.ndarray] = {}
         self._aux_tensors: List[Tensor] = []  # scalar losses (MoE balance)
         self._cached_backward = None
@@ -674,6 +675,30 @@ class FFModel:
                 self.executor, "jits_per_group", False) else jax.jit(fwd)
         sharded = self.executor.shard_batch(batch)
         return self._predict_fn(self.params, self.bn_state, sharded)[0]
+
+    def generate(self, tokens, max_new_tokens: int, temperature: float = 0.0,
+                 top_k: int = 0, eos_token_id=None, pad_token_id: int = 0,
+                 num_beams: int = 1, length_penalty: float = 0.0,
+                 seed: int = 0):
+        """KV-cache autoregressive decoding for decoder-only LM graphs
+        (runtime/generation.py). tokens: (B, S0) int32 prompts of uniform
+        length; returns (B, S0 + max_new_tokens) int32. num_beams > 1
+        switches to beam search (temperature/top_k ignored there)."""
+        from flexflow_tpu.runtime.generation import Generator
+
+        # beam search ignores temperature/top_k: key those out so a
+        # sampling sweep reuses one Generator (and its compiled programs)
+        key = ((0.0, 0, eos_token_id, pad_token_id) if num_beams > 1
+               else (temperature, top_k, eos_token_id, pad_token_id))
+        gen = self._generators.get(key)
+        if gen is None:
+            gen = self._generators[key] = Generator(
+                self, temperature=temperature, top_k=top_k,
+                eos_id=eos_token_id, pad_id=pad_token_id)
+        if num_beams > 1:
+            return gen.beam_search(tokens, max_new_tokens, num_beams,
+                                   length_penalty)
+        return gen(tokens, max_new_tokens, seed=seed)
 
     # ------------------------------------------------------------ weights IO
 

@@ -50,15 +50,19 @@ def flash_seq_cap() -> int:
         return 0
 
 
-def _apply_rope(x, theta: float):
+def _apply_rope(x, theta: float, offset=0):
     """Rotary position embedding (rotate-half convention) on (B,S,H,Dh).
     Angles are computed from absolute positions in f32 and the rotation is
     applied in f32 regardless of compute dtype (bf16 angles at position
-    ~1000+ would lose the low-order bits that distinguish neighbors)."""
+    ~1000+ would lose the low-order bits that distinguish neighbors).
+    `offset` (python int or traced scalar) shifts the absolute positions —
+    the KV-cache decode path rotates a single new token at its true
+    position."""
     s, d = x.shape[1], x.shape[-1]
     half = d // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    ang = jnp.arange(s, dtype=jnp.float32)[:, None] * freqs[None, :]
+    pos = jnp.asarray(offset, jnp.float32) + jnp.arange(s, dtype=jnp.float32)
+    ang = pos[:, None] * freqs[None, :]
     cos = jnp.cos(ang)[None, :, None, :]
     sin = jnp.sin(ang)[None, :, None, :]
     x1 = x[..., :half].astype(jnp.float32)
@@ -144,9 +148,10 @@ class MultiHeadAttention(Op):
                    WeightSpec("bias_o", (self.embed_dim,), init="zero")]
         return ws
 
-    def forward(self, params, xs, *, training=False, rng=None, shard_ctx=None):
-        q, k, v = xs[0], xs[1], xs[2]
-        # (B, Sq, D) x (D, H, Hd) -> (B, Sq, H, Hd)
+    def _project_qkv(self, params, q, k, v, rope_offset=0):
+        """Shared projection: (B,S,D) x (D,H,Hd) -> (B,S,H,Hd) for q and
+        (B,S,KVH,Hd) for k/v, bias and RoPE applied, BEFORE any GQA
+        broadcast — the KV cache stores this pre-broadcast layout."""
         qh = jnp.einsum("bsd,dhk->bshk", q, params["wq"])
         kh = jnp.einsum("bsd,dhk->bshk", k, params["wk"])
         vh = jnp.einsum("bsd,dhk->bshk", v, params["wv"])
@@ -155,14 +160,29 @@ class MultiHeadAttention(Op):
             kh = kh + params["bias_k"]
             vh = vh + params["bias_v"]
         if self.rope:
-            qh = _apply_rope(qh, self.rope_theta)
-            kh = _apply_rope(kh, self.rope_theta)
+            qh = _apply_rope(qh, self.rope_theta, rope_offset)
+            kh = _apply_rope(kh, self.rope_theta, rope_offset)
+        return qh, kh, vh
+
+    def _broadcast_kv(self, kh, vh):
         if self.num_kv_heads != self.num_heads:
             # GQA: broadcast each kv head to its query group; downstream
             # paths (flash / ring / einsum) then see plain MHA shapes
             rep = self.num_heads // self.num_kv_heads
             kh = jnp.repeat(kh, rep, axis=2)
             vh = jnp.repeat(vh, rep, axis=2)
+        return kh, vh
+
+    def _out_proj(self, params, ctx):
+        out = jnp.einsum("bqhk,hkd->bqd", ctx, params["wo"])
+        if self.bias:
+            out = out + params["bias_o"]
+        return out
+
+    def forward(self, params, xs, *, training=False, rng=None, shard_ctx=None):
+        q, k, v = xs[0], xs[1], xs[2]
+        qh, kh, vh = self._project_qkv(params, q, k, v)
+        kh, vh = self._broadcast_kv(kh, vh)
         scale = 1.0 / math.sqrt(self.qk_head_dim)
 
         seq_axes = []
@@ -175,10 +195,67 @@ class MultiHeadAttention(Op):
         else:
             ctx = self._dense_attention(qh, kh, vh, scale, training, rng,
                                         shard_ctx)
-        out = jnp.einsum("bqhk,hkd->bqd", ctx, params["wo"])
-        if self.bias:
-            out = out + params["bias_o"]
-        return [out]
+        return [self._out_proj(params, ctx)]
+
+    # ---- KV-cache inference path (runtime/generation.py) -------------------
+    #
+    # Net-new vs the reference: its inference story is CompMode::
+    # COMP_MODE_INFERENCE (ffconst.h:1-130) — the training graph run
+    # forward-only, re-attending the full prefix every step. The TPU
+    # rebuild adds the modern O(1)-per-token path: a static-shape KV cache
+    # updated with lax.dynamic_update_slice (XLA-friendly: one program for
+    # every decode step) storing PRE-broadcast kv heads, so GQA shrinks
+    # cache HBM by heads/kv_heads.
+
+    def init_cache(self, batch: int, max_len: int, dtype):
+        return {
+            "k": jnp.zeros((batch, max_len, self.num_kv_heads,
+                            self.qk_head_dim), dtype),
+            "v": jnp.zeros((batch, max_len, self.num_kv_heads,
+                            self.v_head_dim), dtype),
+        }
+
+    def prefill_forward(self, params, xs, cache):
+        """Full-prompt forward that also fills cache[:, :S]. Reuses the
+        dense attention path (flash on TPU) for the prompt itself."""
+        qh, kh, vh = self._project_qkv(params, xs[0], xs[1], xs[2])
+        new_cache = {
+            "k": jax.lax.dynamic_update_slice(
+                cache["k"], kh.astype(cache["k"].dtype), (0, 0, 0, 0)),
+            "v": jax.lax.dynamic_update_slice(
+                cache["v"], vh.astype(cache["v"].dtype), (0, 0, 0, 0)),
+        }
+        kh, vh = self._broadcast_kv(kh, vh)
+        scale = 1.0 / math.sqrt(self.qk_head_dim)
+        ctx = self._dense_attention(qh, kh, vh, scale, False, None, None)
+        return self._out_proj(params, ctx), new_cache
+
+    def decode_forward(self, params, xs, cache, pos):
+        """One-token step: write this token's k/v at `pos` (traced scalar),
+        attend q over the cache prefix [0, pos]. The GQA grouping is done
+        by reshaping q to (KVH, G) groups — consecutive query heads share a
+        kv head, matching _broadcast_kv's jnp.repeat layout — so the
+        broadcast is never materialized."""
+        qh, kh, vh = self._project_qkv(params, xs[0], xs[1], xs[2],
+                                       rope_offset=pos)
+        ck = jax.lax.dynamic_update_slice(
+            cache["k"], kh.astype(cache["k"].dtype), (0, pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(
+            cache["v"], vh.astype(cache["v"].dtype), (0, pos, 0, 0))
+        b, max_len = ck.shape[0], ck.shape[1]
+        kvh = self.num_kv_heads
+        grp = self.num_heads // kvh
+        scale = 1.0 / math.sqrt(self.qk_head_dim)
+        qg = qh.reshape(b, 1, kvh, grp, self.qk_head_dim)
+        logits = jnp.einsum("bqkgd,bskd->bkgqs", qg, ck.astype(qh.dtype),
+                            preferred_element_type=jnp.float32) * scale
+        live = jnp.arange(max_len) <= pos
+        logits = jnp.where(live[None, None, None, None, :], logits,
+                           jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits, axis=-1).astype(qh.dtype)
+        ctx = jnp.einsum("bkgqs,bskd->bqkgd", probs, cv.astype(qh.dtype))
+        ctx = ctx.reshape(b, 1, self.num_heads, self.v_head_dim)
+        return self._out_proj(params, ctx), {"k": ck, "v": cv}
 
     def _flash_ok(self, qh, kh) -> bool:
         """Use the hand-tiled Pallas flash kernel (ops/pallas_kernels.py) on

@@ -263,26 +263,65 @@ class GraphExecutor:
         input_ops = [op for op in self.model.ops if isinstance(op, InputOp)]
 
         aux_tensors = list(getattr(self.model, "_aux_tensors", ()))
+        accum = max(1, int(getattr(self.model.config, "grad_accum_steps", 1)))
+
+        def loss_fn(p, st, batch, rng):
+            input_values = {op.outputs[0]: batch[op.name] for op in input_ops}
+            vals, new_state = self.apply_graph(
+                p, st, input_values, training=True, rng=rng)
+            logits = vals[final_tensor]
+            loss = compute_loss(loss_type, logits, batch[label_key])
+            for t in aux_tensors:  # e.g. MoE load-balancing losses
+                loss = loss + vals[t]
+            mets = batch_metrics(loss_type, metric_types, logits,
+                                 batch[label_key])
+            return loss, (new_state, mets)
 
         def step(params, opt_state, state, batch, rng):
-            def loss_fn(p):
-                input_values = {op.outputs[0]: batch[op.name] for op in input_ops}
-                vals, new_state = self.apply_graph(
-                    p, state, input_values, training=True, rng=rng)
-                logits = vals[final_tensor]
-                loss = compute_loss(loss_type, logits, batch[label_key])
-                for t in aux_tensors:  # e.g. MoE load-balancing losses
-                    loss = loss + vals[t]
-                mets = batch_metrics(loss_type, metric_types, logits,
-                                     batch[label_key])
-                return loss, (new_state, mets)
-
             (loss, (new_state, mets)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+                loss_fn, has_aux=True)(params, state, batch, rng)
             new_params, new_opt_state = optimizer.update(params, grads, opt_state)
             return new_params, new_opt_state, new_state, loss, mets
 
-        return step
+        def accum_step(params, opt_state, state, batch, rng):
+            # gradient accumulation: the global batch splits into `accum`
+            # equal microbatches scanned through fwd+bwd with summed grads
+            # and ONE optimizer update — numerically the full-batch step
+            # (all losses are batch means, so mean-of-means is exact),
+            # with activation memory of a microbatch. Net-new vs the
+            # reference (its global batch is always one wave of shards).
+            for k, v in batch.items():
+                if v.shape[0] % accum:
+                    raise ValueError(
+                        f"batch dim {v.shape[0]} of {k!r} not divisible by "
+                        f"grad_accum_steps={accum}")
+            micro = {k: v.reshape(accum, v.shape[0] // accum, *v.shape[1:])
+                     for k, v in batch.items()}
+
+            def body(carry, mb_i):
+                g_acc, st = carry
+                mb, i = mb_i
+                (loss, (st, mets)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(
+                        params, st, mb, jax.random.fold_in(rng, i))
+                g_acc = jax.tree.map(jnp.add, g_acc, grads)
+                return (g_acc, st), (loss, mets)
+
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            (g_sum, new_state), (losses, mets) = jax.lax.scan(
+                body, (zeros, state),
+                (micro, jnp.arange(accum, dtype=jnp.int32)))
+            grads = jax.tree.map(lambda g: g / accum, g_sum)
+            loss = jnp.mean(losses)
+            # counts (e.g. accuracy_count) sum across microbatches; mean
+            # metrics average (equal microbatch sizes -> exact)
+            mets = {k: (jnp.sum(v) if k.endswith("_count") else jnp.mean(v))
+                    for k, v in mets.items()}
+            new_params, new_opt_state = optimizer.update(params, grads,
+                                                         opt_state)
+            return new_params, new_opt_state, new_state, loss, mets
+
+        return accum_step if accum > 1 else step
 
     def make_train_step(self, optimizer, loss_type: LossType,
                         metric_types: List[MetricsType], final_tensor,

@@ -1,0 +1,340 @@
+"""Autoregressive generation with a static-shape KV cache.
+
+Reference parity + extension: the reference's inference story is
+CompMode::COMP_MODE_INFERENCE (include/ffconst.h:1-130) — the training
+graph run forward-only, re-attending the whole prefix at every step
+(src/ops/attention.cu keeps full-sequence descriptors). This module is the
+TPU-native modern path: ONE jitted program performs prefill + a
+`lax.scan` decode loop over a fixed-shape KV cache, so every decode step
+is the same compiled XLA program (no retracing, no dynamic shapes) and
+the host dispatches once per generate() call, not once per token.
+
+Design notes:
+  * The graph is validated up front: only ops whose forward is
+    per-position (dense/norm/elementwise/embedding/...) plus causal
+    self-attention are allowed, so a graph that silently mixes positions
+    (conv, pooling, LSTM, concat on seq, ...) is rejected with the op
+    name instead of generating garbage.
+  * The KV cache stores PRE-broadcast kv heads ((B, L, KVH, Dh)), so
+    grouped-query attention shrinks cache HBM by heads/kv_heads — the
+    reason GQA exists (models/llama.py).
+  * Sampling: greedy (temperature=0), temperature, optional top-k.
+    After `eos_id` is emitted a row keeps emitting `pad_id`.
+  * Sharding: the decode program runs under the model's mesh via jit;
+    params keep their training shardings (head-sharded TP decodes with
+    per-shard caches by GSPMD propagation from the weight shardings).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flexflow_tpu.ffconst import DataType, OperatorType
+from flexflow_tpu.ops.attention import MultiHeadAttention
+from flexflow_tpu.ops.base import InputOp
+
+# ops whose forward treats every (batch, position) independently — safe to
+# run on a (B, 1, ...) decode slice exactly as on the full sequence
+_DECODE_SAFE = {
+    OperatorType.OP_LINEAR,
+    OperatorType.OP_EMBEDDING,
+    OperatorType.OP_LAYERNORM,
+    OperatorType.OP_RMSNORM,
+    OperatorType.OP_DROPOUT,   # inference: identity
+    OperatorType.OP_CAST,
+    OperatorType.OP_SCALAR_MULTIPLY,
+    OperatorType.OP_IDENTITY,
+    OperatorType.OP_EXP,
+    OperatorType.OP_SIN,
+    OperatorType.OP_COS,
+    OperatorType.OP_POW,
+    OperatorType.OP_RSQRT,
+    OperatorType.OP_RELU,
+    OperatorType.OP_SIGMOID,
+    OperatorType.OP_TANH,
+    OperatorType.OP_ELU,
+    OperatorType.OP_GELU,
+    OperatorType.OP_EW_ADD,
+    OperatorType.OP_EW_MUL,
+    OperatorType.OP_EW_SUB,
+    OperatorType.OP_EW_DIV,
+    OperatorType.OP_EW_MAX,
+    OperatorType.OP_EW_MIN,
+}
+
+
+class Generator:
+    """Compiles generate() programs for a decoder-only LM built on FFModel.
+
+    Build once per model (after compile()); each (prompt shape,
+    max_new_tokens) pair jits its own program, cached on this object.
+    """
+
+    def __init__(self, model, temperature: float = 0.0, top_k: int = 0,
+                 eos_id: Optional[int] = None, pad_id: int = 0):
+        self.model = model
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.eos_id = eos_id
+        self.pad_id = pad_id
+        self._jitted: Dict = {}
+
+        input_ops = [op for op in model.ops if isinstance(op, InputOp)]
+        tok_inputs = [op for op in input_ops
+                      if op.outputs[0].dtype in (DataType.DT_INT32,
+                                                 DataType.DT_INT64)]
+        if len(input_ops) != 1 or not tok_inputs:
+            kinds = ", ".join(
+                f"{op.name}:{op.outputs[0].dtype.name}" for op in input_ops)
+            raise ValueError(
+                "generate() needs a decoder-only LM with exactly one "
+                f"integer token input; this graph has [{kinds}]")
+        self.token_input = tok_inputs[0]
+        self.attn_ops = []
+        for op in model.ops:
+            if isinstance(op, InputOp):
+                continue
+            if isinstance(op, MultiHeadAttention):
+                if not op.causal:
+                    raise ValueError(
+                        f"{op.name}: generate() requires causal attention")
+                if not (op.inputs[0] is op.inputs[1] is op.inputs[2]):
+                    raise ValueError(
+                        f"{op.name}: generate() supports self-attention "
+                        "only (q, k, v must be the same tensor)")
+                self.attn_ops.append(op)
+            elif op.op_type == OperatorType.OP_SOFTMAX:
+                ax = op.axis % op.outputs[0].num_dims
+                if ax != op.outputs[0].num_dims - 1:
+                    raise ValueError(
+                        f"{op.name}: softmax over a non-feature axis mixes "
+                        "positions; not decodable")
+            elif op.op_type not in _DECODE_SAFE:
+                raise ValueError(
+                    f"{op.name} ({op.op_type.name}) mixes sequence "
+                    "positions or is unsupported in the KV-cache decode "
+                    "path; generate() supports transformer decoder graphs")
+        if not self.attn_ops:
+            raise ValueError("graph has no attention ops; nothing to cache")
+        # topo index of the last attention op: beyond it every op is
+        # per-position, so the prefill tail (lm_head included) can run on
+        # the final position only instead of the whole prompt
+        self._last_attn_idx = max(i for i, op in enumerate(model.ops)
+                                  if op in self.attn_ops)
+
+    # ---- graph walks -------------------------------------------------------
+
+    def _compute_dtype(self):
+        if self.model.config.compute_dtype == "bfloat16":
+            return jnp.bfloat16
+        return jnp.float32
+
+    def _walk(self, params, state, tokens, caches, pos, last_only=False):
+        """Interpret the graph on a (B, S) token slab. pos=None means
+        prefill (positions 0..S-1, fills cache); otherwise S == 1 and pos
+        is the traced absolute position of the token. last_only=True
+        narrows the prefill tail: past the last attention op every op is
+        per-position (validated in __init__), so only the final position
+        flows through the lm_head — O(1/S) of its FLOPs and no (B, S, V)
+        logits materialization."""
+        bf16 = self._compute_dtype() == jnp.bfloat16
+
+        def to_compute(a):
+            if bf16 and a.dtype == jnp.float32:
+                return a.astype(jnp.bfloat16)
+            return a
+
+        s_full = tokens.shape[1]
+        vals = {self.token_input.outputs[0]: tokens}
+        new_caches = {}
+        for idx, op in enumerate(self.model.ops):
+            if isinstance(op, InputOp):
+                continue
+            xs = [vals[t] for t in op.inputs]
+            if (last_only and pos is None and idx > self._last_attn_idx
+                    and s_full > 1):
+                xs = [x[:, -1:] if (x.ndim >= 2 and x.shape[1] == s_full)
+                      else x for x in xs]
+            p = params.get(op.name, {})
+            if bf16:
+                p = {k: to_compute(v) for k, v in p.items()}
+            with jax.named_scope(op.name):
+                if isinstance(op, MultiHeadAttention):
+                    cache = caches[op.name]
+                    if pos is None:
+                        out, nc = op.prefill_forward(p, xs, cache)
+                    else:
+                        out, nc = op.decode_forward(p, xs, cache, pos)
+                    new_caches[op.name] = nc
+                    outs = [out]
+                else:
+                    kwargs = {}
+                    if getattr(op, "wants_shard_ctx", False):
+                        kwargs["shard_ctx"] = None
+                    if op.stateful:
+                        outs, _ = op.forward_stateful(
+                            p, state.get(op.name, {}), xs,
+                            training=False, rng=None)
+                    else:
+                        outs = op.forward(p, xs, training=False, rng=None,
+                                          **kwargs)
+            for i, t in enumerate(op.outputs):
+                vals[t] = outs[i]
+        return vals[self.model._final_tensor], new_caches
+
+    # ---- sampling ----------------------------------------------------------
+
+    def _sample(self, logits, key):
+        """logits (B, V) -> token (B,) int32."""
+        logits = logits.astype(jnp.float32)
+        if self.temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits / self.temperature
+        if self.top_k > 0:
+            kth = jax.lax.top_k(logits, self.top_k)[0][:, -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+    # ---- the compiled program ---------------------------------------------
+
+    def _build(self, max_new_tokens: int):
+        cdtype = self._compute_dtype()
+
+        def gen(params, state, tokens, key):
+            b, s0 = tokens.shape
+            max_len = s0 + max_new_tokens
+            caches = {op.name: op.init_cache(b, max_len, cdtype)
+                      for op in self.attn_ops}
+            logits, caches = self._walk(params, state, tokens, caches, None,
+                                        last_only=True)
+            key, sub = jax.random.split(key)
+            tok = self._sample(logits[:, -1], sub)
+            done = jnp.zeros((b,), bool)
+            if self.eos_id is not None:
+                done = tok == self.eos_id
+
+            def body(carry, i):
+                caches, tok, done, key = carry
+                logits, caches = self._walk(params, state, tok[:, None],
+                                            caches, s0 + i)
+                key, sub = jax.random.split(key)
+                nxt = self._sample(logits[:, 0], sub)
+                if self.eos_id is not None:
+                    nxt = jnp.where(done, self.pad_id, nxt)
+                    done = done | (nxt == self.eos_id)
+                return (caches, nxt, done, key), nxt
+
+            if max_new_tokens > 1:
+                _, rest = jax.lax.scan(
+                    body, (caches, tok, done, key),
+                    jnp.arange(max_new_tokens - 1, dtype=jnp.int32))
+                new = jnp.concatenate([tok[:, None], rest.T], axis=1)
+            else:
+                new = tok[:, None]
+            return jnp.concatenate([tokens, new], axis=1)
+
+        return jax.jit(gen)
+
+    # ---- beam search -------------------------------------------------------
+
+    def _build_beam(self, max_new_tokens: int, num_beams: int,
+                    length_penalty: float):
+        """Beam decode as one jitted scan. Beams live flattened on the
+        batch dim (B*K rows); each step re-orders the KV caches by beam
+        parent with a batched gather. Finished beams (emitted eos) are
+        frozen: only pad continues them, at logp 0, so their score stops
+        changing; the final pick normalizes by emitted length^penalty."""
+        cdtype = self._compute_dtype()
+        K = num_beams
+
+        def gen(params, state, tokens):
+            b, s0 = tokens.shape
+            max_len = s0 + max_new_tokens
+            caches = {op.name: op.init_cache(b, max_len, cdtype)
+                      for op in self.attn_ops}
+            logits, caches = self._walk(params, state, tokens, caches, None,
+                                        last_only=True)
+            logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32),
+                                      axis=-1)                  # (B, V)
+            vocab = logp.shape[-1]
+            scores, tok = jax.lax.top_k(logp, K)                # (B, K)
+            tok = tok.astype(jnp.int32)
+            done = (tok == self.eos_id) if self.eos_id is not None \
+                else jnp.zeros((b, K), bool)
+            # beam-flatten the caches: row b*K+k is beam k of batch row b
+            caches = jax.tree.map(
+                lambda c: jnp.repeat(c, K, axis=0), caches)
+            buf = jnp.full((b, K, max_new_tokens), self.pad_id, jnp.int32)
+            buf = buf.at[:, :, 0].set(tok)
+            new_len = jnp.ones((b, K), jnp.int32)
+
+            def body(carry, i):
+                caches, buf, tok, scores, done, new_len = carry
+                logits, caches = self._walk(
+                    params, state, tok.reshape(b * K, 1), caches, s0 + i)
+                logp = jax.nn.log_softmax(
+                    logits[:, 0].astype(jnp.float32), axis=-1)
+                logp = logp.reshape(b, K, vocab)
+                # frozen beams: pad continues at logp 0, everything else -inf
+                frozen = jnp.full((vocab,), -jnp.inf
+                                  ).at[self.pad_id].set(0.0)
+                logp = jnp.where(done[..., None], frozen[None, None, :], logp)
+                cand = (scores[..., None] + logp).reshape(b, K * vocab)
+                scores, flat = jax.lax.top_k(cand, K)           # (B, K)
+                parent = flat // vocab                          # (B, K)
+                tok = (flat % vocab).astype(jnp.int32)
+                gather = lambda a: jnp.take_along_axis(a, parent, axis=1)
+                done = gather(done)
+                new_len = gather(new_len)
+                buf = jnp.take_along_axis(
+                    buf, parent[:, :, None], axis=1)
+                buf = buf.at[:, :, i + 1].set(tok)
+                # reorder caches by beam parent (batched row gather)
+                rows = (jnp.arange(b)[:, None] * K + parent).reshape(-1)
+                caches = jax.tree.map(
+                    lambda c: jnp.take(c, rows, axis=0), caches)
+                if self.eos_id is not None:
+                    new_len = jnp.where(done, new_len, new_len + 1)
+                    done = done | (tok == self.eos_id)
+                else:
+                    new_len = new_len + 1
+                return (caches, buf, tok, scores, done, new_len), None
+
+            if max_new_tokens > 1:
+                (caches, buf, tok, scores, done, new_len), _ = jax.lax.scan(
+                    body, (caches, buf, tok, scores, done, new_len),
+                    jnp.arange(max_new_tokens - 1, dtype=jnp.int32))
+            norm = scores / jnp.maximum(new_len, 1).astype(
+                jnp.float32) ** length_penalty
+            best = jnp.argmax(norm, axis=1)                     # (B,)
+            picked = jnp.take_along_axis(
+                buf, best[:, None, None], axis=1)[:, 0]         # (B, T)
+            return jnp.concatenate([tokens, picked], axis=1)
+
+        return jax.jit(gen)
+
+    def beam_search(self, tokens: np.ndarray, max_new_tokens: int,
+                    num_beams: int, length_penalty: float = 0.0) -> np.ndarray:
+        tokens = jnp.asarray(tokens, jnp.int32)
+        key = ("beam", max_new_tokens, num_beams, length_penalty)
+        fn = self._jitted.get(key)
+        if fn is None:
+            fn = self._jitted[key] = self._build_beam(
+                max_new_tokens, num_beams, length_penalty)
+        return np.asarray(fn(self.model.params, self.model.bn_state, tokens))
+
+    def __call__(self, tokens: np.ndarray, max_new_tokens: int,
+                 seed: int = 0) -> np.ndarray:
+        """tokens (B, S0) int32 prompt (uniform length, no padding) ->
+        (B, S0 + max_new_tokens) int32."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        fn = self._jitted.get(max_new_tokens)
+        if fn is None:
+            fn = self._jitted[max_new_tokens] = self._build(max_new_tokens)
+        key = jax.random.PRNGKey(seed)
+        return np.asarray(fn(self.model.params, self.model.bn_state,
+                             tokens, key))

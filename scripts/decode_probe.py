@@ -1,0 +1,77 @@
+"""On-chip decode throughput probe: tokens/s for the KV-cache generate
+path (runtime/generation.py) on a Llama-shaped decoder.
+
+Decode is HBM-bandwidth-bound (each step streams all params + the KV
+cache prefix through the chip for one token per row), so the roofline
+metric here is achieved HBM GB/s = (param_bytes + kv_bytes) / step_time,
+not MFU. Prints one JSON line per config.
+
+Run on the real chip: python scripts/decode_probe.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel
+from flexflow_tpu.models.llama import llama_lm
+
+CONFIGS = [
+    # (batch, hidden, layers, heads, kv_heads, prompt, new)
+    (8, 1024, 8, 8, 4, 256, 128),
+    (32, 1024, 8, 8, 4, 256, 128),
+    (8, 2048, 16, 16, 8, 256, 128),
+]
+
+
+def param_bytes(ff):
+    return sum(int(np.prod(w.shape)) * w.dtype.itemsize
+               for ws in ff.params.values() for w in ws.values())
+
+
+def main():
+    import jax
+
+    backend = jax.default_backend()
+    for batch, hidden, layers, heads, kvh, prompt_len, new in CONFIGS:
+        cfg = FFConfig(batch_size=batch, compute_dtype="bfloat16",
+                       master_dtype="bfloat16")
+        ff = FFModel(cfg)
+        _, logits = llama_lm(ff, batch, seq_len=prompt_len, hidden=hidden,
+                             layers=layers, heads=heads, kv_heads=kvh,
+                             vocab_size=32_000)
+        ff.compile(final_tensor=logits)
+        rs = np.random.RandomState(0)
+        prompt = rs.randint(0, 32_000, (batch, prompt_len)).astype(np.int32)
+
+        t0 = time.time()
+        out = ff.generate(prompt, new)
+        compile_s = time.time() - t0
+        t0 = time.time()
+        iters = 3
+        for i in range(iters):
+            out = ff.generate(prompt, new, seed=i)
+        wall = (time.time() - t0) / iters
+        tok_s = batch * new / wall
+        step_ms = wall / new * 1e3
+        d = hidden // heads
+        kv_avg = batch * (prompt_len + new / 2) * kvh * d * 2 * 2 * layers
+        hbm_gbs = (param_bytes(ff) + kv_avg) / (wall / new) / 1e9
+        print(json.dumps({
+            "metric": "llama_decode_throughput", "unit": "tokens/s",
+            "value": round(tok_s, 1), "step_ms": round(step_ms, 3),
+            "approx_hbm_gbs": round(hbm_gbs, 1),
+            "compile_s": round(compile_s, 1), "backend": backend,
+            "config": {"batch": batch, "hidden": hidden, "layers": layers,
+                       "heads": heads, "kv_heads": kvh,
+                       "prompt": prompt_len, "new_tokens": new},
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

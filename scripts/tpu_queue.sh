@@ -9,7 +9,8 @@ OUT=/tmp/tpu_queue
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 
-# 1. The board number: staged tiers incl. full_opt (bf16 master + fused LN)
+# 1. The board number: staged tiers incl. full_scan_opt (bf16 master) and
+#    the xl_scan head_dim-128 headline
 FF_BENCH_BUDGET=1350 timeout 1400 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"
 
 # 2. Flash streaming kernels at 8k+ on real hardware (the round-3 kernel
@@ -34,6 +35,9 @@ EOF
 # 3. ResNet-50 measure tier (the decisive north-star arbitration)
 timeout 1800 python scripts/northstar_search.py --workload resnet50 \
     --costs measure --budget 40000 > "$OUT/resnet_measure.json" 2> "$OUT/resnet_measure.err"
+
+# 3b. KV-cache decode throughput (round-3 generation subsystem)
+timeout 1200 python scripts/decode_probe.py > "$OUT/decode.json" 2> "$OUT/decode.err"
 
 # 4. Whole-program strategy validation on chip (single chip -> DP-1 configs
 #    only; mesh-shaped runs need the virtual mesh, so this validates the
