@@ -1,11 +1,10 @@
 #!/bin/bash
-# Tunnel watcher (round 3): the axon TPU tunnel flaps — it was up for a
-# ~5-minute window (22:11-22:16 UTC) in which the first-ever TPU bench
-# tiers landed, then dropped mid-compile. This watcher probes with long
-# patience and, the moment the tunnel answers, runs the remaining
-# hardware-blocked work in strict priority order (shortest/most valuable
-# first, one jax process at a time). Each step is independent; a tunnel
-# drop mid-step only loses that step.
+# Tunnel watcher (round 3): the axon TPU tunnel flaps. This watcher
+# probes with long patience and, the moment the tunnel answers, runs the
+# remaining hardware-blocked work in strict priority order (one jax
+# process at a time). Each step is independent; a tunnel drop mid-step
+# only loses that step. Steps already completed in earlier TPU sessions
+# (bench tiers, flash8k proof, MFU ablation+probe sweep) are not re-run.
 #
 # Detach with: nohup bash scripts/tpu_watcher.sh >/tmp/watcher.log 2>&1 &
 OUT=/tmp/tpu_queue
@@ -19,42 +18,28 @@ while true; do
       > /dev/null 2>&1; then
     echo "[$(STAMP)] TUNNEL UP - running work queue"
 
-    # 1. headline bench: a fresh full run (resume-across-children happens
-    #    INSIDE one bench.py invocation; this rerun re-times tiny/mid too,
-    #    cheaply via the persistent XLA cache — the driver's round-end run
-    #    needs all tiers from one invocation anyway)
-    echo "[$(STAMP)] step bench"
-    FF_BENCH_BUDGET=1400 timeout 1460 python bench.py \
-        > "$OUT/bench2.json" 2> "$OUT/bench2.err"
+    # 1. ResNet-50 measure tier (VERDICT #3 arbitration — the one
+    #    remaining north-star gap)
+    echo "[$(STAMP)] step resnet"
+    timeout 2400 python scripts/northstar_search.py --workload resnet50 \
+        --costs measure --budget 40000 \
+        > "$OUT/resnet_measure.json" 2> "$OUT/resnet_measure.err"
     rc=$?
-    echo "[$(STAMP)] bench rc=$rc: $(cat "$OUT/bench2.json")"
+    echo "[$(STAMP)] resnet rc=$rc: $(tail -c 300 "$OUT/resnet_measure.json")"
 
-    # 2. flash streaming kernels at 8k on hardware (VERDICT #2 proof)
-    echo "[$(STAMP)] step flash8k"
-    timeout 700 python scripts/flash8k_probe.py \
-        > "$OUT/flash8k.log" 2>&1
+    # 2. KV-cache decode throughput (round-3 generation subsystem)
+    echo "[$(STAMP)] step decode"
+    timeout 1200 python scripts/decode_probe.py \
+        > "$OUT/decode.json" 2> "$OUT/decode.err"
     rc=$?
-    echo "[$(STAMP)] flash8k rc=$rc: $(tail -2 "$OUT/flash8k.log")"
+    echo "[$(STAMP)] decode rc=$rc: $(cat "$OUT/decode.json")"
 
-    # 3. MFU-lever ablation rows (VERDICT #4 table)
-    echo "[$(STAMP)] step ablation"
-    bash scripts/mfu_ablation.sh "$OUT/ablation" >> "$OUT/ablation.log" 2>&1
-    echo "[$(STAMP)] ablation done"
-
-    # 4. whole-program strategy validation on chip (VERDICT #5 chip leg)
+    # 3. whole-program strategy validation, chip leg (VERDICT #5)
     echo "[$(STAMP)] step validate"
     timeout 900 python scripts/validate_strategies.py --budget 2000 --steps 10 \
         > "$OUT/validate.json" 2> "$OUT/validate.err"
     rc=$?
     echo "[$(STAMP)] validate rc=$rc"
-
-    # 5. ResNet-50 measure tier (VERDICT #3 arbitration; longest last)
-    echo "[$(STAMP)] step resnet"
-    timeout 1800 python scripts/northstar_search.py --workload resnet50 \
-        --costs measure --budget 40000 \
-        > "$OUT/resnet_measure.json" 2> "$OUT/resnet_measure.err"
-    rc=$?
-    echo "[$(STAMP)] resnet rc=$rc"
 
     echo "[$(STAMP)] QUEUE COMPLETE"
     break
