@@ -1,0 +1,54 @@
+"""Vision Transformer (models/vit.py): patchify-conv + RoPE pre-norm
+encoder + mean-pool head, trained on synthetic images.
+
+Net-new model family vs the reference zoo (its vision workloads are all
+CNNs). Run: python examples/native/vit.py [-b BATCH] [-e EPOCHS]
+[--image-size S] [--patch P] [--hidden H] [--num-layers L]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu import (AdamOptimizer, FFConfig, FFModel, LossType,
+                          MetricsType, SingleDataLoader, WarmupCosine)
+from flexflow_tpu.models.vit import vit
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--patch", type=int, default=8)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--num-heads", type=int, default=4)
+    p.add_argument("--classes", type=int, default=10)
+    args, _ = p.parse_known_args()
+    cfg = FFConfig.parse_args()
+
+    ff = FFModel(cfg)
+    x, logits = vit(ff, cfg.batch_size, image_size=args.image_size,
+                    patch_size=args.patch, hidden=args.hidden,
+                    layers=args.num_layers, heads=args.num_heads,
+                    num_classes=args.classes)
+    ff.compile(AdamOptimizer(alpha=1e-3,
+                             schedule=WarmupCosine(warmup_steps=5,
+                                                   total_steps=200)),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=logits)
+
+    n = 4 * cfg.batch_size
+    rs = np.random.RandomState(0)
+    xd = rs.randn(n, 3, args.image_size, args.image_size).astype(np.float32)
+    yd = rs.randint(0, args.classes, (n, 1)).astype(np.int32)
+    SingleDataLoader(ff, x, xd)
+    SingleDataLoader(ff, ff.label_tensor, yd)
+    ff.fit()
+
+
+if __name__ == "__main__":
+    main()

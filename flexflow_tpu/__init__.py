@@ -39,6 +39,9 @@ from flexflow_tpu.config import FFConfig  # noqa: F401
 from flexflow_tpu.tensor import Tensor, Parameter  # noqa: F401
 from flexflow_tpu.model import FFModel  # noqa: F401
 from flexflow_tpu.runtime.optimizer import SGDOptimizer, AdamOptimizer  # noqa: F401
+from flexflow_tpu.runtime.schedule import (  # noqa: F401
+    ConstantSchedule, ExponentialDecay, StepDecay, WarmupCosine,
+    WarmupLinear)
 from flexflow_tpu.runtime.initializer import (  # noqa: F401
     GlorotUniformInitializer,
     ZeroInitializer,

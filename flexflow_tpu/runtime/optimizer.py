@@ -39,11 +39,18 @@ def _f32_view(*arrays):
 
 class SGDOptimizer(Optimizer):
     def __init__(self, model=None, lr: float = 0.01, momentum: float = 0.0,
-                 nesterov: bool = False, weight_decay: float = 0.0):
+                 nesterov: bool = False, weight_decay: float = 0.0,
+                 schedule=None):
+        from flexflow_tpu.runtime.schedule import resolve
+
         self.lr = lr
         self.momentum = momentum
         self.nesterov = nesterov
         self.weight_decay = weight_decay
+        # lr schedule (runtime/schedule.py): pure fn of the traced step,
+        # compiled into the jitted update. None = constant (reference
+        # behavior, optimizer.cc fixed-lr kernels).
+        self.schedule = resolve(schedule)
 
     def init_state(self, params):
         if self.momentum > 0.0:
@@ -53,7 +60,8 @@ class SGDOptimizer(Optimizer):
         return {"v": v, "t": jnp.zeros((), jnp.int32)}
 
     def update(self, params, grads, state):
-        lr, mom, wd = self.lr, self.momentum, self.weight_decay
+        mom, wd = self.momentum, self.weight_decay
+        lr = self.lr * self.schedule(state["t"])
 
         if mom > 0.0:
             def upd(w, g, v):
@@ -83,12 +91,15 @@ class SGDOptimizer(Optimizer):
 class AdamOptimizer(Optimizer):
     def __init__(self, model=None, alpha: float = 0.001, beta1: float = 0.9,
                  beta2: float = 0.999, weight_decay: float = 0.0,
-                 epsilon: float = 1e-8):
+                 epsilon: float = 1e-8, schedule=None):
+        from flexflow_tpu.runtime.schedule import resolve
+
         self.alpha = alpha
         self.beta1 = beta1
         self.beta2 = beta2
         self.weight_decay = weight_decay
         self.epsilon = epsilon
+        self.schedule = resolve(schedule)
 
     def init_state(self, params):
         zeros = lambda p: jax.tree_util.tree_map(jnp.zeros_like, p)
@@ -99,8 +110,8 @@ class AdamOptimizer(Optimizer):
         b1, b2, wd, eps = self.beta1, self.beta2, self.weight_decay, self.epsilon
         t = state["t"] + 1
         # bias-corrected step size, as the reference's AdamOptimizer::next()
-        alpha_t = self.alpha * jnp.sqrt(1.0 - jnp.power(b2, t)) \
-            / (1.0 - jnp.power(b1, t))
+        alpha_t = self.alpha * self.schedule(state["t"]) \
+            * jnp.sqrt(1.0 - jnp.power(b2, t)) / (1.0 - jnp.power(b1, t))
 
         def upd(w, g, m, v):
             wt, mt, vt = w.dtype, m.dtype, v.dtype

@@ -1,0 +1,106 @@
+"""Learning-rate schedules.
+
+Net-new vs the reference (its SGD/Adam learning rate is a fixed scalar
+for the whole run, src/runtime/optimizer.cc:93-358). Schedules are pure
+functions of the traced step counter — they compile into the jitted
+train step, so changing the schedule never adds a host->device transfer.
+
+Each schedule maps step t (0-based int scalar, traced) -> multiplicative
+scale on the optimizer's base lr. Compose with any optimizer:
+
+    SGDOptimizer(lr=0.1, schedule=WarmupCosine(warmup_steps=100,
+                                               total_steps=10_000))
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Schedule:
+    def __call__(self, t):
+        raise NotImplementedError
+
+
+class ConstantSchedule(Schedule):
+    def __call__(self, t):
+        return jnp.float32(1.0)
+
+
+class _WarmupDecay(Schedule):
+    """Linear warmup 0->1 over `warmup_steps`, then `_decay(frac)` from 1
+    to `final_scale` as frac runs 0->1 at `total_steps` (held after)."""
+
+    def __init__(self, warmup_steps: int, total_steps: int,
+                 final_scale: float = 0.0):
+        assert total_steps > warmup_steps >= 0, \
+            f"need total_steps > warmup_steps >= 0, got " \
+            f"{total_steps} / {warmup_steps}"
+        self.warmup_steps = warmup_steps
+        self.total_steps = total_steps
+        self.final_scale = final_scale
+
+    def _decay(self, frac):
+        raise NotImplementedError
+
+    def __call__(self, t):
+        t = jnp.asarray(t, jnp.float32)
+        warm = t / jnp.maximum(self.warmup_steps, 1)
+        frac = (t - self.warmup_steps) / (self.total_steps - self.warmup_steps)
+        frac = jnp.clip(frac, 0.0, 1.0)
+        return jnp.where(t < self.warmup_steps, warm, self._decay(frac))
+
+
+class WarmupCosine(_WarmupDecay):
+    """Linear warmup, cosine decay to `final_scale`."""
+
+    def _decay(self, frac):
+        return self.final_scale + (1.0 - self.final_scale) \
+            * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+
+
+class WarmupLinear(_WarmupDecay):
+    """Linear warmup, linear decay to `final_scale`."""
+
+    def _decay(self, frac):
+        return 1.0 + (self.final_scale - 1.0) * frac
+
+
+class StepDecay(Schedule):
+    """scale = gamma^(t // step_size) — the classic ResNet 0.1x drops."""
+
+    def __init__(self, step_size: int, gamma: float = 0.1):
+        assert step_size > 0
+        self.step_size = step_size
+        self.gamma = gamma
+
+    def __call__(self, t):
+        k = jnp.asarray(t, jnp.int32) // self.step_size
+        return jnp.power(jnp.float32(self.gamma), k.astype(jnp.float32))
+
+
+class ExponentialDecay(Schedule):
+    """scale = gamma^t."""
+
+    def __init__(self, gamma: float):
+        self.gamma = gamma
+
+    def __call__(self, t):
+        return jnp.power(jnp.float32(self.gamma),
+                         jnp.asarray(t, jnp.float32))
+
+
+def resolve(schedule) -> Schedule:
+    """None -> constant; a Schedule instance or any callable passes
+    through. Rejects an uninstantiated class (a forgotten-parens
+    `schedule=WarmupCosine` would otherwise fail deep inside jit
+    tracing with an unrelated-looking message)."""
+    if schedule is None:
+        return ConstantSchedule()
+    if isinstance(schedule, type):
+        raise TypeError(
+            f"schedule must be an instance, got the class {schedule.__name__}"
+            f" — did you mean {schedule.__name__}(...)?")
+    if callable(schedule):
+        return schedule
+    raise TypeError(f"schedule must be callable or None, got {schedule!r}")

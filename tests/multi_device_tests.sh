@@ -48,6 +48,8 @@ python examples/native/llama.py -e 1 -b "$BATCH" --hidden 64 --num-layers 2 \
   --num-heads 4 --num-kv-heads 2 --sequence-length 32 --vocab 256
 python examples/native/llama_generate.py -b "$NDEV" --hidden 64 --num-layers 2 \
   --prompt-length 8 --max-new-tokens 8
+python examples/native/vit.py -e 1 -b "$BATCH" --image-size 32 --patch 8 \
+  --hidden 64 --num-layers 2
 python examples/native/tensor_attach.py -e 1 -b "$BATCH"
 python examples/native/cifar10_cnn_attach.py -e 1 -b "$BATCH"
 

@@ -43,6 +43,19 @@ def test_resnet50_builds_and_steps():
              final=out)
 
 
+def test_vit_builds_and_steps():
+    from flexflow_tpu.models.vit import vit
+
+    B = 8
+    ff = FFModel(FFConfig(batch_size=B, mesh_shape={"data": 4}))
+    x, out = vit(ff, B, image_size=32, patch_size=8, hidden=64, layers=2,
+                 heads=4, num_classes=10)
+    rs = np.random.RandomState(0)
+    one_step(ff, {"input": rs.randn(B, 3, 32, 32).astype(np.float32),
+                  "label": rs.randint(0, 10, (B, 1)).astype(np.int32)},
+             final=out)
+
+
 def test_inception_builds_and_steps():
     from flexflow_tpu.models.cnn import inception_v3_stem
 
