@@ -474,6 +474,7 @@ def main():
         best["all_tiers"] = [
             {"tier": r["tier"], "value": r["value"], "mfu": r["mfu"]}
             for r in tpu_results]
+        _append_history(tpu_results)
 
     if best is None:
         # hard-capped to the remaining budget: overshooting FF_BENCH_BUDGET
@@ -492,16 +493,68 @@ def main():
     if best is not None:
         if errors:
             best["attempt_errors"] = errors
+        if best.get("backend") != "tpu":
+            _attach_prior_tpu(best)
         print(json.dumps(best), flush=True)
         return 0
-    print(json.dumps({
+    out = {
         "metric": "transformer_train_throughput",
         "value": 0.0,
         "unit": "samples/s",
         "vs_baseline": 0.0,
         "error": "; ".join(errors)[-2000:],
-    }), flush=True)
+    }
+    _attach_prior_tpu(out)
+    print(json.dumps(out), flush=True)
     return 1
+
+
+# every TPU-completed tier is appended here so a later run that cannot
+# reach the tunnel can still REPORT (clearly labeled, never as its own
+# headline) what the same code measured on the real chip earlier
+_HISTORY = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".bench_history.jsonl")
+
+
+def _append_history(tpu_results):
+    try:
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        with open(_HISTORY, "a") as f:
+            for r in tpu_results:
+                f.write(json.dumps({"when": stamp, **r}) + "\n")
+    except OSError:
+        pass
+
+
+def _attach_prior_tpu(out):
+    """On a non-TPU (fallback) board line, attach the best TPU result a
+    previous invocation of THIS bench recorded, under a key that cannot
+    be mistaken for the current measurement."""
+    try:
+        rows = []
+        with open(_HISTORY) as f:
+            for line in f:
+                # per-line: a truncated tail (child killed mid-append)
+                # must not discard the valid earlier rows
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue
+                if r.get("backend") == "tpu":
+                    rows.append(r)
+        if not rows:
+            return
+        c = lambda r: r["config"]
+        prior = max(rows, key=lambda r: (c(r)["batch"] * c(r)["seq"]
+                                         * c(r)["hidden"] * c(r)["layers"],
+                                         r["value"]))
+        out["prior_tpu_best_not_this_run"] = {
+            "when": prior.get("when"), "tier": prior.get("tier"),
+            "value": prior.get("value"), "mfu": prior.get("mfu"),
+            "config": prior.get("config"),
+        }
+    except (OSError, ValueError, KeyError):
+        pass
 
 
 if __name__ == "__main__":

@@ -65,6 +65,9 @@ class FFModel:
         self._eval_step = None
         self._predict_fn = None
         self._generators = {}
+        # (dst_op, dst_weight) -> (src_op, src_weight, transform); see
+        # tie_weights()
+        self._tied = {}
         self._current_batch: Dict[str, np.ndarray] = {}
         self._aux_tensors: List[Tensor] = []  # scalar losses (MoE balance)
         self._cached_backward = None
@@ -344,6 +347,61 @@ class FFModel:
         return self._binary(OperatorType.OP_EW_MIN, a, b, name)
 
     # -------------------------------------------------------------- compile
+
+    def tie_weights(self, dst_op: str, dst_weight: str, src_op: str,
+                    src_weight: str, transform: str = "same"):
+        """Share one stored weight between two ops (reference parity: the
+        NMT subsystem's SharedVariable, nmt/rnn.h:37-51, one logical
+        weight behind many timestep ops; modern use: tied embedding /
+        lm_head). The destination op stops owning storage — its weight is
+        resolved from the source at trace time (transform: "same" |
+        "transpose"), so gradients from both ops accumulate into the one
+        array through autodiff. Call after building both ops, before
+        compile()."""
+        if transform not in ("same", "transpose"):
+            raise ValueError(f"transform must be 'same' or 'transpose', "
+                             f"got {transform!r}")
+        if getattr(self, "executor", None) is not None:
+            raise ValueError(
+                "tie_weights must be called before compile(): params and "
+                "the jitted step are already built, so a late tie would "
+                "be silently ignored by traced programs")
+        s, d = self.get_op_by_name(src_op), self.get_op_by_name(dst_op)
+        for nm, op in ((src_op, s), (dst_op, d)):
+            if op is None:
+                raise ValueError(f"tie_weights: no op named {nm!r}")
+        specs_s = {w.name: w for w in s.weight_specs()}
+        specs_d = {w.name: w for w in d.weight_specs()}
+        if src_weight not in specs_s:
+            raise ValueError(f"tie_weights: {src_op!r} has no weight "
+                             f"{src_weight!r} (has {list(specs_s)})")
+        if dst_weight not in specs_d:
+            raise ValueError(f"tie_weights: {dst_op!r} has no weight "
+                             f"{dst_weight!r} (has {list(specs_d)})")
+        shape_s = tuple(specs_s[src_weight].shape)
+        if transform == "transpose":
+            shape_s = shape_s[::-1]
+        if tuple(specs_d[dst_weight].shape) != shape_s:
+            raise ValueError(
+                f"tie_weights: shape mismatch — {dst_op}.{dst_weight} is "
+                f"{tuple(specs_d[dst_weight].shape)} but {src_op}."
+                f"{src_weight} {transform} gives {shape_s}")
+        if (src_op, src_weight) in self._tied:
+            raise ValueError(
+                f"tie_weights: source {src_op}.{src_weight} is itself tied "
+                f"— chain ties to the original storage instead")
+        if (dst_op, dst_weight) in self._tied:
+            prev = self._tied[(dst_op, dst_weight)]
+            raise ValueError(
+                f"tie_weights: {dst_op}.{dst_weight} is already tied to "
+                f"{prev[0]}.{prev[1]}")
+        if any(src == (dst_op, dst_weight)
+               for src in ((v[0], v[1]) for v in self._tied.values())):
+            raise ValueError(
+                f"tie_weights: {dst_op}.{dst_weight} is the SOURCE of an "
+                f"existing tie; it must keep its storage — reverse the tie "
+                f"or chain the other ops to the same source")
+        self._tied[(dst_op, dst_weight)] = (src_op, src_weight, transform)
 
     def get_op_by_name(self, name: str) -> Optional[Op]:
         for op in self.ops:
@@ -703,9 +761,19 @@ class FFModel:
     # ------------------------------------------------------------ weights IO
 
     def get_weights(self, op_name: str, weight_name: str = "kernel") -> np.ndarray:
+        tie = self._tied.get((op_name, weight_name))
+        if tie is not None:
+            src_op, src_w, tf = tie
+            w = np.asarray(self.params[src_op][src_w])
+            return w.T if tf == "transpose" else w
         return np.asarray(self.params[op_name][weight_name])
 
     def set_weights(self, op_name: str, weight_name: str, value: np.ndarray):
+        tie = self._tied.get((op_name, weight_name))
+        if tie is not None:
+            raise ValueError(
+                f"{op_name}.{weight_name} is tied to {tie[0]}.{tie[1]} — "
+                f"set the source weight instead")
         shardings = self.executor.param_shardings()
         sh = shardings[op_name][weight_name]
         self.params[op_name][weight_name] = jax.device_put(

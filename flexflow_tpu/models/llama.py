@@ -32,10 +32,13 @@ def swiglu(ff: FFModel, x, hidden: int, ffn_hidden: int, i: int):
 def llama_lm(ff: FFModel, batch_size: int, seq_len: int = 256,
              hidden: int = 512, layers: int = 4, heads: int = 4,
              kv_heads: int = 0, ffn_hidden: int = 0,
-             vocab_size: int = 32_000, rope_theta: float = 10000.0):
+             vocab_size: int = 32_000, rope_theta: float = 10000.0,
+             tie_embeddings: bool = False):
     """Decoder-only causal LM in the Llama shape. kv_heads=0 -> MHA;
     kv_heads < heads -> grouped-query attention. ffn_hidden defaults to
-    the Llama-style ~8/3 * hidden rounded to a multiple of 128."""
+    the Llama-style ~8/3 * hidden rounded to a multiple of 128.
+    tie_embeddings shares the lm_head with the token embedding
+    (FFModel.tie_weights) — vocab x hidden params stored once."""
     if not ffn_hidden:
         ffn_hidden = max(128, (8 * hidden // 3 + 127) // 128 * 128)
     tokens = ff.create_tensor([batch_size, seq_len], dtype=DataType.DT_INT32,
@@ -52,4 +55,7 @@ def llama_lm(ff: FFModel, batch_size: int, seq_len: int = 256,
         t = ff.add(t, f, name=f"res2_{i}")
     t = ff.rms_norm(t, name="ln_f")
     logits = ff.dense(t, vocab_size, use_bias=False, name="lm_head")
+    if tie_embeddings:
+        ff.tie_weights("lm_head", "kernel", "tok_embed", "kernel",
+                       "transpose")
     return tokens, logits

@@ -114,6 +114,11 @@ class PlacementExecutor:
         from flexflow_tpu.parallel.mesh import mesh_shape_dict
         from flexflow_tpu.runtime.executor import GraphExecutor
 
+        if getattr(model, "_tied", None):
+            raise NotImplementedError(
+                "tie_weights + operator placement is unsupported: a tied "
+                "weight would have to live on two sub-meshes at once; use "
+                "a non-placement strategy for tied models")
         self.model = model
         self.base = GraphExecutor(model)  # strategy resolution + helpers
         self.full_mesh: Mesh = model.mesh

@@ -150,7 +150,10 @@ class GraphExecutor:
                 continue
             op_params = {}
             master_bf16 = self.model.config.master_dtype == "bfloat16"
+            tied = getattr(self.model, "_tied", {})
             for i, spec in enumerate(specs):
+                if (op.name, spec.name) in tied:
+                    continue  # storage lives with the tie source
                 key = jax.random.fold_in(
                     jax.random.fold_in(rng_key, _stable_hash(op.name)), i)
                 sharding = shardings[op.name].get(spec.name)
@@ -207,7 +210,8 @@ class GraphExecutor:
                 seed = getattr(op, "seed", 0)
                 if seed:
                     op_rng = jax.random.fold_in(op_rng, seed)
-            p = params.get(op.name, {})
+            p = resolve_tied_params(self.model, params, op.name,
+                                    params.get(op.name, {}))
             if bf16:
                 p = {k: to_compute(v) for k, v in p.items()}
             kwargs = {}
@@ -425,6 +429,24 @@ class GraphExecutor:
                 sh = NamedSharding(self.mesh, P(*entries))
             out[k] = jax.device_put(v, sh)
         return out
+
+
+def resolve_tied_params(model, params, op_name, p):
+    """Materialize tied weights (FFModel.tie_weights) for `op_name` from
+    their source op's storage. Runs inside the traced step, so autodiff
+    accumulates both ops' gradients into the single source array."""
+    tied = getattr(model, "_tied", None)
+    if not tied:
+        return p
+    out = None
+    for (dst_op, dst_w), (src_op, src_w, tf) in tied.items():
+        if dst_op != op_name:
+            continue
+        if out is None:
+            out = dict(p)
+        w = params[src_op][src_w]
+        out[dst_w] = w.T if tf == "transpose" else w
+    return p if out is None else out
 
 
 def _spec_rank_ok(spec, ndim) -> bool:

@@ -36,6 +36,7 @@ import numpy as np
 from flexflow_tpu.ffconst import DataType, OperatorType
 from flexflow_tpu.ops.attention import MultiHeadAttention
 from flexflow_tpu.ops.base import InputOp
+from flexflow_tpu.runtime.executor import resolve_tied_params
 
 # ops whose forward treats every (batch, position) independently — safe to
 # run on a (B, 1, ...) decode slice exactly as on the full sequence
@@ -159,7 +160,8 @@ class Generator:
                     and s_full > 1):
                 xs = [x[:, -1:] if (x.ndim >= 2 and x.shape[1] == s_full)
                       else x for x in xs]
-            p = params.get(op.name, {})
+            p = resolve_tied_params(self.model, params, op.name,
+                                    params.get(op.name, {}))
             if bf16:
                 p = {k: to_compute(v) for k, v in p.items()}
             with jax.named_scope(op.name):

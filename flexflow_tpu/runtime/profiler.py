@@ -45,7 +45,10 @@ def profile_step(model, batch: Dict, iters: int = 3) -> List[dict]:
         if isinstance(op, InputOp):
             continue
         xs = [vals[t] for t in op.inputs]
-        p = model.params.get(op.name, {})
+        from flexflow_tpu.runtime.executor import resolve_tied_params
+
+        p = resolve_tied_params(model, model.params, op.name,
+                                model.params.get(op.name, {}))
         op_rng = jax.random.fold_in(rng, idx) if op.needs_rng else None
 
         def run():
