@@ -74,11 +74,19 @@ TPU_TIERS = [
     # dominate the mix) — the standard TPU-native design choice
     ("xl_scan", 16, 512, 2048, 8, 16, 15,
      {"scan": True, "master_dtype": "bfloat16"}),
+    # tail tier, pure upside: hidden 4096 pushes matmul arithmetic
+    # intensity further up the roofline (the probe sweep's MFU trend with
+    # width). Larger by the headline model-size key (hidden x layers:
+    # 24576 vs xl_scan's 16384), so it takes the headline only if it
+    # completes; any failure just keeps xl_scan.
+    ("xxl_scan", 8, 512, 4096, 6, 32, 8,
+     {"scan": True, "master_dtype": "bfloat16"}),
 ]
 # rough wall-clock needed per tier (compile + run), used by the child to
 # decide whether to start the next tier with the time it has left
 TIER_COST_S = {"tiny": 90, "mid": 150, "full": 240, "full_scan": 180,
-               "full_scan_opt": 180, "xl_scan": 260, "cpu_smoke": 30,
+               "full_scan_opt": 180, "xl_scan": 260, "xxl_scan": 300,
+               "cpu_smoke": 30,
                "cpu_smoke_scan": 30}
 
 
@@ -461,12 +469,12 @@ def main():
             break  # two attempts in a row made no TPU progress
 
     if tpu_done:
-        # headline = largest completed model config; between tiers of
-        # the same config (full vs full_scan_opt) the faster one wins
+        # headline = largest completed MODEL (hidden x layers — batch/seq
+        # are throughput knobs, not model size); between tiers of the
+        # same model (full vs full_scan_opt) the faster one wins
         def tier_key(r):
             c = r["config"]
-            size = c["batch"] * c["seq"] * c["hidden"] * c["layers"]
-            return (size, r["value"])
+            return (c["hidden"] * c["layers"], r["value"])
 
         tpu_results = list(tpu_done.values())
         best = max(tpu_results, key=tier_key)
@@ -545,8 +553,7 @@ def _attach_prior_tpu(out):
         if not rows:
             return
         c = lambda r: r["config"]
-        prior = max(rows, key=lambda r: (c(r)["batch"] * c(r)["seq"]
-                                         * c(r)["hidden"] * c(r)["layers"],
+        prior = max(rows, key=lambda r: (c(r)["hidden"] * c(r)["layers"],
                                          r["value"]))
         out["prior_tpu_best_not_this_run"] = {
             "when": prior.get("when"), "tier": prior.get("tier"),

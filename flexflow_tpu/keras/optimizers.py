@@ -7,18 +7,20 @@ from flexflow_tpu.runtime.optimizer import AdamOptimizer, SGDOptimizer
 
 class SGD:
     def __init__(self, learning_rate=0.01, lr=None, momentum=0.0,
-                 nesterov=False, weight_decay=0.0):
+                 nesterov=False, weight_decay=0.0, schedule=None):
         self.inner = SGDOptimizer(lr=lr if lr is not None else learning_rate,
                                   momentum=momentum, nesterov=nesterov,
-                                  weight_decay=weight_decay)
+                                  weight_decay=weight_decay,
+                                  schedule=schedule)
 
 
 class Adam:
     def __init__(self, learning_rate=0.001, lr=None, beta_1=0.9, beta_2=0.999,
-                 epsilon=1e-8, weight_decay=0.0):
+                 epsilon=1e-8, weight_decay=0.0, schedule=None):
         self.inner = AdamOptimizer(alpha=lr if lr is not None else learning_rate,
                                    beta1=beta_1, beta2=beta_2, epsilon=epsilon,
-                                   weight_decay=weight_decay)
+                                   weight_decay=weight_decay,
+                                   schedule=schedule)
 
 
 def get_optimizer(opt):

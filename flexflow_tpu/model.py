@@ -737,11 +737,13 @@ class FFModel:
     def generate(self, tokens, max_new_tokens: int, temperature: float = 0.0,
                  top_k: int = 0, eos_token_id=None, pad_token_id: int = 0,
                  num_beams: int = 1, length_penalty: float = 0.0,
-                 seed: int = 0):
+                 prompt_lengths=None, seed: int = 0):
         """KV-cache autoregressive decoding for decoder-only LM graphs
-        (runtime/generation.py). tokens: (B, S0) int32 prompts of uniform
-        length; returns (B, S0 + max_new_tokens) int32. num_beams > 1
-        switches to beam search (temperature/top_k ignored there)."""
+        (runtime/generation.py). tokens: (B, S0) int32 prompts; returns
+        (B, S0 + max_new_tokens) int32 with generated tokens in columns
+        S0 onward. prompt_lengths (B,) enables ragged right-padded
+        prompts. num_beams > 1 switches to beam search (temperature/
+        top_k ignored there; uniform-length prompts only)."""
         from flexflow_tpu.runtime.generation import Generator
 
         # beam search ignores temperature/top_k: key those out so a
@@ -754,9 +756,14 @@ class FFModel:
                 self, temperature=temperature, top_k=top_k,
                 eos_id=eos_token_id, pad_id=pad_token_id)
         if num_beams > 1:
+            if prompt_lengths is not None:
+                raise NotImplementedError(
+                    "beam search supports uniform-length prompts only; "
+                    "pass prompts of equal length or use num_beams=1")
             return gen.beam_search(tokens, max_new_tokens, num_beams,
                                    length_penalty)
-        return gen(tokens, max_new_tokens, seed=seed)
+        return gen(tokens, max_new_tokens, seed=seed,
+                   prompt_lengths=prompt_lengths)
 
     # ------------------------------------------------------------ weights IO
 

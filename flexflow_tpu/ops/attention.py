@@ -55,16 +55,20 @@ def _apply_rope(x, theta: float, offset=0):
     Angles are computed from absolute positions in f32 and the rotation is
     applied in f32 regardless of compute dtype (bf16 angles at position
     ~1000+ would lose the low-order bits that distinguish neighbors).
-    `offset` (python int or traced scalar) shifts the absolute positions —
-    the KV-cache decode path rotates a single new token at its true
-    position."""
+    `offset` shifts the absolute positions — the KV-cache decode path
+    rotates a new token at its true position. Scalar (python int or
+    traced) applies to every row; a (B,) array gives per-row offsets
+    (ragged right-padded prompts)."""
     s, d = x.shape[1], x.shape[-1]
     half = d // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    pos = jnp.asarray(offset, jnp.float32) + jnp.arange(s, dtype=jnp.float32)
-    ang = pos[:, None] * freqs[None, :]
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    off = jnp.asarray(offset, jnp.float32)
+    pos = off[..., None] + jnp.arange(s, dtype=jnp.float32)  # (S,) or (B,S)
+    ang = pos[..., None] * freqs  # (..., S, half)
+    if ang.ndim == 2:  # scalar offset: broadcast over batch
+        ang = ang[None]
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
     x1 = x[..., :half].astype(jnp.float32)
     x2 = x[..., half:].astype(jnp.float32)
     out = jnp.concatenate(
@@ -230,14 +234,23 @@ class MultiHeadAttention(Op):
         ctx = self._dense_attention(qh, kh, vh, scale, False, None, None)
         return self._out_proj(params, ctx), new_cache
 
-    def decode_forward(self, params, xs, cache, pos):
-        """One-token step: write this token's k/v at `pos` (traced scalar),
-        attend q over the cache prefix [0, pos]. The GQA grouping is done
-        by reshaping q to (KVH, G) groups — consecutive query heads share a
-        kv head, matching _broadcast_kv's jnp.repeat layout — so the
-        broadcast is never materialized."""
-        qh, kh, vh = self._project_qkv(params, xs[0], xs[1], xs[2],
-                                       rope_offset=pos)
+    def decode_forward(self, params, xs, cache, pos, rope_pos=None,
+                       row_lengths=None, prompt_len=None):
+        """One-token step: write this token's k/v at slot `pos` (traced
+        scalar), attend q over the live cache prefix. The GQA grouping is
+        done by reshaping q to (KVH, G) groups — consecutive query heads
+        share a kv head, matching _broadcast_kv's jnp.repeat layout — so
+        the broadcast is never materialized.
+
+        Ragged right-padded prompts (runtime/generation.py): `row_lengths`
+        (B,) marks each row's true prompt length and `prompt_len` the
+        padded width; slots in [row_length, prompt_len) hold garbage k/v
+        from pad positions and are masked out, and `rope_pos` (B,) rotates
+        the new token at its LOGICAL position (row_length + step), not its
+        cache slot."""
+        qh, kh, vh = self._project_qkv(
+            params, xs[0], xs[1], xs[2],
+            rope_offset=pos if rope_pos is None else rope_pos)
         ck = jax.lax.dynamic_update_slice(
             cache["k"], kh.astype(cache["k"].dtype), (0, pos, 0, 0))
         cv = jax.lax.dynamic_update_slice(
@@ -249,8 +262,13 @@ class MultiHeadAttention(Op):
         qg = qh.reshape(b, 1, kvh, grp, self.qk_head_dim)
         logits = jnp.einsum("bqkgd,bskd->bkgqs", qg, ck.astype(qh.dtype),
                             preferred_element_type=jnp.float32) * scale
-        live = jnp.arange(max_len) <= pos
-        logits = jnp.where(live[None, None, None, None, :], logits,
+        idx = jnp.arange(max_len)
+        if row_lengths is None:
+            live = (idx <= pos)[None, :]
+        else:
+            live = (idx[None, :] < row_lengths[:, None]) \
+                | ((idx[None, :] >= prompt_len) & (idx[None, :] <= pos))
+        logits = jnp.where(live[:, None, None, None, :], logits,
                            jnp.finfo(jnp.float32).min)
         probs = jax.nn.softmax(logits, axis=-1).astype(qh.dtype)
         ctx = jnp.einsum("bkgqs,bskd->bqkgd", probs, cv.astype(qh.dtype))

@@ -134,14 +134,18 @@ class Generator:
             return jnp.bfloat16
         return jnp.float32
 
-    def _walk(self, params, state, tokens, caches, pos, last_only=False):
+    def _walk(self, params, state, tokens, caches, pos, last_only=False,
+              rope_pos=None, row_lengths=None, prompt_len=None):
         """Interpret the graph on a (B, S) token slab. pos=None means
         prefill (positions 0..S-1, fills cache); otherwise S == 1 and pos
-        is the traced absolute position of the token. last_only=True
-        narrows the prefill tail: past the last attention op every op is
-        per-position (validated in __init__), so only the final position
-        flows through the lm_head — O(1/S) of its FLOPs and no (B, S, V)
-        logits materialization."""
+        is the traced cache slot of the token. last_only=True narrows the
+        prefill tail: past the last attention op every op is per-position
+        (validated in __init__), so only the final position flows through
+        the lm_head — O(1/S) of its FLOPs and no (B, S, V) logits
+        materialization; with `row_lengths` (ragged right-padded prompts)
+        the tail gathers each row's own last valid position instead of
+        column -1, and decode steps get per-row RoPE positions + a pad-
+        slot cache mask (see MultiHeadAttention.decode_forward)."""
         bf16 = self._compute_dtype() == jnp.bfloat16
 
         def to_compute(a):
@@ -158,8 +162,21 @@ class Generator:
             xs = [vals[t] for t in op.inputs]
             if (last_only and pos is None and idx > self._last_attn_idx
                     and s_full > 1):
-                xs = [x[:, -1:] if (x.ndim >= 2 and x.shape[1] == s_full)
-                      else x for x in xs]
+                if row_lengths is None:
+                    xs = [x[:, -1:] if (x.ndim >= 2 and x.shape[1] == s_full)
+                          else x for x in xs]
+                else:
+                    last = (row_lengths - 1)[:, None]
+
+                    def take_last(x):
+                        if not (x.ndim >= 2 and x.shape[1] == s_full):
+                            return x
+                        ix = last.reshape((-1, 1) + (1,) * (x.ndim - 2))
+                        ix = jnp.broadcast_to(
+                            ix, (x.shape[0], 1) + x.shape[2:])
+                        return jnp.take_along_axis(x, ix, axis=1)
+
+                    xs = [take_last(x) for x in xs]
             p = resolve_tied_params(self.model, params, op.name,
                                     params.get(op.name, {}))
             if bf16:
@@ -170,7 +187,9 @@ class Generator:
                     if pos is None:
                         out, nc = op.prefill_forward(p, xs, cache)
                     else:
-                        out, nc = op.decode_forward(p, xs, cache, pos)
+                        out, nc = op.decode_forward(
+                            p, xs, cache, pos, rope_pos=rope_pos,
+                            row_lengths=row_lengths, prompt_len=prompt_len)
                     new_caches[op.name] = nc
                     outs = [out]
                 else:
@@ -203,16 +222,19 @@ class Generator:
 
     # ---- the compiled program ---------------------------------------------
 
-    def _build(self, max_new_tokens: int):
+    def _build(self, max_new_tokens: int, ragged: bool = False):
         cdtype = self._compute_dtype()
 
-        def gen(params, state, tokens, key):
+        def gen(params, state, tokens, key, lengths):
             b, s0 = tokens.shape
             max_len = s0 + max_new_tokens
+            row_lengths = lengths if ragged else None
             caches = {op.name: op.init_cache(b, max_len, cdtype)
                       for op in self.attn_ops}
             logits, caches = self._walk(params, state, tokens, caches, None,
-                                        last_only=True)
+                                        last_only=True,
+                                        row_lengths=row_lengths,
+                                        prompt_len=s0)
             key, sub = jax.random.split(key)
             tok = self._sample(logits[:, -1], sub)
             done = jnp.zeros((b,), bool)
@@ -221,8 +243,10 @@ class Generator:
 
             def body(carry, i):
                 caches, tok, done, key = carry
-                logits, caches = self._walk(params, state, tok[:, None],
-                                            caches, s0 + i)
+                logits, caches = self._walk(
+                    params, state, tok[:, None], caches, s0 + i,
+                    rope_pos=(row_lengths + i) if ragged else None,
+                    row_lengths=row_lengths, prompt_len=s0)
                 key, sub = jax.random.split(key)
                 nxt = self._sample(logits[:, 0], sub)
                 if self.eos_id is not None:
@@ -330,13 +354,32 @@ class Generator:
         return np.asarray(fn(self.model.params, self.model.bn_state, tokens))
 
     def __call__(self, tokens: np.ndarray, max_new_tokens: int,
-                 seed: int = 0) -> np.ndarray:
-        """tokens (B, S0) int32 prompt (uniform length, no padding) ->
-        (B, S0 + max_new_tokens) int32."""
+                 seed: int = 0, prompt_lengths=None) -> np.ndarray:
+        """tokens (B, S0) int32 prompts -> (B, S0 + max_new_tokens) int32
+        with the generated tokens in columns S0 onward. Uniform-length
+        prompts by default; `prompt_lengths` (B,) enables ragged RIGHT-
+        padded prompts — row b's prompt is tokens[b, :prompt_lengths[b]],
+        pad slots are masked out of attention and RoPE continues from each
+        row's true length."""
         tokens = jnp.asarray(tokens, jnp.int32)
-        fn = self._jitted.get(max_new_tokens)
+        ragged = prompt_lengths is not None
+        if ragged:
+            lengths = np.asarray(prompt_lengths, np.int32)
+            if lengths.shape != (tokens.shape[0],):
+                raise ValueError(
+                    f"prompt_lengths shape {lengths.shape} != "
+                    f"({tokens.shape[0]},)")
+            if (lengths < 1).any() or (lengths > tokens.shape[1]).any():
+                raise ValueError(
+                    f"prompt_lengths must be in [1, {tokens.shape[1]}], "
+                    f"got {lengths.tolist()}")
+            lengths = jnp.asarray(lengths)
+        else:
+            lengths = jnp.zeros((tokens.shape[0],), jnp.int32)
+        fn = self._jitted.get((max_new_tokens, ragged))
         if fn is None:
-            fn = self._jitted[max_new_tokens] = self._build(max_new_tokens)
+            fn = self._jitted[(max_new_tokens, ragged)] = self._build(
+                max_new_tokens, ragged)
         key = jax.random.PRNGKey(seed)
         return np.asarray(fn(self.model.params, self.model.bn_state,
-                             tokens, key))
+                             tokens, key, lengths))

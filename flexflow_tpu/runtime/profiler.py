@@ -26,6 +26,8 @@ from typing import Dict, List
 
 import jax
 
+from flexflow_tpu.runtime.executor import resolve_tied_params
+
 
 def profile_step(model, batch: Dict, iters: int = 3) -> List[dict]:
     """Run the forward graph op-by-op (unfused) and time each op.
@@ -45,8 +47,6 @@ def profile_step(model, batch: Dict, iters: int = 3) -> List[dict]:
         if isinstance(op, InputOp):
             continue
         xs = [vals[t] for t in op.inputs]
-        from flexflow_tpu.runtime.executor import resolve_tied_params
-
         p = resolve_tied_params(model, model.params, op.name,
                                 model.params.get(op.name, {}))
         op_rng = jax.random.fold_in(rng, idx) if op.needs_rng else None

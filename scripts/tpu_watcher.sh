@@ -34,6 +34,14 @@ while true; do
     rc=$?
     echo "[$(STAMP)] decode rc=$rc: $(cat "$OUT/decode.json")"
 
+    # 2b. full staged bench: re-proves all tiers through the compile
+    #     cache and measures the new xxl_scan (hidden 4096) tail tier
+    echo "[$(STAMP)] step bench"
+    FF_BENCH_BUDGET=1500 timeout 1560 python bench.py \
+        > "$OUT/bench3.json" 2> "$OUT/bench3.err"
+    rc=$?
+    echo "[$(STAMP)] bench rc=$rc: $(tail -c 400 "$OUT/bench3.json")"
+
     # 3. whole-program strategy validation, chip leg (VERDICT #5)
     echo "[$(STAMP)] step validate"
     timeout 900 python scripts/validate_strategies.py --budget 2000 --steps 10 \

@@ -196,6 +196,37 @@ def test_beam_search_finds_higher_likelihood_than_greedy():
     assert (lp_beam >= lp_greedy - 1e-4).all(), (lp_beam, lp_greedy)
 
 
+def test_ragged_prompts_match_per_row_runs():
+    """Ragged right-padded prompts: each row's generation must equal the
+    run of that row alone at its true (unpadded) length — pad k/v slots
+    masked out, RoPE continuing from the row's own length."""
+    ff = build_llama({"data": 2})
+    rs = np.random.RandomState(7)
+    full = rs.randint(1, VOCAB, (2, 9)).astype(np.int32)
+    lengths = np.array([5, 9], np.int32)
+    padded = full.copy()
+    padded[0, 5:] = 0  # right-pad row 0
+
+    out = ff.generate(padded, max_new_tokens=5, prompt_lengths=lengths)
+    assert out.shape == (2, 14)
+
+    for b in range(2):
+        solo = ff.generate(full[b:b + 1, :lengths[b]], max_new_tokens=5)
+        np.testing.assert_array_equal(
+            out[b, 9:], solo[0, lengths[b]:],
+            err_msg=f"row {b} (len {lengths[b]}) diverged from solo run")
+
+    # validation
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="prompt_lengths"):
+        ff.generate(padded, 3, prompt_lengths=np.array([5], np.int32))
+    with _pytest.raises(ValueError, match="prompt_lengths"):
+        ff.generate(padded, 3, prompt_lengths=np.array([0, 9], np.int32))
+    with _pytest.raises(NotImplementedError):
+        ff.generate(padded, 3, num_beams=2, prompt_lengths=lengths)
+
+
 def test_generate_rejects_non_decodable_graphs():
     cfg = FFConfig(batch_size=2, mesh_shape={"data": 2})
     ff = FFModel(cfg)
