@@ -87,6 +87,12 @@ class FFConfig:
     # numerically the full-batch step (losses are batch means), at a
     # microbatch's activation memory. 1 = off.
     grad_accum_steps: int = 1
+    # FSDP / ZeRO-3 analog: shard every weight (and with it the optimizer
+    # state) over this mesh axis in addition to any strategy sharding —
+    # each weight's largest divisible un-sharded dim is split, GSPMD
+    # all-gathers at use and reduce-scatters the gradient. Param + opt
+    # HBM divides by the axis size. "" = off.
+    fsdp_axis: str = ""
     # keep datasets device-resident (next_batch = on-device slice, the
     # reference's ZC-resident design) when they fit the budget
     device_resident_data: bool = True
@@ -154,6 +160,10 @@ class FFConfig:
         p.add_argument("--profiling", action="store_true")
         p.add_argument("--fusion", action="store_true")
         p.add_argument("--num-devices", type=int, default=None)
+        p.add_argument("--fsdp", dest="fsdp_axis", nargs="?", const="data",
+                       default="", metavar="AXIS",
+                       help="shard params+optimizer state over AXIS "
+                            "(default 'data') — ZeRO-3 analog")
         # e.g. --mesh data=4,model=2 (replaces -ll:gpu device-count knobs)
         p.add_argument("--mesh", type=str, default="")
         args, _ = p.parse_known_args(argv)
@@ -185,4 +195,5 @@ class FFConfig:
             perform_fusion=args.fusion,
             num_devices=args.num_devices,
             mesh_shape=mesh_shape,
+            fsdp_axis=args.fsdp_axis,
         )

@@ -119,6 +119,11 @@ class PlacementExecutor:
                 "tie_weights + operator placement is unsupported: a tied "
                 "weight would have to live on two sub-meshes at once; use "
                 "a non-placement strategy for tied models")
+        if getattr(model.config, "fsdp_axis", ""):
+            raise NotImplementedError(
+                "fsdp_axis + operator placement is unsupported: FSDP "
+                "shards weights over the full mesh axis, but placement "
+                "groups own disjoint device blocks; drop one of the two")
         self.model = model
         self.base = GraphExecutor(model)  # strategy resolution + helpers
         self.full_mesh: Mesh = model.mesh

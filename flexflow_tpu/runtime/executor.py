@@ -125,6 +125,11 @@ class GraphExecutor:
         return NamedSharding(self.mesh, P(*entries))
 
     def param_shardings(self) -> Dict[str, Dict[str, NamedSharding]]:
+        fsdp = getattr(self.model.config, "fsdp_axis", "")
+        if fsdp and fsdp not in self.mesh_shape:
+            raise ValueError(
+                f"fsdp_axis={fsdp!r} is not a mesh axis "
+                f"(mesh {self.mesh_shape})")
         out: Dict[str, Dict[str, NamedSharding]] = {}
         for op in self.model.ops:
             specs = op.weight_specs()
@@ -132,8 +137,13 @@ class GraphExecutor:
                 continue
             am = self._op_axis_maps.get(op.name, {})
             wp = op.weight_partition(am)
-            out[op.name] = {name: NamedSharding(self.mesh, ps)
-                            for name, ps in wp.items()}
+            shapes = {w.name: w.shape for w in specs}
+            out[op.name] = {
+                name: NamedSharding(
+                    self.mesh,
+                    _with_fsdp(ps, shapes.get(name), fsdp,
+                               self.mesh_shape.get(fsdp, 1)) if fsdp else ps)
+                for name, ps in wp.items()}
         return out
 
     # ---- parameter / state initialization -----------------------------------
@@ -317,9 +327,10 @@ class GraphExecutor:
                 (micro, jnp.arange(accum, dtype=jnp.int32)))
             grads = jax.tree.map(lambda g: g / accum, g_sum)
             loss = jnp.mean(losses)
-            # counts (e.g. accuracy_count) sum across microbatches; mean
-            # metrics average (equal microbatch sizes -> exact)
-            mets = {k: (jnp.sum(v) if k.endswith("_count") else jnp.mean(v))
+            # counts and totals (accuracy_count/_total) sum across
+            # microbatches; mean metrics average (equal sizes -> exact)
+            mets = {k: (jnp.sum(v) if k.endswith(("_count", "_total"))
+                        else jnp.mean(v))
                     for k, v in mets.items()}
             new_params, new_opt_state = optimizer.update(params, grads,
                                                          opt_state)
@@ -429,6 +440,34 @@ class GraphExecutor:
                 sh = NamedSharding(self.mesh, P(*entries))
             out[k] = jax.device_put(v, sh)
         return out
+
+
+def _with_fsdp(ps, shape, axis: str, axis_size: int):
+    """FSDP post-process of a weight's PartitionSpec (FFConfig.fsdp_axis):
+    shard its LARGEST still-unsharded, divisible dim over `axis` (on top
+    of any strategy sharding, e.g. TP — 2D weight sharding). The training
+    strategy stays activation-side; GSPMD inserts the all-gather at use
+    and the gradient reduce-scatter, so param + optimizer-state HBM
+    divide by the axis size — the ZeRO-3 design, spelled as shardings."""
+    if shape is None or axis_size <= 1:
+        return ps
+    entries = list(ps) + [None] * (len(shape) - len(ps))
+    used = set()
+    for e in entries:
+        for a in (e if isinstance(e, tuple) else (e,)):
+            if a is not None:
+                used.add(a)
+    if axis in used:
+        return ps  # strategy already spent this axis on the weight
+    best = None
+    for d, e in enumerate(entries):
+        if e is None and shape[d] % axis_size == 0:
+            if best is None or shape[d] > shape[best]:
+                best = d
+    if best is None:
+        return ps  # nothing divisible: weight stays as the strategy left it
+    entries[best] = axis
+    return P(*entries)
 
 
 def resolve_tied_params(model, params, op_name, p):

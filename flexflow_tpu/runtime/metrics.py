@@ -25,6 +25,9 @@ from flexflow_tpu.ffconst import LossType, MetricsType
 class PerfMetrics:
     train_all: int = 0
     train_correct: int = 0
+    # denominator for accuracy: number of PREDICTIONS scored (== train_all
+    # for per-sample classification; batch x seq for token-level tasks)
+    train_pred_total: int = 0
     cce_loss: float = 0.0
     sparse_cce_loss: float = 0.0
     mse_loss: float = 0.0
@@ -36,6 +39,8 @@ class PerfMetrics:
         self.train_all += batch_size
         if "accuracy_count" in batch_metrics:
             self.train_correct += int(batch_metrics["accuracy_count"])
+            self.train_pred_total += int(
+                batch_metrics.get("accuracy_total", batch_size))
         for k in ("cce_loss", "sparse_cce_loss", "mse_loss", "rmse_loss", "mae_loss"):
             if k in batch_metrics:
                 setattr(self, k, getattr(self, k) + float(batch_metrics[k]) * batch_size)
@@ -43,9 +48,10 @@ class PerfMetrics:
     def report(self, loss_type: LossType, metrics: Sequence[MetricsType]) -> str:
         """Epoch summary in the reference's print style (model.cc:1827-1850)."""
         parts = [f"train_all={self.train_all}"]
-        if MetricsType.METRICS_ACCURACY in metrics and self.train_all:
-            acc = 100.0 * self.train_correct / self.train_all
-            parts.append(f"accuracy={acc:.2f}% ({self.train_correct}/{self.train_all})")
+        denom = self.train_pred_total or self.train_all
+        if MetricsType.METRICS_ACCURACY in metrics and denom:
+            acc = 100.0 * self.train_correct / denom
+            parts.append(f"accuracy={acc:.2f}% ({self.train_correct}/{denom})")
         n = max(self.train_all, 1)
         if self.sparse_cce_loss:
             parts.append(f"sparse_cce_loss={self.sparse_cce_loss / n:.4f}")
@@ -58,7 +64,8 @@ class PerfMetrics:
 
     @property
     def accuracy(self) -> float:
-        return self.train_correct / max(self.train_all, 1)
+        return self.train_correct / max(self.train_pred_total
+                                        or self.train_all, 1)
 
 
 def batch_metrics(loss_type: LossType, metric_types: Sequence[MetricsType],
@@ -68,20 +75,27 @@ def batch_metrics(loss_type: LossType, metric_types: Sequence[MetricsType],
     lab = labels
     for m in metric_types:
         if m == MetricsType.METRICS_ACCURACY:
+            # accuracy_total carries the PREDICTION count: for token-level
+            # tasks (labels per position, e.g. causal-LM training) it is
+            # batch x seq, not batch — without it the epoch report divides
+            # token-correct counts by sample counts and prints >100%
             if loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY:
                 li = lab.astype(jnp.int32)
                 if li.ndim == logits.ndim:
                     li = li[..., 0]
                 pred = jnp.argmax(logits, axis=-1)
                 out["accuracy_count"] = jnp.sum(pred == li)
+                out["accuracy_total"] = jnp.asarray(pred.size, jnp.int32)
             elif loss_type == LossType.LOSS_CATEGORICAL_CROSSENTROPY:
                 pred = jnp.argmax(logits, axis=-1)
                 out["accuracy_count"] = jnp.sum(pred == jnp.argmax(lab, axis=-1))
+                out["accuracy_total"] = jnp.asarray(pred.size, jnp.int32)
             else:
                 # regression "accuracy": |err| < 0.5 (metrics_functions.cu MSE path)
                 out["accuracy_count"] = jnp.sum(
                     jnp.all(jnp.abs(logits - lab) < 0.5,
                             axis=tuple(range(1, logits.ndim))))
+                out["accuracy_total"] = jnp.asarray(logits.shape[0], jnp.int32)
         elif m == MetricsType.METRICS_CATEGORICAL_CROSSENTROPY:
             logp = jax.nn.log_softmax(logits, axis=-1)
             out["cce_loss"] = -jnp.mean(jnp.sum(lab * logp, axis=-1))
