@@ -737,24 +737,29 @@ class FFModel:
     def generate(self, tokens, max_new_tokens: int, temperature: float = 0.0,
                  top_k: int = 0, eos_token_id=None, pad_token_id: int = 0,
                  num_beams: int = 1, length_penalty: float = 0.0,
-                 prompt_lengths=None, seed: int = 0):
+                 prompt_lengths=None, quantize=None, seed: int = 0):
         """KV-cache autoregressive decoding for decoder-only LM graphs
         (runtime/generation.py). tokens: (B, S0) int32 prompts; returns
         (B, S0 + max_new_tokens) int32 with generated tokens in columns
         S0 onward. prompt_lengths (B,) enables ragged right-padded
         prompts. num_beams > 1 switches to beam search (temperature/
-        top_k ignored there; uniform-length prompts only)."""
+        top_k ignored there; uniform-length prompts only). quantize=
+        "int8" decodes with weight-only int8 (lossy; halves weight HBM
+        traffic vs bf16)."""
         from flexflow_tpu.runtime.generation import Generator
 
         # beam search ignores temperature/top_k: key those out so a
         # sampling sweep reuses one Generator (and its compiled programs)
-        key = ((0.0, 0, eos_token_id, pad_token_id) if num_beams > 1
-               else (temperature, top_k, eos_token_id, pad_token_id))
+        key = ((0.0, 0, eos_token_id, pad_token_id, quantize)
+               if num_beams > 1
+               else (temperature, top_k, eos_token_id, pad_token_id,
+                     quantize))
         gen = self._generators.get(key)
         if gen is None:
             gen = self._generators[key] = Generator(
                 self, temperature=temperature, top_k=top_k,
-                eos_id=eos_token_id, pad_id=pad_token_id)
+                eos_id=eos_token_id, pad_id=pad_token_id,
+                quantize=quantize)
         if num_beams > 1:
             if prompt_lengths is not None:
                 raise NotImplementedError(

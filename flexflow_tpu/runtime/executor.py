@@ -470,10 +470,19 @@ def _with_fsdp(ps, shape, axis: str, axis_size: int):
     return P(*entries)
 
 
-def resolve_tied_params(model, params, op_name, p):
+def tie_transform(w, tf: str):
+    """The single definition of tie transforms (FFModel.tie_weights);
+    every params consumer (full-precision and quantized walks) resolves
+    through here so a new transform cannot silently diverge."""
+    return w.T if tf == "transpose" else w
+
+
+def resolve_tied_params(model, params, op_name, p, leaf=None):
     """Materialize tied weights (FFModel.tie_weights) for `op_name` from
     their source op's storage. Runs inside the traced step, so autodiff
-    accumulates both ops' gradients into the single source array."""
+    accumulates both ops' gradients into the single source array. `leaf`
+    optionally maps the raw stored leaf before the transform (the int8
+    decode path dequantizes here)."""
     tied = getattr(model, "_tied", None)
     if not tied:
         return p
@@ -484,7 +493,9 @@ def resolve_tied_params(model, params, op_name, p):
         if out is None:
             out = dict(p)
         w = params[src_op][src_w]
-        out[dst_w] = w.T if tf == "transpose" else w
+        if leaf is not None:
+            w = leaf(w)
+        out[dst_w] = tie_transform(w, tf)
     return p if out is None else out
 
 

@@ -65,6 +65,11 @@ _DECODE_SAFE = {
     OperatorType.OP_EW_DIV,
     OperatorType.OP_EW_MAX,
     OperatorType.OP_EW_MIN,
+    # MoE routes each token independently (router logits -> top-k expert
+    # FFNs); at decode the step's N=B tokens never compete with the
+    # training batch for capacity, so routing is effectively drop-free —
+    # the standard inference semantics for capacity-trained MoE
+    OperatorType.OP_MOE,
 }
 
 
@@ -76,12 +81,21 @@ class Generator:
     """
 
     def __init__(self, model, temperature: float = 0.0, top_k: int = 0,
-                 eos_id: Optional[int] = None, pad_id: int = 0):
+                 eos_id: Optional[int] = None, pad_id: int = 0,
+                 quantize: Optional[str] = None):
+        if quantize not in (None, "int8"):
+            raise ValueError(f"quantize must be None or 'int8', "
+                             f"got {quantize!r}")
         self.model = model
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.eos_id = eos_id
         self.pad_id = pad_id
+        self.quantize = quantize
+        # int8 cache invalidated whenever any param leaf is replaced
+        # (training steps reassign the tree; set_weights swaps leaves)
+        self._qparams = None
+        self._qparams_key = None
         self._jitted: Dict = {}
 
         input_ops = [op for op in model.ops if isinstance(op, InputOp)]
@@ -126,6 +140,45 @@ class Generator:
         # the final position only instead of the whole prompt
         self._last_attn_idx = max(i for i, op in enumerate(model.ops)
                                   if op in self.attn_ops)
+
+    # ---- weight-only int8 quantization -------------------------------------
+
+    def _quantized_params(self):
+        """Weight-only int8: every float weight with >= 2 dims stores as
+        {"q": int8, "s": f32 per-out-channel scale}; dequant happens
+        per-use inside the jitted decode program (the int8->compute
+        convert fuses into the consuming matmul, so the weight read from
+        HBM — the decode bottleneck — is the int8 bytes: half of bf16,
+        a quarter of f32). 1-D weights (norm scales, biases) stay exact.
+        Lossy by design: logits shift slightly vs full precision."""
+        key = tuple(id(leaf) for leaf in
+                    jax.tree_util.tree_leaves(self.model.params))
+        if self._qparams is not None and self._qparams_key == key:
+            return self._qparams
+        out = {}
+        for op_name, ws in self.model.params.items():
+            q_ws = {}
+            for w_name, w in ws.items():
+                if w.ndim >= 2 and jnp.issubdtype(w.dtype, jnp.floating):
+                    wf = jnp.asarray(w, jnp.float32)
+                    scale = jnp.max(jnp.abs(wf), axis=tuple(
+                        range(w.ndim - 1)), keepdims=True) / 127.0
+                    scale = jnp.maximum(scale, 1e-12)
+                    q = jnp.clip(jnp.round(wf / scale), -127, 127
+                                 ).astype(jnp.int8)
+                    q_ws[w_name] = {"q": q, "s": scale}
+                else:
+                    q_ws[w_name] = w
+            out[op_name] = q_ws
+        self._qparams = out
+        self._qparams_key = key
+        return out
+
+    @staticmethod
+    def _deq(v, cdtype):
+        if isinstance(v, dict) and "q" in v:
+            return (v["q"].astype(jnp.float32) * v["s"]).astype(cdtype)
+        return v
 
     # ---- graph walks -------------------------------------------------------
 
@@ -177,8 +230,15 @@ class Generator:
                         return jnp.take_along_axis(x, ix, axis=1)
 
                     xs = [take_last(x) for x in xs]
-            p = resolve_tied_params(self.model, params, op.name,
-                                    params.get(op.name, {}))
+            if self.quantize:
+                cdtype = self._compute_dtype()
+                deq = lambda v: self._deq(v, cdtype)
+                p = {k: deq(v) for k, v in params.get(op.name, {}).items()}
+                p = resolve_tied_params(self.model, params, op.name, p,
+                                        leaf=deq)
+            else:
+                p = resolve_tied_params(self.model, params, op.name,
+                                        params.get(op.name, {}))
             if bf16:
                 p = {k: to_compute(v) for k, v in p.items()}
             with jax.named_scope(op.name):
@@ -343,6 +403,10 @@ class Generator:
 
         return jax.jit(gen)
 
+    def _params(self):
+        return (self._quantized_params() if self.quantize
+                else self.model.params)
+
     def beam_search(self, tokens: np.ndarray, max_new_tokens: int,
                     num_beams: int, length_penalty: float = 0.0) -> np.ndarray:
         tokens = jnp.asarray(tokens, jnp.int32)
@@ -351,7 +415,7 @@ class Generator:
         if fn is None:
             fn = self._jitted[key] = self._build_beam(
                 max_new_tokens, num_beams, length_penalty)
-        return np.asarray(fn(self.model.params, self.model.bn_state, tokens))
+        return np.asarray(fn(self._params(), self.model.bn_state, tokens))
 
     def __call__(self, tokens: np.ndarray, max_new_tokens: int,
                  seed: int = 0, prompt_lengths=None) -> np.ndarray:
@@ -381,5 +445,5 @@ class Generator:
             fn = self._jitted[(max_new_tokens, ragged)] = self._build(
                 max_new_tokens, ragged)
         key = jax.random.PRNGKey(seed)
-        return np.asarray(fn(self.model.params, self.model.bn_state,
+        return np.asarray(fn(self._params(), self.model.bn_state,
                              tokens, key, lengths))

@@ -27,6 +27,8 @@ CONFIGS = [
     (32, 1024, 8, 8, 4, 256, 128),
     (8, 2048, 16, 16, 8, 256, 128),
 ]
+if os.environ.get("FF_DECODE_PROBE_TINY"):  # CPU smoke of the script
+    CONFIGS = [(2, 64, 2, 4, 2, 16, 8)]
 
 
 def param_bytes(ff):
@@ -49,28 +51,34 @@ def main():
         rs = np.random.RandomState(0)
         prompt = rs.randint(0, 32_000, (batch, prompt_len)).astype(np.int32)
 
-        t0 = time.time()
-        out = ff.generate(prompt, new)
-        compile_s = time.time() - t0
-        t0 = time.time()
-        iters = 3
-        for i in range(iters):
-            out = ff.generate(prompt, new, seed=i)
-        wall = (time.time() - t0) / iters
-        tok_s = batch * new / wall
-        step_ms = wall / new * 1e3
-        d = hidden // heads
-        kv_avg = batch * (prompt_len + new / 2) * kvh * d * 2 * 2 * layers
-        hbm_gbs = (param_bytes(ff) + kv_avg) / (wall / new) / 1e9
-        print(json.dumps({
-            "metric": "llama_decode_throughput", "unit": "tokens/s",
-            "value": round(tok_s, 1), "step_ms": round(step_ms, 3),
-            "approx_hbm_gbs": round(hbm_gbs, 1),
-            "compile_s": round(compile_s, 1), "backend": backend,
-            "config": {"batch": batch, "hidden": hidden, "layers": layers,
-                       "heads": heads, "kv_heads": kvh,
-                       "prompt": prompt_len, "new_tokens": new},
-        }), flush=True)
+        for quant in (None, "int8"):
+            t0 = time.time()
+            out = ff.generate(prompt, new, quantize=quant)
+            compile_s = time.time() - t0
+            t0 = time.time()
+            iters = 3
+            for i in range(iters):
+                out = ff.generate(prompt, new, seed=i, quantize=quant)
+            wall = (time.time() - t0) / iters
+            tok_s = batch * new / wall
+            step_ms = wall / new * 1e3
+            d = hidden // heads
+            kv_avg = batch * (prompt_len + new / 2) * kvh * d * 2 * 2 * layers
+            pbytes = param_bytes(ff)
+            if quant == "int8":
+                pbytes = pbytes // 2  # int8 vs bf16 storage
+            hbm_gbs = (pbytes + kv_avg) / (wall / new) / 1e9
+            print(json.dumps({
+                "metric": "llama_decode_throughput", "unit": "tokens/s",
+                "value": round(tok_s, 1), "step_ms": round(step_ms, 3),
+                "approx_hbm_gbs": round(hbm_gbs, 1),
+                "compile_s": round(compile_s, 1), "backend": backend,
+                "weights": quant or "bf16",
+                "config": {"batch": batch, "hidden": hidden,
+                           "layers": layers, "heads": heads,
+                           "kv_heads": kvh, "prompt": prompt_len,
+                           "new_tokens": new},
+            }), flush=True)
 
 
 if __name__ == "__main__":
