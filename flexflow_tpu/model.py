@@ -50,6 +50,10 @@ class FFModel:
         self._dataloaders: List = []
         self.mesh = None
         self.executor: Optional[GraphExecutor] = None
+        # bumped on every params replacement/mutation (property setter +
+        # set_weights); consumers that derive from params (the int8 decode
+        # cache) key their caches on it
+        self._params_version = 0
         self.params = None
         self.opt_state = None
         self.bn_state = None
@@ -71,6 +75,15 @@ class FFModel:
         self._current_batch: Dict[str, np.ndarray] = {}
         self._aux_tensors: List[Tensor] = []  # scalar losses (MoE balance)
         self._cached_backward = None
+
+    @property
+    def params(self):
+        return self._params
+
+    @params.setter
+    def params(self, value):
+        self._params = value
+        self._params_version += 1
         self._perf = PerfMetrics()
 
     # ------------------------------------------------------------------ graph
@@ -775,9 +788,11 @@ class FFModel:
     def get_weights(self, op_name: str, weight_name: str = "kernel") -> np.ndarray:
         tie = self._tied.get((op_name, weight_name))
         if tie is not None:
+            from flexflow_tpu.runtime.executor import tie_transform
+
             src_op, src_w, tf = tie
-            w = np.asarray(self.params[src_op][src_w])
-            return w.T if tf == "transpose" else w
+            return np.asarray(tie_transform(
+                np.asarray(self.params[src_op][src_w]), tf))
         return np.asarray(self.params[op_name][weight_name])
 
     def set_weights(self, op_name: str, weight_name: str, value: np.ndarray):
@@ -790,6 +805,7 @@ class FFModel:
         sh = shardings[op_name][weight_name]
         self.params[op_name][weight_name] = jax.device_put(
             jnp.asarray(value), sh)
+        self._params_version += 1  # in-place mutation: bump by hand
 
     # ------------------------------------------------------------- strategy
 

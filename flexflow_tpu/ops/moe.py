@@ -77,18 +77,28 @@ class MoE(Op):
               and self.num_experts % mesh.shape["expert"] == 0)
         return not ep
 
-    def forward(self, params, xs, *, training=False, rng=None):
+    def forward(self, params, xs, *, training=False, rng=None,
+                capacity=None):
+        """`capacity` overrides the build-time training capacity. The
+        inference path (runtime/generation.py) passes N (the slab's token
+        count): a token never picks the same expert twice, so per-expert
+        assignments are <= N and C=N guarantees ZERO drops — standard
+        inference semantics, and the row-independence the decode path
+        promises (a row's output can never depend on other rows through
+        capacity competition)."""
         x = xs[0]
         orig_shape = x.shape
-        D, E, C = self.dim, self.num_experts, self.capacity
+        D, E = self.dim, self.num_experts
         t = x.reshape(-1, D)  # (N, D)
         N = t.shape[0]
+        C = capacity if capacity is not None else self.capacity
 
         logits = t @ params["router"].astype(t.dtype)       # (N, E)
         gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
         if self._use_sort_dispatch():
-            return self._forward_sort(params, t, gates, orig_shape)
+            return self._forward_sort(params, t, gates, orig_shape,
+                                      capacity=C)
 
         # top-k routing with capacity (GShard): iteratively take the best
         # expert per token, mask, repeat k times
@@ -132,11 +142,12 @@ class MoE(Op):
         aux = self.aux_weight * E * jnp.sum(aux_me * (ce / self.k))
         return [y.reshape(orig_shape), aux.astype(jnp.float32)]
 
-    def _forward_sort(self, params, t, gates, orig_shape):
+    def _forward_sort(self, params, t, gates, orig_shape, capacity=None):
         """Sort-based dispatch: O(N*k) routing state. Token assignments are
         ordered round-major (all round-0 picks first, in token order) so
         capacity drops match the dense path's position rule exactly."""
-        D, E, C, k = self.dim, self.num_experts, self.capacity, self.k
+        D, E, k = self.dim, self.num_experts, self.k
+        C = capacity if capacity is not None else self.capacity
         N = t.shape[0]
 
         topk_gates, topk_idx = jax.lax.top_k(gates, k)      # (N, k)

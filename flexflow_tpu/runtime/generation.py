@@ -66,9 +66,10 @@ _DECODE_SAFE = {
     OperatorType.OP_EW_MAX,
     OperatorType.OP_EW_MIN,
     # MoE routes each token independently (router logits -> top-k expert
-    # FFNs); at decode the step's N=B tokens never compete with the
-    # training batch for capacity, so routing is effectively drop-free —
-    # the standard inference semantics for capacity-trained MoE
+    # FFNs); the inference walk overrides capacity to the slab's token
+    # count, which guarantees ZERO drops (a token never picks the same
+    # expert twice) — standard inference semantics for capacity-trained
+    # MoE, and the row-independence guarantee decode promises
     OperatorType.OP_MOE,
 }
 
@@ -92,8 +93,8 @@ class Generator:
         self.eos_id = eos_id
         self.pad_id = pad_id
         self.quantize = quantize
-        # int8 cache invalidated whenever any param leaf is replaced
-        # (training steps reassign the tree; set_weights swaps leaves)
+        # int8 cache keyed on FFModel._params_version (bumped on every
+        # params replacement or set_weights mutation)
         self._qparams = None
         self._qparams_key = None
         self._jitted: Dict = {}
@@ -151,8 +152,7 @@ class Generator:
         HBM — the decode bottleneck — is the int8 bytes: half of bf16,
         a quarter of f32). 1-D weights (norm scales, biases) stay exact.
         Lossy by design: logits shift slightly vs full precision."""
-        key = tuple(id(leaf) for leaf in
-                    jax.tree_util.tree_leaves(self.model.params))
+        key = self.model._params_version
         if self._qparams is not None and self._qparams_key == key:
             return self._qparams
         out = {}
@@ -256,6 +256,12 @@ class Generator:
                     kwargs = {}
                     if getattr(op, "wants_shard_ctx", False):
                         kwargs["shard_ctx"] = None
+                    if op.op_type == OperatorType.OP_MOE:
+                        # inference capacity = the slab's token count:
+                        # guarantees zero drops (see MoE.forward), hence
+                        # row independence for ragged/batched decode
+                        kwargs["capacity"] = int(
+                            np.prod(xs[0].shape[:-1]))
                     if op.stateful:
                         outs, _ = op.forward_stateful(
                             p, state.get(op.name, {}), xs,

@@ -50,6 +50,8 @@ python examples/native/llama_generate.py -b "$NDEV" --hidden 64 --num-layers 2 \
   --prompt-length 8 --max-new-tokens 8
 python examples/native/vit.py -e 1 -b "$BATCH" --image-size 32 --patch 8 \
   --hidden 64 --num-layers 2
+python examples/native/charlm_generate.py -e 1 -b "$NDEV" --hidden 64 \
+  --num-layers 1 --seq 32 --sample-chars 16
 python examples/native/tensor_attach.py -e 1 -b "$BATCH"
 python examples/native/cifar10_cnn_attach.py -e 1 -b "$BATCH"
 

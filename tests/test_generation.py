@@ -227,15 +227,13 @@ def test_ragged_prompts_match_per_row_runs():
         ff.generate(padded, 3, num_beams=2, prompt_lengths=lengths)
 
 
-def test_moe_decoder_generates():
-    """Mixtral-style decoder (attention + MoE FFN blocks) decodes: with
-    capacity high enough that the full forward drops nothing, teacher-
-    forced decode logits equal the training-graph forward exactly."""
+def _moe_decoder(batch, cap):
     from flexflow_tpu.ffconst import DataType
 
-    cfg = FFConfig(batch_size=2, mesh_shape={"data": 2})
+    cfg = FFConfig(batch_size=batch, mesh_shape={"data": 1})
     ff = FFModel(cfg)
-    toks = ff.create_tensor([2, 12], dtype=DataType.DT_INT32, name="input")
+    toks = ff.create_tensor([batch, 12], dtype=DataType.DT_INT32,
+                            name="input")
     t = ff.embedding(toks, VOCAB, 32, name="embed")
     for i in range(2):
         a = ff.rms_norm(t, name=f"ln1_{i}")
@@ -243,12 +241,19 @@ def test_moe_decoder_generates():
                                    rope=True, name=f"attn_{i}")
         t = ff.add(t, a, name=f"res1_{i}")
         m = ff.moe(ff.rms_norm(t, name=f"ln2_{i}"), num_experts=4,
-                   hidden_dim=64, k=2, capacity_factor=8.0,
+                   hidden_dim=64, k=2, capacity_factor=cap,
                    name=f"moe_{i}")
         t = ff.add(t, m, name=f"res2_{i}")
     logits = ff.dense(t, VOCAB, use_bias=False, name="lm_head")
     ff.compile(final_tensor=logits)
+    return ff
 
+
+def test_moe_decoder_generates():
+    """Mixtral-style decoder (attention + MoE FFN blocks) decodes: with
+    capacity high enough that the full forward drops nothing, teacher-
+    forced decode logits equal the training-graph forward exactly."""
+    ff = _moe_decoder(2, cap=8.0)
     rs = np.random.RandomState(11)
     prompt = rs.randint(0, VOCAB, (2, 5)).astype(np.int32)
     out = ff.generate(prompt, max_new_tokens=5)
@@ -257,6 +262,26 @@ def test_moe_decoder_generates():
         nxt = np.asarray(ff.predict({"input": seq}))[:, -1].argmax(-1)
         seq = np.concatenate([seq, nxt[:, None].astype(np.int32)], axis=1)
     np.testing.assert_array_equal(out, seq)
+
+
+def test_moe_decode_rows_independent_under_tight_capacity():
+    """Even with a TIGHT training capacity (drops in training), inference
+    overrides capacity to the token count, so a batched generate equals
+    each row's solo generate — capacity competition can never couple
+    rows at inference. Weights are copied so batch-4 and batch-1 models
+    share parameters."""
+    ff4 = _moe_decoder(4, cap=0.5)
+    ff1 = _moe_decoder(1, cap=0.5)
+    for op, ws in ff4.params.items():
+        for w, v in ws.items():
+            ff1.set_weights(op, w, np.asarray(v))
+    rs = np.random.RandomState(12)
+    prompt = rs.randint(0, VOCAB, (4, 6)).astype(np.int32)
+    out = ff4.generate(prompt, max_new_tokens=4)
+    for b in range(4):
+        solo = ff1.generate(prompt[b:b + 1], max_new_tokens=4)
+        np.testing.assert_array_equal(out[b], solo[0],
+                                      err_msg=f"row {b} coupled")
 
 
 def test_int8_weight_only_decode():
