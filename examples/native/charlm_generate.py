@@ -65,7 +65,14 @@ def main():
     SingleDataLoader(ff, ff.label_tensor, y)
     ff.fit()
 
-    prompt_ids = np.array([[c2i.get(c, 1) for c in args.prompt]], np.int32)
+    known = [c for c in args.prompt if c in c2i]
+    if len(known) != len(args.prompt):
+        dropped = [c for c in args.prompt if c not in c2i]
+        print(f"warning: dropping prompt chars not in the README vocab: "
+              f"{dropped!r}")
+    if not known:
+        raise SystemExit("prompt has no characters from the README vocab")
+    prompt_ids = np.array([[c2i[c] for c in known]], np.int32)
     out = ff.generate(prompt_ids, args.sample_chars, temperature=0.5,
                       top_k=12, seed=0)
     sample = "".join(i2c.get(int(i), "?") for i in out[0])

@@ -75,6 +75,7 @@ class FFModel:
         self._current_batch: Dict[str, np.ndarray] = {}
         self._aux_tensors: List[Tensor] = []  # scalar losses (MoE balance)
         self._cached_backward = None
+        self._perf = PerfMetrics()
 
     @property
     def params(self):
@@ -84,7 +85,6 @@ class FFModel:
     def params(self, value):
         self._params = value
         self._params_version += 1
-        self._perf = PerfMetrics()
 
     # ------------------------------------------------------------------ graph
 

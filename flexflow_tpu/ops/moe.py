@@ -142,12 +142,13 @@ class MoE(Op):
         aux = self.aux_weight * E * jnp.sum(aux_me * (ce / self.k))
         return [y.reshape(orig_shape), aux.astype(jnp.float32)]
 
-    def _forward_sort(self, params, t, gates, orig_shape, capacity=None):
+    def _forward_sort(self, params, t, gates, orig_shape, capacity):
         """Sort-based dispatch: O(N*k) routing state. Token assignments are
         ordered round-major (all round-0 picks first, in token order) so
-        capacity drops match the dense path's position rule exactly."""
+        capacity drops match the dense path's position rule exactly.
+        `capacity` is resolved by forward() — the single resolution site."""
         D, E, k = self.dim, self.num_experts, self.k
-        C = capacity if capacity is not None else self.capacity
+        C = capacity
         N = t.shape[0]
 
         topk_gates, topk_idx = jax.lax.top_k(gates, k)      # (N, k)

@@ -93,12 +93,18 @@ class Generator:
         self.eos_id = eos_id
         self.pad_id = pad_id
         self.quantize = quantize
-        # int8 cache keyed on FFModel._params_version (bumped on every
-        # params replacement or set_weights mutation)
+        # int8 cache: see _quantized_params for the validity rule
         self._qparams = None
         self._qparams_key = None
+        self._q_refs = ()
         self._jitted: Dict = {}
 
+        if getattr(model.executor, "jits_per_group", False):
+            raise NotImplementedError(
+                "generate() is unsupported under an operator-placement "
+                "strategy (params live on disjoint sub-meshes; one decode "
+                "program cannot span them) — compile with a non-placement "
+                "strategy for generation")
         input_ops = [op for op in model.ops if isinstance(op, InputOp)]
         tok_inputs = [op for op in input_ops
                       if op.outputs[0].dtype in (DataType.DT_INT32,
@@ -152,8 +158,21 @@ class Generator:
         HBM — the decode bottleneck — is the int8 bytes: half of bf16,
         a quarter of f32). 1-D weights (norm scales, biases) stay exact.
         Lossy by design: logits shift slightly vs full precision."""
-        key = self.model._params_version
-        if self._qparams is not None and self._qparams_key == key:
+        import weakref
+
+        # validity = version (bumped by the params setter / set_weights)
+        # AND leaf identity (catches raw in-place `ff.params[op][w] = x`
+        # mutation) AND liveness of the recorded leaves (a dead weakref
+        # means an id could have been recycled, so ids stop being
+        # authoritative — rebuild)
+        leaves = jax.tree_util.tree_leaves(self.model.params)
+        try:
+            refs = tuple(weakref.ref(w) for w in leaves)
+        except TypeError:  # non-weakref-able leaf type
+            refs = ()
+        key = (self.model._params_version, tuple(map(id, leaves)))
+        if (self._qparams is not None and self._qparams_key == key
+                and all(r() is not None for r in self._q_refs)):
             return self._qparams
         out = {}
         for op_name, ws in self.model.params.items():
@@ -172,6 +191,7 @@ class Generator:
             out[op_name] = q_ws
         self._qparams = out
         self._qparams_key = key
+        self._q_refs = refs
         return out
 
     @staticmethod

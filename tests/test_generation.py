@@ -323,6 +323,32 @@ def test_int8_weight_only_decode():
     assert not np.array_equal(q, q2), \
         "stale int8 cache: weight update did not reach quantized decode"
 
+    # cache hit on unchanged params; raw in-place mutation (not via
+    # set_weights) also invalidates, caught by the leaf-identity check
+    gen = next(g for k, g in ff._generators.items() if g.quantize == "int8")
+    qp = gen._quantized_params()
+    assert gen._quantized_params() is qp
+    import jax.numpy as _jnp
+
+    ff.params["lm_head"]["kernel"] = _jnp.asarray(
+        ff.params["lm_head"]["kernel"]) * 1.0
+    assert gen._quantized_params() is not qp, \
+        "in-place params mutation did not invalidate the int8 cache"
+
+
+def test_generate_rejects_placement_models():
+    """Params under an operator-placement strategy live on disjoint
+    sub-meshes; one decode program cannot span them."""
+    from tests.test_placement import (MESH as PMESH, build_branchy,
+                                      placement_strategies)
+
+    cfg = FFConfig(batch_size=8, mesh_shape=dict(PMESH))
+    cfg.strategies = placement_strategies()
+    ff, _ = build_branchy(cfg)
+    ff.compile()
+    with pytest.raises(NotImplementedError, match="placement"):
+        Generator(ff)
+
 
 def test_generate_rejects_non_decodable_graphs():
     cfg = FFConfig(batch_size=2, mesh_shape={"data": 2})
