@@ -96,7 +96,7 @@ class Generator:
         # int8 cache: see _quantized_params for the validity rule
         self._qparams = None
         self._qparams_key = None
-        self._q_refs = ()
+        self._q_refs = None
         self._jitted: Dict = {}
 
         if getattr(model.executor, "jits_per_group", False):
@@ -168,10 +168,14 @@ class Generator:
         leaves = jax.tree_util.tree_leaves(self.model.params)
         try:
             refs = tuple(weakref.ref(w) for w in leaves)
-        except TypeError:  # non-weakref-able leaf type
-            refs = ()
+        except TypeError:
+            # non-weakref-able leaf: liveness is unverifiable, so ids are
+            # never authoritative — disable caching rather than risk a
+            # recycled-id stale hit
+            refs = None
         key = (self.model._params_version, tuple(map(id, leaves)))
         if (self._qparams is not None and self._qparams_key == key
+                and self._q_refs is not None
                 and all(r() is not None for r in self._q_refs)):
             return self._qparams
         out = {}

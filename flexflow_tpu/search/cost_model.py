@@ -73,12 +73,30 @@ class CostModel:
     def __init__(self, model, mesh_shape: Dict[str, int],
                  machine: Optional[MachineModel] = None,
                  measured: Optional[Dict] = None,
-                 dtype_bytes: int = 4):
+                 dtype_bytes: int = 4,
+                 fsdp_axis: str = ""):
         self.model = model
         self.mesh_shape = dict(mesh_shape)
         self.machine = machine or MachineModel()
         self.measured = measured or {}  # (op_name, parts) -> seconds (fwd+bwd)
         self.dtype_bytes = dtype_bytes
+        # FSDP (FFConfig.fsdp_axis): weights + opt state further shard over
+        # this axis, paying a per-use all-gather — the simulator must see
+        # both sides or it will veto memory-feasible FSDP configs (and
+        # overrate infeasible non-FSDP ones). Defaulted from the model's
+        # config when not given explicitly.
+        if fsdp_axis:
+            if fsdp_axis not in self.mesh_shape:
+                raise ValueError(
+                    f"fsdp_axis={fsdp_axis!r} is not a mesh axis "
+                    f"(mesh {self.mesh_shape})")
+            self.fsdp_axis = fsdp_axis
+        else:
+            # defaulted from the model config: the config axis may
+            # legitimately be absent from a caller-supplied mesh — drop
+            cfg_axis = getattr(getattr(model, "config", None),
+                               "fsdp_axis", "") or ""
+            self.fsdp_axis = cfg_axis if cfg_axis in self.mesh_shape else ""
 
     @property
     def num_devices(self) -> int:
@@ -160,16 +178,51 @@ class CostModel:
             shard_deg = 1
             for ax in sharded_axes:
                 shard_deg *= self.mesh_shape.get(ax, 1)
+            # FSDP applies to THIS weight only if the executor would
+            # actually shard it: same rule as runtime._with_fsdp, which
+            # degrades indivisible weights to unsharded (they then pay
+            # the plain all-reduce, not reduce-scatter + gathers)
+            fsdp = False
+            if (self.fsdp_axis and self.fsdp_axis not in sharded_axes
+                    and self.mesh_shape[self.fsdp_axis] > 1):
+                from flexflow_tpu.runtime.executor import _with_fsdp
+
+                base = wp.get(spec.name) or ()
+                fsdp = _with_fsdp(base, spec.shape, self.fsdp_axis,
+                                  self.mesh_shape[self.fsdp_axis]) is not base
             for ax, d in (axis_map or {}).items():
                 if d is not None and ax not in sharded_axes:
-                    total += self.machine.all_reduce_time(
-                        wbytes / shard_deg, self.mesh_shape[ax], ax)
+                    if fsdp and ax == self.fsdp_axis:
+                        # FSDP: the gradient over this axis reduce-scatters
+                        # (~half an all-reduce) instead of all-reducing
+                        total += 0.5 * self.machine.all_reduce_time(
+                            wbytes / shard_deg, self.mesh_shape[ax], ax)
+                    else:
+                        total += self.machine.all_reduce_time(
+                            wbytes / shard_deg, self.mesh_shape[ax], ax)
+            if fsdp:
+                # per-step weight re-materialization: all-gather the
+                # fsdp-sharded weight at use in forward and again for
+                # backward (2x); per-chip resident bytes are
+                # wbytes / (shard_deg * fsdp_size)
+                n = self.mesh_shape[self.fsdp_axis]
+                total += 2.0 * self.machine.all_gather_time(
+                    wbytes / shard_deg / n, n, self.fsdp_axis)
         return total
 
     def op_mem_bytes(self, op: Op, axis_map: AxisMap) -> float:
         """Per-device HBM bytes under this choice: weights + grads + opt
         state (x3) plus activations, divided over the partition. CONTRACT
-        axes shard the weight but leave the output replicated."""
+        axes shard the weight but leave the output replicated.
+
+        Approximation note: dividing the weight term by the FULL partition
+        count credits per-shard weight slices even on pure replication
+        (DP) axes — per-shard task accounting in the reference's style
+        (simulator.cc:595-620). A consequence: FSDP's memory saving is
+        already implicitly credited here, so fsdp_axis adds no further
+        division (it would double-count); FSDP shows up in the TIME model
+        instead (op_grad_sync_time: weight all-gathers + grad
+        reduce-scatter)."""
         parts = _parts(axis_map, self.mesh_shape)
         return (op.weight_bytes() * 3 / max(parts, 1)
                 + op.output_bytes()

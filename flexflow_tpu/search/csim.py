@@ -53,6 +53,7 @@ def _load_lib():
                                                   d, d, d, d, d, d]
     lib.ff_mcmc.restype = cd
     lib.ff_mcmc.argtypes = tables + [i32, i32, cd, cd, cd, cd,
+                                     ctypes.c_int,  # allow_place
                                      ctypes.c_int, cd, ctypes.c_uint64,
                                      i32, i32]
     _lib = lib
@@ -205,7 +206,8 @@ class CompiledSearchProblem:
         return total, rows
 
     def mcmc(self, init_choices: np.ndarray, budget: int, alpha: float,
-             seed: int, init_places=None, restarts: int = 1
+             seed: int, init_places=None, restarts: int = 1,
+             allow_place: bool = True
              ) -> Tuple[np.ndarray, np.ndarray, float]:
         """Run `restarts` independent annealing chains and keep the best.
         The reference runs one chain with periodic reset-to-best
@@ -226,7 +228,7 @@ class CompiledSearchProblem:
             p = np.zeros(len(self.ops), np.int32)
             cost = lib.ff_mcmc(
                 *self._table_args(), init, places, *self._machine_args(),
-                budget, alpha, seed * 0x9E3779B1 + k, c, p)
+                int(allow_place), budget, alpha, seed * 0x9E3779B1 + k, c, p)
             return c, p, cost
 
         if K == 1:
@@ -268,8 +270,13 @@ def native_optimize(model, cost, mesh_shape: Dict[str, int], budget: int,
     prob = get_search_problem(model, cost, mesh_shape, epp, eap)
     init = prob.choices_for(data_parallel_strategy(model, mesh_shape))
     dp_cost = prob.simulate(init)
+    # FSDP shards every weight over the full fsdp mesh axis; a sub-mesh
+    # placement cannot hold such a weight, so the annealer must not
+    # propose device-block moves (compile would reject its own winner)
+    allow_place = not getattr(cost, "fsdp_axis", "")
     best_c, best_p, best_cost = prob.mcmc(init, budget, alpha, seed,
-                                          restarts=restarts)
+                                          restarts=restarts,
+                                          allow_place=allow_place)
     if verbose:
         print(f"[search/native] best {best_cost * 1e3:.3f} ms vs DP "
               f"{dp_cost * 1e3:.3f} ms "

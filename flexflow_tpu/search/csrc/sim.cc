@@ -262,6 +262,9 @@ double ff_mcmc(int num_ops, int num_edges, int num_devices,
                const int32_t* init_choices, const int32_t* init_places,
                double hbm_bytes, double ici_bw, double ici_latency,
                double mem_penalty_per_byte,
+               int allow_place,  // 0: never propose device-block moves
+                                 // (FSDP shards weights over the FULL
+                                 // mesh, incompatible with sub-meshes)
                int budget, double alpha, uint64_t seed,
                int32_t* best_choices, int32_t* best_places) {
   Tables T = make_tables(num_ops, num_edges, num_devices, op_cost_offsets,
@@ -301,7 +304,7 @@ double ff_mcmc(int num_ops, int num_edges, int num_devices,
     int old_c = cur_c[op], old_p = cur_p[op];
     // half the proposals move the device block, half the axis map
     // (reference re-randomizes both at once; splitting mixes faster)
-    bool move_place = (rng() & 1) != 0;
+    bool move_place = allow_place && (rng() & 1) != 0;
     int ndev = ndev_of(op, old_c);
     int nblocks = (ndev < D && D % ndev == 0) ? D / ndev : 1;
     if (move_place && nblocks > 1) {

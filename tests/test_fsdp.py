@@ -66,6 +66,62 @@ def test_fsdp_numerics_match_unsharded():
     assert "data" in str(ff_f.params["d2"]["kernel"].sharding.spec)
 
 
+def test_cost_model_prices_fsdp():
+    """Search-side FSDP awareness (time model): grad sync over the fsdp
+    axis becomes a reduce-scatter (~half an all-reduce) plus 2 per-step
+    weight all-gathers; memory is already per-shard-credited (see
+    op_mem_bytes approximation note), so it is unchanged."""
+    from flexflow_tpu.search.cost_model import CostModel
+
+    cfg = FFConfig(batch_size=16, mesh_shape=dict(MESH))
+    ff = FFModel(cfg)
+    x = ff.create_tensor([16, 256], name="input")
+    t = ff.dense(x, 1024, name="big")
+    ff.dense(t, 8, name="head")
+    dp = {"data": 0}
+    plain = CostModel(ff, MESH)
+    fsdp = CostModel(ff, MESH, fsdp_axis="data")
+    op = ff.get_op_by_name("big")
+
+    assert fsdp.op_mem_bytes(op, dp) == plain.op_mem_bytes(op, dp)
+
+    s_plain, s_fsdp = (c.op_grad_sync_time(op, dp) for c in (plain, fsdp))
+    assert s_fsdp != s_plain
+    # reduce-scatter (0.5x all-reduce) + 2 gathers of the 1/4-resident
+    # weight: strictly between half and double the plain all-reduce
+    assert 0.5 * s_plain < s_fsdp < 2.0 * s_plain
+
+    # a weight whose partition already uses the fsdp axis (TP on 'model'
+    # with fsdp_axis='model') gets no FSDP terms at all
+    tp = {"data": 0, "model": 1}
+    both = CostModel(ff, MESH, fsdp_axis="model")
+    np.testing.assert_allclose(both.op_grad_sync_time(op, tp),
+                               plain.op_grad_sync_time(op, tp))
+
+    # CostModel defaults fsdp_axis from the model's config
+    cfg2 = FFConfig(batch_size=16, mesh_shape=dict(MESH), fsdp_axis="data")
+    ff2 = FFModel(cfg2)
+    x2 = ff2.create_tensor([16, 256], name="input")
+    ff2.dense(x2, 1024, name="big")
+    auto = CostModel(ff2, MESH)
+    assert auto.fsdp_axis == "data"
+
+    # explicit typo'd axis raises (config-derived absence is dropped)
+    with pytest.raises(ValueError, match="not a mesh axis"):
+        CostModel(ff, MESH, fsdp_axis="dat")
+
+    # a weight with NO dim divisible by the fsdp axis is priced plain
+    # (matches executor._with_fsdp's degrade-to-unsharded rule)
+    cfg3 = FFConfig(batch_size=16, mesh_shape=dict(MESH))
+    ff3 = FFModel(cfg3)
+    x3 = ff3.create_tensor([16, 255], name="input")
+    ff3.dense(x3, 1023, use_bias=False, name="odd")  # 255x1023: 4 | none
+    odd = ff3.get_op_by_name("odd")
+    np.testing.assert_allclose(
+        CostModel(ff3, MESH, fsdp_axis="data").op_grad_sync_time(odd, dp),
+        CostModel(ff3, MESH).op_grad_sync_time(odd, dp))
+
+
 def test_fsdp_validation_and_indivisible_fallback():
     with pytest.raises(ValueError, match="not a mesh axis"):
         cfg = FFConfig(batch_size=8, mesh_shape={"data": 2},
