@@ -187,7 +187,7 @@ class CostModel:
                     and self.mesh_shape[self.fsdp_axis] > 1):
                 from flexflow_tpu.runtime.executor import _with_fsdp
 
-                base = wp.get(spec.name) or ()
+                base = pspec or ()
                 fsdp = _with_fsdp(base, spec.shape, self.fsdp_axis,
                                   self.mesh_shape[self.fsdp_axis]) is not base
             for ax, d in (axis_map or {}).items():

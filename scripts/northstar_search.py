@@ -135,8 +135,15 @@ def build_workload(name: str, batch: Optional[int] = None):
 
 
 def run_one(name: str, budget: int, seed: int = 0, verbose: bool = True,
-            batch: Optional[int] = None, costs: str = "analytic"):
+            batch: Optional[int] = None, costs: str = "analytic",
+            fsdp: bool = False):
     ff, mesh = build_workload(name, batch)
+    if fsdp:
+        # price the run under FSDP (FFConfig.fsdp_axis): CostModel picks
+        # the axis up from the config; the annealer then skips placement
+        # proposals (csim.native semantics) — mirrored here via
+        # allow_place on the direct prob.mcmc call below
+        ff.config.fsdp_axis = "data"
     machine = v5e32_machine()
     measured = None
     if costs == "analyze":
@@ -164,8 +171,11 @@ def run_one(name: str, budget: int, seed: int = 0, verbose: bool = True,
     dp_cost = prob.simulate(dp_choices)
 
     t0 = time.time()
+    # authoritative gate: whatever ended up in the cost model (CLI flag OR
+    # a workload config that set fsdp_axis itself) disables placement
     best_c, best_p, best_cost = prob.mcmc(dp_choices, budget, 0.05, seed,
-                                          restarts=4)
+                                          restarts=4,
+                                          allow_place=not cost.fsdp_axis)
     search_s = time.time() - t0
     speedup = dp_cost / max(best_cost, 1e-12)
 
@@ -180,6 +190,7 @@ def run_one(name: str, budget: int, seed: int = 0, verbose: bool = True,
 
     result = {
         "workload": name,
+        "fsdp": fsdp,
         "costs": costs,
         "global_batch": ff.config.batch_size,
         "machine": "simulated v5e-32 (4 hosts x 8 chips, ICI+DCN)",
@@ -216,17 +227,21 @@ def main():
                          "XLA cost analysis, or real-device timing")
     ap.add_argument("--large-batch", action="store_true",
                     help="also run the 16-samples/chip large-batch regime")
+    ap.add_argument("--fsdp", action="store_true",
+                    help="price the search under FSDP over 'data' "
+                         "(weight gathers + grad reduce-scatter; no "
+                         "placement proposals)")
     args = ap.parse_args()
 
     names = (["transformer", "bert_fx", "llama", "resnet50", "inception",
               "dlrm"]
              if args.workload == "all" else [args.workload])
     results = [run_one(n, args.budget, args.seed, batch=args.batch,
-                       costs=args.costs)
+                       costs=args.costs, fsdp=args.fsdp)
                for n in names]
     if args.large_batch:
         results += [run_one(n, args.budget, args.seed, batch=16 * 32,
-                            costs=args.costs)
+                            costs=args.costs, fsdp=args.fsdp)
                     for n in names if n != "dlrm"]
     print("\n== north-star summary (simulated v5e-32) ==")
     for r in results:
