@@ -750,7 +750,8 @@ class FFModel:
     def generate(self, tokens, max_new_tokens: int, temperature: float = 0.0,
                  top_k: int = 0, eos_token_id=None, pad_token_id: int = 0,
                  num_beams: int = 1, length_penalty: float = 0.0,
-                 prompt_lengths=None, quantize=None, seed: int = 0):
+                 prompt_lengths=None, quantize=None,
+                 prefill_chunk: int = 0, seed: int = 0):
         """KV-cache autoregressive decoding for decoder-only LM graphs
         (runtime/generation.py). tokens: (B, S0) int32 prompts; returns
         (B, S0 + max_new_tokens) int32 with generated tokens in columns
@@ -779,9 +780,11 @@ class FFModel:
                     "beam search supports uniform-length prompts only; "
                     "pass prompts of equal length or use num_beams=1")
             return gen.beam_search(tokens, max_new_tokens, num_beams,
-                                   length_penalty)
+                                   length_penalty,
+                                   prefill_chunk=prefill_chunk)
         return gen(tokens, max_new_tokens, seed=seed,
-                   prompt_lengths=prompt_lengths)
+                   prompt_lengths=prompt_lengths,
+                   prefill_chunk=prefill_chunk)
 
     # ------------------------------------------------------------ weights IO
 

@@ -234,13 +234,53 @@ class MultiHeadAttention(Op):
         ctx = self._dense_attention(qh, kh, vh, scale, False, None, None)
         return self._out_proj(params, ctx), new_cache
 
+    def _grouped_cache_attention(self, qh, ck, cv, live):
+        """Shared cache-attention body for the decode and chunked-prefill
+        paths: q (B, C, H, Dh) against cached k/v (B, L, KVH, Dh) with a
+        `live` mask broadcastable to (B, KVH, G, C, L). The GQA grouping
+        reshapes q to (KVH, G) groups — consecutive query heads share a
+        kv head, matching _broadcast_kv's jnp.repeat layout — so the
+        broadcast is never materialized. f32 scores/softmax."""
+        b, c = qh.shape[0], qh.shape[1]
+        kvh = self.num_kv_heads
+        grp = self.num_heads // kvh
+        scale = 1.0 / math.sqrt(self.qk_head_dim)
+        qg = qh.reshape(b, c, kvh, grp, self.qk_head_dim)
+        logits = jnp.einsum("bqkgd,bskd->bkgqs", qg, ck.astype(qh.dtype),
+                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(live, logits, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits, axis=-1).astype(qh.dtype)
+        ctx = jnp.einsum("bkgqs,bskd->bqkgd", probs, cv.astype(qh.dtype))
+        return ctx.reshape(b, c, self.num_heads, self.v_head_dim)
+
+    def chunk_forward(self, params, xs, cache, start):
+        """Chunked prefill: a (B, C, D) slab of prompt positions
+        [start, start+C) writes its k/v into the cache and attends the
+        STATIC prefix slice [0, start+C) with the causal rule (position j
+        attends idx <= start + j) — O(C * prefix) score memory, and the
+        unwritten decode tail of the cache is never touched. Same mask
+        and positions as the whole-prompt pass; logits are bitwise-equal
+        to it on the einsum path (a flash-prefill backend accumulates in
+        a different order, so there equality is within kernel tolerance —
+        runtime/generation.py notes)."""
+        qh, kh, vh = self._project_qkv(params, xs[0], xs[1], xs[2],
+                                       rope_offset=start)
+        ck = jax.lax.dynamic_update_slice(
+            cache["k"], kh.astype(cache["k"].dtype), (0, start, 0, 0))
+        cv = jax.lax.dynamic_update_slice(
+            cache["v"], vh.astype(cache["v"].dtype), (0, start, 0, 0))
+        c = qh.shape[1]
+        end = start + c  # python ints: a static slice of the live prefix
+        live = (jnp.arange(end)[None, :]
+                <= (start + jnp.arange(c))[:, None])        # (C, end)
+        ctx = self._grouped_cache_attention(
+            qh, ck[:, :end], cv[:, :end], live[None, None, None, :, :])
+        return self._out_proj(params, ctx), {"k": ck, "v": cv}
+
     def decode_forward(self, params, xs, cache, pos, rope_pos=None,
                        row_lengths=None, prompt_len=None):
         """One-token step: write this token's k/v at slot `pos` (traced
-        scalar), attend q over the live cache prefix. The GQA grouping is
-        done by reshaping q to (KVH, G) groups — consecutive query heads
-        share a kv head, matching _broadcast_kv's jnp.repeat layout — so
-        the broadcast is never materialized.
+        scalar), attend q over the live cache prefix.
 
         Ragged right-padded prompts (runtime/generation.py): `row_lengths`
         (B,) marks each row's true prompt length and `prompt_len` the
@@ -255,24 +295,14 @@ class MultiHeadAttention(Op):
             cache["k"], kh.astype(cache["k"].dtype), (0, pos, 0, 0))
         cv = jax.lax.dynamic_update_slice(
             cache["v"], vh.astype(cache["v"].dtype), (0, pos, 0, 0))
-        b, max_len = ck.shape[0], ck.shape[1]
-        kvh = self.num_kv_heads
-        grp = self.num_heads // kvh
-        scale = 1.0 / math.sqrt(self.qk_head_dim)
-        qg = qh.reshape(b, 1, kvh, grp, self.qk_head_dim)
-        logits = jnp.einsum("bqkgd,bskd->bkgqs", qg, ck.astype(qh.dtype),
-                            preferred_element_type=jnp.float32) * scale
-        idx = jnp.arange(max_len)
+        idx = jnp.arange(ck.shape[1])
         if row_lengths is None:
             live = (idx <= pos)[None, :]
         else:
             live = (idx[None, :] < row_lengths[:, None]) \
                 | ((idx[None, :] >= prompt_len) & (idx[None, :] <= pos))
-        logits = jnp.where(live[:, None, None, None, :], logits,
-                           jnp.finfo(jnp.float32).min)
-        probs = jax.nn.softmax(logits, axis=-1).astype(qh.dtype)
-        ctx = jnp.einsum("bkgqs,bskd->bqkgd", probs, cv.astype(qh.dtype))
-        ctx = ctx.reshape(b, 1, self.num_heads, self.v_head_dim)
+        ctx = self._grouped_cache_attention(
+            qh, ck, cv, live[:, None, None, None, :])
         return self._out_proj(params, ctx), {"k": ck, "v": cv}
 
     def _flash_ok(self, qh, kh) -> bool:

@@ -212,7 +212,8 @@ class Generator:
         return jnp.float32
 
     def _walk(self, params, state, tokens, caches, pos, last_only=False,
-              rope_pos=None, row_lengths=None, prompt_len=None):
+              rope_pos=None, row_lengths=None, prompt_len=None,
+              chunk_start=None, skip_tail=False):
         """Interpret the graph on a (B, S) token slab. pos=None means
         prefill (positions 0..S-1, fills cache); otherwise S == 1 and pos
         is the traced cache slot of the token. last_only=True narrows the
@@ -236,6 +237,10 @@ class Generator:
         for idx, op in enumerate(self.model.ops):
             if isinstance(op, InputOp):
                 continue
+            if skip_tail and idx > self._last_attn_idx:
+                # non-final prefill chunk: only the caches matter; the
+                # post-attention tail (final norm + lm_head) is unused
+                return None, new_caches
             xs = [vals[t] for t in op.inputs]
             if (last_only and pos is None and idx > self._last_attn_idx
                     and s_full > 1):
@@ -269,7 +274,11 @@ class Generator:
                 if isinstance(op, MultiHeadAttention):
                     cache = caches[op.name]
                     if pos is None:
-                        out, nc = op.prefill_forward(p, xs, cache)
+                        if chunk_start is not None:
+                            out, nc = op.chunk_forward(p, xs, cache,
+                                                       chunk_start)
+                        else:
+                            out, nc = op.prefill_forward(p, xs, cache)
                     else:
                         out, nc = op.decode_forward(
                             p, xs, cache, pos, rope_pos=rope_pos,
@@ -297,6 +306,31 @@ class Generator:
                 vals[t] = outs[i]
         return vals[self.model._final_tensor], new_caches
 
+    def _prefill(self, params, state, tokens, caches, row_lengths,
+                 prefill_chunk):
+        """Whole-prompt prefill, or chunked (`prefill_chunk` > 0 and the
+        prompt longer than it): each chunk writes its k/v and attends the
+        static prefix slice under the same causal rule — score memory is
+        O(chunk * S) not O(S^2). Logits are bitwise-equal to whole-prompt
+        prefill on the einsum path; when whole-prompt prefill rides the
+        flash kernel (TPU), accumulation order differs, so equality is
+        within kernel tolerance there. Uniform prompts only (a ragged
+        row's last position can fall in an earlier chunk; rejected in
+        __call__)."""
+        b, s0 = tokens.shape
+        if not prefill_chunk or s0 <= prefill_chunk:
+            return self._walk(params, state, tokens, caches, None,
+                              last_only=True, row_lengths=row_lengths,
+                              prompt_len=s0)
+        starts = list(range(0, s0, prefill_chunk))
+        for st in starts[:-1]:
+            _, caches = self._walk(
+                params, state, tokens[:, st:st + prefill_chunk], caches,
+                None, chunk_start=st, skip_tail=True)
+        st = starts[-1]
+        return self._walk(params, state, tokens[:, st:], caches, None,
+                          last_only=True, chunk_start=st)
+
     # ---- sampling ----------------------------------------------------------
 
     def _sample(self, logits, key):
@@ -312,7 +346,8 @@ class Generator:
 
     # ---- the compiled program ---------------------------------------------
 
-    def _build(self, max_new_tokens: int, ragged: bool = False):
+    def _build(self, max_new_tokens: int, ragged: bool = False,
+               prefill_chunk: int = 0):
         cdtype = self._compute_dtype()
 
         def gen(params, state, tokens, key, lengths):
@@ -321,10 +356,8 @@ class Generator:
             row_lengths = lengths if ragged else None
             caches = {op.name: op.init_cache(b, max_len, cdtype)
                       for op in self.attn_ops}
-            logits, caches = self._walk(params, state, tokens, caches, None,
-                                        last_only=True,
-                                        row_lengths=row_lengths,
-                                        prompt_len=s0)
+            logits, caches = self._prefill(params, state, tokens, caches,
+                                           row_lengths, prefill_chunk)
             key, sub = jax.random.split(key)
             tok = self._sample(logits[:, -1], sub)
             done = jnp.zeros((b,), bool)
@@ -358,7 +391,7 @@ class Generator:
     # ---- beam search -------------------------------------------------------
 
     def _build_beam(self, max_new_tokens: int, num_beams: int,
-                    length_penalty: float):
+                    length_penalty: float, prefill_chunk: int = 0):
         """Beam decode as one jitted scan. Beams live flattened on the
         batch dim (B*K rows); each step re-orders the KV caches by beam
         parent with a batched gather. Finished beams (emitted eos) are
@@ -372,8 +405,8 @@ class Generator:
             max_len = s0 + max_new_tokens
             caches = {op.name: op.init_cache(b, max_len, cdtype)
                       for op in self.attn_ops}
-            logits, caches = self._walk(params, state, tokens, caches, None,
-                                        last_only=True)
+            logits, caches = self._prefill(params, state, tokens, caches,
+                                           None, prefill_chunk)
             logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32),
                                       axis=-1)                  # (B, V)
             vocab = logp.shape[-1]
@@ -438,23 +471,30 @@ class Generator:
                 else self.model.params)
 
     def beam_search(self, tokens: np.ndarray, max_new_tokens: int,
-                    num_beams: int, length_penalty: float = 0.0) -> np.ndarray:
+                    num_beams: int, length_penalty: float = 0.0,
+                    prefill_chunk: int = 0) -> np.ndarray:
+        if prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0, got {prefill_chunk}")
         tokens = jnp.asarray(tokens, jnp.int32)
-        key = ("beam", max_new_tokens, num_beams, length_penalty)
+        key = ("beam", max_new_tokens, num_beams, length_penalty,
+               prefill_chunk)
         fn = self._jitted.get(key)
         if fn is None:
             fn = self._jitted[key] = self._build_beam(
-                max_new_tokens, num_beams, length_penalty)
+                max_new_tokens, num_beams, length_penalty, prefill_chunk)
         return np.asarray(fn(self._params(), self.model.bn_state, tokens))
 
     def __call__(self, tokens: np.ndarray, max_new_tokens: int,
-                 seed: int = 0, prompt_lengths=None) -> np.ndarray:
+                 seed: int = 0, prompt_lengths=None,
+                 prefill_chunk: int = 0) -> np.ndarray:
         """tokens (B, S0) int32 prompts -> (B, S0 + max_new_tokens) int32
         with the generated tokens in columns S0 onward. Uniform-length
         prompts by default; `prompt_lengths` (B,) enables ragged RIGHT-
         padded prompts — row b's prompt is tokens[b, :prompt_lengths[b]],
         pad slots are masked out of attention and RoPE continues from each
-        row's true length."""
+        row's true length. `prefill_chunk` > 0 prefills the prompt in
+        chunks of that many positions (O(chunk * S) score memory)."""
         tokens = jnp.asarray(tokens, jnp.int32)
         ragged = prompt_lengths is not None
         if ragged:
@@ -470,10 +510,18 @@ class Generator:
             lengths = jnp.asarray(lengths)
         else:
             lengths = jnp.zeros((tokens.shape[0],), jnp.int32)
-        fn = self._jitted.get((max_new_tokens, ragged))
+        if prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0, got {prefill_chunk}")
+        if ragged and prefill_chunk:
+            raise NotImplementedError(
+                "prefill_chunk + prompt_lengths is unsupported: a ragged "
+                "row's last position can fall in an earlier chunk")
+        cache_key = (max_new_tokens, ragged, prefill_chunk)
+        fn = self._jitted.get(cache_key)
         if fn is None:
-            fn = self._jitted[(max_new_tokens, ragged)] = self._build(
-                max_new_tokens, ragged)
+            fn = self._jitted[cache_key] = self._build(
+                max_new_tokens, ragged, prefill_chunk)
         key = jax.random.PRNGKey(seed)
         return np.asarray(fn(self._params(), self.model.bn_state,
                              tokens, key, lengths))

@@ -336,6 +336,52 @@ def test_int8_weight_only_decode():
         "in-place params mutation did not invalidate the int8 cache"
 
 
+def test_chunked_prefill_matches_whole_prompt():
+    """prefill_chunk: chunk-by-chunk prefill (incl. an uneven tail chunk)
+    must produce EXACTLY the whole-prompt generation — same causal mask,
+    same RoPE positions — for greedy and beam. (Exact equality holds on
+    the einsum path this CPU test runs; a flash-prefill backend differs
+    only by accumulation order.)"""
+    ff = build_llama({"data": 2})
+    rs = np.random.RandomState(17)
+    prompt = rs.randint(0, VOCAB, (2, 10)).astype(np.int32)
+    whole = ff.generate(prompt, max_new_tokens=5)
+    for chunk in (3, 4, 10, 64):
+        out = ff.generate(prompt, max_new_tokens=5, prefill_chunk=chunk)
+        np.testing.assert_array_equal(out, whole, err_msg=f"chunk={chunk}")
+    beam_whole = ff.generate(prompt, max_new_tokens=5, num_beams=3)
+    beam_chunk = ff.generate(prompt, max_new_tokens=5, num_beams=3,
+                             prefill_chunk=4)
+    np.testing.assert_array_equal(beam_whole, beam_chunk)
+    with pytest.raises(NotImplementedError, match="prefill_chunk"):
+        ff.generate(prompt, 3, prompt_lengths=np.full(2, 10, np.int32),
+                    prefill_chunk=4)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        ff.generate(prompt, 3, prefill_chunk=-1)
+
+
+def test_generate_under_bf16_compute():
+    """All generate modes run under the production bf16 compute/master
+    dtypes (casts at the graph boundary; f32 rope/softmax inside)."""
+    cfg = FFConfig(batch_size=2, mesh_shape={"data": 2},
+                   compute_dtype="bfloat16", master_dtype="bfloat16")
+    ff = FFModel(cfg)
+    from flexflow_tpu.models.llama import llama_lm as _llama
+
+    _, logits = _llama(ff, 2, seq_len=8, hidden=64, layers=2, heads=4,
+                       kv_heads=2, vocab_size=VOCAB, tie_embeddings=True)
+    ff.compile(final_tensor=logits)
+    rs = np.random.RandomState(21)
+    p = rs.randint(0, VOCAB, (2, 6)).astype(np.int32)
+    for out in (ff.generate(p, 4),
+                ff.generate(p, 4, num_beams=2),
+                ff.generate(p, 4, quantize="int8"),
+                ff.generate(p, 4,
+                            prompt_lengths=np.array([4, 6], np.int32))):
+        assert out.shape == (2, 10)
+        assert ((out >= 0) & (out < VOCAB)).all()
+
+
 def test_generate_rejects_placement_models():
     """Params under an operator-placement strategy live on disjoint
     sub-meshes; one decode program cannot span them."""
