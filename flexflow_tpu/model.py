@@ -751,15 +751,20 @@ class FFModel:
                  top_k: int = 0, eos_token_id=None, pad_token_id: int = 0,
                  num_beams: int = 1, length_penalty: float = 0.0,
                  prompt_lengths=None, quantize=None,
-                 prefill_chunk: int = 0, seed: int = 0):
+                 prefill_chunk: int = 0, return_scores: bool = False,
+                 seed: int = 0):
         """KV-cache autoregressive decoding for decoder-only LM graphs
         (runtime/generation.py). tokens: (B, S0) int32 prompts; returns
         (B, S0 + max_new_tokens) int32 with generated tokens in columns
-        S0 onward. prompt_lengths (B,) enables ragged right-padded
-        prompts. num_beams > 1 switches to beam search (temperature/
-        top_k ignored there; uniform-length prompts only). quantize=
-        "int8" decodes with weight-only int8 (lossy; halves weight HBM
-        traffic vs bf16)."""
+        S0 onward — or, with return_scores=True, a (tokens, scores)
+        tuple where scores is (B, max_new_tokens) per-token model
+        logprobs for greedy/sampling (pads after eos carry 0.0) and (B,)
+        length-penalty-normalized total logp of the chosen beam for beam
+        search. prompt_lengths (B,) enables ragged right-padded prompts.
+        num_beams > 1 switches to beam search (temperature/top_k ignored
+        there; uniform-length prompts only). quantize="int8" decodes
+        with weight-only int8 (lossy; halves weight HBM traffic vs
+        bf16). prefill_chunk=N bounds prefill score memory."""
         from flexflow_tpu.runtime.generation import Generator
 
         # beam search ignores temperature/top_k: key those out so a
@@ -770,8 +775,11 @@ class FFModel:
                      quantize))
         gen = self._generators.get(key)
         if gen is None:
+            # construct from the KEYED values (not the raw args): a beam
+            # call keys temperature/top_k out, and its cached Generator
+            # must behave greedy if a later num_beams=1 call reuses it
             gen = self._generators[key] = Generator(
-                self, temperature=temperature, top_k=top_k,
+                self, temperature=key[0], top_k=key[1],
                 eos_id=eos_token_id, pad_id=pad_token_id,
                 quantize=quantize)
         if num_beams > 1:
@@ -781,10 +789,12 @@ class FFModel:
                     "pass prompts of equal length or use num_beams=1")
             return gen.beam_search(tokens, max_new_tokens, num_beams,
                                    length_penalty,
-                                   prefill_chunk=prefill_chunk)
+                                   prefill_chunk=prefill_chunk,
+                                   return_scores=return_scores)
         return gen(tokens, max_new_tokens, seed=seed,
                    prompt_lengths=prompt_lengths,
-                   prefill_chunk=prefill_chunk)
+                   prefill_chunk=prefill_chunk,
+                   return_scores=return_scores)
 
     # ------------------------------------------------------------ weights IO
 

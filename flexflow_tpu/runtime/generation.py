@@ -333,21 +333,33 @@ class Generator:
 
     # ---- sampling ----------------------------------------------------------
 
-    def _sample(self, logits, key):
-        """logits (B, V) -> token (B,) int32."""
+    def _sample(self, logits, key, with_score=False):
+        """logits (B, V) -> (token (B,) int32, logp (B,) f32 or None).
+        The score is the MODEL's log-probability of the chosen token
+        (raw softmax, independent of temperature/top-k warping of the
+        sampling distribution); computed only when requested, so
+        score-free decode programs never pay the full-vocab
+        log_softmax."""
         logits = logits.astype(jnp.float32)
         if self.temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = logits / self.temperature
-        if self.top_k > 0:
-            kth = jax.lax.top_k(logits, self.top_k)[0][:, -1:]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
-        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            warped = logits / self.temperature
+            if self.top_k > 0:
+                kth = jax.lax.top_k(warped, self.top_k)[0][:, -1:]
+                warped = jnp.where(warped < kth, -jnp.inf, warped)
+            tok = jax.random.categorical(key, warped, axis=-1
+                                         ).astype(jnp.int32)
+        if not with_score:
+            return tok, None
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        score = jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
+        return tok, score
 
     # ---- the compiled program ---------------------------------------------
 
     def _build(self, max_new_tokens: int, ragged: bool = False,
-               prefill_chunk: int = 0):
+               prefill_chunk: int = 0, with_scores: bool = False):
         cdtype = self._compute_dtype()
 
         def gen(params, state, tokens, key, lengths):
@@ -359,7 +371,8 @@ class Generator:
             logits, caches = self._prefill(params, state, tokens, caches,
                                            row_lengths, prefill_chunk)
             key, sub = jax.random.split(key)
-            tok = self._sample(logits[:, -1], sub)
+            tok, score = self._sample(logits[:, -1], sub,
+                                      with_score=with_scores)
             done = jnp.zeros((b,), bool)
             if self.eos_id is not None:
                 done = tok == self.eos_id
@@ -371,20 +384,31 @@ class Generator:
                     rope_pos=(row_lengths + i) if ragged else None,
                     row_lengths=row_lengths, prompt_len=s0)
                 key, sub = jax.random.split(key)
-                nxt = self._sample(logits[:, 0], sub)
+                nxt, sc = self._sample(logits[:, 0], sub,
+                                       with_score=with_scores)
                 if self.eos_id is not None:
                     nxt = jnp.where(done, self.pad_id, nxt)
+                    if with_scores:
+                        sc = jnp.where(done, 0.0, sc)  # pads score 0
                     done = done | (nxt == self.eos_id)
-                return (caches, nxt, done, key), nxt
+                ys = (nxt, sc) if with_scores else nxt
+                return (caches, nxt, done, key), ys
 
             if max_new_tokens > 1:
-                _, rest = jax.lax.scan(
+                _, ys = jax.lax.scan(
                     body, (caches, tok, done, key),
                     jnp.arange(max_new_tokens - 1, dtype=jnp.int32))
+                rest = ys[0] if with_scores else ys
                 new = jnp.concatenate([tok[:, None], rest.T], axis=1)
+                if with_scores:
+                    scores = jnp.concatenate([score[:, None], ys[1].T],
+                                             axis=1)
             else:
                 new = tok[:, None]
-            return jnp.concatenate([tokens, new], axis=1)
+                if with_scores:
+                    scores = score[:, None]
+            out = jnp.concatenate([tokens, new], axis=1)
+            return (out, scores) if with_scores else out
 
         return jax.jit(gen)
 
@@ -462,7 +486,9 @@ class Generator:
             best = jnp.argmax(norm, axis=1)                     # (B,)
             picked = jnp.take_along_axis(
                 buf, best[:, None, None], axis=1)[:, 0]         # (B, T)
-            return jnp.concatenate([tokens, picked], axis=1)
+            best_score = jnp.take_along_axis(norm, best[:, None],
+                                             axis=1)[:, 0]
+            return jnp.concatenate([tokens, picked], axis=1), best_score
 
         return jax.jit(gen)
 
@@ -472,7 +498,7 @@ class Generator:
 
     def beam_search(self, tokens: np.ndarray, max_new_tokens: int,
                     num_beams: int, length_penalty: float = 0.0,
-                    prefill_chunk: int = 0) -> np.ndarray:
+                    prefill_chunk: int = 0, return_scores: bool = False):
         if prefill_chunk < 0:
             raise ValueError(
                 f"prefill_chunk must be >= 0, got {prefill_chunk}")
@@ -483,11 +509,15 @@ class Generator:
         if fn is None:
             fn = self._jitted[key] = self._build_beam(
                 max_new_tokens, num_beams, length_penalty, prefill_chunk)
-        return np.asarray(fn(self._params(), self.model.bn_state, tokens))
+        out, score = fn(self._params(), self.model.bn_state, tokens)
+        if return_scores:
+            # (B,) length-penalty-normalized total logp of the chosen beam
+            return np.asarray(out), np.asarray(score)
+        return np.asarray(out)
 
     def __call__(self, tokens: np.ndarray, max_new_tokens: int,
                  seed: int = 0, prompt_lengths=None,
-                 prefill_chunk: int = 0) -> np.ndarray:
+                 prefill_chunk: int = 0, return_scores: bool = False):
         """tokens (B, S0) int32 prompts -> (B, S0 + max_new_tokens) int32
         with the generated tokens in columns S0 onward. Uniform-length
         prompts by default; `prompt_lengths` (B,) enables ragged RIGHT-
@@ -517,11 +547,17 @@ class Generator:
             raise NotImplementedError(
                 "prefill_chunk + prompt_lengths is unsupported: a ragged "
                 "row's last position can fall in an earlier chunk")
-        cache_key = (max_new_tokens, ragged, prefill_chunk)
+        cache_key = (max_new_tokens, ragged, prefill_chunk, return_scores)
         fn = self._jitted.get(cache_key)
         if fn is None:
             fn = self._jitted[cache_key] = self._build(
-                max_new_tokens, ragged, prefill_chunk)
+                max_new_tokens, ragged, prefill_chunk,
+                with_scores=return_scores)
         key = jax.random.PRNGKey(seed)
-        return np.asarray(fn(self._params(), self.model.bn_state,
-                             tokens, key, lengths))
+        res = fn(self._params(), self.model.bn_state, tokens, key, lengths)
+        if return_scores:
+            # (B, S0+new) tokens + (B, new) model logprobs per new token
+            # (pads after eos carry 0.0)
+            out, scores = res
+            return np.asarray(out), np.asarray(scores)
+        return np.asarray(res)

@@ -336,6 +336,53 @@ def test_int8_weight_only_decode():
         "in-place params mutation did not invalidate the int8 cache"
 
 
+def test_return_scores():
+    """return_scores: greedy scores are the model's own logp of each
+    chosen token — rescoring with the full forward must reproduce them;
+    beam returns the chosen beam's normalized total logp."""
+    ff = build_llama({"data": 2})
+    rs = np.random.RandomState(19)
+    prompt = rs.randint(0, VOCAB, (2, 5)).astype(np.int32)
+    out, scores = ff.generate(prompt, max_new_tokens=4, return_scores=True)
+    assert scores.shape == (2, 4)
+    assert (scores <= 0).all()  # logprobs
+    lg = full_logits(ff, out[:, :-1])  # logits predicting positions 1..
+    lp = lg - np.log(np.exp(lg - lg.max(-1, keepdims=True)).sum(-1))[..., None] \
+        - lg.max(-1, keepdims=True)
+    for b in range(2):
+        for i in range(4):
+            want = lp[b, 4 + i, out[b, 5 + i]]
+            np.testing.assert_allclose(scores[b, i], want, atol=2e-4,
+                                       err_msg=f"row {b} step {i}")
+
+    bout, bscore = ff.generate(prompt, max_new_tokens=4, num_beams=3,
+                               return_scores=True)
+    assert bscore.shape == (2,)
+    assert (bscore <= 0).all()
+    # the real invariant: bscore (length_penalty 0 -> total logp of the
+    # chosen beam) equals the full-forward rescoring of bout
+    blg = full_logits(ff, bout[:, :-1])
+    blp = blg - np.log(np.exp(blg - blg.max(-1, keepdims=True))
+                       .sum(-1))[..., None] - blg.max(-1, keepdims=True)
+    for b in range(2):
+        tot = sum(blp[b, 4 + i, bout[b, 5 + i]] for i in range(4))
+        np.testing.assert_allclose(bscore[b], tot, atol=5e-4,
+                                   err_msg=f"beam row {b}")
+
+
+def test_beam_with_temperature_does_not_poison_greedy_cache():
+    """A beam call keys temperature/top_k out of the Generator cache; the
+    cached Generator must therefore BE greedy, or a later num_beams=1
+    call with default temperature would silently sample."""
+    ff = build_llama({"data": 2})
+    rs = np.random.RandomState(23)
+    prompt = rs.randint(0, VOCAB, (2, 5)).astype(np.int32)
+    ff.generate(prompt, 3, num_beams=2, temperature=0.9, top_k=5)
+    g1 = ff.generate(prompt, 3, seed=1)
+    g2 = ff.generate(prompt, 3, seed=2)  # greedy: seed must not matter
+    np.testing.assert_array_equal(g1, g2)
+
+
 def test_chunked_prefill_matches_whole_prompt():
     """prefill_chunk: chunk-by-chunk prefill (incl. an uneven tail chunk)
     must produce EXACTLY the whole-prompt generation — same causal mask,
