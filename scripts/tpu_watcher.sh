@@ -12,14 +12,27 @@ mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 STAMP() { date -u +"%H:%M:%S"; }
 
+# hard deadline (epoch seconds): stop probing/starting steps after this,
+# so a late tunnel return can't leave a long measure run holding the
+# chip when the round-end driver bench needs it. Override: FF_WATCH_UNTIL.
+UNTIL="${FF_WATCH_UNTIL:-$(date -u -d '14:00' +%s 2>/dev/null || echo 0)}"
+
 while true; do
+  if [ "$UNTIL" -gt 0 ] && [ "$(date +%s)" -ge "$UNTIL" ]; then
+    echo "[$(STAMP)] deadline reached; exiting so the driver owns the chip"
+    break
+  fi
   echo "[$(STAMP)] probe"
   if timeout 200 python -c "import jax; assert jax.devices()[0].platform=='tpu'" \
       > /dev/null 2>&1; then
     echo "[$(STAMP)] TUNNEL UP - running work queue"
+    # a step only starts with its own timeout of headroom to the deadline
+    HEADROOM() { [ "$UNTIL" -le 0 ] \
+        || [ $(( $(date +%s) + $1 )) -lt "$UNTIL" ]; }
 
     # 1. ResNet-50 measure tier (VERDICT #3 arbitration — the one
     #    remaining north-star gap)
+    HEADROOM 2400 || { echo "[$(STAMP)] skip resnet (deadline)"; break; }
     echo "[$(STAMP)] step resnet"
     timeout 2400 python scripts/northstar_search.py --workload resnet50 \
         --costs measure --budget 40000 \
@@ -28,6 +41,7 @@ while true; do
     echo "[$(STAMP)] resnet rc=$rc: $(tail -c 300 "$OUT/resnet_measure.json")"
 
     # 2. KV-cache decode throughput (round-3 generation subsystem)
+    HEADROOM 1200 || { echo "[$(STAMP)] skip decode (deadline)"; break; }
     echo "[$(STAMP)] step decode"
     timeout 1200 python scripts/decode_probe.py \
         > "$OUT/decode.json" 2> "$OUT/decode.err"
@@ -36,6 +50,7 @@ while true; do
 
     # 2b. full staged bench: re-proves all tiers through the compile
     #     cache and measures the new xxl_scan (hidden 4096) tail tier
+    HEADROOM 1560 || { echo "[$(STAMP)] skip bench (deadline)"; break; }
     echo "[$(STAMP)] step bench"
     FF_BENCH_BUDGET=1500 timeout 1560 python bench.py \
         > "$OUT/bench3.json" 2> "$OUT/bench3.err"
@@ -43,6 +58,7 @@ while true; do
     echo "[$(STAMP)] bench rc=$rc: $(tail -c 400 "$OUT/bench3.json")"
 
     # 3. whole-program strategy validation, chip leg (VERDICT #5)
+    HEADROOM 900 || { echo "[$(STAMP)] skip validate (deadline)"; break; }
     echo "[$(STAMP)] step validate"
     timeout 900 python scripts/validate_strategies.py --budget 2000 --steps 10 \
         > "$OUT/validate.json" 2> "$OUT/validate.err"
