@@ -76,6 +76,16 @@ def test_scheduled_lr_reaches_the_update():
     assert deltas[2] < base[2] * 0.5
 
 
+def test_keras_optimizer_schedule_passthrough():
+    from flexflow_tpu.keras.optimizers import SGD, Adam, get_optimizer
+    from flexflow_tpu.runtime.schedule import ConstantSchedule
+
+    s = StepDecay(5, 0.5)
+    assert get_optimizer(SGD(learning_rate=0.1, schedule=s)).schedule is s
+    assert get_optimizer(Adam(schedule=s)).schedule is s
+    assert isinstance(get_optimizer(SGD()).schedule, ConstantSchedule)
+
+
 def test_adam_schedule_smoke():
     batch = {"input": np.ones((4, 3), np.float32),
              "label": np.zeros((4, 1), np.float32)}
