@@ -548,7 +548,10 @@ def _attach_prior_tpu(out):
                     r = json.loads(line)
                 except ValueError:
                     continue
-                if r.get("backend") == "tpu":
+                # machine-written rows only: _append_history never writes a
+                # "source" key — a hand-seeded row (which would carry one to
+                # label its provenance) must never reach the board
+                if r.get("backend") == "tpu" and "source" not in r:
                     rows.append(r)
         if not rows:
             return

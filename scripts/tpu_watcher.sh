@@ -1,21 +1,57 @@
 #!/bin/bash
-# Tunnel watcher (round 3): the axon TPU tunnel flaps. This watcher
-# probes with long patience and, the moment the tunnel answers, runs the
-# remaining hardware-blocked work in strict priority order (one jax
-# process at a time). Each step is independent; a tunnel drop mid-step
-# only loses that step. Steps already completed in earlier TPU sessions
-# (bench tiers, flash8k proof, MFU ablation+probe sweep) are not re-run.
+# Tunnel watcher (round 4): the axon TPU tunnel flaps, and — round-3
+# postmortem — can be HALF-OPEN: jax.devices() answers but the remote
+# compile service refuses connections, so a shallow probe green-lights a
+# queue step that then burns its whole timeout compiling nothing. The
+# round-4 probe therefore compiles AND runs a jitted op end to end.
+#
+# Steps are independent, retried on the next tunnel-up until their done
+# marker exists (output file non-empty + rc recorded 0), and strictly
+# serialized (one jax process at a time — a second wedges the tunnel).
+# Priority order = VERDICT round-3 "next round" order: the driver-board
+# bench (machine-written history) outranks everything.
 #
 # Detach with: nohup bash scripts/tpu_watcher.sh >/tmp/watcher.log 2>&1 &
-OUT=/tmp/tpu_queue
+OUT=/tmp/tpu_queue_r4
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 STAMP() { date -u +"%H:%M:%S"; }
 
-# hard deadline (epoch seconds): stop probing/starting steps after this,
-# so a late tunnel return can't leave a long measure run holding the
-# chip when the round-end driver bench needs it. Override: FF_WATCH_UNTIL.
-UNTIL="${FF_WATCH_UNTIL:-$(date -u -d '14:00' +%s 2>/dev/null || echo 0)}"
+# hard deadline (epoch secs): stop starting steps after this so a late
+# tunnel return can't leave a long measure run holding the chip when the
+# round-end driver bench needs it. Default 2026-08-01 03:00 UTC.
+UNTIL="${FF_WATCH_UNTIL:-1785553200}"
+
+HEADROOM() { [ "$UNTIL" -le 0 ] || [ $(( $(date +%s) + $1 )) -lt "$UNTIL" ]; }
+
+# run_step <name> <timeout> <done-predicate> <cmd...>: skip if done-marker
+# exists or no headroom; mark done only on rc=0 + non-empty output + the
+# step's own success predicate (an eval'd shell expr — rc=0 alone is NOT
+# proof of a TPU result: bench.py's CPU fallback and mfu_ablation.sh's
+# quarantine path both exit 0 by design). PENDING counts steps still
+# lacking a marker after this pass.
+PENDING=0
+DLSKIP=0
+run_step() {
+  local name=$1 tmo=$2 pred=$3; shift 3
+  [ -f "$OUT/$name.done" ] && return 0
+  if ! HEADROOM "$tmo"; then
+    echo "[$(STAMP)] skip $name (deadline)"
+    PENDING=$((PENDING + 1)); DLSKIP=$((DLSKIP + 1))
+    return 1
+  fi
+  echo "[$(STAMP)] step $name"
+  timeout "$tmo" "$@" > "$OUT/$name.json" 2> "$OUT/$name.err"
+  local rc=$?
+  echo "[$(STAMP)] $name rc=$rc: $(tail -c 300 "$OUT/$name.json")"
+  if [ "$rc" -eq 0 ] && [ -s "$OUT/$name.json" ] && eval "$pred"; then
+    touch "$OUT/$name.done"
+  else
+    echo "[$(STAMP)] $name NOT done (pred/rc failed); will retry next pass"
+    PENDING=$((PENDING + 1))
+  fi
+  return 0
+}
 
 while true; do
   if [ "$UNTIL" -gt 0 ] && [ "$(date +%s)" -ge "$UNTIL" ]; then
@@ -23,51 +59,64 @@ while true; do
     break
   fi
   echo "[$(STAMP)] probe"
-  if timeout 200 python -c "import jax; assert jax.devices()[0].platform=='tpu'" \
-      > /dev/null 2>&1; then
-    echo "[$(STAMP)] TUNNEL UP - running work queue"
-    # a step only starts with its own timeout of headroom to the deadline
-    HEADROOM() { [ "$UNTIL" -le 0 ] \
-        || [ $(( $(date +%s) + $1 )) -lt "$UNTIL" ]; }
+  # deep probe: backend init AND a remote compile+execute round trip —
+  # catches the half-open state that wasted the round-3 resnet window
+  if timeout 240 python -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform == 'tpu'
+assert float(jax.jit(lambda x: x * 2 + 1)(jnp.float32(3))) == 7.0
+" > /dev/null 2>&1; then
+    echo "[$(STAMP)] TUNNEL UP (compile verified) - running work queue"
+    PENDING=0
+    DLSKIP=0
 
-    # 1. ResNet-50 measure tier (VERDICT #3 arbitration — the one
-    #    remaining north-star gap)
-    HEADROOM 2400 || { echo "[$(STAMP)] skip resnet (deadline)"; break; }
-    echo "[$(STAMP)] step resnet"
-    timeout 2400 python scripts/northstar_search.py --workload resnet50 \
-        --costs measure --budget 40000 \
-        > "$OUT/resnet_measure.json" 2> "$OUT/resnet_measure.err"
-    rc=$?
-    echo "[$(STAMP)] resnet rc=$rc: $(tail -c 300 "$OUT/resnet_measure.json")"
+    # 1. driver-board bench: staged tiers, machine-written history rows
+    #    (VERDICT #1). Done only when a real TPU tier reached the board —
+    #    the CPU fallback also exits 0 and must be retried.
+    FF_BENCH_BUDGET=1500 run_step bench 1560 \
+        'grep -q "\"backend\": \"tpu\"" "$OUT/bench.json"' python bench.py
 
-    # 2. KV-cache decode throughput (round-3 generation subsystem)
-    HEADROOM 1200 || { echo "[$(STAMP)] skip decode (deadline)"; break; }
-    echo "[$(STAMP)] step decode"
-    timeout 1200 python scripts/decode_probe.py \
-        > "$OUT/decode.json" 2> "$OUT/decode.err"
-    rc=$?
-    echo "[$(STAMP)] decode rc=$rc: $(cat "$OUT/decode.json")"
+    # 2. ResNet-50 + InceptionV3 measure-tier arbitration (VERDICT #2).
+    #    A half-open tunnel degrades measure->analytic fallback (skips
+    #    logged with a transport error) — retry those; a single op that
+    #    fails measurement for a NON-tunnel reason still counts as done.
+    run_step resnet_measure 2400 \
+        '! grep -qE "UNAVAILABLE|Connection (Failed|refused)" "$OUT/resnet_measure.err"' \
+        python scripts/northstar_search.py \
+        --workload resnet50 --costs measure --budget 40000
+    run_step inception_measure 2400 \
+        '! grep -qE "UNAVAILABLE|Connection (Failed|refused)" "$OUT/inception_measure.err"' \
+        python scripts/northstar_search.py \
+        --workload inception --costs measure --budget 40000
 
-    # 2b. full staged bench: re-proves all tiers through the compile
-    #     cache and measures the new xxl_scan (hidden 4096) tail tier
-    HEADROOM 1560 || { echo "[$(STAMP)] skip bench (deadline)"; break; }
-    echo "[$(STAMP)] step bench"
-    FF_BENCH_BUDGET=1500 timeout 1560 python bench.py \
-        > "$OUT/bench3.json" 2> "$OUT/bench3.err"
-    rc=$?
-    echo "[$(STAMP)] bench rc=$rc: $(tail -c 400 "$OUT/bench3.json")"
+    # 3. whole-program strategy validation, chip leg (VERDICT #3) — a
+    #    tunnel drop mid-queue silently lands it on CPU; that's not done
+    run_step validate 900 'grep -q "\"backend\": \"tpu\"" "$OUT/validate.json"' \
+        python scripts/validate_strategies.py --budget 2000 --steps 10
 
-    # 3. whole-program strategy validation, chip leg (VERDICT #5)
-    HEADROOM 900 || { echo "[$(STAMP)] skip validate (deadline)"; break; }
-    echo "[$(STAMP)] step validate"
-    timeout 900 python scripts/validate_strategies.py --budget 2000 --steps 10 \
-        > "$OUT/validate.json" 2> "$OUT/validate.err"
-    rc=$?
-    echo "[$(STAMP)] validate rc=$rc"
+    # 4. d=64 MFU levers on the full tier: fused optimizer update +
+    #    fused-LN-at-wide-hidden arbitration (VERDICT #4). Done needs at
+    #    least one non-quarantined (TPU) ablation row on disk.
+    run_step mfu_d64 1800 'ls "$OUT"/mfu_d64/*.json >/dev/null 2>&1' \
+        bash scripts/mfu_ablation.sh "$OUT/mfu_d64"
 
-    echo "[$(STAMP)] QUEUE COMPLETE"
-    break
+    # 5. KV-cache decode throughput (carried from round 3)
+    run_step decode 1200 'grep -q "\"backend\": \"tpu\"" "$OUT/decode.json"' \
+        python scripts/decode_probe.py
+
+    if [ "$PENDING" -eq 0 ]; then
+      echo "[$(STAMP)] QUEUE COMPLETE"
+      break
+    fi
+    if [ "$DLSKIP" -eq "$PENDING" ]; then
+      # everything still pending lacks deadline headroom — stop probing
+      # (each probe holds the tunnel) so the driver owns the chip
+      echo "[$(STAMP)] all $PENDING pending steps deadline-bound; exiting"
+      break
+    fi
+    echo "[$(STAMP)] queue pass done ($PENDING steps pending); re-probing"
+  else
+    echo "[$(STAMP)] tunnel down/half-open; sleeping 150s"
   fi
-  echo "[$(STAMP)] tunnel down; sleeping 150s"
   sleep 150
 done
