@@ -321,8 +321,12 @@ class MultiHeadAttention(Op):
         sq, sk = qh.shape[1], kh.shape[1]
         if self.qk_head_dim != self.v_head_dim:
             return False
-        if self.causal and sq != sk:
-            return False  # kernel's causal mask has no cross-attn diag offset
+        if self.causal and sq > sk:
+            # more queries than keys under bottom-right-aligned causality
+            # leaves the first sq-sk rows with no live key (0/0 in the
+            # online softmax); the einsum path's uniform-softmax answer for
+            # such rows is equally meaningless, so don't pretend parity
+            return False
         # escape hatch: the streaming kernels carry no architectural length
         # cap, but if a deployment's Mosaic build rejects some long-sequence
         # compile, FF_FLASH_MAX_SEQ routes those shapes to the blockwise
@@ -344,7 +348,7 @@ class MultiHeadAttention(Op):
         if max(sq, sk) > BLOCKWISE_SEQ_THRESHOLD \
                 and self.qk_head_dim == self.v_head_dim:
             # long-context dense fallback for flash-refused shapes (CPU
-            # backend, dropout, cross-attn causal): pure-JAX blockwise
+            # backend, dropout, causal with sq > sk): pure-JAX blockwise
             # online-softmax scan (O(block) working set) with rematerialized
             # backward — an einsum here would materialize the S x S
             # probability tensor. Block size degrades to any divisor of sk

@@ -68,11 +68,16 @@ def _maybe_when(cond, fn):
         pl.when(cond)(fn)
 
 
-def _causal_mask(s, qi, ki, block_q, block_k):
+def _causal_mask(s, qi, ki, block_q, block_k, offset):
+    """Causal mask with the cross-attention diagonal offset: row q attends
+    k_pos <= q_pos + offset, offset = sk - sq (bottom-right alignment, the
+    same convention as the einsum path's tril(k=sk-sq) — reference vendor
+    kernel handled distinct q/kv lengths, attention.cu:533-570). offset is
+    a static python int; offset=0 is plain self-attention causality."""
     bq, bk = s.shape
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+    return jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
 
 
 # ---------------------------------------------------------------- forward
@@ -80,7 +85,7 @@ def _causal_mask(s, qi, ki, block_q, block_k):
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q: int,
                       block_k: int, causal: bool, scale: float,
-                      need_lse: bool):
+                      need_lse: bool, offset: int = 0):
     if need_lse:
         lse_ref, m_scr, l_scr, acc_scr = rest
     else:
@@ -95,8 +100,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q: int,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # causal: a k tile strictly after the last row of this q tile is dead
-    live = (qi + 1) * block_q > ki * block_k if causal else None
+    # causal: a k tile strictly after the (offset-shifted) last row of this
+    # q tile is dead
+    live = (qi + 1) * block_q + offset > ki * block_k if causal else None
 
     def _step():
         q = q_ref[0]  # (block_q, d) — native dtype into the MXU (bf16 fast
@@ -105,7 +111,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q: int,
         v = v_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k)
+            s = _causal_mask(s, qi, ki, block_q, block_k, offset)
         m_prev = m_scr[:, 0:1]                      # (bq, 1)
         l_prev = l_scr[:, 0:1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -146,6 +152,11 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool, scale: float,
     block_q = _pick_block(sq, block_q)
     block_k = _pick_block(sk, block_k)
     assert sq % block_q == 0 and sk % block_k == 0
+    # cross-attention diagonal offset (bottom-right aligned causality);
+    # sq > sk with causal would leave the first rows keyless (0/0 in the
+    # online softmax) — refused upstream in attention._flash_ok
+    offset = sk - sq
+    assert not (causal and offset < 0), "causal flash needs sq <= sk"
 
     # (B, S, H, D) -> (B*H, S, D)
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
@@ -154,13 +165,14 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool, scale: float,
 
     kernel = functools.partial(_flash_fwd_kernel, block_q=block_q,
                                block_k=block_k, causal=causal, scale=scale,
-                               need_lse=need_lse)
+                               need_lse=need_lse, offset=offset)
     if causal:
         # clamp dead (fully-masked) inner steps to the last live tile: the
         # revisited block is already VMEM-resident, so masked steps cost no
         # DMA (pl.when(live) already skips their compute)
         def kv_map(i, j, t):
-            return (i, jnp.minimum(t, ((j + 1) * block_q - 1) // block_k), 0)
+            return (i, jnp.minimum(
+                t, ((j + 1) * block_q - 1 + offset) // block_k), 0)
     else:
         def kv_map(i, j, t):
             return (i, t, 0)
@@ -196,7 +208,7 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool, scale: float,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, dq_scr, *, block_q: int, block_k: int,
-                         causal: bool, scale: float):
+                         causal: bool, scale: float, offset: int = 0):
     """One q tile, k/v tiles streaming: dq = scale * sum_j ds_j @ k_j,
     ds = p * (do @ v^T - delta)."""
     qi = pl.program_id(1)
@@ -207,7 +219,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    live = (qi + 1) * block_q > ki * block_k if causal else None
+    live = (qi + 1) * block_q + offset > ki * block_k if causal else None
 
     def _step():
         q = q_ref[0]
@@ -218,7 +230,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, :, 0:1]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k)
+            s = _causal_mask(s, qi, ki, block_q, block_k, offset)
         p = jnp.exp(s - lse)                                # (bq, bk)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta)).astype(k.dtype)
@@ -234,7 +246,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_scr, dv_scr, *, block_q: int,
-                          block_k: int, causal: bool, scale: float):
+                          block_k: int, causal: bool, scale: float,
+                          offset: int = 0):
     """One k tile, q/do tiles streaming:
     dv = sum_i p_i^T @ do_i; dk = scale * sum_i ds_i^T @ q_i."""
     ki = pl.program_id(1)
@@ -246,9 +259,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    # causal: a q tile strictly before the first row of this k tile sees
-    # nothing of it
-    live = (qi + 1) * block_q > ki * block_k if causal else None
+    # causal: a q tile strictly before the (offset-shifted) first row of
+    # this k tile sees nothing of it
+    live = (qi + 1) * block_q + offset > ki * block_k if causal else None
 
     def _step():
         k = k_ref[0]   # (block_k, d)
@@ -259,7 +272,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, :, 0:1]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k)
+            s = _causal_mask(s, qi, ki, block_q, block_k, offset)
         p = jnp.exp(s - lse)                               # (bq, bk)
         dv_scr[...] = dv_scr[...] + jnp.dot(
             p.astype(do.dtype).T, do, preferred_element_type=jnp.float32)
@@ -285,6 +298,8 @@ def flash_attention_bwd_pallas(q, k, v, o, lse, do, causal: bool,
     block_q = _pick_block(sq, block_q)
     block_k = _pick_block(sk, block_k)
     assert sq % block_q == 0 and sk % block_k == 0
+    offset = sk - sq
+    assert not (causal and offset < 0), "causal flash needs sq <= sk"
 
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
@@ -310,10 +325,13 @@ def flash_attention_bwd_pallas(q, k, v, o, lse, do, causal: bool,
         # dead-tile clamps (see forward): masked inner steps re-reference a
         # resident block instead of fetching one
         def kv_map(i, j, t):
-            return (i, jnp.minimum(t, ((j + 1) * block_q - 1) // block_k), 0)
+            return (i, jnp.minimum(
+                t, ((j + 1) * block_q - 1 + offset) // block_k), 0)
 
         def q_map(i, j, t):
-            return (i, jnp.maximum(t, (j * block_k) // block_q), 0)
+            # first q tile whose last row reaches this k tile: q_pos >=
+            # j*block_k - offset (floor div handles the negative numerator)
+            return (i, jnp.maximum(t, (j * block_k - offset) // block_q), 0)
     else:
         def kv_map(i, j, t):
             return (i, t, 0)
@@ -322,7 +340,8 @@ def flash_attention_bwd_pallas(q, k, v, o, lse, do, causal: bool,
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
-                          block_k=block_k, causal=causal, scale=scale),
+                          block_k=block_k, causal=causal, scale=scale,
+                          offset=offset),
         grid=(b * h, sq // block_q, sk // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0)),
@@ -341,7 +360,8 @@ def flash_attention_bwd_pallas(q, k, v, o, lse, do, causal: bool,
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
-                          block_k=block_k, causal=causal, scale=scale),
+                          block_k=block_k, causal=causal, scale=scale,
+                          offset=offset),
         grid=(b * h, sk // block_k, sq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), q_map),
