@@ -107,14 +107,20 @@ class Op:
         machinery (linear.cu:171-192,774-835)."""
         return None
 
+    def pipeline_stages(self) -> int:
+        """Number of identical stacked layers this op can split into pipeline
+        stages (STAGE axis_map proposals): 0 = not pipelineable. Ops with a
+        stacked-layer weight layout (TransformerPipelineStack) return their
+        layer count; the search proposes {axis: STAGE} when the axis size
+        divides it."""
+        return 0
+
     def output_axis_map(self, axis_map: Dict[str, Optional[int]]
                         ) -> Dict[str, Optional[int]]:
         """The sharding the op's OUTPUT actually has under `axis_map`:
-        CONTRACT axes produce a psum-replicated output, so consumers see
-        them as replicated."""
-        from flexflow_tpu.parallel.pconfig import CONTRACT
-
-        return {ax: (None if d == CONTRACT else d)
+        CONTRACT and STAGE axes produce a psum-replicated output, so
+        consumers see them as replicated."""
+        return {ax: (d if d is not None and d >= 0 else None)
                 for ax, d in (axis_map or {}).items()}
 
     def weight_partition(self, axis_map: Dict[str, Optional[int]]):

@@ -115,31 +115,63 @@ class TransformerPipelineStack(Op):
 
     # -- parallelization -------------------------------------------------------
 
-    def _pipe_stages(self) -> int:
-        mesh_shape = getattr(self.model.config, "mesh_shape", None) or {}
-        s = mesh_shape.get("pipe", 1)
+    def pipeline_stages(self) -> int:
+        # the search proposes {axis: STAGE} when the axis size divides this
+        return self.num_layers
+
+    def _stage_axis(self, axis_map, mesh_shape=None):
+        """(axis_name, n_stages) the stack pipelines over: a STAGE
+        assignment in the strategy's axis_map (search-discovered PP — any
+        mesh axis name), else the legacy convention of a mesh axis literally
+        named 'pipe'. (None, 1) = run serial.
+
+        `mesh_shape` defaults to the model config's, but callers holding
+        the authoritative mesh (forward's shard_ctx; a search over a
+        mesh_shape override) pass theirs — a STAGE assignment must not be
+        silently degraded just because config.mesh_shape lacks the axis."""
+        from flexflow_tpu.parallel.pconfig import STAGE
+
+        if mesh_shape is None:
+            mesh_shape = getattr(self.model.config, "mesh_shape", None) or {}
+        ax = next((a for a, d in (axis_map or {}).items() if d == STAGE),
+                  None)
+        if ax is None and mesh_shape.get("pipe", 1) > 1:
+            ax = "pipe"
+        if ax is None:
+            return None, 1
+        s = mesh_shape.get(ax, 1)
         if s > 1 and self.num_layers % s != 0:
             if not getattr(self, "_warned_pipe_mismatch", False):
                 self._warned_pipe_mismatch = True
                 from flexflow_tpu.logger import fflogger
 
                 fflogger.warning(
-                    "%s: num_layers=%d not divisible by pipe axis size %d — "
-                    "pipeline parallelism DISABLED, running serial on "
-                    "replicated weights (the %d pipe devices stay idle)",
-                    self.name, self.num_layers, s, s)
-            return 1
-        return s if s > 1 else 1
+                    "%s: num_layers=%d not divisible by stage axis %r "
+                    "size %d — pipeline parallelism DISABLED, running "
+                    "serial on replicated weights (the %d devices stay "
+                    "idle)", self.name, self.num_layers, ax, s, s)
+            return None, 1
+        return (ax, s) if s > 1 else (None, 1)
 
     def weight_partition(self, axis_map):
         from jax.sharding import PartitionSpec as P
+        from flexflow_tpu.parallel.pconfig import STAGE
 
-        if self._pipe_stages() > 1:
-            # layer dim (0) over 'pipe' — each stage owns its layers' weights
-            # (the SharedVariable-per-node placement analog, rnn.h:37-51)
-            return {w.name: P(*(["pipe"] + [None] * (len(w.shape) - 1)))
-                    for w in self.weight_specs()}
-        return super().weight_partition(axis_map)
+        # a STAGE assignment shards the layer dim over its axis
+        # UNCONDITIONALLY of config.mesh_shape: the proposer (search over a
+        # possibly-overridden mesh) already validated divisibility, and the
+        # cost model's grad-sync pricing keys off this spec — degrading to
+        # replicated here would charge PP candidates DP's all-reduce
+        ax = next((a for a, d in (axis_map or {}).items() if d == STAGE),
+                  None)
+        if ax is None:
+            ax, stages = self._stage_axis(axis_map)
+            if stages <= 1:
+                return super().weight_partition(axis_map)
+        # each stage owns its layers' weights (SharedVariable-per-node
+        # analog, rnn.h:37-51)
+        return {w.name: P(*([ax] + [None] * (len(w.shape) - 1)))
+                for w in self.weight_specs()}
 
     def partitionable_output_dims(self):
         return [0]
@@ -156,10 +188,12 @@ class TransformerPipelineStack(Op):
         x = xs[0]
         L, H, causal = self.num_layers, self.num_heads, self.causal
         use_flash = getattr(self.model.config, "use_flash_attention", True)
-        stages = self._pipe_stages()
+        axis_map = (shard_ctx.get("axis_map") or {}) if shard_ctx else {}
         mesh = shard_ctx["mesh"] if shard_ctx else None
+        pipe_axis, stages = self._stage_axis(
+            axis_map, dict(mesh.shape) if mesh is not None else None)
 
-        if stages > 1 and mesh is not None and "pipe" in mesh.shape:
+        if stages > 1 and mesh is not None and pipe_axis in mesh.shape:
             from flexflow_tpu.parallel.pipeline import pipeline
 
             per_stage = L // stages
@@ -178,13 +212,14 @@ class TransformerPipelineStack(Op):
             # the axis sharding the batch dim comes from the strategy, not a
             # hardcoded name — a mesh calling its data axis something else
             # must still shard microbatches over it
-            axis_map = (shard_ctx.get("axis_map") or {}) if shard_ctx else {}
             batch_axes = [ax for ax, d in axis_map.items()
-                          if d == 0 and ax != "pipe"
+                          if d == 0 and ax != pipe_axis
                           and mesh.shape.get(ax, 1) > 1]
             data_axis = batch_axes[0] if batch_axes else None
             return [pipeline(stage_fn, stacked, x, mesh,
-                             num_microbatches=num_micro, data_axis=data_axis)]
+                             axis_name=pipe_axis,
+                             num_microbatches=num_micro,
+                             data_axis=data_axis)]
 
         def body(hh, lp):
             return _block(lp, hh, H, causal, use_flash), None

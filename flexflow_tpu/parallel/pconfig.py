@@ -30,6 +30,16 @@ from typing import Dict, List, Optional, Tuple
 # (linear.cu:171-192,774-835: replicated input + backward2 reduction).
 CONTRACT = -2
 
+# axis_map value meaning "run this op PIPELINED over this mesh axis": the op's
+# layer/stage dim (a weight dim, not an output dim — only ops exposing
+# pipeline_stages() accept it) shards over the axis and microbatches ripple
+# through a ppermute ring (parallel/pipeline.py). Like CONTRACT, the output is
+# delivered replicated over the axis, so it never appears in output
+# PartitionSpecs. The reference's only pipelining was the hand-scheduled NMT
+# per-(layer,timestep) device tables (nmt/rnn.h:21-63); here PP is a
+# first-class strategy-search axis.
+STAGE = -3
+
 
 @dataclasses.dataclass
 class ParallelConfig:
@@ -63,6 +73,11 @@ class ParallelConfig:
         for ax, d in axis_map.items():
             if d == CONTRACT:
                 contract_deg *= mesh_shape[ax]
+            elif d == STAGE:
+                # stage degree shards a WEIGHT dim, not an output dim — it
+                # lives only in the axis_map (degree lists follow the
+                # reference file schema, which has no PP concept)
+                continue
             elif d is not None:
                 dims[d] *= mesh_shape[ax]
         if contract_deg > 1:

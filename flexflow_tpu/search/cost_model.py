@@ -48,13 +48,11 @@ def _shard_degree_on_dim(axis_map: AxisMap, mesh_shape: Dict[str, int],
 
 
 def _parts_out(axis_map: AxisMap, mesh_shape: Dict[str, int]) -> int:
-    """Partition count of the op's OUTPUT: CONTRACT axes shard inputs and
-    weights but deliver a psum-replicated output, so they are excluded."""
-    from flexflow_tpu.parallel.pconfig import CONTRACT
-
+    """Partition count of the op's OUTPUT: CONTRACT and STAGE axes shard
+    inputs/weights but deliver a replicated output, so they are excluded."""
     n = 1
     for ax, d in (axis_map or {}).items():
-        if d is not None and d != CONTRACT:
+        if d is not None and d >= 0:
             n *= mesh_shape[ax]
     return n
 
@@ -108,11 +106,13 @@ class CostModel:
     # ---- per-op --------------------------------------------------------------
 
     def op_compute_time(self, op: Op, axis_map: AxisMap) -> float:
-        from flexflow_tpu.parallel.pconfig import CONTRACT
+        from flexflow_tpu.parallel.pconfig import CONTRACT, STAGE
 
         parts = _parts(axis_map, self.mesh_shape)
         contract_axes = [ax for ax, d in (axis_map or {}).items()
                          if d == CONTRACT]
+        stage_axes = [ax for ax, d in (axis_map or {}).items()
+                      if d == STAGE]
         t = None
         if self.measured:
             # real-device measurement keyed by choice_key — per-shard output
@@ -125,7 +125,11 @@ class CostModel:
                              self.mesh_shape)
             if key in self.measured:
                 t = self.measured[key]
-            elif not contract_axes and (op.name, parts) in self.measured:
+            elif (not contract_axes and not stage_axes
+                    and (op.name, parts) in self.measured):
+                # the legacy parts-keyed fallback cannot distinguish
+                # weight-sharding markers from output sharding — a STAGE
+                # choice must not read a data-parallel shard's timing
                 t = self.measured[(op.name, parts)]
         if t is None:
             flops = op.flops() / max(parts, 1)
@@ -151,6 +155,29 @@ class CostModel:
             for ax in contract_axes:
                 t += 2.0 * self.machine.all_reduce_time(
                     out_bytes, self.mesh_shape[ax], ax)
+        # STAGE (pipeline-parallel) axes: the op's layers shard n ways (the
+        # 1/n compute is already in `parts`), but the schedule pays (a) the
+        # pipeline bubble — (m + n - 1)/m with m microbatches — and (b) the
+        # boundary-activation p2p: one ppermute of a microbatch activation
+        # per tick, forward and backward (2x; the 1F1B recompute re-reads
+        # stashed inputs locally, no extra hop). Priced on top of either
+        # cost tier, like CONTRACT's psum.
+        if stage_axes:
+            n = 1
+            for ax in stage_axes:
+                n *= self.mesh_shape[ax]
+            # the runtime honors num_microbatches verbatim (pipeline()
+            # defaults to n when unset) — so must the bubble price: a
+            # clamp would underprice m < n configurations
+            m = int(getattr(op, "num_microbatches", 0) or 0) or n
+            ticks = m + n - 1
+            t *= ticks / m  # bubble stretches the compute timeline
+            out_bytes = (sum(t_.volume() for t_ in op.outputs)
+                         * self.dtype_bytes
+                         / max(_parts_out(axis_map, self.mesh_shape), 1))
+            mb_bytes = out_bytes / m
+            t += 2.0 * ticks * (mb_bytes / self.machine.ici_bw
+                                + self.machine.ici_latency)
         return t
 
     def op_grad_sync_time(self, op: Op, axis_map: AxisMap) -> float:

@@ -36,7 +36,7 @@ def legal_axis_maps(op, mesh_shape: Dict[str, int],
     --enable-attribute-parallel for conv spatial dims, model.cc:2027 — minus
     the upstream bug where the latter sets the former)."""
     from flexflow_tpu.ffconst import OperatorType
-    from flexflow_tpu.parallel.pconfig import CONTRACT
+    from flexflow_tpu.parallel.pconfig import CONTRACT, STAGE
 
     dims = list(op.partitionable_output_dims())
     out_shape = op.outputs[0].dims
@@ -78,6 +78,13 @@ def legal_axis_maps(op, mesh_shape: Dict[str, int],
                         deg *= mesh_shape[a2]
                 if csize % deg == 0:
                     new_maps.append({**m, ax: CONTRACT})
+            # STAGE (pipeline-parallel) proposals: one mesh axis becomes the
+            # ppermute ring the op's stacked layers pipeline over. Single
+            # axis only — the GPipe/1F1B loop rotates around ONE named axis
+            stages = op.pipeline_stages()
+            if (stages and stages % size == 0 and size > 1
+                    and not any(d2 == STAGE for d2 in m.values())):
+                new_maps.append({**m, ax: STAGE})
         maps = new_maps
     return maps
 

@@ -45,14 +45,24 @@ def choice_key(op_name: str, out_dims, axis_map,
     alone cannot distinguish CONTRACT (row-parallel) from plain data
     parallelism — contract axes shard the inputs and weights, not the
     output — so the contract degree is appended when present."""
-    from flexflow_tpu.parallel.pconfig import CONTRACT
+    from flexflow_tpu.parallel.pconfig import CONTRACT, STAGE
 
     cdeg = 1
+    sdeg = 1
     for ax, d in (axis_map or {}).items():
         if d == CONTRACT:
             cdeg *= mesh_shape.get(ax, 1)
+        elif d == STAGE:
+            # STAGE shards the layer dim of the WEIGHTS (measured as one
+            # stage's slice over the full batch); the output shape alone
+            # would collide with the replicated choice
+            sdeg *= mesh_shape.get(ax, 1)
     key = (op_name, shard_shape(out_dims, axis_map, mesh_shape))
-    return key if cdeg == 1 else key + (("contract", cdeg),)
+    if cdeg > 1:
+        key = key + (("contract", cdeg),)
+    if sdeg > 1:
+        key = key + (("stage", sdeg),)
+    return key
 
 
 def _op_signature(op: Op, in_shapes, w_shapes) -> Tuple:
