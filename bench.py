@@ -154,12 +154,16 @@ def _run_tier(tier, n_dev, compute, peak, peak_src, backend, dev_kind):
         if os.environ.get("FF_BENCH_FUSED_LN"):
             levers["use_fused_ln"] = \
                 os.environ["FF_BENCH_FUSED_LN"] == "1"
+        if os.environ.get("FF_BENCH_FUSED_OPT"):
+            levers["fused_optimizer"] = \
+                os.environ["FF_BENCH_FUSED_OPT"] == "1"
     master = (levers or {}).get("master_dtype", "float32")
     fused_ln = (levers or {}).get("use_fused_ln", False)
+    fused_opt = bool((levers or {}).get("fused_optimizer", False))
     scan_mode = bool((levers or {}).get("scan", False))
     cfg = FFConfig(batch_size=batch, mesh_shape={"data": n_dev},
                    compute_dtype=compute, master_dtype=master,
-                   use_fused_ln=fused_ln)
+                   use_fused_ln=fused_ln, fused_optimizer=fused_opt)
     ff = FFModel(cfg)
     x, out = build_encoder_classifier(ff, batch, seq, hidden, layers, heads)
     ff.compile(SGDOptimizer(lr=0.01),
@@ -229,7 +233,7 @@ def _run_tier(tier, n_dev, compute, peak, peak_src, backend, dev_kind):
         "config": {"batch": batch, "seq": seq, "hidden": hidden,
                    "layers": layers, "heads": heads, "dtype": compute,
                    "master_dtype": master, "fused_ln": fused_ln,
-                   "scan": scan_mode},
+                   "fused_opt": fused_opt, "scan": scan_mode},
     }
 
 

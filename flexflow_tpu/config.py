@@ -75,6 +75,11 @@ class FFConfig:
     # fuse residual-add + layernorm into one Pallas kernel in models that
     # opt in (models/transformer.py encoder blocks)
     use_fused_ln: bool = False
+    # single-fusion optimizer update over flattened param buckets
+    # (runtime/optimizer.py FusedUpdate): one elementwise kernel per dtype
+    # instead of one per weight. Applies only when every param is
+    # replicated (single chip / pure DP); sharded strategies fall back
+    fused_optimizer: bool = False
     use_flash_attention: bool = True  # Pallas flash kernel on the dense path
     # multi-step scanned training (executor.make_train_scan): fit() runs up
     # to this many steps per device dispatch via lax.scan — the TPU-native
@@ -93,6 +98,10 @@ class FFConfig:
     # all-gathers at use and reduce-scatters the gradient. Param + opt
     # HBM divides by the axis size. "" = off.
     fsdp_axis: str = ""
+    # label value excluded from token-level accuracy (count AND
+    # denominator) — set to the pad id for causal-LM training so padded
+    # positions don't dilute the metric; None counts every position
+    metrics_ignore_index: int = None
     # keep datasets device-resident (next_batch = on-device slice, the
     # reference's ZC-resident design) when they fit the budget
     device_resident_data: bool = True

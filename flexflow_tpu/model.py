@@ -511,6 +511,8 @@ class FFModel:
         self.params = self.executor.init_params(init_key)
         self.bn_state = self.executor.init_state()
         if self.optimizer is not None:
+            if getattr(self.config, "fused_optimizer", False):
+                self.optimizer = self._maybe_fuse_optimizer(self.optimizer)
             self.opt_state = self.optimizer.init_state(self.params)
             self._train_step = self.executor.make_train_step(
                 self.optimizer, self.loss_type, self.metric_types,
@@ -522,6 +524,32 @@ class FFModel:
             from flexflow_tpu.runtime.profiler import export_sim_taskgraph
 
             export_sim_taskgraph(self, cfg.taskgraph_file)
+
+    def _maybe_fuse_optimizer(self, opt):
+        """FFConfig.fused_optimizer: wrap in FusedUpdate when every param
+        is replicated; sharded strategies (TP/FSDP) and operator-placement
+        lowering fall back to the per-leaf update — flattening leaves that
+        live on different sub-meshes (or GSPMD-sharded ones) would force
+        cross-mesh copies / all-gathers per step."""
+        from flexflow_tpu.logger import fflogger
+        from flexflow_tpu.runtime.optimizer import FusedUpdate
+
+        if getattr(self.executor, "jits_per_group", False):
+            fflogger.warning(
+                "fused_optimizer: unsupported under an operator-placement "
+                "strategy (params live on disjoint sub-meshes) — using "
+                "the per-leaf update")
+            return opt
+        if self.mesh is not None and self.mesh.devices.size > 1:
+            for op_name, per_op in self.executor.param_shardings().items():
+                for w_name, ns in per_op.items():
+                    if any(e is not None for e in ns.spec):
+                        fflogger.warning(
+                            "fused_optimizer: weight %s/%s is sharded "
+                            "(%s) — using the per-leaf update",
+                            op_name, w_name, ns.spec)
+                        return opt
+        return FusedUpdate(opt)
 
     # ---------------------------------------------------------- train verbs
 
@@ -762,9 +790,21 @@ class FFModel:
         length-penalty-normalized total logp of the chosen beam for beam
         search. prompt_lengths (B,) enables ragged right-padded prompts.
         num_beams > 1 switches to beam search (temperature/top_k ignored
-        there; uniform-length prompts only). quantize="int8" decodes
+        there; uniform-length prompts only). length_penalty follows the
+        norm score/len**penalty — the default 0.0 means RAW SUM of
+        logprobs (length-biased toward short beams; HF-style length
+        normalization is length_penalty=1.0). quantize="int8" decodes
         with weight-only int8 (lossy; halves weight HBM traffic vs
-        bf16). prefill_chunk=N bounds prefill score memory."""
+        bf16). prefill_chunk=N bounds prefill score memory.
+
+        Compilation caching: each distinct (sampling config) keeps a
+        Generator, and each distinct (max_new_tokens, ragged,
+        prefill_chunk, scores | beam params) + prompt SHAPE traces its
+        own XLA program. Programs are LRU-bounded per Generator
+        (FF_GEN_PROGRAM_CACHE, default 8) so a long-lived serving
+        process sweeping shapes doesn't accumulate compiled programs
+        without bound; sweeping sampling configs still grows
+        _generators — reuse temperatures/top_k where possible."""
         from flexflow_tpu.runtime.generation import Generator
 
         # beam search ignores temperature/top_k: key those out so a

@@ -423,7 +423,10 @@ class PlacementExecutor:
 
         def loss_mets(logits, labels):
             loss = compute_loss(loss_type, logits, labels)
-            mets = batch_metrics(loss_type, metric_types, logits, labels)
+            mets = batch_metrics(
+                loss_type, metric_types, logits, labels,
+                ignore_index=getattr(self.model.config,
+                                     "metrics_ignore_index", None))
             return loss, mets
 
         loss_jit = jax.jit(loss_mets)
@@ -466,7 +469,10 @@ class PlacementExecutor:
                     loss = loss + a
                 return loss
             loss, dlogits = jax.value_and_grad(f)(logits)
-            mets = batch_metrics(loss_type, metric_types, logits, labels)
+            mets = batch_metrics(
+                loss_type, metric_types, logits, labels,
+                ignore_index=getattr(self.model.config,
+                                     "metrics_ignore_index", None))
             return loss, dlogits, mets
 
         loss_jit = jax.jit(loss_and_grad_logits)

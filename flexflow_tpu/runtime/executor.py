@@ -287,8 +287,10 @@ class GraphExecutor:
             loss = compute_loss(loss_type, logits, batch[label_key])
             for t in aux_tensors:  # e.g. MoE load-balancing losses
                 loss = loss + vals[t]
-            mets = batch_metrics(loss_type, metric_types, logits,
-                                 batch[label_key])
+            mets = batch_metrics(
+                loss_type, metric_types, logits, batch[label_key],
+                ignore_index=getattr(self.model.config,
+                                     "metrics_ignore_index", None))
             return loss, (new_state, mets)
 
         def step(params, opt_state, state, batch, rng):
@@ -406,7 +408,10 @@ class GraphExecutor:
                                        training=False, rng=None)
             logits = vals[final_tensor]
             loss = compute_loss(loss_type, logits, batch[label_key])
-            mets = batch_metrics(loss_type, metric_types, logits, batch[label_key])
+            mets = batch_metrics(
+                loss_type, metric_types, logits, batch[label_key],
+                ignore_index=getattr(self.model.config,
+                                     "metrics_ignore_index", None))
             return loss, mets, logits
 
         return jax.jit(step)

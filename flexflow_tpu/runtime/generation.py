@@ -97,7 +97,13 @@ class Generator:
         self._qparams = None
         self._qparams_key = None
         self._q_refs = None
-        self._jitted: Dict = {}
+        # compiled decode programs, LRU-bounded (FF_GEN_PROGRAM_CACHE,
+        # default 8): a long-lived serving process sweeping
+        # max_new_tokens/prompt shapes must not accumulate XLA programs
+        # (and their device buffers) for the life of the model
+        import collections
+
+        self._jitted: Dict = collections.OrderedDict()
 
         if getattr(model.executor, "jits_per_group", False):
             raise NotImplementedError(
@@ -345,9 +351,19 @@ class Generator:
             tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         else:
             warped = logits / self.temperature
+            vocab = logits.shape[-1]
+            if self.top_k >= vocab:
+                raise ValueError(
+                    f"top_k={self.top_k} >= vocab size {vocab} — the "
+                    f"filter would be a no-op; use top_k=0 for plain "
+                    f"temperature sampling")
             if self.top_k > 0:
-                kth = jax.lax.top_k(warped, self.top_k)[0][:, -1:]
-                warped = jnp.where(warped < kth, -jnp.inf, warped)
+                # scatter from the top_k indices (not a >=kth threshold
+                # compare, which keeps every logit TIED with the k-th
+                # value — more than k candidates on ties)
+                vals, idxs = jax.lax.top_k(warped, self.top_k)
+                warped = jnp.full_like(warped, -jnp.inf).at[
+                    jnp.arange(warped.shape[0])[:, None], idxs].set(vals)
             tok = jax.random.categorical(key, warped, axis=-1
                                          ).astype(jnp.int32)
         if not with_score:
@@ -496,6 +512,23 @@ class Generator:
         return (self._quantized_params() if self.quantize
                 else self.model.params)
 
+    def _cached_program(self, key, build):
+        """LRU lookup/insert for compiled decode programs."""
+        import os
+
+        fn = self._jitted.get(key)
+        if fn is not None:
+            self._jitted.move_to_end(key)
+            return fn
+        fn = self._jitted[key] = build()
+        try:
+            cap = int(os.environ.get("FF_GEN_PROGRAM_CACHE", "8") or 8)
+        except ValueError:
+            cap = 8
+        while cap > 0 and len(self._jitted) > cap:
+            self._jitted.popitem(last=False)
+        return fn
+
     def beam_search(self, tokens: np.ndarray, max_new_tokens: int,
                     num_beams: int, length_penalty: float = 0.0,
                     prefill_chunk: int = 0, return_scores: bool = False):
@@ -503,12 +536,14 @@ class Generator:
             raise ValueError(
                 f"prefill_chunk must be >= 0, got {prefill_chunk}")
         tokens = jnp.asarray(tokens, jnp.int32)
+        # prompt shape is part of the key: each LRU entry then holds ~one
+        # XLA executable, so eviction genuinely bounds compiled programs
+        # (a shape-generic jit wrapper would grow an unbounded internal
+        # per-shape cache behind a single key)
         key = ("beam", max_new_tokens, num_beams, length_penalty,
-               prefill_chunk)
-        fn = self._jitted.get(key)
-        if fn is None:
-            fn = self._jitted[key] = self._build_beam(
-                max_new_tokens, num_beams, length_penalty, prefill_chunk)
+               prefill_chunk, tuple(tokens.shape))
+        fn = self._cached_program(key, lambda: self._build_beam(
+            max_new_tokens, num_beams, length_penalty, prefill_chunk))
         out, score = fn(self._params(), self.model.bn_state, tokens)
         if return_scores:
             # (B,) length-penalty-normalized total logp of the chosen beam
@@ -547,12 +582,13 @@ class Generator:
             raise NotImplementedError(
                 "prefill_chunk + prompt_lengths is unsupported: a ragged "
                 "row's last position can fall in an earlier chunk")
-        cache_key = (max_new_tokens, ragged, prefill_chunk, return_scores)
-        fn = self._jitted.get(cache_key)
-        if fn is None:
-            fn = self._jitted[cache_key] = self._build(
-                max_new_tokens, ragged, prefill_chunk,
-                with_scores=return_scores)
+        # prompt shape in the key: see beam_search — makes LRU eviction
+        # actually bound compiled executables, not just jit wrappers
+        cache_key = (max_new_tokens, ragged, prefill_chunk, return_scores,
+                     tuple(tokens.shape))
+        fn = self._cached_program(cache_key, lambda: self._build(
+            max_new_tokens, ragged, prefill_chunk,
+            with_scores=return_scores))
         key = jax.random.PRNGKey(seed)
         res = fn(self._params(), self.model.bn_state, tokens, key, lengths)
         if return_scores:

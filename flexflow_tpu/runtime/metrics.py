@@ -69,8 +69,14 @@ class PerfMetrics:
 
 
 def batch_metrics(loss_type: LossType, metric_types: Sequence[MetricsType],
-                  logits, labels) -> Dict[str, jnp.ndarray]:
-    """Per-batch metric values, computed inside the jitted step (sharded)."""
+                  logits, labels,
+                  ignore_index: int = None) -> Dict[str, jnp.ndarray]:
+    """Per-batch metric values, computed inside the jitted step (sharded).
+
+    ignore_index (FFConfig.metrics_ignore_index): label value excluded
+    from token-level accuracy — both the correct count AND the
+    denominator — so padded causal-LM batches aren't diluted by pad
+    positions. None = count every position."""
     out: Dict[str, jnp.ndarray] = {}
     lab = labels
     for m in metric_types:
@@ -84,8 +90,13 @@ def batch_metrics(loss_type: LossType, metric_types: Sequence[MetricsType],
                 if li.ndim == logits.ndim:
                     li = li[..., 0]
                 pred = jnp.argmax(logits, axis=-1)
-                out["accuracy_count"] = jnp.sum(pred == li)
-                out["accuracy_total"] = jnp.asarray(pred.size, jnp.int32)
+                if ignore_index is not None:
+                    live = li != ignore_index
+                    out["accuracy_count"] = jnp.sum((pred == li) & live)
+                    out["accuracy_total"] = jnp.sum(live).astype(jnp.int32)
+                else:
+                    out["accuracy_count"] = jnp.sum(pred == li)
+                    out["accuracy_total"] = jnp.asarray(pred.size, jnp.int32)
             elif loss_type == LossType.LOSS_CATEGORICAL_CROSSENTROPY:
                 pred = jnp.argmax(logits, axis=-1)
                 out["accuracy_count"] = jnp.sum(pred == jnp.argmax(lab, axis=-1))

@@ -37,6 +37,75 @@ def _f32_view(*arrays):
                  for a in arrays)
 
 
+class FusedUpdate(Optimizer):
+    """Single-fusion optimizer update over flattened parameter buckets
+    (FFConfig.fused_optimizer; VERDICT r3 #4 MFU lever for d=64-class
+    models with many leaves).
+
+    The per-leaf tree_map update emits one elementwise loop per weight —
+    ~100 kernel launches of mostly-tiny arrays on a transformer. Here all
+    leaves of one storage dtype flatten into ONE vector inside the jitted
+    step: XLA fuses the concatenate into the elementwise read and the
+    splits into the write, so the whole update compiles to one fused loop
+    per dtype bucket; optimizer STATE is stored genuinely flat across
+    steps (init_state sees the flat pytree), so it pays no reshaping at
+    all. Values are bit-identical to the unfused update (same elementwise
+    formula, concat changes no values) — tested.
+
+    Only valid when every parameter is replicated (single device, or pure
+    DP): flattening GSPMD-sharded leaves would force all-gathers. The
+    compile path checks this and falls back to the inner optimizer.
+    NOTE: the optimizer-state pytree shape differs from the unfused
+    layout, so checkpoints written with fused_optimizer on must be
+    restored with it on (and vice versa)."""
+
+    def __init__(self, inner: Optimizer):
+        self.inner = inner
+
+    # schedule etc. proxied for code that introspects the optimizer
+    def __getattr__(self, name):
+        if name == "inner":
+            raise AttributeError(name)
+        return getattr(self.inner, name)
+
+    @staticmethod
+    def _flatten(tree):
+        """pytree -> ({dtype_name: 1-D vector}, spec) where spec rebuilds
+        the original tree. Bucket membership/order follows the flatten
+        order, which is stable for a fixed tree structure."""
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        order = {}
+        for i, leaf in enumerate(leaves):
+            order.setdefault(jnp.dtype(leaf.dtype).name, []).append(i)
+        flat = {dt: (jnp.concatenate([leaves[i].ravel() for i in idxs])
+                     if len(idxs) > 1 else leaves[idxs[0]].ravel())
+                for dt, idxs in order.items()}
+        spec = (treedef, [(jnp.dtype(l.dtype).name, l.shape, l.size)
+                          for l in leaves])
+        return flat, spec
+
+    @staticmethod
+    def _unflatten(flat, spec):
+        treedef, leaf_info = spec
+        cursors = {dt: 0 for dt in flat}
+        leaves = []
+        for dt, shape, size in leaf_info:
+            c = cursors[dt]
+            leaves.append(flat[dt][c:c + size].reshape(shape))
+            cursors[dt] = c + size
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def init_state(self, params):
+        flat, _ = self._flatten(params)
+        return self.inner.init_state(flat)
+
+    def update(self, params, grads, state):
+        fp, spec = self._flatten(params)
+        fg, _ = self._flatten(grads)
+        nfp, nstate = self.inner.update(fp, fg, state)
+        return self._unflatten(nfp, spec), nstate
+
+
 class SGDOptimizer(Optimizer):
     def __init__(self, model=None, lr: float = 0.01, momentum: float = 0.0,
                  nesterov: bool = False, weight_decay: float = 0.0,

@@ -66,7 +66,16 @@ def main():
             kv_avg = batch * (prompt_len + new / 2) * kvh * d * 2 * 2 * layers
             pbytes = param_bytes(ff)
             if quant == "int8":
-                pbytes = pbytes // 2  # int8 vs bf16 storage
+                # bytes of the ACTUAL quantized pytree (q + per-channel
+                # scales + the 1-D weights that stay full precision) —
+                # pbytes//2 overstates the cut and the reported bandwidth
+                import jax as _jax
+
+                gen = next(g for g in ff._generators.values()
+                           if g.quantize == "int8")
+                pbytes = sum(
+                    x.nbytes for x in
+                    _jax.tree_util.tree_leaves(gen._quantized_params()))
             hbm_gbs = (pbytes + kv_avg) / (wall / new) / 1e9
             print(json.dumps({
                 "metric": "llama_decode_throughput", "unit": "tokens/s",

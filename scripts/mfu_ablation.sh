@@ -1,26 +1,30 @@
 #!/bin/bash
-# MFU-lever ablation on the bench `full` config (VERDICT r2 #4).
-# Runs the bench CHILD directly, one lever combination per process, on the
-# full_scan_opt tier with env-overridden levers: the scanned tier runs all
-# iters inside ONE device program, so the rows are free of the tunnel's
-# per-dispatch latency and isolate the levers themselves.
+# MFU-lever ablation, round 4 (VERDICT r3 #4): quantify the fused
+# single-kernel optimizer update on the d=64 `full` config — the shape
+# imported BERT/ViT models actually have — and arbitrate the parked
+# fused-LN kernel in its claimed wide-hidden regime (hidden 4096).
+# Runs the bench CHILD directly, one lever combination per process, on
+# scanned tiers (all iters inside ONE device program — rows free of the
+# tunnel's per-dispatch latency).
 # Strictly serialized: the axon tunnel wedges a second jax process at
 # `import jax`, so never run this while any other jax process (bench,
 # tests, search) is alive.
 #
-# Rows: base (both off) = the staged bench's full_scan tier; both on =
-# its full_scan_opt tier; this script fills in the two single-lever rows.
+# Baseline rows come from the staged bench itself: full_scan (no levers),
+# full_scan_opt (bf16 master only), xxl_scan (bf16 master, no fused LN).
 set -x
 OUT=${1:-/tmp/mfu_ablation}
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 
-run_combo() { # name master_dtype fused_ln
-  # deadline via shell arithmetic — spawning python here would dial the
-  # tunnel through sitecustomize and can hang if it is half-open
+ALL_TIERS="tiny,mid,full,full_scan,full_scan_opt,xl_scan,xxl_scan"
+
+run_combo() { # name tier master_dtype fused_ln fused_opt
+  local skip
+  skip=$(echo "$ALL_TIERS" | tr ',' '\n' | grep -v "^$2\$" | paste -sd,)
   FF_BENCH_CHILD=1 \
-  FF_BENCH_SKIP_TIERS=tiny,mid,full,full_scan,xl_scan \
-  FF_BENCH_MASTER_DTYPE="$2" FF_BENCH_FUSED_LN="$3" \
+  FF_BENCH_SKIP_TIERS="$skip" \
+  FF_BENCH_MASTER_DTYPE="$3" FF_BENCH_FUSED_LN="$4" FF_BENCH_FUSED_OPT="$5" \
   FF_BENCH_DEADLINE=$(($(date +%s) + 540)) \
   timeout 560 python bench.py > "$OUT/$1.json" 2> "$OUT/$1.err"
   # a tunnel drop makes the child fall back to a CPU cpu_smoke run that
@@ -31,6 +35,11 @@ run_combo() { # name master_dtype fused_ln
   fi
 }
 
-run_combo bf16_master_only bfloat16 0
-run_combo fused_ln_only float32 1
+# fused optimizer on the d=64 full config: alone, then with bf16 master
+# (the full-tier >=0.62 candidate)
+run_combo fused_opt_only   full_scan_opt float32  0 1
+run_combo bf16_fused_opt   full_scan_opt bfloat16 0 1
+# fused-LN arbitration at hidden 4096 (its claimed win regime; baseline =
+# the staged bench's plain xxl_scan row)
+run_combo fused_ln_wide    xxl_scan      bfloat16 1 0
 echo "mfu_ablation: done; results in $OUT"

@@ -187,3 +187,45 @@ def test_device_resident_dataloader_stages_and_slices():
                                rtol=1e-6)
     dl.unstage()
     assert dl._dev_data is None
+
+
+def test_batch_metrics_ignore_index():
+    """Token-accuracy pad mask (ADVICE r3): ignore_index excludes pad
+    positions from both the correct count and the denominator."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu.ffconst import LossType, MetricsType
+    from flexflow_tpu.runtime.metrics import batch_metrics
+
+    logits = jnp.asarray(np.eye(4, dtype=np.float32)[None])  # (1, 4, 4)
+    labels = jnp.asarray([[0, 1, 9, 9]], jnp.int32)  # 2 real, 2 pad(=9)
+    m = batch_metrics(LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                      [MetricsType.METRICS_ACCURACY], logits, labels,
+                      ignore_index=9)
+    assert int(m["accuracy_count"]) == 2 and int(m["accuracy_total"]) == 2
+    m2 = batch_metrics(LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                       [MetricsType.METRICS_ACCURACY], logits, labels)
+    assert int(m2["accuracy_total"]) == 4  # unmasked counts every position
+
+
+def test_topk_sampling_exactly_k_on_ties():
+    """Top-k filter keeps exactly k candidates even when logits tie with
+    the k-th value, and rejects top_k >= vocab (ADVICE r3)."""
+    import jax
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from flexflow_tpu.runtime.generation import Generator
+
+    gen = object.__new__(Generator)  # sampling only — no model needed
+    gen.temperature = 1.0
+    gen.top_k = 2
+    # four-way tie: a >=kth threshold filter would keep all four
+    logits = jnp.zeros((512, 4), jnp.float32)
+    tok, _ = gen._sample(logits, jax.random.PRNGKey(0))
+    assert len(np.unique(np.asarray(tok))) <= 2, \
+        "more than top_k distinct tokens sampled on a tie"
+
+    gen.top_k = 4
+    with _pytest.raises(ValueError, match="top_k=4 >= vocab"):
+        gen._sample(logits, jax.random.PRNGKey(0))

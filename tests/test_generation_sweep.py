@@ -1,0 +1,208 @@
+"""Randomized generation cross-feature sweep (VERDICT r3 #7).
+
+Drives random combinations of {greedy, temperature, top-k, beam} x
+{ragged prompts, chunked prefill, int8, tied weights, GQA/MHA, MoE}
+against the naive full-forward rescoring oracle: every claim the decode
+path makes (chosen tokens, reported per-token logprobs, beam scores) is
+re-derived by running the TRAINING graph forward on the realized token
+prefix — the oracle that caught the beam cache-poisoning (3cf0d66) and
+int8 cache-validity (c51d982) bug class after the fact, now run across
+the whole feature lattice before the fact.
+
+Model/oracle pairs are cached per architecture so ~200 sampled configs
+reuse a handful of compiled programs (the Generator's LRU does the
+rest); FF_GEN_SWEEP_N overrides the sample count.
+"""
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel
+
+VOCAB = 61
+B, S0, NEW = 2, 8, 5
+N_CONFIGS = int(os.environ.get("FF_GEN_SWEEP_N", "220"))
+
+_MODELS = {}
+
+
+def _build(arch):
+    """arch: (family, tied, kv_heads, moe)."""
+    family, tied, kv_heads, moe = arch
+    cfg = FFConfig(batch_size=B, mesh_shape={"data": 1}, seed=7)
+    ff = FFModel(cfg)
+    if family == "llama":
+        from flexflow_tpu.models.llama import llama_lm
+
+        _, logits = llama_lm(ff, B, seq_len=S0, hidden=32, layers=2,
+                             heads=4, kv_heads=kv_heads, vocab_size=VOCAB,
+                             tie_embeddings=tied)
+    else:
+        from flexflow_tpu.models.bert import gpt_lm
+
+        _, logits = gpt_lm(ff, B, seq_len=S0, hidden=32, layers=2, heads=4,
+                           vocab_size=VOCAB, moe_every=2, num_experts=4)
+        # the decode path routes MoE with capacity = slab token count
+        # (zero drops, generation.py decode walk); the full-forward oracle
+        # must match that semantic, so lift the training capacity above
+        # any token count this sweep feeds it — otherwise capacity-bound
+        # drops in the ORACLE (not the decode) fail the comparison
+        from flexflow_tpu.ffconst import OperatorType
+
+        for op in ff.ops:
+            if op.op_type == OperatorType.OP_MOE:
+                op.capacity = 64
+    ff.compile(final_tensor=logits)
+    return ff
+
+
+def _model(arch):
+    if arch not in _MODELS:
+        _MODELS[arch] = _build(arch)
+    return _MODELS[arch]
+
+
+def _oracle_model(arch, quantize):
+    """The rescoring oracle: the same graph full-forward. For int8 it
+    carries the DEQUANTIZED weights (decode computes with q*s, so the
+    oracle must too — full-precision logits would differ legitimately)."""
+    if not quantize:
+        return _model(arch)
+    key = arch + ("deq",)
+    if key not in _MODELS:
+        from flexflow_tpu.runtime.generation import Generator
+
+        src = _model(arch)
+        gen = src._generators.get(("int8-oracle")) or Generator(
+            src, quantize="int8")
+        qp = gen._quantized_params()
+        ff = _build(arch)
+        for op_name, ws in qp.items():
+            for w_name, v in ws.items():
+                if isinstance(v, dict) and "q" in v:
+                    ff.set_weights(op_name, w_name, np.asarray(
+                        v["q"].astype(jnp.float32) * v["s"]))
+                else:
+                    ff.set_weights(op_name, w_name, np.asarray(v))
+        _MODELS[key] = ff
+    return _MODELS[key]
+
+
+def _full_logits(ff, toks):
+    return np.asarray(ff.predict({"input": np.asarray(toks, np.int32)}))
+
+
+def _sample_config(rs):
+    mode = rs.choice(["greedy", "temp", "topk", "beam"])
+    arch_pool = [("llama", False, 0, False),   # MHA
+                 ("llama", True, 2, False),    # tied + GQA
+                 ("llama", False, 2, False),   # GQA
+                 ("gpt", False, 0, True)]      # MoE
+    arch = arch_pool[rs.randint(len(arch_pool))]
+    quant = "int8" if rs.rand() < 0.25 else None
+    ragged = mode != "beam" and rs.rand() < 0.3
+    chunk = 0 if ragged else int(rs.choice([0, 0, 3]))
+    cfgd = {"mode": mode, "arch": arch, "quant": quant, "ragged": ragged,
+            "chunk": chunk}
+    if mode == "temp":
+        cfgd["temperature"], cfgd["top_k"] = 0.7, 0
+    elif mode == "topk":
+        cfgd["temperature"], cfgd["top_k"] = 1.0, 5
+    elif mode == "beam":
+        cfgd["num_beams"] = int(rs.choice([2, 3]))
+        cfgd["length_penalty"] = float(rs.choice([0.0, 1.0]))
+    return cfgd
+
+
+def _row_prefix(toks, lengths, row):
+    return toks[row, :lengths[row]] if lengths is not None else toks[row]
+
+
+def _stable_log_softmax(v):
+    v = v.astype(np.float64)
+    m = v.max()
+    return v - (m + np.log(np.exp(v - m).sum()))
+
+
+def _oracle_rows(ff, prompt, lengths, out_tokens):
+    """Teacher-forcing oracle, ONE forward per row: run the training graph
+    on each row's realized sequence (its TRUE prefix for ragged rows, plus
+    the generated tokens) and return [(step_logits, logps)] per row, where
+    step_logits[j] is the full-vocab distribution that produced generated
+    token j and logps[j] its log-softmax score."""
+    rows = []
+    for r in range(B):
+        prefix = _row_prefix(prompt, lengths, r)
+        new_toks = out_tokens[r, prompt.shape[1]:]
+        seq = np.concatenate([prefix, new_toks]).astype(np.int32)
+        logits = _full_logits(ff, seq[None])[0]  # (L+NEW, V)
+        L = len(prefix)
+        step_logits = logits[L - 1:L - 1 + NEW]
+        logps = np.asarray([_stable_log_softmax(step_logits[j])[new_toks[j]]
+                            for j in range(NEW)])
+        rows.append((step_logits, logps))
+    return rows
+
+
+@pytest.mark.parametrize("i", range(N_CONFIGS))
+def test_generation_sweep(i):
+    rs = np.random.RandomState(1000 + i)
+    c = _sample_config(rs)
+    ff = _model(c["arch"])
+    oracle = _oracle_model(c["arch"], c["quant"])
+    prompt = rs.randint(0, VOCAB, (B, S0)).astype(np.int32)
+    lengths = None
+    if c["ragged"]:
+        lengths = rs.randint(2, S0 + 1, (B,)).astype(np.int32)
+        lengths[rs.randint(B)] = S0  # at least one full row
+
+    if c["mode"] == "beam":
+        out, score = ff.generate(prompt, NEW, num_beams=c["num_beams"],
+                                 length_penalty=c["length_penalty"],
+                                 quantize=c["quant"],
+                                 prefill_chunk=c["chunk"],
+                                 return_scores=True)
+        assert out.shape == (B, S0 + NEW)
+        # oracle: rescore the returned beam token-by-token
+        rows = _oracle_rows(oracle, prompt, None, out)
+        want = np.asarray([r[1].sum() for r in rows])
+        if c["length_penalty"]:
+            want = want / (NEW ** c["length_penalty"])
+        np.testing.assert_allclose(score, want, atol=5e-3, rtol=1e-3)
+        return
+
+    kwargs = dict(quantize=c["quant"], prefill_chunk=c["chunk"],
+                  return_scores=True, seed=int(rs.randint(1 << 16)),
+                  temperature=c.get("temperature", 0.0),
+                  top_k=c.get("top_k", 0))
+    if c["ragged"]:
+        kwargs["prompt_lengths"] = lengths
+    out, scores = ff.generate(prompt, NEW, **kwargs)
+    assert out.shape == (B, S0 + NEW) and scores.shape == (B, NEW)
+    assert ((out[:, S0:] >= 0) & (out[:, S0:] < VOCAB)).all()
+
+    # oracle 1: the reported per-token logprob equals full-forward
+    # rescoring of the realized sequence (pins cache correctness across
+    # RoPE offsets, GQA grouping, ragged masking, chunked prefill, int8)
+    rows = _oracle_rows(oracle, prompt, lengths, out)
+    want = np.stack([r[1] for r in rows])
+    np.testing.assert_allclose(scores, want, atol=5e-3, rtol=1e-3)
+
+    for r in range(B):
+        step_logits, _ = rows[r]
+        for j in range(NEW):
+            tok = int(out[r, S0 + j])
+            # oracle 2 (top-k): sampled token within the oracle's top-k
+            # set (up to float ties at the boundary)
+            if c.get("top_k"):
+                kth = np.sort(step_logits[j])[-c["top_k"]]
+                assert step_logits[j][tok] >= kth - 1e-3, \
+                    f"token {tok} outside oracle top-{c['top_k']} step {j}"
+            # greedy: chosen token maximizes the oracle logits (tolerance
+            # for kernel-order float differences on near-ties)
+            if c["mode"] == "greedy":
+                assert step_logits[j][tok] >= step_logits[j].max() - 1e-3, \
+                    f"greedy token {tok} not argmax at step {j}"
