@@ -114,11 +114,9 @@ class PlacementExecutor:
         from flexflow_tpu.parallel.mesh import mesh_shape_dict
         from flexflow_tpu.runtime.executor import GraphExecutor
 
-        if getattr(model, "_tied", None):
-            raise NotImplementedError(
-                "tie_weights + operator placement is unsupported: a tied "
-                "weight would have to live on two sub-meshes at once; use "
-                "a non-placement strategy for tied models")
+        # tie_weights composes with placement when source and dest sit on
+        # the same device BLOCK (any groups) — validated after
+        # _build_groups below; cross-block ties are refused
         if getattr(model.config, "fsdp_axis", ""):
             raise NotImplementedError(
                 "fsdp_axis + operator placement is unsupported: FSDP "
@@ -133,6 +131,28 @@ class PlacementExecutor:
         self.groups: List[PlacementGroup] = []
         self._op_group: Dict[str, PlacementGroup] = {}
         self._build_groups()
+        # ties compose across groups as long as both ops sit on the same
+        # device BLOCK (params live on those devices either way; the dst
+        # group's program just takes the source weight as an extra input
+        # and its gradient contribution is summed with the source group's)
+        self._group_tie_srcs: Dict[int, Dict[str, set]] = {}
+        for (dst_op, dst_w), (src_op, src_w, _) in \
+                (getattr(model, "_tied", None) or {}).items():
+            gd = self._op_group.get(dst_op)
+            gs = self._op_group.get(src_op)
+            if gd is None or gs is None:
+                continue
+            if (gd.place, gd.ndev) != (gs.place, gs.ndev):
+                raise NotImplementedError(
+                    f"tie_weights({dst_op}.{dst_w} -> {src_op}.{src_w}) + "
+                    f"operator placement: the tied ops land on different "
+                    f"device blocks ([{gd.place},{gd.place + gd.ndev}) vs "
+                    f"[{gs.place},{gs.place + gs.ndev})), so the weight "
+                    f"would live on two sub-meshes at once; place both ops "
+                    f"on one device block or use a non-placement strategy")
+            if gd is not gs:
+                self._group_tie_srcs.setdefault(
+                    gd.index, {}).setdefault(src_op, set()).add(src_w)
         # strategy table shared with the single-mesh executor (profiler &
         # tests read executor._op_axis_maps)
         self._op_axis_maps = self.base._op_axis_maps
@@ -233,6 +253,7 @@ class PlacementExecutor:
                 if (bf16 and a.dtype == jnp.float32) else a
 
         op_indices = {op.name: i for i, op in enumerate(self.model.ops)}
+        from flexflow_tpu.runtime.executor import resolve_tied_params
 
         def fn(params_g, state_g, inputs, rng):
             vals: Dict[str, jnp.ndarray] = {k: to_compute(v)
@@ -246,7 +267,8 @@ class PlacementExecutor:
                     seed = getattr(op, "seed", 0)
                     if seed:
                         op_rng = jax.random.fold_in(op_rng, seed)
-                p = params_g.get(op.name, {})
+                p = resolve_tied_params(self.model, params_g, op.name,
+                                        params_g.get(op.name, {}))
                 if bf16:
                     p = {k: to_compute(v) for k, v in p.items()}
                 kwargs = {}
@@ -339,7 +361,10 @@ class PlacementExecutor:
             if not specs:
                 continue
             op_params = {}
+            tied = getattr(self.model, "_tied", {})
             for i, spec in enumerate(specs):
+                if (op.name, spec.name) in tied:
+                    continue  # storage lives with the tie source
                 key = jax.random.fold_in(
                     jax.random.fold_in(rng_key, _stable_hash(op.name)), i)
                 sharding = shardings[op.name].get(spec.name)
@@ -388,6 +413,19 @@ class PlacementExecutor:
                     ins[t.name] = self._put(vals[t.name], g)
         return ins
 
+    def _group_params(self, g: PlacementGroup, params):
+        """The param slice group g's program sees: its member ops' params
+        plus, for ties whose dest lives here but source elsewhere (same
+        device block — validated in __init__), the source weights the tie
+        resolves from."""
+        p_g = {op.name: params[op.name] for op in g.ops
+               if op.name in params}
+        for src_op, names in self._group_tie_srcs.get(g.index, {}).items():
+            if src_op in params:
+                p_g[src_op] = {w: params[src_op][w] for w in names
+                               if w in params[src_op]}
+        return p_g
+
     # ---- compiled steps -----------------------------------------------------
 
     def shard_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
@@ -405,8 +443,7 @@ class PlacementExecutor:
             vals: Dict[str, Any] = {}
             for g, f in zip(self.groups, fwd_jits):
                 ins = self._group_inputs(g, vals, batch)
-                p_g = {op.name: params[op.name] for op in g.ops
-                       if op.name in params}
+                p_g = self._group_params(g, params)
                 s_g = {op.name: state[op.name] for op in g.ops
                        if op.name in state}
                 outs, _ = f(p_g, s_g, ins, rng)
@@ -492,8 +529,7 @@ class PlacementExecutor:
             for g, f in zip(self.groups, fwd_jits):
                 ins = self._group_inputs(g, vals, batch)
                 group_ins.append(ins)
-                p_g = {op.name: params[op.name] for op in g.ops
-                       if op.name in params}
+                p_g = self._group_params(g, params)
                 s_g = {op.name: state[op.name] for op in g.ops
                        if op.name in state}
                 outs, ns = f(p_g, s_g, ins, rng)
@@ -514,8 +550,7 @@ class PlacementExecutor:
             grads: Dict[str, Dict] = {}
             for gi in range(len(self.groups) - 1, -1, -1):
                 g = self.groups[gi]
-                p_g = {op.name: params[op.name] for op in g.ops
-                       if op.name in params}
+                p_g = self._group_params(g, params)
                 s_g = {op.name: state[op.name] for op in g.ops
                        if op.name in state}
                 g_cots = {}
@@ -527,7 +562,16 @@ class PlacementExecutor:
                         g_cots[name] = self._put(
                             jnp.zeros(ref.shape, ref.dtype), g)
                 dp, dins = bwd_jits[gi](p_g, s_g, group_ins[gi], rng, g_cots)
-                grads.update(dp)
+                for op_name, ws in dp.items():
+                    if op_name not in grads:
+                        grads[op_name] = dict(ws)
+                        continue
+                    # tie source: this group's contribution sums with the
+                    # source group's own gradients (same device block)
+                    acc = grads[op_name]
+                    for w_name, gv in ws.items():
+                        acc[w_name] = (acc[w_name] + gv
+                                       if w_name in acc else gv)
                 for name, ct in dins.items():
                     pg = tensor_group.get(name)
                     if pg is None:

@@ -237,3 +237,115 @@ def test_search_to_placement_execution_chain(tmp_path):
     loaded = load_strategies_from_file(path)
     for name, pc in best.items():
         assert loaded[name].device_ids == tuple(pc.device_ids)
+
+
+def test_tied_weights_same_group_placement():
+    """tie_weights + placement (VERDICT r3 weak #6): composes when source
+    and dest land in the SAME placement group — the group's one program
+    resolves the tie and accumulates both gradient contributions. Loss
+    trajectory must match the single-mesh executor exactly."""
+    rs = np.random.RandomState(5)
+    x = rs.randn(64, 64).astype(np.float32)
+    y = rs.randint(0, 8, (64, 1)).astype(np.int32)
+
+    def build_tied(cfg):
+        ff = FFModel(cfg)
+        xt = ff.create_tensor([cfg.batch_size, 64], name="x")
+        a = ff.dense(xt, 64, ActiMode.AC_MODE_RELU, name="enc")
+        a = ff.dense(a, 64, ActiMode.AC_MODE_RELU, name="dec")
+        b = ff.dense(xt, 64, ActiMode.AC_MODE_RELU, name="other")
+        t = ff.concat([a, b], axis=1, name="join")
+        ff.dense(t, 8, name="head")
+        ff.tie_weights("dec", "kernel", "enc", "kernel")
+        return ff, xt
+
+    def losses(strategies, steps=4):
+        cfg = FFConfig(batch_size=16, epochs=1, mesh_shape=MESH, seed=3)
+        cfg.strategies.update(strategies)
+        ff, xt = build_tied(cfg)
+        ff.compile(SGDOptimizer(lr=0.05),
+                   LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+        SingleDataLoader(ff, xt, x)
+        SingleDataLoader(ff, ff.label_tensor, y)
+        out = []
+        for _ in range(steps):
+            loss, _ = ff._run_train_step(ff._stage_batch())
+            out.append(float(loss))
+        return out, ff
+
+    # enc+dec on block 0-3 (one group); 'other' on 4-7
+    placed = {
+        "enc": dp4(), "dec": dp4(),
+        "other": dp4(ids=range(4, 8)),
+        "join": dp4(), "head": dp4(),
+    }
+    l_placed, ffp = losses(placed)
+    assert isinstance(ffp.executor, PlacementExecutor)
+    # tied dest has no storage of its own under placement either
+    assert "kernel" not in ffp.params.get("dec", {})
+    l_single, _ = losses({})
+    np.testing.assert_allclose(l_placed, l_single, rtol=2e-4)
+    assert l_placed[-1] < l_placed[0]
+
+
+def test_tied_weights_cross_group_same_block_placement():
+    """Sandwich shape (reviewer case): embedding-like source on block 0-3,
+    a middle op on block 4-7, tied head back on block 0-3 — dependency
+    ordering forces source and dest into DIFFERENT groups on the SAME
+    block. The dest group takes the source weight as an extra input and
+    its gradient contribution sums with the source group's; loss
+    trajectory must match the single-mesh executor."""
+    rs = np.random.RandomState(9)
+    x = rs.randn(64, 64).astype(np.float32)
+    y = rs.randint(0, 8, (64, 1)).astype(np.int32)
+
+    def losses(strategies, steps=4):
+        cfg = FFConfig(batch_size=16, epochs=1, mesh_shape=MESH, seed=3)
+        cfg.strategies.update(strategies)
+        ff = FFModel(cfg)
+        xt = ff.create_tensor([16, 64], name="x")
+        a = ff.dense(xt, 64, ActiMode.AC_MODE_RELU, name="enc")
+        a = ff.dense(a, 64, ActiMode.AC_MODE_RELU, name="mid")
+        a = ff.dense(a, 64, ActiMode.AC_MODE_RELU, name="dec")
+        ff.dense(a, 8, name="head")
+        ff.tie_weights("dec", "kernel", "enc", "kernel")
+        ff.compile(SGDOptimizer(lr=0.05),
+                   LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+        SingleDataLoader(ff, xt, x)
+        SingleDataLoader(ff, ff.label_tensor, y)
+        out = []
+        for _ in range(steps):
+            loss, _ = ff._run_train_step(ff._stage_batch())
+            out.append(float(loss))
+        return out, ff
+
+    placed = {"enc": dp4(), "mid": dp4(ids=range(4, 8)),
+              "dec": dp4(), "head": dp4()}
+    l_placed, ffp = losses(placed)
+    assert isinstance(ffp.executor, PlacementExecutor)
+    genc = ffp.executor._op_group["enc"]
+    gdec = ffp.executor._op_group["dec"]
+    assert genc is not gdec, "sandwich did not split groups — vacuous test"
+    assert (genc.place, genc.ndev) == (gdec.place, gdec.ndev)
+    l_single, _ = losses({})
+    np.testing.assert_allclose(l_placed, l_single, rtol=2e-4)
+    assert l_placed[-1] < l_placed[0]
+
+
+def test_tied_weights_cross_block_placement_rejected():
+    """A tie whose ops land on different device blocks is refused with an
+    actionable error (the weight would live on two sub-meshes at once)."""
+    cfg = FFConfig(batch_size=16, epochs=1, mesh_shape=MESH, seed=3)
+    cfg.strategies.update({
+        "enc": dp4(), "dec": dp4(ids=range(4, 8)),  # different blocks
+        "head": dp4(),
+    })
+    ff = FFModel(cfg)
+    xt = ff.create_tensor([16, 64], name="x")
+    a = ff.dense(xt, 64, ActiMode.AC_MODE_RELU, name="enc")
+    a = ff.dense(a, 64, ActiMode.AC_MODE_RELU, name="dec")
+    ff.dense(a, 8, name="head")
+    ff.tie_weights("dec", "kernel", "enc", "kernel")
+    with pytest.raises(NotImplementedError, match="different device blocks"):
+        ff.compile(SGDOptimizer(lr=0.05),
+                   LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
