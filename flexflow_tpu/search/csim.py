@@ -292,4 +292,56 @@ def native_optimize(model, cost, mesh_shape: Dict[str, int], budget: int,
         start = int(best_p[i])
         pc.device_ids = tuple(range(start, start + ndev))
         out[op.name] = pc
+    _snap_tied_blocks(model, out, prob.num_devices)
     return out
+
+
+def _snap_tied_blocks(model, out: Dict[str, ParallelConfig],
+                      num_devices: int):
+    """tie_weights constraint the annealer doesn't model: every op in a
+    tie-connected component must share ONE device block (PlacementExecutor
+    refuses cross-block ties). Components (a source with several dests, a
+    dest tied to several sources) are resolved together — a pairwise
+    single pass is not a fixpoint: snapping pair 2 can re-break pair 1.
+    Per component, pick the largest member block whose size every member's
+    sharding degree divides; if none fits, the full mesh (block 0) —
+    always valid. The simulated cost of the snapped strategy can differ
+    from the annealer's estimate; correct-and-executable beats
+    optimal-and-rejected."""
+    tied = getattr(model, "_tied", None) or {}
+    if not tied:
+        return
+    # union-find over tie edges
+    parent: Dict[str, str] = {}
+
+    def find(a):
+        parent.setdefault(a, a)
+        while parent[a] != a:
+            parent[a] = parent[parent[a]]
+            a = parent[a]
+        return a
+
+    for (dst_op, _), (src_op, _, _) in tied.items():
+        if dst_op in out and src_op in out:
+            parent[find(dst_op)] = find(src_op)
+    comps: Dict[str, list] = {}
+    for name in parent:
+        comps.setdefault(find(name), []).append(name)
+
+    def blk(pc):
+        return ((min(pc.device_ids), len(pc.device_ids))
+                if pc.device_ids else (0, num_devices))
+
+    for members in comps.values():
+        blocks = {blk(out[m]) for m in members}
+        if len(blocks) <= 1:
+            continue
+        chosen = (0, num_devices)
+        for cand in sorted(blocks, key=lambda b: -b[1]):
+            if all(cand[1] % max(out[m].num_parts(), 1) == 0
+                   for m in members):
+                chosen = cand
+                break
+        ids = tuple(range(chosen[0], chosen[0] + chosen[1]))
+        for m in members:
+            out[m].device_ids = ids

@@ -131,3 +131,71 @@ def test_attention_seq_dim_never_multi_axis():
     for m in legal_axis_maps(op, {"data": 2, "model": 2}):
         seq_axes = [a for a, d in m.items() if d == 1]
         assert len(seq_axes) <= 1, m
+
+
+def test_native_search_snaps_tied_pair_to_one_block():
+    """The annealer doesn't model tie_weights; its winner must still
+    execute, so native_optimize snaps every tie-connected component onto
+    one device block (PlacementExecutor refuses cross-block ties). Calls
+    native_optimize directly — the optimize_strategies fallback to the
+    Python annealer has no placement proposals and would make this
+    vacuous."""
+    from flexflow_tpu import ActiMode, FFConfig, FFModel
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.csim import native_optimize
+
+    mesh_shape = {"data": 8}
+    cfg = FFConfig(batch_size=16, mesh_shape=mesh_shape)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([16, 64], name="x")
+    a = ff.dense(x, 64, ActiMode.AC_MODE_RELU, name="enc")
+    a = ff.dense(a, 64, ActiMode.AC_MODE_RELU, name="mid")
+    a = ff.dense(a, 64, ActiMode.AC_MODE_RELU, name="dec")
+    ff.dense(a, 8, name="head")
+    ff.tie_weights("dec", "kernel", "enc", "kernel")
+
+    cost = CostModel(ff, mesh_shape)
+    try:
+        best = {s: native_optimize(ff, cost, mesh_shape, 2000, 0.05, s)
+                for s in range(4)}
+    except (ImportError, OSError) as e:
+        pytest.skip(f"native search core unavailable: {e}")
+    for seed, st in best.items():
+        s, d = st["enc"], st["dec"]
+        blk = lambda pc: ((min(pc.device_ids), len(pc.device_ids))
+                          if pc.device_ids else (0, 8))
+        assert blk(s) == blk(d), \
+            f"seed {seed}: tied pair on different blocks {blk(s)} {blk(d)}"
+
+
+def test_snap_tied_blocks_multi_dest_fixpoint():
+    """One source, two dests on three different blocks with different
+    sharding degrees: the component resolves to ONE block that every
+    member's degree divides (a pairwise pass would re-break the first
+    pair when handling the second)."""
+    from flexflow_tpu import ActiMode, FFConfig, FFModel
+    from flexflow_tpu.parallel.pconfig import ParallelConfig
+    from flexflow_tpu.search.csim import _snap_tied_blocks
+
+    mesh_shape = {"data": 8}
+    cfg = FFConfig(batch_size=16, mesh_shape=mesh_shape)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([16, 64], name="x")
+    a = ff.dense(x, 64, ActiMode.AC_MODE_RELU, name="enc")
+    b = ff.dense(a, 64, ActiMode.AC_MODE_RELU, name="dec1")
+    ff.dense(b, 64, ActiMode.AC_MODE_RELU, name="dec2")
+    ff.tie_weights("dec1", "kernel", "enc", "kernel")
+    ff.tie_weights("dec2", "kernel", "enc", "kernel")
+
+    def pc(deg, start, n):
+        p = ParallelConfig.from_axis_map(2, {"data": deg}, {"data": 0})
+        p.device_ids = tuple(range(start, start + n))
+        return p
+
+    out = {"enc": pc(2, 0, 2), "dec1": pc(2, 2, 2), "dec2": pc(4, 4, 4)}
+    _snap_tied_blocks(ff, out, 8)
+    blocks = {(min(p.device_ids), len(p.device_ids)) for p in out.values()}
+    assert len(blocks) == 1, blocks
+    (start, n), = blocks
+    for name, p in out.items():
+        assert n % p.num_parts() == 0, (name, n, p.num_parts())
