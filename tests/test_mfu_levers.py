@@ -200,3 +200,33 @@ def test_fused_optimizer_end_to_end_and_sharded_fallback():
         2, {"data": 2, "model": 2}, {"data": 0, "model": 1})}
     ff2 = build({"data": 2, "model": 2}, tp)
     assert not isinstance(ff2.optimizer, FusedUpdate)
+
+
+@pytest.mark.parametrize("opt_kind", ["sgd", "adam"])
+@pytest.mark.parametrize("master", ["float32", "bfloat16"])
+def test_fused_optimizer_scanned_training_bitwise(opt_kind, master):
+    """train_scanned + FusedUpdate (the bench's chip-ablation path): the
+    scanned multi-step program with the fused update must be bit-identical
+    to the per-leaf update — a break here would burn the TPU ablation
+    window."""
+    from flexflow_tpu import AdamOptimizer
+
+    def run(fused):
+        cfg = FFConfig(batch_size=8, mesh_shape={"data": 1}, seed=4,
+                       fused_optimizer=fused, master_dtype=master)
+        ff = FFModel(cfg)
+        x = ff.create_tensor([8, 16], name="x")
+        t = ff.dense(x, 32, name="fc1")
+        ff.dense(t, 8, name="fc2")
+        opt = (SGDOptimizer(lr=0.05) if opt_kind == "sgd"
+               else AdamOptimizer(alpha=0.01))
+        ff.compile(opt, LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                   [MetricsType.METRICS_ACCURACY])
+        rs = np.random.RandomState(0)
+        SingleDataLoader(ff, x, rs.randn(32, 16).astype(np.float32))
+        SingleDataLoader(ff, ff.label_tensor,
+                         rs.randint(0, 8, (32, 1)).astype(np.int32))
+        losses, _ = ff.train_scanned(6)
+        return np.asarray(losses)
+
+    np.testing.assert_array_equal(run(False), run(True))
