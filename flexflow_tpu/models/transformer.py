@@ -57,6 +57,50 @@ def build_reference_transformer(ff: FFModel, batch_size: int,
     return x, out
 
 
+def build_seq2seq_transformer(ff: FFModel, batch_size: int,
+                              src_len: int = 128, tgt_len: int = 64,
+                              hidden: int = 512, layers: int = 4,
+                              heads: int = 8, ffn_mult: int = 4,
+                              vocab_size: int = 0):
+    """Modern encoder-decoder transformer with DISTINCT source/target
+    lengths: pre-LN encoder; decoder = causal self-attention + (non-causal)
+    cross-attention over the encoder states + FFN per layer. The
+    sq != sk cross-attention runs on the flash kernel when eligible — the
+    workload class the reference's vendor kernel served with distinct
+    q/kv lengths (attention.cu:533-570) and its Transformer app built as
+    twin streams (transformer.cc:39-56; see build_reference_transformer
+    for the faithful twin-stream port).
+
+    Returns (src_input, tgt_input, out): out is per-target-position
+    hidden states, projected to vocab_size logits when vocab_size > 0
+    (seq2seq LM head) else raw (B, tgt_len, hidden)."""
+    src = ff.create_tensor([batch_size, src_len, hidden], name="src")
+    tgt = ff.create_tensor([batch_size, tgt_len, hidden], name="tgt")
+    e = src
+    for i in range(layers):
+        e = encoder_block(ff, e, hidden, heads, ffn_mult, f"enc{i}")
+    e = ff.layer_norm(e, name="enc_ln_f")
+    d = tgt
+    for i in range(layers):
+        a = ff.layer_norm(d, name=f"dec_ln1_{i}")
+        a = ff.multihead_attention(a, a, a, hidden, heads, causal=True,
+                                   name=f"dec_self_{i}")
+        d = ff.add(d, a, name=f"dec_res1_{i}")
+        c = ff.layer_norm(d, name=f"dec_ln2_{i}")
+        c = ff.multihead_attention(c, e, e, hidden, heads,
+                                   name=f"dec_cross_{i}")
+        d = ff.add(d, c, name=f"dec_res2_{i}")
+        f = ff.layer_norm(d, name=f"dec_ln3_{i}")
+        f = ff.dense(f, hidden * ffn_mult, ActiMode.AC_MODE_GELU,
+                     name=f"dec_ffn1_{i}")
+        f = ff.dense(f, hidden, name=f"dec_ffn2_{i}")
+        d = ff.add(d, f, name=f"dec_res3_{i}")
+    d = ff.layer_norm(d, name="dec_ln_f")
+    if vocab_size > 0:
+        d = ff.dense(d, vocab_size, use_bias=False, name="lm_head")
+    return src, tgt, d
+
+
 def encoder_block(ff: FFModel, x, hidden, heads, ffn_mult, i, causal=False,
                   dropout=0.0):
     """Pre-LN block: x + MHA(LN(x)); x + FFN(LN(x)) with GELU."""
