@@ -100,6 +100,38 @@ assert float(jax.jit(lambda x: x * 2 + 1)(jnp.float32(3))) == 7.0
     run_step mfu_d64 1800 'ls "$OUT"/mfu_d64/*.json >/dev/null 2>&1' \
         bash scripts/mfu_ablation.sh "$OUT/mfu_d64"
 
+    # 4b. if the fused-optimizer lever measured as a WIN vs the staged
+    #     bench's bf16-master row, put driver-visible machine rows with
+    #     the lever on the history (lever env rescopes lever tiers only)
+    if [ -f "$OUT/mfu_d64.done" ] && [ ! -f "$OUT/fused_followup.done" ]; then
+      if python3 - "$OUT" <<'PYEOF'
+import json, os, sys
+out = sys.argv[1]
+try:
+    abl = json.load(open(os.path.join(out, "mfu_d64", "bf16_fused_opt.json")))
+    board = json.loads(open(os.path.join(out, "bench.json")).read())
+except Exception:
+    sys.exit(1)
+base = None
+for t in board.get("all_tiers", []):
+    if t.get("tier") == "full_scan_opt":
+        base = t.get("mfu")
+if base is None or abl.get("mfu") is None:
+    sys.exit(1)
+sys.exit(0 if abl["mfu"] > base else 1)
+PYEOF
+      then
+        FF_BENCH_BUDGET=900 FF_BENCH_FUSED_OPT=1 \
+        FF_BENCH_SKIP_TIERS=tiny,mid,full,full_scan \
+        run_step fused_followup 960 \
+            'grep -q "\"backend\": \"tpu\"" "$OUT/fused_followup.json"' \
+            python bench.py
+      else
+        echo "[$(STAMP)] fused-opt not a measured win (or rows missing); no follow-up"
+        touch "$OUT/fused_followup.done"
+      fi
+    fi
+
     # 5. KV-cache decode throughput (carried from round 3)
     run_step decode 1200 'grep -q "\"backend\": \"tpu\"" "$OUT/decode.json"' \
         python scripts/decode_probe.py
