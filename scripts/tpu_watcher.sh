@@ -97,14 +97,21 @@ assert float(jax.jit(lambda x: x * 2 + 1)(jnp.float32(3))) == 7.0
     # 4. d=64 MFU levers on the full tier: fused optimizer update +
     #    fused-LN-at-wide-hidden arbitration (VERDICT #4). Done needs at
     #    least one non-quarantined (TPU) ablation row on disk.
-    run_step mfu_d64 1800 'ls "$OUT"/mfu_d64/*.json >/dev/null 2>&1' \
+    #    Done requires the specific row step 4b consumes (a tunnel drop
+    #    mid-ablation quarantines individual rows; any-row-exists would
+    #    mark done with the decisive row missing)
+    run_step mfu_d64 1800 'test -f "$OUT"/mfu_d64/bf16_fused_opt.json' \
         bash scripts/mfu_ablation.sh "$OUT/mfu_d64"
 
     # 4b. if the fused-optimizer lever measured as a WIN vs the staged
     #     bench's bf16-master row, put driver-visible machine rows with
     #     the lever on the history (lever env rescopes lever tiers only)
-    if [ -f "$OUT/mfu_d64.done" ] && [ ! -f "$OUT/fused_followup.done" ]; then
-      if python3 - "$OUT" <<'PYEOF'
+    #     Gated on BOTH inputs being real TPU results (bench.done +
+    #     mfu_d64.done); exit 2 = measured loss (record + stop), exit 1 =
+    #     inputs unreadable (leave pending — retry next pass)
+    if [ -f "$OUT/mfu_d64.done" ] && [ -f "$OUT/bench.done" ] \
+        && [ ! -f "$OUT/fused_followup.done" ]; then
+      python3 - "$OUT" <<'PYEOF'
 import json, os, sys
 out = sys.argv[1]
 try:
@@ -118,17 +125,21 @@ for t in board.get("all_tiers", []):
         base = t.get("mfu")
 if base is None or abl.get("mfu") is None:
     sys.exit(1)
-sys.exit(0 if abl["mfu"] > base else 1)
+sys.exit(0 if abl["mfu"] > base else 2)
 PYEOF
-      then
+      gate=$?
+      if [ "$gate" -eq 0 ]; then
         FF_BENCH_BUDGET=900 FF_BENCH_FUSED_OPT=1 \
         FF_BENCH_SKIP_TIERS=tiny,mid,full,full_scan \
         run_step fused_followup 960 \
             'grep -q "\"backend\": \"tpu\"" "$OUT/fused_followup.json"' \
             python bench.py
-      else
-        echo "[$(STAMP)] fused-opt not a measured win (or rows missing); no follow-up"
+      elif [ "$gate" -eq 2 ]; then
+        echo "[$(STAMP)] fused-opt measured as a loss on chip; no follow-up"
         touch "$OUT/fused_followup.done"
+      else
+        echo "[$(STAMP)] fused-opt gate inputs unreadable; will retry"
+        PENDING=$((PENDING + 1))
       fi
     fi
 
