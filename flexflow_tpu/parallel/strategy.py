@@ -12,7 +12,21 @@ Format (reference: src/runtime/strategy.cc:95-189):
 
 The reference keys strategies by hash(op name) (strategy.cc:22-25) used as a
 Legion MappingTagID; we key by the op name itself.
-"""
+
+Extension (ours, backward compatible): an optional `@axismap` record after
+an op's ids persists the EXACT mesh-axis assignment —
+
+    @axismap <k> <axis0> <dim0> ... <axis_{k-1}> <dim_{k-1}>
+
+with dim -1 = replicated over that axis, -2 = CONTRACT (row-parallel),
+-3 = STAGE (pipeline). Degrees alone cannot express CONTRACT/STAGE (they
+shard weights, not the output) or axis names, so without this record a
+search-discovered PP or row-parallel strategy would not survive a
+save/load round trip (the loader would fall back to the greedy
+degree->axis heuristic, resolve_axis_map). Reference-written files never
+contain `@` tokens, so they load unchanged; our files with the extension
+are NOT parseable by the reference (it never reads our files — SURVEY
+§7.6 cross-parse compat is reference->us only)."""
 
 from __future__ import annotations
 
@@ -37,6 +51,13 @@ def save_strategies_to_file(filename: str, strategies: Dict[str, ParallelConfig]
             f.write(f"{n}\n")
             ids = pc.device_ids if len(pc.device_ids) == n else tuple(range(n))
             f.write("\t".join(str(i) for i in ids) + "\n")
+            if pc.axis_map:
+                parts = []
+                for ax, d in pc.axis_map.items():
+                    parts.append(str(ax))
+                    parts.append(str(-1 if d is None else d))
+                f.write(f"@axismap {len(pc.axis_map)} "
+                        + "\t".join(parts) + "\n")
 
 
 def load_strategies_from_file(filename: str) -> Dict[str, ParallelConfig]:
@@ -59,9 +80,19 @@ def load_strategies_from_file(filename: str) -> Dict[str, ParallelConfig]:
         rev_dims = [int(take()) for _ in range(ndims)]
         nids = int(take())
         ids = tuple(int(take()) for _ in range(nids))
+        axis_map = None
+        if pos < len(tokens) and tokens[pos] == "@axismap":
+            take()
+            k = int(take())
+            axis_map = {}
+            for _ in range(k):
+                ax = take()
+                d = int(take())
+                axis_map[ax] = None if d == -1 else d
         out[name] = ParallelConfig(
             device_type=device_type,
             dims=tuple(reversed(rev_dims)),
             device_ids=ids,
+            axis_map=axis_map,
         )
     return out

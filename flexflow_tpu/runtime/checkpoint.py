@@ -150,8 +150,19 @@ def restore_checkpoint(model, directory: str, step: Optional[int] = None):
         saved = load_strategies_from_file(
             os.path.join(directory, "strategy.txt"))
         current = model.config.strategies
+        def differs(a, b):
+            if a.dims != b.dims:
+                return True
+            # dims alone miss CONTRACT/STAGE divergence (they shard
+            # weights, not the output) — compare axis maps when both known
+            if a.axis_map is not None and b.axis_map is not None:
+                na = {k: v for k, v in a.axis_map.items() if v is not None}
+                nb = {k: v for k, v in b.axis_map.items() if v is not None}
+                return na != nb
+            return False
+
         diff = [k for k in saved
-                if k in current and saved[k].dims != current[k].dims]
+                if k in current and differs(saved[k], current[k])]
         if diff:
             import sys
 

@@ -38,6 +38,31 @@ def resolve_axis_map(pc: ParallelConfig, mesh_shape: Dict[str, int],
     from flexflow_tpu.parallel.pconfig import CONTRACT
 
     if pc.axis_map is not None:
+        # explicit axis_map (search output, or a file's @axismap record):
+        # validate against THIS mesh — a file written on a differently-
+        # named mesh must fail here with the axis named, not deep inside
+        # JAX; a same-name different-SIZE mesh silently changes degrees,
+        # so check the recorded dims still match
+        missing = [ax for ax in pc.axis_map if ax not in mesh_shape]
+        if missing:
+            raise ValueError(
+                f"strategy axis_map references mesh axes {missing} absent "
+                f"from this mesh {mesh_shape} — the strategy was "
+                f"produced for a different mesh; regenerate it or rename "
+                f"the mesh axes")
+        if pc.dims:
+            expect = [1] * len(pc.dims)
+            for ax, d in pc.axis_map.items():
+                if d is not None and 0 <= d < len(expect):
+                    expect[d] *= mesh_shape[ax]
+            if tuple(expect) != tuple(pc.dims):
+                from flexflow_tpu.logger import fflogger
+
+                fflogger.warning(
+                    "strategy axis_map on this mesh gives degrees %s but "
+                    "the strategy recorded %s — the mesh axis sizes "
+                    "changed since it was written; executing at the NEW "
+                    "degrees", tuple(expect), tuple(pc.dims))
         return pc.axis_map
     remaining = dict(mesh_shape)
     axis_map: Dict[str, Optional[int]] = {}

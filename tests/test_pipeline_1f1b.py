@@ -209,3 +209,60 @@ def test_stack_executes_search_assigned_stage_axis():
     # stage weights really shard over 'blocks'
     spec = piped.params["stack"]["w1"].sharding.spec
     assert spec[0] == "blocks", spec
+
+
+def test_stage_strategy_file_round_trip(tmp_path):
+    """A search-discovered PP strategy survives save -> load -> execute:
+    the @axismap extension record persists STAGE (degrees alone cannot),
+    and the loaded file drives the pipelined lowering via
+    import_strategy_file."""
+    from flexflow_tpu.parallel.pconfig import CONTRACT, STAGE, ParallelConfig
+    from flexflow_tpu.parallel.strategy import (load_strategies_from_file,
+                                                save_strategies_to_file)
+
+    mesh = {"grid": 4, "data": 2}
+    st = {
+        "stack": ParallelConfig.from_axis_map(3, mesh,
+                                              {"grid": STAGE, "data": 0}),
+        "proj": ParallelConfig.from_axis_map(2, mesh,
+                                             {"grid": CONTRACT, "data": 0}),
+        "head": ParallelConfig.from_axis_map(2, mesh, {"data": 0}),
+    }
+    f = str(tmp_path / "pp_strategy.txt")
+    save_strategies_to_file(f, st)
+    back = load_strategies_from_file(f)
+    for name in st:
+        assert back[name].axis_map == st[name].axis_map, name
+        assert back[name].dims == st[name].dims, name
+
+    # execute through import_strategy_file: the stack must actually
+    # pipeline over 'grid' (stage weights sharded on the loaded strategy)
+    from flexflow_tpu import FFConfig, FFModel
+
+    B, S, D, H, L = 8, 8, 32, 2, 8
+    cfg = FFConfig(batch_size=B, mesh_shape=mesh, seed=5,
+                   import_strategy_file=f)
+    ff = FFModel(cfg)
+    xt = ff.create_tensor([B, S, D], name="x")
+    t = ff.transformer_pipeline_stack(xt, L, H, name="stack")
+    ff.compile(optimizer=None, final_tensor=t)
+    assert ff.params["stack"]["w1"].sharding.spec[0] == "grid"
+
+
+def test_strategy_file_wrong_mesh_fails_clearly(tmp_path):
+    """A file written on a differently-NAMED mesh must fail with the axis
+    named, not deep inside JAX."""
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.parallel.pconfig import STAGE, ParallelConfig
+    from flexflow_tpu.parallel.strategy import save_strategies_to_file
+
+    f = str(tmp_path / "other_mesh.txt")
+    save_strategies_to_file(f, {"stack": ParallelConfig.from_axis_map(
+        3, {"grid": 4}, {"grid": STAGE})})
+    cfg = FFConfig(batch_size=4, mesh_shape={"pipes": 4},
+                   import_strategy_file=f)
+    ff = FFModel(cfg)
+    xt = ff.create_tensor([4, 8, 32], name="x")
+    t = ff.transformer_pipeline_stack(xt, 8, 2, name="stack")
+    with pytest.raises(ValueError, match="grid"):
+        ff.compile(optimizer=None, final_tensor=t)
