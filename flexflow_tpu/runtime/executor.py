@@ -43,7 +43,8 @@ def resolve_axis_map(pc: ParallelConfig, mesh_shape: Dict[str, int],
         # named mesh must fail here with the axis named, not deep inside
         # JAX; a same-name different-SIZE mesh silently changes degrees,
         # so check the recorded dims still match
-        missing = [ax for ax in pc.axis_map if ax not in mesh_shape]
+        missing = [ax for ax, d in pc.axis_map.items()
+                   if d is not None and ax not in mesh_shape]
         if missing:
             raise ValueError(
                 f"strategy axis_map references mesh axes {missing} absent "
@@ -51,10 +52,13 @@ def resolve_axis_map(pc: ParallelConfig, mesh_shape: Dict[str, int],
                 f"produced for a different mesh; regenerate it or rename "
                 f"the mesh axes")
         if pc.dims:
-            expect = [1] * len(pc.dims)
-            for ax, d in pc.axis_map.items():
-                if d is not None and 0 <= d < len(expect):
-                    expect[d] *= mesh_shape[ax]
+            # re-derive degrees exactly the way the serializer did
+            # (from_axis_map: CONTRACT appends a trailing degree, STAGE
+            # contributes none) so a correct unchanged-mesh strategy
+            # never trips the drift warning
+            from flexflow_tpu.parallel.pconfig import ParallelConfig as _PC
+
+            expect = _PC.from_axis_map(ndims, mesh_shape, pc.axis_map).dims
             if tuple(expect) != tuple(pc.dims):
                 from flexflow_tpu.logger import fflogger
 

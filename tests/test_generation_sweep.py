@@ -105,8 +105,12 @@ def _sample_config(rs):
     quant = "int8" if rs.rand() < 0.25 else None
     ragged = mode != "beam" and rs.rand() < 0.3
     chunk = 0 if ragged else int(rs.choice([0, 0, 3]))
+    # eos early-stop joins the lattice for non-beam modes: a random token
+    # declared eos; rows that emit it must pad (and score 0) afterwards
+    eos = int(rs.randint(VOCAB)) if mode != "beam" and rs.rand() < 0.3 \
+        else None
     cfgd = {"mode": mode, "arch": arch, "quant": quant, "ragged": ragged,
-            "chunk": chunk}
+            "chunk": chunk, "eos": eos}
     if mode == "temp":
         cfgd["temperature"], cfgd["top_k"] = 0.7, 0
     elif mode == "topk":
@@ -180,20 +184,38 @@ def test_generation_sweep(i):
                   top_k=c.get("top_k", 0))
     if c["ragged"]:
         kwargs["prompt_lengths"] = lengths
+    if c["eos"] is not None:
+        kwargs["eos_token_id"] = c["eos"]
+        kwargs["pad_token_id"] = 0
     out, scores = ff.generate(prompt, NEW, **kwargs)
     assert out.shape == (B, S0 + NEW) and scores.shape == (B, NEW)
     assert ((out[:, S0:] >= 0) & (out[:, S0:] < VOCAB)).all()
+
+    # eos early-stop: post-eos positions are pad with 0.0 scores; all
+    # oracle checks below truncate to each row's live prefix (an eos
+    # config must NOT skip the top-k/greedy oracles for pre-eos steps)
+    live_new = np.full((B,), NEW, np.int64)
+    if c["eos"] is not None:
+        for r in range(B):
+            hits = np.nonzero(out[r, S0:] == c["eos"])[0]
+            if hits.size:
+                e = int(hits[0])
+                live_new[r] = e + 1
+                assert (out[r, S0 + e + 1:] == 0).all(), out[r, S0:]
+                assert (scores[r, e + 1:] == 0.0).all(), scores[r]
 
     # oracle 1: the reported per-token logprob equals full-forward
     # rescoring of the realized sequence (pins cache correctness across
     # RoPE offsets, GQA grouping, ragged masking, chunked prefill, int8)
     rows = _oracle_rows(oracle, prompt, lengths, out)
-    want = np.stack([r[1] for r in rows])
-    np.testing.assert_allclose(scores, want, atol=5e-3, rtol=1e-3)
+    for r in range(B):
+        np.testing.assert_allclose(scores[r, :live_new[r]],
+                                   rows[r][1][:live_new[r]],
+                                   atol=5e-3, rtol=1e-3)
 
     for r in range(B):
         step_logits, _ = rows[r]
-        for j in range(NEW):
+        for j in range(int(live_new[r])):
             tok = int(out[r, S0 + j])
             # oracle 2 (top-k): sampled token within the oracle's top-k
             # set (up to float ties at the boundary)
