@@ -526,13 +526,17 @@ class FFModel:
             export_sim_taskgraph(self, cfg.taskgraph_file)
 
     def _maybe_fuse_optimizer(self, opt):
-        """FFConfig.fused_optimizer: wrap in FusedUpdate when every param
-        is replicated; sharded strategies (TP/FSDP) and operator-placement
-        lowering fall back to the per-leaf update — flattening leaves that
-        live on different sub-meshes (or GSPMD-sharded ones) would force
-        cross-mesh copies / all-gathers per step."""
+        """FFConfig.fused_optimizer: replicated-param strategies (single
+        device / pure DP) get the global-flatten FusedUpdate; GSPMD-sharded
+        strategies (TP/FSDP) get ShardedFusedUpdate, which flattens each
+        device's LOCAL shards inside a shard_map — shard-local, zero
+        collectives. Only operator-placement lowering still falls back to
+        the per-leaf update (params live on disjoint sub-meshes, so no
+        single program sees them all); a leaf whose shape doesn't divide
+        its mesh extent also falls back, with the leaf named."""
         from flexflow_tpu.logger import fflogger
-        from flexflow_tpu.runtime.optimizer import FusedUpdate
+        from flexflow_tpu.runtime.optimizer import (FusedUpdate,
+                                                    ShardedFusedUpdate)
 
         if getattr(self.executor, "jits_per_group", False):
             fflogger.warning(
@@ -540,16 +544,33 @@ class FFModel:
                 "strategy (params live on disjoint sub-meshes) — using "
                 "the per-leaf update")
             return opt
-        if self.mesh is not None and self.mesh.devices.size > 1:
-            for op_name, per_op in self.executor.param_shardings().items():
-                for w_name, ns in per_op.items():
-                    if any(e is not None for e in ns.spec):
-                        fflogger.warning(
-                            "fused_optimizer: weight %s/%s is sharded "
-                            "(%s) — using the per-leaf update",
-                            op_name, w_name, ns.spec)
-                        return opt
-        return FusedUpdate(opt)
+        shardings = (self.executor.param_shardings()
+                     if self.mesh is not None and self.mesh.devices.size > 1
+                     else {})
+        sharded = any(any(e is not None for e in ns.spec)
+                      for per_op in shardings.values()
+                      for ns in per_op.values())
+        if not sharded:
+            return FusedUpdate(opt)
+
+        from jax.sharding import PartitionSpec as P
+
+        specs = {}
+        for op, ws in self.params.items():
+            specs[op] = {}
+            for w, arr in ws.items():
+                ns = shardings.get(op, {}).get(w)
+                spec = ns.spec if ns is not None else P()
+                try:
+                    ShardedFusedUpdate.local_leaf_size(
+                        arr.shape, spec, self.mesh)
+                except ValueError as e:
+                    fflogger.warning(
+                        "fused_optimizer: weight %s/%s: %s — using the "
+                        "per-leaf update", op, w, e)
+                    return opt
+                specs[op][w] = spec
+        return ShardedFusedUpdate(opt, self.mesh, specs)
 
     # ---------------------------------------------------------- train verbs
 

@@ -171,8 +171,12 @@ def _1f1b_loop(stage_fn, loss_fn, params, x_mb, lab_mb, head_params,
                 y, head_params)
         fin = jnp.logical_and(is_last, do_f)
         loss = loss + jnp.where(fin, loss_j.astype(jnp.float32), 0.0)
+        # select, not multiply-by-mask: dead warm-up ticks run stage_fn on
+        # zero-initialized garbage, and a loss with log/div yields NaN there;
+        # 0*NaN = NaN would poison the accumulator even though the tick is
+        # masked. where() drops the dead value entirely.
         gh = jax.tree_util.tree_map(
-            lambda a, b: a + jnp.where(fin, 1.0, 0.0) * b, gh, dh_j)
+            lambda a, b: a + jnp.where(fin, b, jnp.zeros_like(b)), gh, dh_j)
 
         # ---- backward slot: B(idx, jb) ----
         jb = t - (2 * (n - 1) - idx)
@@ -183,7 +187,7 @@ def _1f1b_loop(stage_fn, loss_fn, params, x_mb, lab_mb, head_params,
         _, pull = jax.vjp(stage_fn, params, inp_b)
         dparams, dinp = pull(cot)
         g = jax.tree_util.tree_map(
-            lambda a, b: a + jnp.where(do_b, 1.0, 0.0) * b, g, dparams)
+            lambda a, b: a + jnp.where(do_b, b, jnp.zeros_like(b)), g, dparams)
         dx = lax.cond(
             jnp.logical_and(idx == 0, do_b),
             lambda d: lax.dynamic_update_index_in_dim(d, dinp, mb_b, 0),

@@ -27,6 +27,33 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
+def _opt_layout(model) -> str:
+    """Optimizer-state pytree layout: the fused wrappers store state as
+    flat per-dtype vectors, so a checkpoint written under one layout
+    cannot restore into another (the tree structures differ). Recorded in
+    meta.json; restore refuses a mismatch with a clear error instead of
+    an opaque tree-structure failure."""
+    from flexflow_tpu.runtime.optimizer import (FusedUpdate,
+                                                ShardedFusedUpdate)
+
+    opt = model.optimizer
+    if isinstance(opt, ShardedFusedUpdate):
+        return "sharded_fused"
+    if isinstance(opt, FusedUpdate):
+        return "fused"
+    return "per_leaf"
+
+
+def _sharded_fused_shardings(model):
+    """The sharded-fused flat vector's element order is a pure function
+    of (tree structure, leaf shardings, mesh) — record all three so a
+    restore onto a DIFFERENT topology is refused instead of silently
+    scrambling the moments (same per-dtype length, different
+    (leaf, element) mapping)."""
+    return {op: {w: str(spec) for w, spec in ws.items()}
+            for op, ws in model.optimizer.specs.items()}
+
+
 def _is_multihost() -> bool:
     return jax.process_count() > 1
 
@@ -76,6 +103,10 @@ def save_checkpoint(model, directory: str, step: Optional[int] = None) -> str:
                 "mesh_shape": model.config.mesh_shape,
                 "multihost": multihost,
                 "loss_type": model.loss_type.name if model.loss_type else None}
+        if "opt_state" in state:  # layout only meaningful when state saved
+            meta["opt_layout"] = _opt_layout(model)
+            if meta["opt_layout"] == "sharded_fused":
+                meta["opt_state_shardings"] = _sharded_fused_shardings(model)
         with open(os.path.join(directory, "meta.json"), "w") as f:
             json.dump(meta, f)
         save_strategies_to_file(os.path.join(directory, "strategy.txt"),
@@ -99,6 +130,39 @@ def restore_checkpoint(model, directory: str, step: Optional[int] = None):
         meta = json.load(f)
     step = step if step is not None else meta["step"]
     path = os.path.join(directory, f"step_{step}")
+
+    # absent on pre-r5 and params-only checkpoints (no opt state to
+    # mismatch — a weights-export -> fine-tune restore must not be blocked)
+    saved_layout = meta.get("opt_layout")
+    if saved_layout is not None and model.optimizer is not None:
+        if saved_layout != _opt_layout(model):
+            raise ValueError(
+                f"checkpoint at {directory} stores optimizer state in the "
+                f"{saved_layout!r} layout but this model uses "
+                f"{_opt_layout(model)!r} (FFConfig.fused_optimizer and the "
+                f"sharding strategy determine the layout). Re-compile with "
+                f"a matching fused_optimizer setting to restore.")
+        if saved_layout == "sharded_fused":
+            # same layout kind is not enough: the flat vector's element
+            # order depends on (mesh, leaf shardings) — a cross-topology
+            # restore would silently scramble the moments
+            saved_sh = meta.get("opt_state_shardings")
+            cur_sh = _sharded_fused_shardings(model)
+            # ordered compare: the flat layout follows mesh AXIS ORDER
+            # (P(tuple(axis_names))), so {'data':2,'model':2} and
+            # {'model':2,'data':2} are different layouts even though the
+            # dicts compare equal (JSON preserves key order)
+            mesh_saved = list((meta.get("mesh_shape") or {}).items())
+            mesh_cur = list(model.config.mesh_shape.items())
+            if (mesh_saved != mesh_cur
+                    or (saved_sh is not None and saved_sh != cur_sh)):
+                raise ValueError(
+                    f"checkpoint at {directory} stores sharded-fused "
+                    f"optimizer state for mesh {meta.get('mesh_shape')} "
+                    f"with different parameter shardings — the flat state "
+                    f"layout is topology-dependent. Re-compile with the "
+                    f"saved mesh/strategy, or restore weights only "
+                    f"(optimizer=None) and start the optimizer fresh.")
 
     if _is_multihost():
         import orbax.checkpoint as ocp

@@ -35,7 +35,7 @@ def resolve_axis_map(pc: ParallelConfig, mesh_shape: Dict[str, int],
     """Fill in pc.axis_map from degrees when a strategy came from a file
     (degrees only). Greedy: each partitioned dim takes unused mesh axes whose
     sizes multiply to its degree; sample dim prefers 'data'."""
-    from flexflow_tpu.parallel.pconfig import CONTRACT
+    from flexflow_tpu.parallel.pconfig import CONTRACT, STAGE
 
     if pc.axis_map is not None:
         # explicit axis_map (search output, or a file's @axismap record):
@@ -51,6 +51,18 @@ def resolve_axis_map(pc: ParallelConfig, mesh_shape: Dict[str, int],
                 f"from this mesh {mesh_shape} — the strategy was "
                 f"produced for a different mesh; regenerate it or rename "
                 f"the mesh axes")
+        # dim indices must be valid for THIS op's rank: a hand-edited /
+        # corrupt @axismap record would otherwise surface as a bare
+        # IndexError inside from_axis_map rather than a diagnosis
+        bad = {ax: d for ax, d in pc.axis_map.items()
+               if d is not None and d not in (CONTRACT, STAGE)
+               and not (0 <= d < ndims)}
+        if bad:
+            raise ValueError(
+                f"strategy axis_map entries {bad} map mesh axes to tensor "
+                f"dims outside this op's rank {ndims} (valid: 0..{ndims - 1} "
+                f"or the CONTRACT/STAGE sentinels) — the @axismap record is "
+                f"corrupt or was written for a different operator")
         if pc.dims:
             # re-derive degrees exactly the way the serializer did
             # (from_axis_map: CONTRACT appends a trailing degree, STAGE

@@ -352,16 +352,23 @@ class Generator:
         else:
             warped = logits / self.temperature
             vocab = logits.shape[-1]
-            if self.top_k >= vocab:
-                raise ValueError(
-                    f"top_k={self.top_k} >= vocab size {vocab} — the "
-                    f"filter would be a no-op; use top_k=0 for plain "
-                    f"temperature sampling")
-            if self.top_k > 0:
+            top_k = self.top_k
+            if top_k >= vocab:
+                # HF semantics: top_k >= vocab is a legal no-op
+                # (full-distribution sampling) — a caller sweeping top_k or
+                # serving a tiny-vocab model must not crash at trace time.
+                if not getattr(self, "_warned_topk", False):
+                    from flexflow_tpu.logger import fflogger
+                    fflogger.warning(
+                        "top_k=%d >= vocab %d; treating as top_k=0 "
+                        "(full-distribution sampling)", top_k, vocab)
+                    self._warned_topk = True
+                top_k = 0
+            if top_k > 0:
                 # scatter from the top_k indices (not a >=kth threshold
                 # compare, which keeps every logit TIED with the k-th
                 # value — more than k candidates on ties)
-                vals, idxs = jax.lax.top_k(warped, self.top_k)
+                vals, idxs = jax.lax.top_k(warped, top_k)
                 warped = jnp.full_like(warped, -jnp.inf).at[
                     jnp.arange(warped.shape[0])[:, None], idxs].set(vals)
             tok = jax.random.categorical(key, warped, axis=-1

@@ -12,15 +12,15 @@
 # bench (machine-written history) outranks everything.
 #
 # Detach with: nohup bash scripts/tpu_watcher.sh >/tmp/watcher.log 2>&1 &
-OUT=/tmp/tpu_queue_r4
+OUT="${FF_WATCH_OUT:-/tmp/tpu_queue_r5}"
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 STAMP() { date -u +"%H:%M:%S"; }
 
 # hard deadline (epoch secs): stop starting steps after this so a late
 # tunnel return can't leave a long measure run holding the chip when the
-# round-end driver bench needs it. Default 2026-08-01 03:00 UTC.
-UNTIL="${FF_WATCH_UNTIL:-1785553200}"
+# round-end driver bench needs it. Default 2026-08-01 15:30 UTC.
+UNTIL="${FF_WATCH_UNTIL:-1785598200}"
 
 HEADROOM() { [ "$UNTIL" -le 0 ] || [ $(( $(date +%s) + $1 )) -lt "$UNTIL" ]; }
 

@@ -58,6 +58,97 @@ def test_checkpoint_roundtrip(tmp_path):
     assert np.isfinite(float(l))
 
 
+def test_checkpoint_opt_layout_mismatch_refused(tmp_path):
+    """ADVICE r4: fused and per-leaf optimizer-state layouts differ; a
+    mismatched restore must raise a CLEAR error naming the layouts, not
+    an opaque tree-structure failure — and a matching fused->fused
+    restore must round-trip."""
+    from flexflow_tpu.runtime.checkpoint import (restore_checkpoint,
+                                                 save_checkpoint)
+
+    def build(fused, steps):
+        cfg = FFConfig(batch_size=16, mesh_shape={"data": 2},
+                       fused_optimizer=fused, seed=9)
+        ff = FFModel(cfg)
+        x = ff.create_tensor([16, 8], name="x")
+        ff.dense(x, 4, name="out")
+        ff.compile(SGDOptimizer(lr=0.05, momentum=0.9),
+                   LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                   [MetricsType.METRICS_ACCURACY])
+        rs = np.random.RandomState(0)
+        SingleDataLoader(ff, x, rs.randn(32, 8).astype(np.float32))
+        SingleDataLoader(ff, ff.label_tensor,
+                         rs.randint(0, 4, (32, 1)).astype(np.int32))
+        for _ in range(steps):
+            ff._run_train_step(ff._stage_batch())
+        return ff
+
+    ff = build(fused=True, steps=2)
+    ckpt = str(tmp_path / "ck_fused")
+    save_checkpoint(ff, ckpt)
+
+    with pytest.raises(ValueError, match="'fused'.*'per_leaf'"):
+        restore_checkpoint(build(fused=False, steps=0), ckpt)
+
+    ff3 = build(fused=True, steps=0)
+    assert restore_checkpoint(ff3, ckpt) == 2
+    np.testing.assert_allclose(ff3.get_weights("out", "kernel"),
+                               ff.get_weights("out", "kernel"), rtol=1e-6)
+    l, _ = ff3._run_train_step(ff3._stage_batch())
+    assert np.isfinite(float(l))
+
+
+def test_checkpoint_sharded_fused_cross_topology_refused(tmp_path):
+    """The sharded-fused flat state's element order is topology-dependent:
+    restoring it onto a different mesh/sharding must be refused (silent
+    moment-scrambling otherwise), while a params-only checkpoint restores
+    into ANY optimizer layout unchecked."""
+    from flexflow_tpu.runtime.checkpoint import (restore_checkpoint,
+                                                 save_checkpoint)
+    from flexflow_tpu.runtime.optimizer import ShardedFusedUpdate
+
+    def build(mesh, fsdp="", fused=True, opt=True):
+        cfg = FFConfig(batch_size=16, mesh_shape=dict(mesh), seed=9,
+                       fused_optimizer=fused, fsdp_axis=fsdp)
+        ff = FFModel(cfg)
+        x = ff.create_tensor([16, 8], name="x")
+        ff.dense(x, 8, name="out")
+        ff.compile(SGDOptimizer(lr=0.05, momentum=0.9) if opt else None,
+                   LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                   [MetricsType.METRICS_ACCURACY])
+        rs = np.random.RandomState(0)
+        SingleDataLoader(ff, x, rs.randn(32, 8).astype(np.float32))
+        SingleDataLoader(ff, ff.label_tensor,
+                         rs.randint(0, 8, (32, 1)).astype(np.int32))
+        return ff
+
+    ff = build({"data": 4}, fsdp="data")
+    assert isinstance(ff.optimizer, ShardedFusedUpdate)
+    ff._run_train_step(ff._stage_batch())
+    ckpt = str(tmp_path / "ck_sf")
+    save_checkpoint(ff, ckpt)
+
+    # same layout kind, different topology -> refused with a clear error
+    ff2 = build({"data": 2, "model": 2}, fsdp="model")
+    assert isinstance(ff2.optimizer, ShardedFusedUpdate)
+    with pytest.raises(ValueError, match="topology-dependent"):
+        restore_checkpoint(ff2, ckpt)
+
+    # identical topology -> restores
+    ff3 = build({"data": 4}, fsdp="data")
+    assert restore_checkpoint(ff3, ckpt) == 1
+
+    # params-only checkpoint (optimizer=None) -> restores into a fused
+    # model without tripping the layout guard
+    ff4 = build({"data": 4}, fsdp="data", opt=False)
+    ckpt2 = str(tmp_path / "ck_weights_only")
+    save_checkpoint(ff4, ckpt2)
+    ff5 = build({"data": 4}, fsdp="data")
+    restore_checkpoint(ff5, ckpt2)
+    np.testing.assert_allclose(ff5.get_weights("out", "kernel"),
+                               ff4.get_weights("out", "kernel"), rtol=1e-6)
+
+
 def test_profiler_per_op(tmp_path):
     from flexflow_tpu.runtime.profiler import export_taskgraph, profile_step
 
@@ -210,10 +301,10 @@ def test_batch_metrics_ignore_index():
 
 def test_topk_sampling_exactly_k_on_ties():
     """Top-k filter keeps exactly k candidates even when logits tie with
-    the k-th value, and rejects top_k >= vocab (ADVICE r3)."""
+    the k-th value; top_k >= vocab is a legal NO-OP (HF semantics —
+    full-distribution sampling), not a crash (ADVICE r3 + r4)."""
     import jax
     import jax.numpy as jnp
-    import pytest as _pytest
 
     from flexflow_tpu.runtime.generation import Generator
 
@@ -226,6 +317,12 @@ def test_topk_sampling_exactly_k_on_ties():
     assert len(np.unique(np.asarray(tok))) <= 2, \
         "more than top_k distinct tokens sampled on a tie"
 
-    gen.top_k = 4
-    with _pytest.raises(ValueError, match="top_k=4 >= vocab"):
-        gen._sample(logits, jax.random.PRNGKey(0))
+    # top_k >= vocab: must sample the FULL distribution (every token
+    # reachable on a 4-way tie), identical to top_k=0
+    for k in (4, 9999):
+        gen = object.__new__(Generator)
+        gen.temperature = 1.0
+        gen.top_k = k
+        tok, _ = gen._sample(logits, jax.random.PRNGKey(0))
+        assert len(np.unique(np.asarray(tok))) == 4, \
+            f"top_k={k} >= vocab should be a no-op (full distribution)"

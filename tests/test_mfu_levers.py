@@ -195,11 +195,117 @@ def test_fused_optimizer_end_to_end_and_sharded_fallback():
                      rs.randint(0, 8, (16, 1)).astype(np.int32))
     ff.fit(epochs=2)
 
-    # TP-sharded weight -> per-leaf fallback
+    # TP-sharded weight -> the shard-local fused update (VERDICT r4 #3:
+    # the lever must not no-op exactly where it matters)
+    from flexflow_tpu.runtime.optimizer import ShardedFusedUpdate
+
     tp = {"fc1": ParallelConfig.from_axis_map(
         2, {"data": 2, "model": 2}, {"data": 0, "model": 1})}
     ff2 = build({"data": 2, "model": 2}, tp)
-    assert not isinstance(ff2.optimizer, FusedUpdate)
+    assert isinstance(ff2.optimizer, ShardedFusedUpdate)
+    SingleDataLoader(ff2, ff2.ops[0].outputs[0],
+                     rs.randn(16, 16).astype(np.float32))
+    SingleDataLoader(ff2, ff2.label_tensor,
+                     rs.randint(0, 8, (16, 1)).astype(np.int32))
+    ff2.fit(epochs=2)  # trains end-to-end under TP
+
+
+def _sharded_vs_per_leaf(mesh_shape, strategies=None, fsdp_axis="",
+                         steps=4, master="float32"):
+    """Train the same model with fused_optimizer on/off on a sharded mesh;
+    return (losses_fused, losses_ref, params_fused, params_ref, opt_f)."""
+    from flexflow_tpu.parallel.pconfig import ParallelConfig
+
+    def build(fused):
+        cfg = FFConfig(batch_size=8, mesh_shape=dict(mesh_shape), seed=5,
+                       fused_optimizer=fused, master_dtype=master,
+                       fsdp_axis=fsdp_axis)
+        if strategies:
+            cfg.strategies.update({k: ParallelConfig.from_axis_map(*v)
+                                   for k, v in strategies.items()})
+        from flexflow_tpu.ffconst import ActiMode
+
+        ff = FFModel(cfg)
+        x = ff.create_tensor([8, 16], name="x")
+        t = ff.dense(x, 32, name="fc1", activation=ActiMode.AC_MODE_RELU)
+        t = ff.dense(t, 32, name="fc2", activation=ActiMode.AC_MODE_RELU)
+        ff.dense(t, 8, name="head")
+        from flexflow_tpu import AdamOptimizer
+
+        ff.compile(AdamOptimizer(alpha=0.01),
+                   LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                   [MetricsType.METRICS_ACCURACY])
+        rs = np.random.RandomState(1)
+        SingleDataLoader(ff, x, rs.randn(16, 16).astype(np.float32))
+        SingleDataLoader(ff, ff.label_tensor,
+                         rs.randint(0, 8, (16, 1)).astype(np.int32))
+        losses = [float(ff._run_train_step(ff._stage_batch())[0])
+                  for _ in range(steps)]
+        return losses, ff
+
+    lf, ff_f = build(True)
+    lr, ff_r = build(False)
+    return lf, lr, ff_f, ff_r
+
+
+@pytest.mark.parametrize("case", ["tp", "fsdp"])
+def test_sharded_fused_update_bitwise_matches_per_leaf(case):
+    """ShardedFusedUpdate (shard_map-local flatten) must be BIT-identical
+    to the per-leaf update under TP and FSDP shardings — same elementwise
+    formula, concat of local shards changes no values (VERDICT r4 #3)."""
+    from flexflow_tpu.runtime.optimizer import ShardedFusedUpdate
+
+    if case == "tp":
+        strat = {"fc1": (2, {"data": 2, "model": 2}, {"data": 0, "model": 1}),
+                 "fc2": (2, {"data": 2, "model": 2},
+                         {"data": 0, "model": -2})}  # CONTRACT row-parallel
+        lf, lr, ff_f, ff_r = _sharded_vs_per_leaf({"data": 2, "model": 2},
+                                                  strat)
+    else:
+        lf, lr, ff_f, ff_r = _sharded_vs_per_leaf({"data": 4},
+                                                  fsdp_axis="data")
+    assert isinstance(ff_f.optimizer, ShardedFusedUpdate)
+    np.testing.assert_array_equal(np.asarray(lf), np.asarray(lr))
+    for op in ff_r.params:
+        for w in ff_r.params[op]:
+            a = np.asarray(ff_r.params[op][w])
+            b = np.asarray(ff_f.params[op][w])
+            assert a.dtype == b.dtype
+            np.testing.assert_array_equal(a, b, err_msg=f"{op}/{w}")
+    # per-device state bytes match the per-leaf layout: flat state is
+    # sharded over ALL axes (each device persists only its slice)
+    flat_m = ff_f.opt_state["m"]
+    n_dev = ff_f.mesh.devices.size
+    for dt, vec in flat_m.items():
+        assert vec.addressable_shards[0].data.size * n_dev == vec.size, \
+            f"flat state {dt} is not fully sharded"
+
+
+def test_fused_grad_dtype_mismatch_buckets_by_param_dtype():
+    """ADVICE r4: a grad leaf whose dtype differs from its param's must
+    not misalign the dtype buckets (grads bucket by PARAM dtype) — and
+    a full-precision f32 grad for a bf16 param is NOT rounded through
+    bf16, so the result stays bit-identical to the per-leaf update."""
+    from flexflow_tpu.runtime.optimizer import (AdamOptimizer, FusedUpdate)
+
+    rs = np.random.RandomState(0)
+    params = {"a": {"k": jnp.asarray(rs.randn(8, 4), jnp.float32)},
+              "b": {"k": jnp.asarray(rs.randn(4), jnp.bfloat16)}}
+    # grads dtypes SWAPPED vs params: independent bucketing would pair
+    # a's grad with b's param (symmetric counts -> silent wrong pairing)
+    grads = {"a": {"k": jnp.asarray(rs.randn(8, 4), jnp.bfloat16)},
+             "b": {"k": jnp.asarray(rs.randn(4), jnp.float32)}}
+    mk = lambda: AdamOptimizer(alpha=0.01, weight_decay=0.01)
+    fused, ref = FusedUpdate(mk()), mk()
+    pf, sf = params, fused.init_state(params)
+    pr, sr = params, ref.init_state(params)
+    for _ in range(3):
+        pf, sf = jax.jit(fused.update)(pf, grads, sf)
+        pr, sr = jax.jit(ref.update)(pr, grads, sr)
+    for op in params:
+        a, b = np.asarray(pr[op]["k"]), np.asarray(pf[op]["k"])
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(a, b, err_msg=op)
 
 
 @pytest.mark.parametrize("opt_kind", ["sgd", "adam"])

@@ -266,3 +266,54 @@ def test_strategy_file_wrong_mesh_fails_clearly(tmp_path):
     t = ff.transformer_pipeline_stack(xt, 8, 2, name="stack")
     with pytest.raises(ValueError, match="grid"):
         ff.compile(optimizer=None, final_tensor=t)
+
+
+def test_1f1b_dead_ticks_cannot_poison_grads_with_nonfinite():
+    """ADVICE r4 (medium): warm-up / drain ticks run stage_fn and loss_fn
+    on zero-initialized garbage. A stage whose math divides by an
+    input-dependent quantity yields inf/NaN there; with the old
+    multiply-by-mask accumulation (0 * inf = NaN) one dead tick poisoned
+    the grads of the whole step. The select-based mask must keep grads
+    finite AND equal to serial autodiff."""
+    n, m, mb, d = 4, 6, 2, 16
+    rs = np.random.RandomState(7)
+    stacked = _mlp_stages(n, d, rs)
+    head = {"wo": jnp.asarray(rs.randn(d, 4).astype(np.float32) * 0.3)}
+    x = jnp.asarray((rs.randn(m * mb, d) + 3.0).astype(np.float32))
+    lab = jnp.asarray(rs.randn(m * mb, 4).astype(np.float32))
+    mesh = make_mesh({"pipe": n})
+
+    def bad_stage(p, h):
+        # 1/sqrt(mean(h^2)): finite on real activations, inf/NaN on the
+        # all-zero garbage that dead ticks carry
+        return jnp.tanh(h @ p["w"] + p["b"]) / jnp.sqrt(jnp.mean(h * h))
+
+    def serial(sp, hp, xx, ll):
+        xm = xx.reshape(m, mb, d)
+        lm = ll.reshape(m, mb, 4)
+
+        def one(j):
+            h = xm[j]
+            for i in range(n):
+                h = bad_stage({k: v[i] for k, v in sp.items()}, h)
+            return _loss_fn(h, lm[j], hp)
+
+        return jnp.mean(jnp.stack([one(j) for j in range(m)]))
+
+    loss, g, gh, dx = jax.jit(
+        lambda sp, hp, xx, ll: pipeline_train_1f1b(
+            bad_stage, _loss_fn, sp, xx, ll, mesh,
+            num_microbatches=m, head_params=hp))(stacked, head, x, lab)
+
+    for name, arr in [("loss", loss), ("g.w", g["w"]), ("g.b", g["b"]),
+                      ("gh.wo", gh["wo"]), ("dx", dx)]:
+        assert bool(jnp.all(jnp.isfinite(arr))), \
+            f"{name} contains non-finite values (dead-tick leak)"
+    ref = jax.grad(serial, argnums=(0, 1))(stacked, head, x, lab)
+    for k in stacked:
+        np.testing.assert_allclose(np.asarray(g[k]),
+                                   np.asarray(ref[0][k]) * m,
+                                   rtol=1e-3, atol=1e-4, err_msg=k)
+    np.testing.assert_allclose(np.asarray(gh["wo"]),
+                               np.asarray(ref[1]["wo"]) * m,
+                               rtol=1e-3, atol=1e-4)

@@ -166,3 +166,23 @@ def test_bfloat16_mixed_precision_training():
         losses.append(float(loss))
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0] * 0.7, losses[::10]
+
+
+def test_corrupt_axismap_dim_index_raises_descriptive_error():
+    """ADVICE r4: a hand-edited @axismap record with a dim index outside
+    the op's rank must produce a descriptive ValueError, not a bare
+    IndexError from deep inside degree re-derivation."""
+    import pytest as _pytest
+
+    from flexflow_tpu.parallel.pconfig import ParallelConfig
+    from flexflow_tpu.runtime.executor import resolve_axis_map
+
+    pc = ParallelConfig(dims=(2, 1), device_ids=(0, 1),
+                        axis_map={"data": 5})  # dim 5 of a rank-2 tensor
+    with _pytest.raises(ValueError, match="outside this op's rank 2"):
+        resolve_axis_map(pc, {"data": 2}, ndims=2)
+    # sentinels still pass through untouched
+    pc2 = ParallelConfig(dims=(2, 1), device_ids=(0, 1),
+                         axis_map={"data": 0, "model": -2})
+    assert resolve_axis_map(pc2, {"data": 2, "model": 2}, ndims=2) \
+        == {"data": 0, "model": -2}
