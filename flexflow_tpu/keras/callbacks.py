@@ -38,6 +38,15 @@ class Callback:
         pass
 
 
+def _data_provenance() -> str:
+    """Data-source stamp for gate output (VERDICT r4 #9): a gate that
+    passed on the synthetic fallback must say so, so it can never be read
+    as a reference-parity real-data result."""
+    from flexflow_tpu.keras.datasets import loaded_provenance
+
+    return loaded_provenance()
+
+
 class VerifyMetrics(Callback):
     """Assert at train end that accuracy reached the target."""
 
@@ -48,8 +57,10 @@ class VerifyMetrics(Callback):
     def on_train_end(self):
         acc = 100.0 * self.model._perf.accuracy
         assert acc >= self.target, \
-            f"accuracy {acc:.2f}% below target {self.target}%"
-        print(f"[VerifyMetrics] accuracy {acc:.2f}% >= {self.target}% OK")
+            f"accuracy {acc:.2f}% below target {self.target}% " \
+            f"(data: {_data_provenance()})"
+        print(f"[VerifyMetrics] accuracy {acc:.2f}% >= {self.target}% OK "
+              f"(data: {_data_provenance()})")
 
 
 class EpochVerifyMetrics(Callback):
@@ -67,12 +78,16 @@ class EpochVerifyMetrics(Callback):
         acc = 100.0 * self.model._perf.accuracy
         if acc >= self.target:
             self.reached = True
+            print(f"[EpochVerifyMetrics] accuracy {acc:.2f}% >= "
+                  f"{self.target}% at epoch {epoch} OK "
+                  f"(data: {_data_provenance()})")
             return self.early_stop
         return False
 
     def on_train_end(self):
         assert self.reached, \
-            f"accuracy never reached target {self.target}%"
+            f"accuracy never reached target {self.target}% " \
+            f"(data: {_data_provenance()})"
 
 
 class PrintDebug(Callback):

@@ -16,6 +16,23 @@ import numpy as np
 
 _KERAS_CACHE = os.path.expanduser("~/.keras/datasets")
 
+# dataset-name -> "real" | "synthetic" for every load_data() call made in
+# this process (VERDICT r4 #9: gate results must carry their data source,
+# so a synthetic pass can never be mistaken for reference-parity accuracy)
+_PROVENANCE: dict = {}
+
+
+def _record(name: str, source: str):
+    _PROVENANCE[name] = source
+
+
+def loaded_provenance() -> str:
+    """'mnist=synthetic,cifar10=real' for all datasets loaded so far, or
+    'no-dataset-loaded'. Printed by the accuracy-gate callbacks."""
+    if not _PROVENANCE:
+        return "no-dataset-loaded"
+    return ",".join(f"{k}={v}" for k, v in sorted(_PROVENANCE.items()))
+
 
 def _limit(pair_train, pair_test):
     """Honor FLEXFLOW_DATASET_LIMIT=N (cap samples per split) so e2e sweeps
@@ -47,6 +64,7 @@ class digits:
     def load_data():
         pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         full = os.path.join(pkg, "data", "digits.npz")
+        _record("digits", "real")
         with np.load(full) as f:
             return _limit((f["x_train"], f["y_train"]),
                           (f["x_test"], f["y_test"]))
@@ -57,9 +75,11 @@ class mnist:
     def load_data(path="mnist.npz"):
         full = os.path.join(_KERAS_CACHE, path)
         if os.path.exists(full):
+            _record("mnist", "real")
             with np.load(full, allow_pickle=True) as f:
                 return _limit((f["x_train"], f["y_train"]),
                               (f["x_test"], f["y_test"]))
+        _record("mnist", "synthetic")
         print("[flexflow_tpu.keras.datasets] mnist cache missing; using "
               "deterministic synthetic data (offline environment)",
               file=sys.stderr)
@@ -73,6 +93,7 @@ class cifar10:
     def load_data():
         full = os.path.join(_KERAS_CACHE, "cifar-10-batches-py")
         if os.path.exists(full):
+            _record("cifar10", "real")
             import pickle
 
             xs, ys = [], []
@@ -86,6 +107,7 @@ class cifar10:
             return _limit((np.concatenate(xs), np.concatenate(ys)),
                           (d[b"data"].reshape(-1, 3, 32, 32),
                            np.asarray(d[b"labels"])))
+        _record("cifar10", "synthetic")
         print("[flexflow_tpu.keras.datasets] cifar10 cache missing; using "
               "deterministic synthetic data (offline environment)",
               file=sys.stderr)
@@ -99,12 +121,14 @@ class reuters:
     def load_data(num_words=1000, maxlen=200, test_split=0.2):
         full = os.path.join(_KERAS_CACHE, "reuters.npz")
         if os.path.exists(full):
+            _record("reuters", "real")
             with np.load(full, allow_pickle=True) as f:
                 xs, ys = f["x"], f["y"]
             xs = [[w for w in seq if w < num_words] for seq in xs]
             n_test = int(len(xs) * test_split)
             return _limit((xs[n_test:], ys[n_test:].astype(np.int32)),
                           (xs[:n_test], ys[:n_test].astype(np.int32)))
+        _record("reuters", "synthetic")
         print("[flexflow_tpu.keras.datasets] reuters: synthetic fallback",
               file=sys.stderr)
         rs = np.random.RandomState(4)

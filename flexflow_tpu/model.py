@@ -811,7 +811,8 @@ class FFModel:
         length-penalty-normalized total logp of the chosen beam for beam
         search. prompt_lengths (B,) enables ragged right-padded prompts.
         num_beams > 1 switches to beam search (temperature/top_k ignored
-        there; uniform-length prompts only). length_penalty follows the
+        there; ragged prompts supported via prompt_lengths, same as
+        greedy/sampling). length_penalty follows the
         norm score/len**penalty — the default 0.0 means RAW SUM of
         logprobs (length-biased toward short beams; HF-style length
         normalization is length_penalty=1.0). quantize="int8" decodes
@@ -844,14 +845,11 @@ class FFModel:
                 eos_id=eos_token_id, pad_id=pad_token_id,
                 quantize=quantize)
         if num_beams > 1:
-            if prompt_lengths is not None:
-                raise NotImplementedError(
-                    "beam search supports uniform-length prompts only; "
-                    "pass prompts of equal length or use num_beams=1")
             return gen.beam_search(tokens, max_new_tokens, num_beams,
                                    length_penalty,
                                    prefill_chunk=prefill_chunk,
-                                   return_scores=return_scores)
+                                   return_scores=return_scores,
+                                   prompt_lengths=prompt_lengths)
         return gen(tokens, max_new_tokens, seed=seed,
                    prompt_lengths=prompt_lengths,
                    prefill_chunk=prefill_chunk,

@@ -114,9 +114,9 @@ class PlacementExecutor:
         from flexflow_tpu.parallel.mesh import mesh_shape_dict
         from flexflow_tpu.runtime.executor import GraphExecutor
 
-        # tie_weights composes with placement when source and dest sit on
-        # the same device BLOCK (any groups) — validated after
-        # _build_groups below; cross-block ties are refused
+        # tie_weights composes with placement: same-block ties resolve
+        # in-program, cross-block ties broadcast the source weight to the
+        # dest block and route the gradient home (see _group_tie_srcs)
         if getattr(model.config, "fsdp_axis", ""):
             raise NotImplementedError(
                 "fsdp_axis + operator placement is unsupported: FSDP "
@@ -131,10 +131,14 @@ class PlacementExecutor:
         self.groups: List[PlacementGroup] = []
         self._op_group: Dict[str, PlacementGroup] = {}
         self._build_groups()
-        # ties compose across groups as long as both ops sit on the same
-        # device BLOCK (params live on those devices either way; the dst
-        # group's program just takes the source weight as an extra input
-        # and its gradient contribution is summed with the source group's)
+        # ties compose across groups: the dst group's program takes the
+        # source weight as an extra input and its gradient contribution is
+        # summed with the source group's own. Same device BLOCK: the weight
+        # already lives on those devices. DIFFERENT blocks (r5, VERDICT r4
+        # #5): the source weight is device_put into the dst block for the
+        # dst program (one ICI broadcast per step) and the dst's gradient
+        # contribution is device_put back to the source block before the
+        # sum — storage and the optimizer state stay with the source.
         self._group_tie_srcs: Dict[int, Dict[str, set]] = {}
         for (dst_op, dst_w), (src_op, src_w, _) in \
                 (getattr(model, "_tied", None) or {}).items():
@@ -142,14 +146,6 @@ class PlacementExecutor:
             gs = self._op_group.get(src_op)
             if gd is None or gs is None:
                 continue
-            if (gd.place, gd.ndev) != (gs.place, gs.ndev):
-                raise NotImplementedError(
-                    f"tie_weights({dst_op}.{dst_w} -> {src_op}.{src_w}) + "
-                    f"operator placement: the tied ops land on different "
-                    f"device blocks ([{gd.place},{gd.place + gd.ndev}) vs "
-                    f"[{gs.place},{gs.place + gs.ndev})), so the weight "
-                    f"would live on two sub-meshes at once; place both ops "
-                    f"on one device block or use a non-placement strategy")
             if gd is not gs:
                 self._group_tie_srcs.setdefault(
                     gd.index, {}).setdefault(src_op, set()).add(src_w)
@@ -413,17 +409,25 @@ class PlacementExecutor:
                     ins[t.name] = self._put(vals[t.name], g)
         return ins
 
+    def _same_block(self, a: PlacementGroup, b: PlacementGroup) -> bool:
+        return (a.place, a.ndev) == (b.place, b.ndev)
+
     def _group_params(self, g: PlacementGroup, params):
         """The param slice group g's program sees: its member ops' params
-        plus, for ties whose dest lives here but source elsewhere (same
-        device block — validated in __init__), the source weights the tie
-        resolves from."""
+        plus, for ties whose dest lives here but source elsewhere, the
+        source weights the tie resolves from — device_put onto THIS block
+        (replicated) when the source lives on a different one."""
         p_g = {op.name: params[op.name] for op in g.ops
                if op.name in params}
         for src_op, names in self._group_tie_srcs.get(g.index, {}).items():
-            if src_op in params:
-                p_g[src_op] = {w: params[src_op][w] for w in names
-                               if w in params[src_op]}
+            if src_op not in params:
+                continue
+            gs = self._op_group[src_op]
+            cross = not self._same_block(gs, g)
+            p_g[src_op] = {
+                w: (self._put(params[src_op][w], g) if cross
+                    else params[src_op][w])
+                for w in names if w in params[src_op]}
         return p_g
 
     # ---- compiled steps -----------------------------------------------------
@@ -525,11 +529,14 @@ class PlacementExecutor:
             # ---- forward ----
             vals: Dict[str, Any] = {}
             group_ins = []
+            group_ps = []  # reused by the backward loop: a cross-block
+            # tied source is device_put to the dest block ONCE per step
             new_state: Dict[str, Dict] = {}
             for g, f in zip(self.groups, fwd_jits):
                 ins = self._group_inputs(g, vals, batch)
                 group_ins.append(ins)
                 p_g = self._group_params(g, params)
+                group_ps.append(p_g)
                 s_g = {op.name: state[op.name] for op in g.ops
                        if op.name in state}
                 outs, ns = f(p_g, s_g, ins, rng)
@@ -550,7 +557,7 @@ class PlacementExecutor:
             grads: Dict[str, Dict] = {}
             for gi in range(len(self.groups) - 1, -1, -1):
                 g = self.groups[gi]
-                p_g = self._group_params(g, params)
+                p_g = group_ps[gi]
                 s_g = {op.name: state[op.name] for op in g.ops
                        if op.name in state}
                 g_cots = {}
@@ -563,11 +570,18 @@ class PlacementExecutor:
                             jnp.zeros(ref.shape, ref.dtype), g)
                 dp, dins = bwd_jits[gi](p_g, s_g, group_ins[gi], rng, g_cots)
                 for op_name, ws in dp.items():
+                    # tie-source grads computed on a DIFFERENT block than
+                    # the weight's owner (cross-block tie) move home
+                    # before accumulating, so the sum — and the optimizer
+                    # state it feeds — lives with the source weight
+                    owner = self._op_group[op_name]
+                    if not self._same_block(owner, g):
+                        ws = {w: self._put(gv, owner) for w, gv in ws.items()}
                     if op_name not in grads:
                         grads[op_name] = dict(ws)
                         continue
                     # tie source: this group's contribution sums with the
-                    # source group's own gradients (same device block)
+                    # source group's own gradients
                     acc = grads[op_name]
                     for w_name, gv in ws.items():
                         acc[w_name] = (acc[w_name] + gv

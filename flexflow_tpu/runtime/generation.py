@@ -438,22 +438,28 @@ class Generator:
     # ---- beam search -------------------------------------------------------
 
     def _build_beam(self, max_new_tokens: int, num_beams: int,
-                    length_penalty: float, prefill_chunk: int = 0):
+                    length_penalty: float, prefill_chunk: int = 0,
+                    ragged: bool = False):
         """Beam decode as one jitted scan. Beams live flattened on the
         batch dim (B*K rows); each step re-orders the KV caches by beam
         parent with a batched gather. Finished beams (emitted eos) are
         frozen: only pad continues them, at logp 0, so their score stops
-        changing; the final pick normalizes by emitted length^penalty."""
+        changing; the final pick normalizes by emitted length^penalty.
+        With `ragged` (right-padded prompts + row lengths), prefill
+        scores each row at its OWN last valid position — exactly as the
+        greedy path does — and decode steps carry per-row RoPE positions
+        and the pad-slot cache mask, repeated per beam."""
         cdtype = self._compute_dtype()
         K = num_beams
 
-        def gen(params, state, tokens):
+        def gen(params, state, tokens, lengths):
             b, s0 = tokens.shape
             max_len = s0 + max_new_tokens
+            row_lengths = lengths if ragged else None
             caches = {op.name: op.init_cache(b, max_len, cdtype)
                       for op in self.attn_ops}
             logits, caches = self._prefill(params, state, tokens, caches,
-                                           None, prefill_chunk)
+                                           row_lengths, prefill_chunk)
             logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32),
                                       axis=-1)                  # (B, V)
             vocab = logp.shape[-1]
@@ -464,6 +470,8 @@ class Generator:
             # beam-flatten the caches: row b*K+k is beam k of batch row b
             caches = jax.tree.map(
                 lambda c: jnp.repeat(c, K, axis=0), caches)
+            # per-beam row lengths for the flattened (B*K) decode batch
+            rep_lengths = (jnp.repeat(lengths, K) if ragged else None)
             buf = jnp.full((b, K, max_new_tokens), self.pad_id, jnp.int32)
             buf = buf.at[:, :, 0].set(tok)
             new_len = jnp.ones((b, K), jnp.int32)
@@ -471,7 +479,9 @@ class Generator:
             def body(carry, i):
                 caches, buf, tok, scores, done, new_len = carry
                 logits, caches = self._walk(
-                    params, state, tok.reshape(b * K, 1), caches, s0 + i)
+                    params, state, tok.reshape(b * K, 1), caches, s0 + i,
+                    rope_pos=(rep_lengths + i) if ragged else None,
+                    row_lengths=rep_lengths, prompt_len=s0)
                 logp = jax.nn.log_softmax(
                     logits[:, 0].astype(jnp.float32), axis=-1)
                 logp = logp.reshape(b, K, vocab)
@@ -538,24 +548,51 @@ class Generator:
 
     def beam_search(self, tokens: np.ndarray, max_new_tokens: int,
                     num_beams: int, length_penalty: float = 0.0,
-                    prefill_chunk: int = 0, return_scores: bool = False):
+                    prefill_chunk: int = 0, return_scores: bool = False,
+                    prompt_lengths=None):
         if prefill_chunk < 0:
             raise ValueError(
                 f"prefill_chunk must be >= 0, got {prefill_chunk}")
         tokens = jnp.asarray(tokens, jnp.int32)
+        lengths, ragged = self._check_lengths(tokens, prompt_lengths)
+        if ragged and prefill_chunk:
+            raise NotImplementedError(
+                "prefill_chunk + prompt_lengths is unsupported: a ragged "
+                "row's last position can fall in an earlier chunk")
         # prompt shape is part of the key: each LRU entry then holds ~one
         # XLA executable, so eviction genuinely bounds compiled programs
         # (a shape-generic jit wrapper would grow an unbounded internal
         # per-shape cache behind a single key)
         key = ("beam", max_new_tokens, num_beams, length_penalty,
-               prefill_chunk, tuple(tokens.shape))
+               prefill_chunk, ragged, tuple(tokens.shape))
         fn = self._cached_program(key, lambda: self._build_beam(
-            max_new_tokens, num_beams, length_penalty, prefill_chunk))
-        out, score = fn(self._params(), self.model.bn_state, tokens)
+            max_new_tokens, num_beams, length_penalty, prefill_chunk,
+            ragged=ragged))
+        out, score = fn(self._params(), self.model.bn_state, tokens,
+                        lengths)
         if return_scores:
             # (B,) length-penalty-normalized total logp of the chosen beam
             return np.asarray(out), np.asarray(score)
         return np.asarray(out)
+
+    @staticmethod
+    def _check_lengths(tokens, prompt_lengths):
+        """Validate (B,) prompt lengths against the prompt slab; returns
+        (lengths_device_array, ragged_flag). Uniform prompts pass zeros —
+        the compiled program ignores them."""
+        ragged = prompt_lengths is not None
+        if not ragged:
+            return jnp.zeros((tokens.shape[0],), jnp.int32), False
+        lengths = np.asarray(prompt_lengths, np.int32)
+        if lengths.shape != (tokens.shape[0],):
+            raise ValueError(
+                f"prompt_lengths shape {lengths.shape} != "
+                f"({tokens.shape[0]},)")
+        if (lengths < 1).any() or (lengths > tokens.shape[1]).any():
+            raise ValueError(
+                f"prompt_lengths must be in [1, {tokens.shape[1]}], "
+                f"got {lengths.tolist()}")
+        return jnp.asarray(lengths), True
 
     def __call__(self, tokens: np.ndarray, max_new_tokens: int,
                  seed: int = 0, prompt_lengths=None,
@@ -568,20 +605,7 @@ class Generator:
         row's true length. `prefill_chunk` > 0 prefills the prompt in
         chunks of that many positions (O(chunk * S) score memory)."""
         tokens = jnp.asarray(tokens, jnp.int32)
-        ragged = prompt_lengths is not None
-        if ragged:
-            lengths = np.asarray(prompt_lengths, np.int32)
-            if lengths.shape != (tokens.shape[0],):
-                raise ValueError(
-                    f"prompt_lengths shape {lengths.shape} != "
-                    f"({tokens.shape[0]},)")
-            if (lengths < 1).any() or (lengths > tokens.shape[1]).any():
-                raise ValueError(
-                    f"prompt_lengths must be in [1, {tokens.shape[1]}], "
-                    f"got {lengths.tolist()}")
-            lengths = jnp.asarray(lengths)
-        else:
-            lengths = jnp.zeros((tokens.shape[0],), jnp.int32)
+        lengths, ragged = self._check_lengths(tokens, prompt_lengths)
         if prefill_chunk < 0:
             raise ValueError(
                 f"prefill_chunk must be >= 0, got {prefill_chunk}")

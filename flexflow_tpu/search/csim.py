@@ -298,9 +298,12 @@ def native_optimize(model, cost, mesh_shape: Dict[str, int], budget: int,
 
 def _snap_tied_blocks(model, out: Dict[str, ParallelConfig],
                       num_devices: int):
-    """tie_weights constraint the annealer doesn't model: every op in a
-    tie-connected component must share ONE device block (PlacementExecutor
-    refuses cross-block ties). Components (a source with several dests, a
+    """tie_weights PREFERENCE the annealer doesn't model: every op in a
+    tie-connected component should share ONE device block. Since r5 the
+    PlacementExecutor executes cross-block ties (per-step source-weight
+    broadcast + gradient route-home), but the snapped strategy avoids
+    that per-step transfer entirely, so the search still proposes only
+    same-block tie components. Components (a source with several dests, a
     dest tied to several sources) are resolved together — a pairwise
     single pass is not a fixpoint: snapping pair 2 can re-break pair 1.
     Per component, pick the largest member block whose size every member's

@@ -219,3 +219,17 @@ def test_onnx_keras_variant_builds():
     x = ff.create_tensor([4, 16], name="input")
     out = ONNXModelKeras(_mlp_proto()).apply(ff, {"input": x})
     assert out.dims == (4, 10)
+
+
+def test_dataset_provenance_recorded_and_stamped():
+    """VERDICT r4 #9: every keras dataset load records real|synthetic and
+    the gate callbacks stamp it into their output."""
+    from flexflow_tpu.keras import datasets
+    from flexflow_tpu.keras.callbacks import _data_provenance
+
+    datasets.digits.load_data()
+    datasets.mnist.load_data()  # offline image -> synthetic fallback
+    prov = datasets.loaded_provenance()
+    assert "digits=real" in prov
+    assert "mnist=" in prov  # real if a cache exists, else synthetic
+    assert _data_provenance() == prov

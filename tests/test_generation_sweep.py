@@ -103,7 +103,7 @@ def _sample_config(rs):
                  ("gpt", False, 0, True)]      # MoE
     arch = arch_pool[rs.randint(len(arch_pool))]
     quant = "int8" if rs.rand() < 0.25 else None
-    ragged = mode != "beam" and rs.rand() < 0.3
+    ragged = rs.rand() < 0.3  # beam included since r5 (VERDICT r4 #4)
     chunk = 0 if ragged else int(rs.choice([0, 0, 3]))
     # eos early-stop joins the lattice for non-beam modes: a random token
     # declared eos; rows that emit it must pad (and score 0) afterwards
@@ -168,10 +168,13 @@ def test_generation_sweep(i):
                                  length_penalty=c["length_penalty"],
                                  quantize=c["quant"],
                                  prefill_chunk=c["chunk"],
+                                 prompt_lengths=lengths,
                                  return_scores=True)
         assert out.shape == (B, S0 + NEW)
-        # oracle: rescore the returned beam token-by-token
-        rows = _oracle_rows(oracle, prompt, None, out)
+        # oracle: rescore the returned beam token-by-token (each ragged
+        # row rescored on its TRUE prefix — pins the per-row prefill
+        # position, RoPE offsets, and pad-slot masking under beams)
+        rows = _oracle_rows(oracle, prompt, lengths, out)
         want = np.asarray([r[1].sum() for r in rows])
         if c["length_penalty"]:
             want = want / (NEW ** c["length_penalty"])

@@ -332,20 +332,49 @@ def test_tied_weights_cross_group_same_block_placement():
     assert l_placed[-1] < l_placed[0]
 
 
-def test_tied_weights_cross_block_placement_rejected():
-    """A tie whose ops land on different device blocks is refused with an
-    actionable error (the weight would live on two sub-meshes at once)."""
-    cfg = FFConfig(batch_size=16, epochs=1, mesh_shape=MESH, seed=3)
-    cfg.strategies.update({
-        "enc": dp4(), "dec": dp4(ids=range(4, 8)),  # different blocks
-        "head": dp4(),
-    })
-    ff = FFModel(cfg)
-    xt = ff.create_tensor([16, 64], name="x")
-    a = ff.dense(xt, 64, ActiMode.AC_MODE_RELU, name="enc")
-    a = ff.dense(a, 64, ActiMode.AC_MODE_RELU, name="dec")
-    ff.dense(a, 8, name="head")
-    ff.tie_weights("dec", "kernel", "enc", "kernel")
-    with pytest.raises(NotImplementedError, match="different device blocks"):
-        ff.compile(SGDOptimizer(lr=0.05),
+def test_tied_weights_cross_block_placement():
+    """VERDICT r4 #5: a tie whose ops land on DIFFERENT device blocks now
+    executes — the dest block's program receives the source weight via a
+    per-step device_put broadcast, and the dest's gradient contribution
+    moves back to the source block before summing (storage + optimizer
+    state stay with the source). Loss trajectory must match the
+    single-mesh executor; the plausible-LM shape: embedding-like source
+    on block 0-3, tied head on block 4-7."""
+    rs = np.random.RandomState(13)
+    x = rs.randn(64, 64).astype(np.float32)
+    y = rs.randint(0, 8, (64, 1)).astype(np.int32)
+
+    def losses(strategies, steps=5):
+        cfg = FFConfig(batch_size=16, epochs=1, mesh_shape=MESH, seed=3)
+        cfg.strategies.update(strategies)
+        ff = FFModel(cfg)
+        xt = ff.create_tensor([16, 64], name="x")
+        a = ff.dense(xt, 64, ActiMode.AC_MODE_RELU, name="enc")
+        a = ff.dense(a, 64, ActiMode.AC_MODE_RELU, name="dec")
+        ff.dense(a, 8, name="head")
+        ff.tie_weights("dec", "kernel", "enc", "kernel")
+        ff.compile(SGDOptimizer(lr=0.02),
                    LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+        SingleDataLoader(ff, xt, x)
+        SingleDataLoader(ff, ff.label_tensor, y)
+        out = []
+        for _ in range(steps):
+            loss, _ = ff._run_train_step(ff._stage_batch())
+            out.append(float(loss))
+        return out, ff
+
+    placed = {"enc": dp4(), "dec": dp4(ids=range(4, 8)),  # different blocks
+              "head": dp4(ids=range(4, 8))}
+    l_placed, ffp = losses(placed)
+    assert isinstance(ffp.executor, PlacementExecutor)
+    genc = ffp.executor._op_group["enc"]
+    gdec = ffp.executor._op_group["dec"]
+    assert (genc.place, genc.ndev) != (gdec.place, gdec.ndev), \
+        "ops landed on the same block — vacuous test"
+    # storage stays with the source only
+    assert "kernel" not in ffp.params.get("dec", {})
+    l_single, _ = losses({})
+    np.testing.assert_allclose(l_placed, l_single, rtol=2e-4)
+    # 64 samples / batch 16 = 4 batches per epoch: step 4 revisits step
+    # 0's batch — the tied model must have improved on it
+    assert l_placed[4] < l_placed[0]
