@@ -1,0 +1,57 @@
+"""keras_exp CIFAR-10 CNN: genuine tf.keras Conv2D stack (channels_first,
+as the reference demands) exported to ONNX bytes and trained as FFModel.
+
+Reference: examples/python/keras_exp/func_cifar10_cnn.py.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+
+def top_level_task():
+    import keras
+    from keras import optimizers
+    from keras.layers import (Activation, Conv2D, Dense, Flatten, Input,
+                              MaxPooling2D)
+
+    from flexflow_tpu.keras.datasets import cifar10
+    from flexflow_tpu.keras_exp.models import Model
+
+    num_classes = 10
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+    print("shape: ", x_train.shape)
+
+    cf = dict(data_format="channels_first")
+    input_tensor1 = Input(shape=(3, 32, 32), dtype="float32")
+    t = Conv2D(filters=32, kernel_size=(3, 3), strides=(1, 1),
+               padding="valid", activation="relu", **cf)(input_tensor1)
+    t = Conv2D(filters=32, kernel_size=(3, 3), strides=(1, 1),
+               padding="valid", activation="relu", **cf)(t)
+    t = MaxPooling2D(pool_size=(2, 2), strides=(2, 2), padding="valid",
+                     **cf)(t)
+    t = Conv2D(filters=64, kernel_size=(3, 3), strides=(1, 1),
+               padding="valid", activation="relu", **cf)(t)
+    t = Conv2D(filters=64, kernel_size=(3, 3), strides=(1, 1),
+               padding="valid", activation="relu", **cf)(t)
+    t = MaxPooling2D(pool_size=(2, 2), strides=(2, 2), padding="valid",
+                     **cf)(t)
+    t = Flatten(**cf)(t)
+    t = Dense(512, activation="relu")(t)
+    t = Dense(num_classes)(t)
+    output = Activation("softmax")(t)
+
+    model = Model(inputs={1: input_tensor1}, outputs=output)
+    print(model.summary())
+    opt = optimizers.SGD(learning_rate=0.01)
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"])
+    model.fit(x_train, y_train, epochs=int(os.environ.get("EPOCHS", 1)))
+
+
+if __name__ == "__main__":
+    print("Functional API, cifar10 cnn (keras_exp)")
+    top_level_task()

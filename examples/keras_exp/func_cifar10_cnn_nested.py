@@ -1,0 +1,64 @@
+"""keras_exp CIFAR-10 CNN built from two nested tf.keras sub-Models.
+
+Reference: examples/python/keras_exp/func_cifar10_cnn_nested.py — conv
+tower as model1, classifier as model2, composed model1 -> model2 on a
+fresh Input; exercises recursive sub-model inlining in the exporter.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+
+def top_level_task():
+    import keras
+    from keras import optimizers
+    from keras.layers import (Activation, Conv2D, Dense, Flatten, Input,
+                              MaxPooling2D)
+
+    from flexflow_tpu.keras.datasets import cifar10
+    from flexflow_tpu.keras_exp.models import Model
+
+    num_classes = 10
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+
+    cf = dict(data_format="channels_first")
+    input_tensor1 = Input(shape=(3, 32, 32), dtype="float32")
+    t = Conv2D(filters=32, kernel_size=(3, 3), strides=(1, 1),
+               padding="valid", activation="relu", **cf)(input_tensor1)
+    t = Conv2D(filters=32, kernel_size=(3, 3), strides=(1, 1),
+               padding="valid", activation="relu", **cf)(t)
+    t = MaxPooling2D(pool_size=(2, 2), strides=(2, 2), padding="valid",
+                     **cf)(t)
+    model1 = keras.Model(input_tensor1, t, name="tower")
+
+    input_tensor2 = Input(shape=(32, 14, 14), dtype="float32")
+    t = Conv2D(filters=64, kernel_size=(3, 3), strides=(1, 1),
+               padding="valid", activation="relu", **cf)(input_tensor2)
+    t = Conv2D(filters=64, kernel_size=(3, 3), strides=(1, 1),
+               padding="valid", activation="relu", **cf)(t)
+    t = MaxPooling2D(pool_size=(2, 2), strides=(2, 2), padding="valid",
+                     **cf)(t)
+    t = Flatten(**cf)(t)
+    t = Dense(256, activation="relu")(t)
+    t = Dense(num_classes)(t)
+    t = Activation("softmax")(t)
+    model2 = keras.Model(input_tensor2, t, name="classifier")
+
+    input_tensor3 = Input(shape=(3, 32, 32), dtype="float32")
+    out = model2(model1(input_tensor3))
+    model = Model(inputs={3: input_tensor3}, outputs=out)
+    print(model.summary())
+
+    opt = optimizers.SGD(learning_rate=0.01)
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"])
+    model.fit(x_train, y_train, epochs=int(os.environ.get("EPOCHS", 1)))
+
+
+if __name__ == "__main__":
+    print("Functional API, cifar10 cnn nested (keras_exp)")
+    top_level_task()

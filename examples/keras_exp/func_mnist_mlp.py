@@ -1,0 +1,45 @@
+"""keras_exp MNIST MLP: a GENUINE tf.keras functional model exported to
+ONNX bytes and replayed through ONNXModelKeras.
+
+Reference: examples/python/keras_exp/func_mnist_mlp.py (tf.keras Input/
+Dense -> keras2onnx -> flexflow.keras_exp.models.Model). Same layer
+stack, same optimizer/loss/metrics call shape.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
+
+def top_level_task():
+    import keras
+    from keras import optimizers
+    from keras.layers import Activation, Dense, Input
+
+    from flexflow_tpu.keras.datasets import mnist
+    from flexflow_tpu.keras_exp.models import Model
+
+    num_classes = 10
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype("float32") / 255
+    y_train = y_train.astype("int32").reshape(-1, 1)
+    print("shape: ", x_train.shape)
+
+    input_tensor = Input(shape=(784,))
+    output = Dense(512, activation="relu")(input_tensor)
+    output = Dense(512, activation="relu")(output)
+    output = Dense(num_classes)(output)
+    output = Activation("softmax")(output)
+    model = Model(inputs={1: input_tensor}, outputs=output)
+    print(model.summary())
+
+    opt = optimizers.SGD(learning_rate=0.01)
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"])
+    model.fit(x_train, y_train, epochs=int(os.environ.get("EPOCHS", 1)))
+
+
+if __name__ == "__main__":
+    print("Functional API, mnist mlp (keras_exp)")
+    top_level_task()

@@ -82,18 +82,11 @@ class BaseModel:
 
     def fit(self, x, y, epochs: int = 1, batch_size: Optional[int] = None,
             callbacks: Sequence = (), verbose: bool = True):
+        from flexflow_tpu.runtime.dataloader import attach_training_data
+
         assert self.ffmodel is not None, "compile() first"
-        xs = x if isinstance(x, (list, tuple)) else [x]
-        assert len(xs) == len(self._input_fftensors)
-        self.ffmodel._dataloaders = []
-        for t, arr in zip(self._input_fftensors, xs):
-            arr = np.asarray(arr)
-            SingleDataLoader(self.ffmodel, t, arr)
-        y = np.asarray(y)
-        if self._loss == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY \
-                and y.ndim == 1:
-            y = y.reshape(-1, 1)
-        SingleDataLoader(self.ffmodel, self.ffmodel.label_tensor, y)
+        attach_training_data(self.ffmodel, self._input_fftensors, x, y,
+                             self._loss)
         return self.ffmodel.fit(epochs=epochs, batch_size=batch_size,
                                 callbacks=callbacks, verbose=verbose)
 

@@ -1,0 +1,5 @@
+"""keras_exp frontend: genuine tf.keras models -> ONNX bytes -> FFModel.
+
+Reference: python/flexflow/keras_exp/ (tf.keras + keras2onnx + ONNXModelKeras).
+"""
+from flexflow_tpu.keras_exp.models import Model, Sequential  # noqa: F401

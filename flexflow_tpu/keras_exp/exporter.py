@@ -1,0 +1,254 @@
+"""tf.keras -> ONNX exporter: the keras2onnx analog for the keras_exp path.
+
+Reference: python/flexflow/keras_exp/models/model.py:16-60 converts a live
+tf.keras model with `keras2onnx.convert_keras` and replays the resulting
+ONNX graph through ONNXModelKeras. keras2onnx cannot run here (it predates
+TF2.16/Keras 3 and is not in the image), so this module IS the exporter:
+it walks a genuine tf.keras (Keras 3) model — real layer objects, real
+trained weights read through the Keras API — and emits the same
+keras2onnx-style ONNX graph (Gemm with transposed B + bias, activation
+nodes split out, node names = layer names), serialized through the in-repo
+protobuf codec (minionnx, whose wire format is validated against real
+`torch.onnx.export` bytes in tests/test_minionnx.py).
+
+Supported layers mirror the reference keras_exp examples
+(examples/python/keras_exp/*.py): InputLayer, Dense, Activation, Flatten,
+Conv2D, MaxPooling2D, AveragePooling2D, Dropout, Concatenate, Add.
+Conv models must use channels_first data format, exactly as the reference
+examples demand (`backend.set_image_data_format('channels_first')`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from flexflow_tpu.onnx import minionnx as mo
+
+_ACT_NODES = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+              "softmax": "Softmax", "elu": "Elu"}
+
+
+def _act_name(layer) -> str:
+    act = getattr(layer, "activation", None)
+    if act is None:
+        return "linear"
+    return getattr(act, "__name__", str(act))
+
+
+def _pads(layer, kh: int, kw: int, in_hw) -> List[int]:
+    """TF 'same' pads: total = max((ceil(in/s)-1)*s + k - in, 0), with the
+    EXTRA pixel at the END when total is odd. FF conv/pool take symmetric
+    padding, so an asymmetric-'same' combination (even kernels, or
+    stride>1 on mismatched sizes) is refused rather than silently shifted
+    by one pixel."""
+    if getattr(layer, "padding", "valid") != "same":
+        return [0, 0, 0, 0]
+    strides = [int(s) for s in layer.strides]
+    out = []
+    for size, k, s in zip(in_hw, (kh, kw), strides):
+        total = max((-(-size // s) - 1) * s + k - size, 0)
+        if total % 2:
+            raise NotImplementedError(
+                f"keras_exp: padding='same' on layer {layer.name!r} needs "
+                f"asymmetric pads (input {size}, kernel {k}, stride {s}) "
+                f"which the symmetric FF conv cannot express; use "
+                f"padding='valid' or shapes where 'same' is symmetric")
+        out.append(total // 2)
+    ph, pw = out
+    return [ph, pw, ph, pw]
+
+
+class _Export:
+    def __init__(self, batch_size: int):
+        self.batch = batch_size
+        self.nodes: List[mo.NodeProto] = []
+        self.inits: List[mo.TensorProto] = []
+        self.inputs: List[mo.ValueInfoProto] = []
+        self.names: Dict[str, str] = {}  # keras tensor name -> onnx symbol
+        self.prefix = ""  # nested sub-model scope, "outer/inner/"
+        self.used: set = set()  # emitted scoped names (layer-reuse guard)
+
+    def _n(self, layer) -> str:
+        return f"{self.prefix}{layer.name}"
+
+    def _emit_activation(self, layer, act: str, sym: str) -> str:
+        node = _ACT_NODES.get(act)
+        if node is None:
+            raise NotImplementedError(
+                f"keras_exp exporter: activation {act!r} of layer "
+                f"{layer.name!r} has no ONNX mapping")
+        out = f"{self._n(layer)}/{act}:0"
+        self.nodes.append(mo.make_node(node, [sym], [out],
+                                       name=f"{self._n(layer)}/{act}"))
+        return out
+
+    def _inline_model(self, sub, node) -> None:
+        """A keras Model called as a layer (reference
+        func_cifar10_cnn_nested.py): inline its graph under a name scope —
+        sub-model inputs alias the caller's symbols, InputLayers emit
+        nothing, every inner name is prefixed so two sub-models may reuse
+        layer names."""
+        ins = [self.names[t.name] for t in node.input_tensors]
+        for sub_in, sym in zip(sub.inputs, ins):
+            self.names[sub_in.name] = sym
+        saved = self.prefix
+        scope = f"{saved}{sub.name}/"
+        if scope in self.used:
+            raise NotImplementedError(
+                f"keras_exp exporter: sub-model {sub.name!r} is called more "
+                f"than once (weight sharing); instantiate a separate "
+                f"sub-model per call or use the native frontend")
+        self.used.add(scope)
+        self.prefix = scope
+        for depth in sorted(sub._nodes_by_depth.keys(), reverse=True):
+            for n in sub._nodes_by_depth[depth]:
+                if type(n.operation).__name__ == "InputLayer":
+                    continue  # aliased above
+                self.add_layer(n)
+        self.prefix = saved
+        # the caller's output tensor aliases the sub-graph's output
+        for out_t, sub_out in zip(node.output_tensors, sub.outputs):
+            self.names[out_t.name] = self.names[sub_out.name]
+
+    def add_layer(self, node) -> None:
+        """Emit ONNX node(s) for one Keras graph node (layer call)."""
+        layer = node.operation
+        kind = type(layer).__name__
+        if kind in ("Functional", "Sequential") or (
+                hasattr(layer, "_nodes_by_depth") and kind != "InputLayer"):
+            self._inline_model(layer, node)
+            return
+        scoped = self._n(layer)
+        if scoped in self.used:
+            raise NotImplementedError(
+                f"keras_exp exporter: layer {scoped!r} is called more than "
+                f"once (weight sharing); give each call its own layer or "
+                f"use the native frontend's tie_weights")
+        self.used.add(scoped)
+        ins = [self.names[t.name] for t in node.input_tensors]
+        out_t = node.output_tensors[0]
+        out = f"{scoped}:0"
+
+        if kind == "InputLayer":
+            shape = [self.batch] + [int(d) for d in out_t.shape[1:]]
+            self.inputs.append(
+                mo.make_tensor_value_info(layer.name, mo.DT_FLOAT, shape))
+            self.names[out_t.name] = layer.name
+            return
+
+        if kind == "Dense":
+            # keras2onnx layout: Gemm with B = kernel^T (out, in), transB
+            # semantics — ONNXModelKeras reads out_dim from B.dims[0]
+            k, *rest = layer.get_weights()
+            wname = f"{self._n(layer)}/kernel:0"
+            self.inits.append(mo.from_array(
+                np.ascontiguousarray(k.T.astype(np.float32)), wname))
+            gemm_in = [ins[0], wname]
+            if layer.use_bias:
+                bname = f"{self._n(layer)}/bias:0"
+                self.inits.append(mo.from_array(
+                    rest[0].astype(np.float32), bname))
+                gemm_in.append(bname)
+            self.nodes.append(mo.make_node(
+                "Gemm", gemm_in, [out], name=self._n(layer), alpha=1.0,
+                beta=1.0, transB=1))
+            act = _act_name(layer)
+            if act != "linear":
+                out = self._emit_activation(layer, act, out)
+        elif kind == "Conv2D":
+            if layer.data_format != "channels_first":
+                raise NotImplementedError(
+                    "keras_exp conv models must use channels_first "
+                    "(reference keras_exp examples set "
+                    "backend.set_image_data_format('channels_first'))")
+            k, *rest = layer.get_weights()  # HWIO
+            kh, kw = int(k.shape[0]), int(k.shape[1])
+            wname = f"{self._n(layer)}/kernel:0"
+            self.inits.append(mo.from_array(
+                np.ascontiguousarray(
+                    k.transpose(3, 2, 0, 1).astype(np.float32)), wname))
+            conv_in = [ins[0], wname]
+            if layer.use_bias:
+                bname = f"{self._n(layer)}/bias:0"
+                self.inits.append(mo.from_array(
+                    rest[0].astype(np.float32), bname))
+                conv_in.append(bname)
+            self.nodes.append(mo.make_node(
+                "Conv", conv_in, [out], name=self._n(layer),
+                kernel_shape=[kh, kw],
+                strides=[int(s) for s in layer.strides],
+                pads=_pads(layer, kh, kw,
+                           node.input_tensors[0].shape[2:4]),
+                group=int(getattr(layer, "groups", 1))))
+            act = _act_name(layer)
+            if act != "linear":
+                out = self._emit_activation(layer, act, out)
+        elif kind in ("MaxPooling2D", "AveragePooling2D"):
+            ph, pw = (int(p) for p in layer.pool_size)
+            self.nodes.append(mo.make_node(
+                "MaxPool" if kind == "MaxPooling2D" else "AveragePool",
+                ins, [out], name=self._n(layer), kernel_shape=[ph, pw],
+                strides=[int(s) for s in layer.strides],
+                pads=_pads(layer, ph, pw,
+                           node.input_tensors[0].shape[2:4])))
+        elif kind == "Flatten":
+            sym = ins[0]
+            in_rank = len(node.input_tensors[0].shape)
+            if getattr(layer, "data_format", None) == "channels_first" \
+                    and in_rank > 2:
+                # keras Flatten(channels_first) switches to channels-last
+                # BEFORE reshaping (keras2onnx emitted the same Transpose)
+                perm = [0] + list(range(2, in_rank)) + [1]
+                tsym = f"{self._n(layer)}/transpose:0"
+                self.nodes.append(mo.make_node(
+                    "Transpose", [sym], [tsym],
+                    name=f"{self._n(layer)}/transpose", perm=perm))
+                sym = tsym
+            self.nodes.append(mo.make_node("Flatten", [sym], [out],
+                                           name=self._n(layer)))
+        elif kind == "Activation":
+            out = self._emit_activation(layer, _act_name(layer), ins[0])
+        elif kind == "Dropout":
+            self.nodes.append(mo.make_node("Dropout", ins, [out],
+                                           name=self._n(layer),
+                                           ratio=float(layer.rate)))
+        elif kind == "Concatenate":
+            self.nodes.append(mo.make_node("Concat", ins, [out],
+                                           name=self._n(layer),
+                                           axis=int(layer.axis)))
+        elif kind == "Add":
+            self.nodes.append(mo.make_node("Add", ins, [out],
+                                           name=self._n(layer)))
+        else:
+            raise NotImplementedError(
+                f"keras_exp exporter: unsupported layer type {kind} "
+                f"({layer.name!r})")
+        self.names[out_t.name] = out
+
+
+def keras_to_onnx(model, batch_size: int) -> bytes:
+    """Convert a live tf.keras model to serialized ONNX bytes (the
+    keras2onnx.convert_keras analog). Returns the protobuf wire bytes —
+    callers parse them back with minionnx.parse, so the exact exported
+    bytes are what reaches the graph importer."""
+    ex = _Export(batch_size)
+    # Keras 3 functional graphs: _nodes_by_depth walks producers before
+    # consumers at descending depth
+    for depth in sorted(model._nodes_by_depth.keys(), reverse=True):
+        for node in model._nodes_by_depth[depth]:
+            ex.add_layer(node)
+    out_syms = [ex.names[t.name] for t in model.outputs]
+    # graph inputs follow the model.inputs order, so a multi-input fit's
+    # array list lines up positionally (reference passes {key: Input} dicts)
+    order = [ex.names[t.name] for t in model.inputs]
+    ex.inputs.sort(key=lambda vi: order.index(vi.name))
+    graph = mo.make_graph(
+        ex.nodes, model.name or "keras_model", ex.inputs,
+        [mo.make_tensor_value_info(s, mo.DT_FLOAT, [])
+         for s in out_syms],
+        initializer=ex.inits)
+    proto = mo.make_model(graph)
+    proto.producer_name = "flexflow_tpu.keras_exp"
+    return mo.serialize(proto)

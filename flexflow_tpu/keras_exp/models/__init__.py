@@ -1,0 +1,2 @@
+from flexflow_tpu.keras_exp.models.model import (BaseModel, Model,  # noqa: F401
+                                                 Sequential)

@@ -1,0 +1,171 @@
+"""keras_exp: drive FFModel training from a GENUINE tf.keras model.
+
+Reference: python/flexflow/keras_exp/models/model.py — BaseModel wraps a
+tf.keras Model, converts it with keras2onnx, replays the ONNX graph
+through ONNXModelKeras, maps the tf.keras optimizer onto the FF one, and
+fit()s with FF dataloaders. This is the same flow with the in-repo
+exporter (exporter.py) in keras2onnx's seat: the live Keras layers and
+their real weights are serialized to ONNX protobuf BYTES and those exact
+bytes are parsed back (minionnx) to build + initialize the FFModel —
+nothing is read from the Keras object after export.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.keras.optimizers import get_optimizer
+from flexflow_tpu.model import FFModel
+from flexflow_tpu.onnx import minionnx
+from flexflow_tpu.onnx.model import ONNXModelKeras
+from flexflow_tpu.runtime.loss import LossType, loss_type_from_name
+from flexflow_tpu.runtime.metrics import metrics_from_names
+
+
+def _map_keras_optimizer(opt):
+    """tf.keras optimizer instance -> FF optimizer (reference maps the
+    tf.keras optimizer config onto flexflow optimizers the same way)."""
+    kind = type(opt).__name__.lower()
+    from flexflow_tpu.runtime.optimizer import AdamOptimizer, SGDOptimizer
+
+    if kind not in ("sgd", "adam", "adamw"):
+        return get_optimizer(opt)  # FF-side optimizers / strings
+    try:
+        lr = float(np.asarray(opt.learning_rate))
+    except Exception as e:
+        raise NotImplementedError(
+            f"keras_exp: cannot map {type(opt).__name__}.learning_rate "
+            f"({opt.learning_rate!r}) to a constant — keras LR schedule "
+            f"objects are unsupported; use FFConfig/FF optimizers with a "
+            f"runtime/schedule.py schedule instead") from e
+    if kind == "adamw":
+        return AdamOptimizer(alpha=lr, beta1=float(opt.beta_1),
+                             beta2=float(opt.beta_2),
+                             weight_decay=float(opt.weight_decay))
+    if kind == "adam":
+        return AdamOptimizer(alpha=lr, beta1=float(opt.beta_1),
+                             beta2=float(opt.beta_2))
+    return SGDOptimizer(lr=lr, momentum=float(np.asarray(opt.momentum)))
+
+
+class BaseModel:
+    def __init__(self, keras_model, ffconfig: Optional[FFConfig] = None):
+        from flexflow_tpu.keras_exp.exporter import keras_to_onnx
+
+        self.ffconfig = ffconfig or FFConfig.parse_args()
+        # the exported BYTES are the interface: serialize the live keras
+        # model, then parse those bytes back for the importer
+        self.onnx_bytes = keras_to_onnx(keras_model, self.ffconfig.batch_size)
+        self.onnx_model = minionnx.parse(self.onnx_bytes)
+        self.ffmodel: Optional[FFModel] = None
+        self._keras_name = keras_model.name
+
+    # ---- reference BaseModel.compile (model.py:80-160) ---------------------
+    def compile(self, optimizer, loss=None, metrics=None, **kwargs):
+        self._optimizer = _map_keras_optimizer(optimizer)
+        self._loss = loss_type_from_name(loss)
+        self._metrics = metrics_from_names(metrics or [])
+        ff = FFModel(self.ffconfig)
+        importer = ONNXModelKeras(self.onnx_model)
+        input_dict = {}
+        self._input_fftensors = []
+        for vi in self.onnx_model.graph.input:
+            t = ff.create_tensor(list(vi.type.shape_dims), name=vi.name)
+            input_dict[vi.name] = t
+            self._input_fftensors.append(t)
+        out = importer.apply(ff, input_dict)
+        if isinstance(out, (list, tuple)):
+            out = out[-1]
+        ff.compile(self._optimizer, self._loss, self._metrics,
+                   final_tensor=out)
+        self._load_weights_from_onnx(ff, importer)
+        self.ffmodel = ff
+        return self
+
+    def _load_weights_from_onnx(self, ff, importer):
+        """Initialize FF params from the graph INITIALIZERS — the weights
+        ride the exported bytes, proving the protobuf carries the real
+        keras state (Gemm B is stored (out, in) keras2onnx-style, FF dense
+        kernels are (in, out); Conv is OIHW on both sides)."""
+        ops = {op.name: op for op in ff.ops}
+        for node in self.onnx_model.graph.node:
+            if node.op_type not in ("Gemm", "Dense", "Conv", "MatMul"):
+                continue
+            if node.name not in ops or len(node.input) < 2:
+                continue
+            w = importer.initializer.get(node.input[1])
+            if w is None:
+                continue
+            kernel = minionnx.to_array(w)
+            if node.op_type in ("Gemm", "Dense"):
+                kernel = np.ascontiguousarray(kernel.T)
+            ff.set_weights(node.name, "kernel", kernel)
+            if len(node.input) > 2:
+                b = importer.initializer.get(node.input[2])
+                if b is not None:
+                    ff.set_weights(node.name, "bias", minionnx.to_array(b))
+
+    # ---- reference BaseModel.fit (model.py:160-220) ------------------------
+    def fit(self, x, y, batch_size: Optional[int] = None, epochs: int = 1,
+            callbacks: Sequence = (), verbose: bool = True):
+        from flexflow_tpu.runtime.dataloader import attach_training_data
+
+        assert self.ffmodel is not None, "compile() first"
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        attach_training_data(self.ffmodel, self._input_fftensors,
+                             [np.asarray(a, np.float32) for a in xs],
+                             y, self._loss)
+        return self.ffmodel.fit(epochs=epochs, batch_size=batch_size,
+                                callbacks=callbacks, verbose=verbose)
+
+    def predict(self, x) -> np.ndarray:
+        assert self.ffmodel is not None, "compile() first"
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        batch = {t.owner_op.name: np.asarray(a, np.float32)
+                 for t, a in zip(self._input_fftensors, xs)}
+        return np.asarray(self.ffmodel.predict(batch))
+
+    def summary(self) -> str:
+        g = self.onnx_model.graph
+        lines = [f"keras_exp model {self._keras_name!r}: "
+                 f"{len(g.node)} onnx nodes, {len(g.initializer)} weights"]
+        for n in g.node:
+            lines.append(f"  {n.op_type:>12} {n.name or '-'} "
+                         f"{list(n.input)} -> {list(n.output)}")
+        return "\n".join(lines)
+
+
+class Model(BaseModel):
+    """Functional keras_exp entry (reference model.py:252-268): accepts
+    live tf.keras Input/output tensors, builds the tf.keras Model, then
+    the shared BaseModel export/replay flow."""
+
+    def __init__(self, inputs, outputs, name: Optional[str] = None,
+                 ffconfig: Optional[FFConfig] = None):
+        import keras
+
+        if isinstance(inputs, dict):
+            inputs = list(inputs.values())
+        if isinstance(inputs, (list, tuple)) and len(inputs) == 1:
+            inputs = inputs[0]
+        km = keras.Model(inputs=inputs, outputs=outputs,
+                         name=name or "keras_exp_model")
+        super().__init__(km, ffconfig=ffconfig)
+
+
+class Sequential(BaseModel):
+    """Sequential keras_exp entry (reference model.py:270-290)."""
+
+    def __init__(self, layers=None, name: Optional[str] = None,
+                 ffconfig: Optional[FFConfig] = None):
+        import keras
+
+        km = keras.Sequential(layers or [], name=name or "keras_exp_seq")
+        if not km.built:
+            raise ValueError(
+                "Sequential keras_exp models need an Input layer first "
+                "(keras.Input(shape=...)) so shapes are known at export")
+        super().__init__(km, ffconfig=ffconfig)

@@ -479,12 +479,29 @@ class FFModel:
                 save_strategies_to_file(cfg.export_strategy_file, cfg.strategies)
 
         self._final_tensor = final_tensor or self.ops[-1].outputs[0]
+        # fused softmax + cross-entropy, the reference semantics: its CE
+        # loss kernels consume the Softmax OUTPUT with an identity backward
+        # through the softmax (loss_functions.cu grad = probs - one_hot),
+        # which equals CE-from-logits. compute_loss applies log_softmax
+        # itself, so a graph ending in Softmax must feed the loss its
+        # logits INPUT — otherwise training runs on a double softmax with
+        # flattened gradients. predict()/generate() still return the
+        # softmax output.
+        self._loss_tensor = self._final_tensor
+        if self.loss_type in (LossType.LOSS_CATEGORICAL_CROSSENTROPY,
+                              LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY):
+            fop = self._final_tensor.owner_op
+            from flexflow_tpu.ops.norm import Softmax as _Softmax
+
+            if isinstance(fop, _Softmax) \
+                    and fop.axis in (-1, fop.outputs[0].num_dims - 1):
+                self._loss_tensor = fop.inputs[0]
 
         if cfg.perform_fusion:
             # reference: FFModel::apply_fusion after search (model.cc:1538-1593)
             from flexflow_tpu.ops.fused import apply_fusion
 
-            protected = [self._final_tensor] + list(
+            protected = [self._final_tensor, self._loss_tensor] + list(
                 getattr(self, "_aux_tensors", ()))
             apply_fusion(self, protected=protected)
 
@@ -516,9 +533,9 @@ class FFModel:
             self.opt_state = self.optimizer.init_state(self.params)
             self._train_step = self.executor.make_train_step(
                 self.optimizer, self.loss_type, self.metric_types,
-                self._final_tensor)
+                self._loss_tensor)
         self._eval_step = self.executor.make_eval_step(
-            self.loss_type, self.metric_types, self._final_tensor)
+            self.loss_type, self.metric_types, self._loss_tensor)
 
         if cfg.taskgraph_file:
             from flexflow_tpu.runtime.profiler import export_sim_taskgraph
@@ -640,7 +657,7 @@ class FFModel:
         if self._train_scan is None:
             self._train_scan = self.executor.make_train_scan(
                 self.optimizer, self.loss_type, self.metric_types,
-                self._final_tensor)
+                self._loss_tensor)
         staged = {dl.name: dl._dev_data for dl in self._dataloaders}
         nb = min(dl.num_batches for dl in self._dataloaders)
         start = (self._dataloaders[0].next_index

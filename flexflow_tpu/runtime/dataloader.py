@@ -115,3 +115,23 @@ class SingleDataLoader:
             bi = (start // b) % self._dev_data.shape[0]
             return self._dev_slice(self._dev_data, bi)
         return self.data[start:start + b]
+
+
+def attach_training_data(ffmodel, input_tensors, x, y, loss_type):
+    """Shared keras-style fit() plumbing (keras + keras_exp frontends):
+    reset dataloaders, attach one loader per graph input, reshape 1-D
+    sparse-CE labels to the (N, 1) the label tensor expects, attach the
+    label loader."""
+    from flexflow_tpu.ffconst import LossType
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    assert len(xs) == len(input_tensors), \
+        f"{len(xs)} input arrays for {len(input_tensors)} graph inputs"
+    ffmodel._dataloaders = []
+    for t, arr in zip(input_tensors, xs):
+        SingleDataLoader(ffmodel, t, np.asarray(arr))
+    y = np.asarray(y)
+    if loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY \
+            and y.ndim == 1:
+        y = y.reshape(-1, 1)
+    SingleDataLoader(ffmodel, ffmodel.label_tensor, y)
