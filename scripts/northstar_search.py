@@ -121,6 +121,23 @@ def build_workload(name: str, batch: Optional[int] = None):
         ff = FFModel(cfg)
         llama_lm(ff, cfg.batch_size, seq_len=512, hidden=1024, layers=8,
                  heads=16, kv_heads=4, vocab_size=32_000)
+    elif name == "llama8b":
+        # the REAL Llama-3-8B shape BASELINE.json config 5 names (hidden
+        # 4096, 32 layers, 32 heads / 8 kv, ffn 14336, vocab 128256) on a
+        # simulated 64-chip two-tier pod (8 hosts x 8 chips): the
+        # scale-shaped joint search — expect the winner to COMBINE axes
+        # (TP over 'model' x DP/FSDP over 'data'), not pick one
+        from flexflow_tpu.models.llama import llama_lm
+
+        mesh = {"data": 8, "model": 8}
+        # default batch 16 @ seq 4096 = 65k tokens: the memory/latency-
+        # bound regime (fine-tune/RL-scale) where pure DP both exceeds
+        # HBM (weights replicated) and cannot shard 64 ways — the regime
+        # where joint search must find combined-axis structure
+        cfg = FFConfig(batch_size=batch or 16, mesh_shape=mesh)
+        ff = FFModel(cfg)
+        llama_lm(ff, cfg.batch_size, seq_len=4096, hidden=4096, layers=32,
+                 heads=32, kv_heads=8, ffn_hidden=14336, vocab_size=128_256)
     elif name == "dlrm":
         # reference run_summit.sh: 512 samples/device batch, 1M-row x 64-dim
         # tables, mlp-bot 64-512-512-64, mlp-top 576-1024-1024-1024-1
@@ -138,13 +155,21 @@ def run_one(name: str, budget: int, seed: int = 0, verbose: bool = True,
             batch: Optional[int] = None, costs: str = "analytic",
             fsdp: bool = False):
     ff, mesh = build_workload(name, batch)
+    if name == "llama8b":
+        fsdp = True  # an 8B can't replicate weights per chip: ZeRO-3 regime
     if fsdp:
         # price the run under FSDP (FFConfig.fsdp_axis): CostModel picks
         # the axis up from the config; the annealer then skips placement
         # proposals (csim.native semantics) — mirrored here via
         # allow_place on the direct prob.mcmc call below
         ff.config.fsdp_axis = "data"
-    machine = v5e32_machine()
+    if name == "llama8b":
+        # two-tier 64-chip pod: ICI within each 8-chip host, DCN across 8
+        machine = MachineModel(dcn_axes={"data": mesh["data"]})
+        machine_desc = "simulated 64-chip pod (8 hosts x 8 chips, ICI+DCN)"
+    else:
+        machine = v5e32_machine()
+        machine_desc = "simulated v5e-32 (4 hosts x 8 chips, ICI+DCN)"
     measured = None
     if costs == "analyze":
         # compile-only XLA cost analysis per shard signature on the attached
@@ -167,8 +192,30 @@ def run_one(name: str, budget: int, seed: int = 0, verbose: bool = True,
     prob = get_search_problem(ff, cost, mesh)
     build_s = time.time() - t0
 
-    dp_choices = prob.choices_for(full_dp_strategy(ff, mesh))
+    dp_map = full_dp_strategy(ff, mesh)
+    dp_choices = prob.choices_for(dp_map)
     dp_cost = prob.simulate(dp_choices)
+
+    # memory honesty: when pure DP does not FIT per-chip HBM, its
+    # simulated time is dominated by the 1 ms/MB over-capacity penalty
+    # (the reference's pricing, simulator.cc:595-620) — report per-chip
+    # bytes and a second DP number on a hypothetical infinite-HBM machine
+    # so the speedup can be read as feasibility + time, not conflated
+    from flexflow_tpu.ops.base import InputOp
+
+    dp_mem = sum(cost.op_mem_bytes(op, dp_map.get(op.name, {}))
+                 for op in ff.ops if not isinstance(op, InputOp))
+    dp_fits = dp_mem <= machine.hbm_bytes
+    dp_nopenalty_cost = None
+    if not dp_fits:
+        import dataclasses
+
+        # price ONE fixed strategy on the infinite-HBM machine via the
+        # Python schedule mirror — no O(edges x choices^2) table rebuild
+        machine_inf = dataclasses.replace(machine, hbm_bytes=1e18)
+        cost_inf = CostModel(ff, mesh, machine=machine_inf, dtype_bytes=2,
+                             measured=measured)
+        dp_nopenalty_cost = cost_inf.iteration_time(dp_map)
 
     t0 = time.time()
     # authoritative gate: whatever ended up in the cost model (CLI flag OR
@@ -179,21 +226,42 @@ def run_one(name: str, budget: int, seed: int = 0, verbose: bool = True,
     search_s = time.time() - t0
     speedup = dp_cost / max(best_cost, 1e-12)
 
-    # summarize what the search chose
+    # summarize what the search chose, per mesh axis: which PARALLELISM
+    # KINDS the winner uses (dp = sample dim, tp = non-sample output dim,
+    # contract = row-parallel weight shard, stage = pipeline) — the
+    # scale-shaped check is that a big-model winner COMBINES axes
+    from flexflow_tpu.parallel.pconfig import CONTRACT, STAGE
+
     n_tp = n_placed = 0
+    axes_used: dict = {}
     for i, op in enumerate(prob.ops):
         am = prob.op_maps[i][int(best_c[i])]
         if any(d is not None and d != 0 for d in am.values()):
             n_tp += 1
         if int(best_p[i]) != 0:
             n_placed += 1
+        for ax, d in am.items():
+            if d is None:
+                continue
+            kind = ("dp" if d == 0 else "contract" if d == CONTRACT
+                    else "stage" if d == STAGE else "tp")
+            axes_used.setdefault(ax, set()).add(kind)
+    # NB: 'fsdp' here is config-imposed pricing (every weight shards over
+    # that axis), not a search choice — assertions about search-CHOSEN
+    # structure must look at dp/tp/contract/stage entries instead
+    if cost.fsdp_axis:
+        axes_used.setdefault(cost.fsdp_axis, set()).add("fsdp")
+    axes_used = {k: sorted(v) for k, v in axes_used.items()}
+    best_mem = sum(
+        cost.op_mem_bytes(op, prob.op_maps[i][int(best_c[i])])
+        for i, op in enumerate(prob.ops))
 
     result = {
         "workload": name,
         "fsdp": fsdp,
         "costs": costs,
         "global_batch": ff.config.batch_size,
-        "machine": "simulated v5e-32 (4 hosts x 8 chips, ICI+DCN)",
+        "machine": machine_desc,
         "num_ops": len(prob.ops),
         "dp_iter_ms": round(dp_cost * 1e3, 3),
         "best_iter_ms": round(best_cost * 1e3, 3),
@@ -201,6 +269,17 @@ def run_one(name: str, budget: int, seed: int = 0, verbose: bool = True,
         "target": 1.5,
         "ops_with_model_parallel_dims": n_tp,
         "ops_placed_off_block0": n_placed,
+        "axes_used": axes_used,
+        "dp_mem_gb_per_chip": round(dp_mem / 1e9, 1),
+        "best_mem_gb_per_chip": round(best_mem / 1e9, 1),
+        "hbm_gb_per_chip": round(machine.hbm_bytes / 1e9, 1),
+        "dp_fits_hbm": dp_fits,
+        # None when DP fits (dp_iter_ms already penalty-free then)
+        "dp_nopenalty_iter_ms": (round(dp_nopenalty_cost * 1e3, 3)
+                                 if dp_nopenalty_cost is not None else None),
+        "speedup_vs_dp_nopenalty": (
+            round(dp_nopenalty_cost / max(best_cost, 1e-12), 3)
+            if dp_nopenalty_cost is not None else None),
         "budget": budget,
         "table_build_s": round(build_s, 1),
         "search_s": round(search_s, 1),
@@ -216,7 +295,7 @@ def main():
                     help="MCMC iterations (reference --budget)")
     ap.add_argument("--workload", default="all",
                     choices=["all", "transformer", "bert_fx", "llama",
-                             "resnet50", "inception",
+                             "llama8b", "resnet50", "inception",
                              "dlrm"])
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--batch", type=int, default=None,
@@ -233,8 +312,8 @@ def main():
                          "placement proposals)")
     args = ap.parse_args()
 
-    names = (["transformer", "bert_fx", "llama", "resnet50", "inception",
-              "dlrm"]
+    names = (["transformer", "bert_fx", "llama", "llama8b", "resnet50",
+              "inception", "dlrm"]
              if args.workload == "all" else [args.workload])
     results = [run_one(n, args.budget, args.seed, batch=args.batch,
                        costs=args.costs, fsdp=args.fsdp)
@@ -243,13 +322,18 @@ def main():
         results += [run_one(n, args.budget, args.seed, batch=16 * 32,
                             costs=args.costs, fsdp=args.fsdp)
                     for n in names if n != "dlrm"]
-    print("\n== north-star summary (simulated v5e-32) ==")
+    print("\n== north-star summary (simulated) ==")
     for r in results:
         flag = "MET" if r["speedup_vs_dp"] >= r["target"] else "below"
-        print(f"  {r['workload']:<12} b={r['global_batch']:<6} "
-              f"DP {r['dp_iter_ms']:>9.3f} ms -> "
-              f"best {r['best_iter_ms']:>9.3f} ms  "
-              f"({r['speedup_vs_dp']:.2f}x vs target 1.5x: {flag})")
+        line = (f"  {r['workload']:<12} b={r['global_batch']:<6} "
+                f"DP {r['dp_iter_ms']:>9.3f} ms -> "
+                f"best {r['best_iter_ms']:>9.3f} ms  "
+                f"({r['speedup_vs_dp']:.2f}x vs target 1.5x: {flag})")
+        if not r["dp_fits_hbm"]:
+            line += (f"  [DP needs {r['dp_mem_gb_per_chip']} GB/chip vs "
+                     f"{r['hbm_gb_per_chip']} HBM — infeasible; vs "
+                     f"no-penalty DP: {r['speedup_vs_dp_nopenalty']:.2f}x]")
+        print(line)
     return 0
 
 
