@@ -9,7 +9,7 @@
 #   accuracy  — accuracy-gated training runs (nightly tier)
 #   native    — C shim + C++ apps build & run
 #
-# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|all]
+# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|all]
 set -e
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -36,13 +36,15 @@ run_native()   {
   FFT_JAX_PLATFORMS=cpu FFT_NUM_CPU_DEVICES=4 FFT_REPO_ROOT="$ROOT" \
     ./examples/cpp/alexnet 16 1 32
 }
+run_docs()     { make -C docs html; }
 
 case "$TIER" in
   unit)     run_unit ;;
   sweep)    run_sweep ;;
   accuracy) run_accuracy ;;
   native)   run_native ;;
-  all)      run_unit; run_native; run_sweep ;;
+  docs)     run_docs ;;
+  all)      run_unit; run_native; run_docs; run_sweep ;;
   *) echo "unknown tier $TIER"; exit 2 ;;
 esac
 echo "ci($TIER): PASSED"

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Render the markdown doc tree to HTML (reference parity: docs/Makefile +
+Sphinx tree, /root/reference/docs/. Sphinx is not in this image, so this
+uses the stdlib-adjacent `markdown` package — same role: a rendered,
+navigable doc build from the committed sources).
+
+Usage: python docs/build_docs.py [outdir]   (default docs/_build/html)
+Or: make -C docs html
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+try:
+    import markdown
+except ImportError:  # minimal fallback: readable <pre> pages, no deps
+    markdown = None
+
+DOCS = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(DOCS)
+
+PAGES = [("index", os.path.join(ROOT, "README.md"), "Overview"),
+         ("architecture", os.path.join(DOCS, "architecture.md"),
+          "Architecture"),
+         ("migration", os.path.join(DOCS, "migration.md"),
+          "Migration from FlexFlow"),
+         ("install", os.path.join(ROOT, "INSTALL.md"), "Install")]
+# every round-notes file, newest first (numeric: round10 > round9)
+_rounds = []
+for fn in os.listdir(DOCS):
+    m = re.match(r"round(\d+)_notes\.md$", fn)
+    if m:
+        _rounds.append((int(m.group(1)), fn))
+for n_round, fn in sorted(_rounds, reverse=True):
+    PAGES.append((f"round{n_round}", os.path.join(DOCS, fn),
+                  f"Round {n_round} notes"))
+
+TEMPLATE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>{title} — flexflow_tpu</title>
+<style>
+body {{ font: 15px/1.5 system-ui, sans-serif; max-width: 60rem;
+       margin: 2rem auto; padding: 0 1rem; color: #1a1a1a; }}
+nav {{ border-bottom: 1px solid #ddd; padding-bottom: .5rem;
+      margin-bottom: 1.5rem; }}
+nav a {{ margin-right: 1rem; }}
+pre {{ background: #f6f8fa; padding: .8rem; overflow-x: auto; }}
+code {{ background: #f6f8fa; padding: .1rem .25rem; }}
+table {{ border-collapse: collapse; }}
+td, th {{ border: 1px solid #ccc; padding: .3rem .6rem; }}
+</style></head><body>
+<nav>{nav}</nav>
+{body}
+</body></html>
+"""
+
+
+def build(outdir: str) -> int:
+    os.makedirs(outdir, exist_ok=True)
+    nav = " ".join(f'<a href="{slug}.html">{title}</a>'
+                   for slug, _, title in PAGES)
+    n = 0
+    for slug, path, title in PAGES:
+        if not os.path.exists(path):
+            print(f"skip {path} (missing)", file=sys.stderr)
+            continue
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        if markdown is not None:
+            body = markdown.markdown(
+                text, extensions=["tables", "fenced_code"])
+        else:
+            import html
+
+            body = f"<pre>{html.escape(text)}</pre>"
+        with open(os.path.join(outdir, f"{slug}.html"), "w",
+                  encoding="utf-8") as f:
+            f.write(TEMPLATE.format(title=title, nav=nav, body=body))
+        n += 1
+    print(f"built {n} pages -> {outdir}")
+    return 0
+
+
+if __name__ == "__main__":
+    out = sys.argv[1] if len(sys.argv) > 1 else \
+        os.path.join(DOCS, "_build", "html")
+    sys.exit(build(out))

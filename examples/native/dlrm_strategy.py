@@ -8,8 +8,13 @@ vocab rows stay whole, channels split — the memory-balancing analog of the
 reference's per-GPU table placement), interaction + MLPs run data-parallel.
 
 Usage: python examples/native/dlrm_strategy.py --out dlrm_strategy.txt
-       [--num-tables 8] [--data 4] [--model 2]
+       [--num-tables 8] [--data 4] [--model 2] [--hetero]
 Then:  python examples/native/dlrm.py --import dlrm_strategy.txt
+
+--hetero emits the reference's HETEROGENEOUS strategy
+(dlrm_strategy_hetero.cc): embedding tables on the HOST CPU backend
+(device_type CPU in the file, the embedding_avx2.cc analog), MLPs
+data-parallel on the accelerator pool.
 """
 
 import argparse
@@ -27,6 +32,9 @@ def main():
     ap.add_argument("--model", type=int, default=2)
     ap.add_argument("--mlp-bot", type=int, default=3)
     ap.add_argument("--mlp-top", type=int, default=4)
+    ap.add_argument("--hetero", action="store_true",
+                    help="embeddings on the host CPU backend "
+                         "(dlrm_strategy_hetero.cc analog)")
     args = ap.parse_args()
 
     from flexflow_tpu.parallel.pconfig import ParallelConfig
@@ -34,10 +42,13 @@ def main():
 
     mesh = {"data": args.data, "model": args.model}
     strategies = {}
-    # embeddings: batch over 'data', embedding channels over 'model'
+    # embeddings: hetero -> host CPU backend (reference CPU embeddings);
+    # otherwise batch over 'data', embedding channels over 'model'
     for i in range(args.num_tables):
-        strategies[f"emb_{i}"] = ParallelConfig.from_axis_map(
-            2, mesh, {"data": 0, "model": 1})
+        strategies[f"emb_{i}"] = (
+            ParallelConfig.host(2) if args.hetero
+            else ParallelConfig.from_axis_map(
+                2, mesh, {"data": 0, "model": 1}))
     # MLPs: pure data parallel (the reference keeps MLPs data-parallel and
     # embeddings placed, run_summit.sh strategy files)
     for i in range(args.mlp_bot):

@@ -66,6 +66,17 @@ class ParallelConfig:
         return ParallelConfig(dims=(1,) * ndims, device_ids=(0,), axis_map={})
 
     @staticmethod
+    def host(ndims: int) -> "ParallelConfig":
+        """Host (CPU) placement: the op runs replicated on the host CPU
+        backend via a PlacementExecutor group — the reference's
+        heterogeneous strategy (CPU embeddings with AVX2 kernels,
+        src/ops/embedding_avx2.cc:5-30 + DLRM
+        examples/cpp/DLRM/dlrm_strategy_hetero.cc). Degree 1: like the
+        reference's per-node CPU embedding, host ops do not shard."""
+        return ParallelConfig(dims=(1,) * ndims, device_ids=(0,),
+                              axis_map={}, device_type="CPU")
+
+    @staticmethod
     def from_axis_map(ndims: int, mesh_shape: Dict[str, int],
                       axis_map: Dict[str, Optional[int]]) -> "ParallelConfig":
         dims = [1] * ndims

@@ -43,15 +43,17 @@ class PlacementGroup:
     interleave ops from parallel branches in insertion order, and strictly
     consecutive runs would fragment them into many tiny programs)."""
 
-    def __init__(self, index: int, place: int, ndev: int, mesh: Mesh):
+    def __init__(self, index: int, place: int, ndev: int, mesh: Mesh,
+                 devtype: str = "TPU"):
         self.index = index
         self.place = place
         self.ndev = ndev
         self.mesh = mesh
+        self.devtype = devtype  # "TPU" (accelerator pool) | "CPU" (host)
         self.ops: List[Op] = []
 
     def __repr__(self):
-        return (f"PlacementGroup({self.index}: devices "
+        return (f"PlacementGroup({self.index}: {self.devtype} devices "
                 f"[{self.place},{self.place + self.ndev}), "
                 f"ops={[o.name for o in self.ops]})")
 
@@ -94,6 +96,10 @@ def has_placement(strategies: Dict[str, ParallelConfig],
     as one full-mesh program. Any genuine multi-block placement necessarily
     has an op whose block starts at a non-zero device."""
     for pc in strategies.values():
+        if getattr(pc, "device_type", "TPU") == "CPU":
+            # host-placed op (reference hetero DLRM: embeddings on CPU,
+            # embedding_avx2.cc) — always needs the per-group executor
+            return True
         ids = getattr(pc, "device_ids", ())
         if (ids and min(ids) > 0 and 0 < len(ids) < num_devices
                 and num_devices % len(ids) == 0):
@@ -197,9 +203,26 @@ class PlacementExecutor:
             if isinstance(op, InputOp):
                 continue
             am = self.base._op_axis_maps.get(op.name, {})
-            place, ndev = op_block(strategies.get(op.name), am,
-                                   self.mesh_shape, self.num_devices)
-            op_axes = {ax: d for ax, d in am.items() if d is not None}
+            pc = strategies.get(op.name)
+            devtype = getattr(pc, "device_type", "TPU") if pc else "TPU"
+            if devtype == "CPU":
+                # host placement (reference embedding_avx2.cc /
+                # dlrm_strategy_hetero.cc): the op runs replicated on the
+                # host CPU backend — one device per process, like the
+                # reference's per-node CPU embedding
+                op_axes = {ax: d for ax, d in am.items() if d is not None}
+                if op_axes:
+                    raise NotImplementedError(
+                        f"op {op.name!r}: device_type CPU with a sharded "
+                        f"axis_map {op_axes} — host-placed ops run "
+                        f"replicated on the host backend; drop the "
+                        f"sharding or place the op back on the "
+                        f"accelerator pool")
+                place, ndev = 0, 1
+            else:
+                place, ndev = op_block(pc, am, self.mesh_shape,
+                                       self.num_devices)
+                op_axes = {ax: d for ax, d in am.items() if d is not None}
             g_min = 0
             for t in op.inputs:
                 if t.owner_op is not None \
@@ -210,7 +233,8 @@ class PlacementExecutor:
             target = None
             for gi in range(g_min, len(self.groups)):
                 g = self.groups[gi]
-                if g.place != place or g.ndev != ndev:
+                if g.place != place or g.ndev != ndev \
+                        or g.devtype != devtype:
                     continue
                 cand = dict(group_axes[gi])
                 cand.update(op_axes)
@@ -219,14 +243,19 @@ class PlacementExecutor:
                     group_axes[gi] = cand
                     break
             if target is None:
-                target = PlacementGroup(len(self.groups), place, ndev, None)
+                target = PlacementGroup(len(self.groups), place, ndev,
+                                        None, devtype)
                 self.groups.append(target)
                 group_axes.append(dict(op_axes))
             target.ops.append(op)
             self._op_group[op.name] = target
         # build each group's mesh to cover all axes its member ops use
         for g, axes in zip(self.groups, group_axes):
-            g.mesh = self._submesh(g.place, g.ndev, axes)
+            if g.devtype == "CPU":
+                host = jax.local_devices(backend="cpu")[:1]
+                g.mesh = Mesh(np.asarray(host).reshape(1), ("_host",))
+            else:
+                g.mesh = self._submesh(g.place, g.ndev, axes)
 
     # ---- per-group forward --------------------------------------------------
 
@@ -410,7 +439,7 @@ class PlacementExecutor:
         return ins
 
     def _same_block(self, a: PlacementGroup, b: PlacementGroup) -> bool:
-        return (a.place, a.ndev) == (b.place, b.ndev)
+        return (a.place, a.ndev, a.devtype) == (b.place, b.ndev, b.devtype)
 
     def _group_params(self, g: PlacementGroup, params):
         """The param slice group g's program sees: its member ops' params

@@ -246,21 +246,15 @@ def get_search_problem(model, cost, mesh_shape: Dict[str, int],
     cost-table build instead of enumerating the O(edges x choices^2) tables
     twice."""
     measured = getattr(cost, "measured", None)
-    import dataclasses as _dc
-
     machine = getattr(cost, "machine", None)
     key = (tuple(op.name for op in model.ops),
            tuple(sorted(mesh_shape.items())), epp, eap,
            # the machine parameters feed every table entry: two cost
            # models over different machines (e.g. the infinite-HBM
-           # no-penalty comparison) must not share cached tables
-           (tuple(sorted((f.name, str(getattr(machine, f.name)))
-                         for f in _dc.fields(machine)))
-            if machine is not None and _dc.is_dataclass(machine) else
-            # value-based fallback — id() can be reused by a new object
-            # at the same address (same hazard as the measured dict)
-            str(sorted(vars(machine).items())) if machine is not None
-            and hasattr(machine, "__dict__") else repr(machine)),
+           # no-penalty comparison) must not share cached tables.
+           # Value-based (never id(): reusable addresses) — a dataclass
+           # repr carries every field in declaration order
+           repr(machine),
            getattr(cost, "fsdp_axis", None),
            getattr(cost, "dtype_bytes", None),
            # content hash of the measured table: a refreshed or in-place

@@ -252,9 +252,13 @@ def run_one(name: str, budget: int, seed: int = 0, verbose: bool = True,
     if cost.fsdp_axis:
         axes_used.setdefault(cost.fsdp_axis, set()).add("fsdp")
     axes_used = {k: sorted(v) for k, v in axes_used.items()}
-    best_mem = sum(
-        cost.op_mem_bytes(op, prob.op_maps[i][int(best_c[i])])
-        for i, op in enumerate(prob.ops))
+    # per-chip bytes of the winner: exact only when no op is placed on a
+    # proper device block (then every op spans the full mesh and each
+    # chip holds the sum); with placement, blocks don't co-reside, so
+    # report None rather than an overstated sum
+    best_mem = (sum(cost.op_mem_bytes(op, prob.op_maps[i][int(best_c[i])])
+                    for i, op in enumerate(prob.ops))
+                if n_placed == 0 else None)
 
     result = {
         "workload": name,
@@ -271,7 +275,8 @@ def run_one(name: str, budget: int, seed: int = 0, verbose: bool = True,
         "ops_placed_off_block0": n_placed,
         "axes_used": axes_used,
         "dp_mem_gb_per_chip": round(dp_mem / 1e9, 1),
-        "best_mem_gb_per_chip": round(best_mem / 1e9, 1),
+        "best_mem_gb_per_chip": (round(best_mem / 1e9, 1)
+                                 if best_mem is not None else None),
         "hbm_gb_per_chip": round(machine.hbm_bytes / 1e9, 1),
         "dp_fits_hbm": dp_fits,
         # None when DP fits (dp_iter_ms already penalty-free then)
