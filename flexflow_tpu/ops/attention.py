@@ -277,6 +277,22 @@ class MultiHeadAttention(Op):
             qh, ck[:, :end], cv[:, :end], live[None, None, None, :, :])
         return self._out_proj(params, ctx), {"k": ck, "v": cv}
 
+    def query_forward(self, params, xs, cache, rope_pos, row_lengths):
+        """Read-only cache query (ragged CHUNKED prefill's gather pass,
+        runtime/generation.py): a (B, 1) slab holding each row's LAST
+        prompt token, whose k/v the chunk passes already wrote — compute
+        only q at the row's own position (`rope_pos` = row_lengths - 1)
+        and attend the row's live prefix idx < row_lengths. The cache is
+        returned untouched (re-writing the slot would be idempotent but
+        pointless work)."""
+        qh, _, _ = self._project_qkv(params, xs[0], xs[1], xs[2],
+                                     rope_offset=rope_pos)
+        idx = jnp.arange(cache["k"].shape[1])
+        live = idx[None, :] < row_lengths[:, None]
+        ctx = self._grouped_cache_attention(
+            qh, cache["k"], cache["v"], live[:, None, None, None, :])
+        return self._out_proj(params, ctx), cache
+
     def decode_forward(self, params, xs, cache, pos, rope_pos=None,
                        row_lengths=None, prompt_len=None):
         """One-token step: write this token's k/v at slot `pos` (traced

@@ -219,7 +219,7 @@ class Generator:
 
     def _walk(self, params, state, tokens, caches, pos, last_only=False,
               rope_pos=None, row_lengths=None, prompt_len=None,
-              chunk_start=None, skip_tail=False):
+              chunk_start=None, skip_tail=False, gather_last=False):
         """Interpret the graph on a (B, S) token slab. pos=None means
         prefill (positions 0..S-1, fills cache); otherwise S == 1 and pos
         is the traced cache slot of the token. last_only=True narrows the
@@ -280,7 +280,14 @@ class Generator:
                 if isinstance(op, MultiHeadAttention):
                     cache = caches[op.name]
                     if pos is None:
-                        if chunk_start is not None:
+                        if gather_last:
+                            # ragged chunked prefill: read-only query of
+                            # each row's last prompt position against the
+                            # chunk-filled cache
+                            out, nc = op.query_forward(
+                                p, xs, cache, rope_pos=row_lengths - 1,
+                                row_lengths=row_lengths)
+                        elif chunk_start is not None:
                             out, nc = op.chunk_forward(p, xs, cache,
                                                        chunk_start)
                         else:
@@ -320,15 +327,31 @@ class Generator:
         O(chunk * S) not O(S^2). Logits are bitwise-equal to whole-prompt
         prefill on the einsum path; when whole-prompt prefill rides the
         flash kernel (TPU), accumulation order differs, so equality is
-        within kernel tolerance there. Uniform prompts only (a ragged
-        row's last position can fall in an earlier chunk; rejected in
-        __call__)."""
+        within kernel tolerance there.
+
+        Ragged + chunked (round 5): a ragged row's last position can fall
+        in ANY chunk, so every chunk runs cache-only (skip_tail) and a
+        final read-only GATHER pass queries each row's own last prompt
+        token against the filled cache (MultiHeadAttention.query_forward)
+        — right-padding keeps this sound: a real position's causal window
+        holds only real positions, and pad slots' garbage k/v are masked
+        by row_lengths in the gather and in every decode step."""
         b, s0 = tokens.shape
         if not prefill_chunk or s0 <= prefill_chunk:
             return self._walk(params, state, tokens, caches, None,
                               last_only=True, row_lengths=row_lengths,
                               prompt_len=s0)
         starts = list(range(0, s0, prefill_chunk))
+        if row_lengths is not None:
+            for st in starts:
+                _, caches = self._walk(
+                    params, state, tokens[:, st:st + prefill_chunk],
+                    caches, None, chunk_start=st, skip_tail=True)
+            tok_last = jnp.take_along_axis(
+                tokens, (row_lengths - 1)[:, None], axis=1)      # (B, 1)
+            return self._walk(params, state, tok_last, caches, None,
+                              last_only=True, row_lengths=row_lengths,
+                              gather_last=True)
         for st in starts[:-1]:
             _, caches = self._walk(
                 params, state, tokens[:, st:st + prefill_chunk], caches,
@@ -555,10 +578,6 @@ class Generator:
                 f"prefill_chunk must be >= 0, got {prefill_chunk}")
         tokens = jnp.asarray(tokens, jnp.int32)
         lengths, ragged = self._check_lengths(tokens, prompt_lengths)
-        if ragged and prefill_chunk:
-            raise NotImplementedError(
-                "prefill_chunk + prompt_lengths is unsupported: a ragged "
-                "row's last position can fall in an earlier chunk")
         # prompt shape is part of the key: each LRU entry then holds ~one
         # XLA executable, so eviction genuinely bounds compiled programs
         # (a shape-generic jit wrapper would grow an unbounded internal
@@ -609,10 +628,6 @@ class Generator:
         if prefill_chunk < 0:
             raise ValueError(
                 f"prefill_chunk must be >= 0, got {prefill_chunk}")
-        if ragged and prefill_chunk:
-            raise NotImplementedError(
-                "prefill_chunk + prompt_lengths is unsupported: a ragged "
-                "row's last position can fall in an earlier chunk")
         # prompt shape in the key: see beam_search — makes LRU eviction
         # actually bound compiled executables, not just jit wrappers
         cache_key = (max_new_tokens, ragged, prefill_chunk, return_scores,

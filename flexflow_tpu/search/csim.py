@@ -253,8 +253,12 @@ def get_search_problem(model, cost, mesh_shape: Dict[str, int],
            # models over different machines (e.g. the infinite-HBM
            # no-penalty comparison) must not share cached tables.
            # Value-based (never id(): reusable addresses) — a dataclass
-           # repr carries every field in declaration order
-           repr(machine),
+           # repr carries every field; a plain object's default repr is
+           # its ADDRESS, so fall back to its attribute dict
+           (repr(machine) if machine is None or "object at 0x"
+            not in repr(machine)
+            else str(sorted((k, str(v))
+                            for k, v in vars(machine).items()))),
            getattr(cost, "fsdp_axis", None),
            getattr(cost, "dtype_bytes", None),
            # content hash of the measured table: a refreshed or in-place

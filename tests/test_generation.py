@@ -518,3 +518,35 @@ def test_generate_rejects_non_decodable_graphs():
     ff.compile(final_tensor=t)
     with pytest.raises(ValueError):
         Generator(ff)
+
+
+def test_ragged_chunked_prefill_matches_unchunked():
+    """Round 5: ragged + chunked prefill (previously refused). All chunks
+    run cache-only, then a read-only gather pass queries each row's own
+    last prompt token against the filled cache — results must equal the
+    whole-prompt ragged prefill exactly (einsum path), for greedy with
+    scores AND beam search, including a row whose last position falls in
+    an EARLIER chunk."""
+    ff = build_llama({"data": 1})
+    rs = np.random.RandomState(21)
+    full = rs.randint(1, VOCAB, (3, 9)).astype(np.int32)
+    # lengths 2 and 5: last positions in chunk 0 and chunk 1 (chunk=4);
+    # length 9: in the final chunk
+    lengths = np.array([2, 9, 5], np.int32)
+    padded = full.copy()
+    for b in range(3):
+        padded[b, lengths[b]:] = 0
+
+    out0, sc0 = ff.generate(padded, 5, prompt_lengths=lengths,
+                            return_scores=True)
+    out1, sc1 = ff.generate(padded, 5, prompt_lengths=lengths,
+                            prefill_chunk=4, return_scores=True)
+    np.testing.assert_array_equal(out0, out1)
+    np.testing.assert_allclose(sc0, sc1, rtol=1e-5, atol=1e-6)
+
+    b0, s0 = ff.generate(padded, 4, num_beams=2, prompt_lengths=lengths,
+                         return_scores=True)
+    b1, s1 = ff.generate(padded, 4, num_beams=2, prompt_lengths=lengths,
+                         prefill_chunk=4, return_scores=True)
+    np.testing.assert_array_equal(b0, b1)
+    np.testing.assert_allclose(s0, s1, rtol=1e-5, atol=1e-6)

@@ -405,7 +405,6 @@ def test_host_placed_embedding_hetero_dlrm(tmp_path):
                               embedding_entries=50, num_tables=2,
                               indices_per_table=2, dense_dim=16,
                               mlp_bot=(16, 8), mlp_top=(8, 1))
-        from flexflow_tpu import LossType as LT
         ff.compile(SGDOptimizer(lr=0.05),
                    LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
                    final_tensor=out)
