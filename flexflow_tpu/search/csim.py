@@ -238,6 +238,28 @@ class CompiledSearchProblem:
         return min(results, key=lambda r: r[2])
 
 
+def _machine_cache_key(machine):
+    """Value identity for the machine in the search-table cache key. The
+    machine parameters feed every table entry, so two cost models over
+    different machines (e.g. the infinite-HBM no-penalty comparison)
+    must not share cached tables. Never id()-based: addresses get
+    reused. A dataclass repr carries class + every field by value; any
+    machine whose repr (or an attribute's) is the default address form
+    is UNCACHEABLE — a fresh sentinel guarantees a rebuild rather than
+    risking stale tables on a recycled address."""
+    if machine is None:
+        return None
+    r = repr(machine)
+    if "object at 0x" not in r:
+        return (type(machine).__qualname__, r)
+    attrs = getattr(machine, "__dict__", None)
+    if attrs is not None:
+        items = tuple(sorted((k, repr(v)) for k, v in attrs.items()))
+        if not any("object at 0x" in v for _, v in items):
+            return (type(machine).__qualname__, items)
+    return object()  # unknown value identity: never share cache entries
+
+
 def get_search_problem(model, cost, mesh_shape: Dict[str, int],
                        epp: bool = True, eap: bool = True
                        ) -> CompiledSearchProblem:
@@ -249,16 +271,7 @@ def get_search_problem(model, cost, mesh_shape: Dict[str, int],
     machine = getattr(cost, "machine", None)
     key = (tuple(op.name for op in model.ops),
            tuple(sorted(mesh_shape.items())), epp, eap,
-           # the machine parameters feed every table entry: two cost
-           # models over different machines (e.g. the infinite-HBM
-           # no-penalty comparison) must not share cached tables.
-           # Value-based (never id(): reusable addresses) — a dataclass
-           # repr carries every field; a plain object's default repr is
-           # its ADDRESS, so fall back to its attribute dict
-           (repr(machine) if machine is None or "object at 0x"
-            not in repr(machine)
-            else str(sorted((k, str(v))
-                            for k, v in vars(machine).items()))),
+           _machine_cache_key(machine),
            getattr(cost, "fsdp_axis", None),
            getattr(cost, "dtype_bytes", None),
            # content hash of the measured table: a refreshed or in-place

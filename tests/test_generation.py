@@ -431,9 +431,11 @@ def test_chunked_prefill_matches_whole_prompt():
     beam_chunk = ff.generate(prompt, max_new_tokens=5, num_beams=3,
                              prefill_chunk=4)
     np.testing.assert_array_equal(beam_whole, beam_chunk)
-    with pytest.raises(NotImplementedError, match="prefill_chunk"):
-        ff.generate(prompt, 3, prompt_lengths=np.full(2, 10, np.int32),
-                    prefill_chunk=4)
+    # ragged + chunk is legal since r5 (full-length rows == uniform)
+    ragged_chunk = ff.generate(prompt, 5,
+                               prompt_lengths=np.full(2, 10, np.int32),
+                               prefill_chunk=4)
+    np.testing.assert_array_equal(ragged_chunk, whole)
     with pytest.raises(ValueError, match="prefill_chunk"):
         ff.generate(prompt, 3, prefill_chunk=-1)
 
@@ -550,3 +552,43 @@ def test_ragged_chunked_prefill_matches_unchunked():
                          prefill_chunk=4, return_scores=True)
     np.testing.assert_array_equal(b0, b1)
     np.testing.assert_allclose(s0, s1, rtol=1e-5, atol=1e-6)
+
+
+def test_beam_search_eos_freezes_and_normalizes_by_emitted_length():
+    """Beam + eos: a beam that emits eos freezes (only pad continues, at
+    logp 0, so its score stops changing) and the final pick normalizes by
+    the TRUE emitted length, not max_new_tokens. The returned winner's
+    score must equal full-forward rescoring of its emitted tokens up to
+    and including eos, divided by emitted_len**penalty; tokens after eos
+    must be pad."""
+    ff = build_llama({"data": 1})
+    rs = np.random.RandomState(17)
+    prompt = rs.randint(1, VOCAB, (2, 4)).astype(np.int32)
+
+    # choose the token beam-2 emits FIRST as eos so freezing triggers at
+    # step 1 for at least one row
+    probe, _ = ff.generate(prompt, 6, num_beams=2, return_scores=True)
+    eos = int(probe[0, 4])
+
+    for lp in (0.0, 1.0):
+        out, score = ff.generate(prompt, 6, num_beams=2, length_penalty=lp,
+                                 eos_token_id=eos, pad_token_id=0,
+                                 return_scores=True)
+        for r in range(2):
+            new = out[r, 4:]
+            hits = np.where(new == eos)[0]
+            emitted = int(hits[0]) + 1 if hits.size else len(new)
+            if hits.size:
+                assert (new[hits[0] + 1:] == 0).all(), new
+            # rescore the emitted tokens (incl. eos) by teacher forcing
+            seq = np.concatenate([prompt[r], new[:emitted]])[None]
+            lg = full_logits(ff, seq)[0]
+            logp = 0.0
+            for j in range(emitted):
+                v = lg[4 - 1 + j].astype(np.float64)
+                v = v - (v.max() + np.log(np.exp(v - v.max()).sum()))
+                logp += v[new[j]]
+            want = logp / (max(emitted, 1) ** lp)
+            np.testing.assert_allclose(score[r], want, rtol=1e-3,
+                                       atol=5e-3,
+                                       err_msg=f"row {r} lp {lp}")
