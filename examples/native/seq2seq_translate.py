@@ -1,0 +1,67 @@
+"""Train + DECODE an encoder-decoder transformer (round 5).
+
+The reference's NMT subsystem trains its seq2seq models but has no
+decode story (inference = the training graph forward). This example
+trains the token-level seq2seq LM on a synthetic copy task and then
+serves it with generate_seq2seq — one encode, static cross-attention
+k/v, KV-cached decoder scan (runtime/seq2seq_generation.py).
+
+Run: python examples/native/seq2seq_translate.py  # ~100 s on the
+2-device CPU mesh; reaches 100% held-out copy accuracy
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                          AdamOptimizer, SingleDataLoader)
+from flexflow_tpu.models.transformer import seq2seq_lm
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int,
+                    default=int(os.environ.get("EPOCHS", 40)))
+    ap.add_argument("--vocab", type=int, default=30)
+    ap.add_argument("--seq", type=int, default=6)
+    args, _ = ap.parse_known_args()
+
+    bos, vocab, s = 1, args.vocab, args.seq
+    cfg = FFConfig(batch_size=32, mesh_shape={"data": 2}, seed=0)
+    ff = FFModel(cfg)
+    src_t, tgt_t, logits = seq2seq_lm(ff, cfg.batch_size, src_len=s,
+                                      tgt_len=s, hidden=64, layers=2,
+                                      heads=4, vocab_size=vocab)
+    ff.compile(AdamOptimizer(alpha=3e-3),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY], final_tensor=logits)
+
+    # copy task: target = source, teacher-forced with BOS-shifted input
+    rs = np.random.RandomState(0)
+    n = 4096
+    src = rs.randint(2, vocab, (n, s)).astype(np.int32)
+    tgt_in = np.concatenate([np.full((n, 1), bos, np.int32),
+                             src[:, :-1]], axis=1)
+    SingleDataLoader(ff, src_t, src)
+    SingleDataLoader(ff, tgt_t, tgt_in)
+    SingleDataLoader(ff, ff.label_tensor, src.copy())
+    ff.fit(epochs=args.epochs)
+
+    # decode a held-out batch and report copy accuracy
+    test = rs.randint(2, vocab, (8, s)).astype(np.int32)
+    out = ff.generate_seq2seq(test, max_new_tokens=s, bos_token_id=bos)
+    hyp = out[:, 1:1 + s]
+    acc = float((hyp == test).mean())
+    print(f"decode copy accuracy: {100 * acc:.1f}% "
+          f"({(hyp == test).sum()}/{test.size} tokens)")
+    print("sample src:", test[0].tolist())
+    print("sample hyp:", hyp[0].tolist())
+
+
+if __name__ == "__main__":
+    main()

@@ -872,6 +872,30 @@ class FFModel:
                    prefill_chunk=prefill_chunk,
                    return_scores=return_scores)
 
+    def generate_seq2seq(self, src_tokens, tgt_prompt=None,
+                         max_new_tokens: int = 32, bos_token_id: int = 1,
+                         temperature: float = 0.0, top_k: int = 0,
+                         eos_token_id: Optional[int] = None,
+                         pad_token_id: int = 0, seed: int = 0):
+        """Encoder-decoder decoding (runtime/seq2seq_generation.py): the
+        encoder runs once on `src_tokens` (B, S_src), cross-attention k/v
+        are projected once, and the decoder runs the KV-cached one-program
+        token loop starting from `tgt_prompt` (B, T0) — or a BOS column of
+        `bos_token_id` when omitted. Returns (B, T0 + max_new_tokens)
+        int32. Graph contract and v1 scope: see Seq2SeqGenerator."""
+        from flexflow_tpu.runtime.seq2seq_generation import Seq2SeqGenerator
+
+        key = ("s2s", temperature, top_k, eos_token_id, pad_token_id)
+        gen = self._generators.get(key)
+        if gen is None:
+            gen = self._generators[key] = Seq2SeqGenerator(
+                self, temperature=temperature, top_k=top_k,
+                eos_id=eos_token_id, pad_id=pad_token_id)
+        src = np.asarray(src_tokens)
+        if tgt_prompt is None:
+            tgt_prompt = np.full((src.shape[0], 1), bos_token_id, np.int32)
+        return gen(src, tgt_prompt, max_new_tokens, seed=seed)
+
     # ------------------------------------------------------------ weights IO
 
     def get_weights(self, op_name: str, weight_name: str = "kernel") -> np.ndarray:

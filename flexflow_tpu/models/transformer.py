@@ -141,3 +141,59 @@ def build_encoder_classifier(ff: FFModel, batch_size: int, seq_len: int = 128,
     t = ff.mean(t, dims=[1], name="pool")
     out = ff.dense(t, num_classes, name="head")
     return x, out
+
+
+def seq2seq_lm(ff: FFModel, batch_size: int, src_len: int = 32,
+               tgt_len: int = 32, hidden: int = 128, layers: int = 2,
+               heads: int = 4, ffn_mult: int = 4,
+               vocab_size: int = 1000, rope_theta: float = 10000.0):
+    """Token-level encoder-decoder LM, the GENERATION-capable member of
+    the seq2seq family (build_seq2seq_transformer is the hidden-state
+    twin of the reference's Transformer app). Positions come from RoPE
+    inside every SELF-attention (encoder bidirectional, decoder causal);
+    cross-attention carries no positional rotation — position info is
+    already mixed into both streams by their self-attentions. This is
+    the layout Seq2SeqGenerator decodes with a KV cache on decoder
+    self-attention and a STATIC projected k/v for cross-attention.
+
+    Returns (src_tokens, tgt_tokens, logits) with logits
+    (B, tgt_len, vocab)."""
+    from flexflow_tpu.ffconst import DataType
+
+    src = ff.create_tensor([batch_size, src_len], dtype=DataType.DT_INT32,
+                           name="src")
+    tgt = ff.create_tensor([batch_size, tgt_len], dtype=DataType.DT_INT32,
+                           name="tgt")
+    e = ff.embedding(src, vocab_size, hidden, name="src_embed")
+    for i in range(layers):
+        a = ff.layer_norm(e, name=f"s2s_enc_ln1_{i}")
+        a = ff.multihead_attention(a, a, a, hidden, heads, rope=True,
+                                   rope_theta=rope_theta,
+                                   name=f"s2s_enc_attn_{i}")
+        e = ff.add(e, a, name=f"s2s_enc_res1_{i}")
+        f = ff.layer_norm(e, name=f"s2s_enc_ln2_{i}")
+        f = ff.dense(f, hidden * ffn_mult, ActiMode.AC_MODE_GELU,
+                     name=f"s2s_enc_ffn1_{i}")
+        f = ff.dense(f, hidden, name=f"s2s_enc_ffn2_{i}")
+        e = ff.add(e, f, name=f"s2s_enc_res2_{i}")
+    e = ff.layer_norm(e, name="s2s_enc_ln_f")
+
+    d = ff.embedding(tgt, vocab_size, hidden, name="tgt_embed")
+    for i in range(layers):
+        a = ff.layer_norm(d, name=f"s2s_dec_ln1_{i}")
+        a = ff.multihead_attention(a, a, a, hidden, heads, causal=True,
+                                   rope=True, rope_theta=rope_theta,
+                                   name=f"s2s_dec_self_{i}")
+        d = ff.add(d, a, name=f"s2s_dec_res1_{i}")
+        c = ff.layer_norm(d, name=f"s2s_dec_ln2_{i}")
+        c = ff.multihead_attention(c, e, e, hidden, heads,
+                                   name=f"s2s_dec_cross_{i}")
+        d = ff.add(d, c, name=f"s2s_dec_res2_{i}")
+        f = ff.layer_norm(d, name=f"s2s_dec_ln3_{i}")
+        f = ff.dense(f, hidden * ffn_mult, ActiMode.AC_MODE_GELU,
+                     name=f"s2s_dec_ffn1_{i}")
+        f = ff.dense(f, hidden, name=f"s2s_dec_ffn2_{i}")
+        d = ff.add(d, f, name=f"s2s_dec_res3_{i}")
+    d = ff.layer_norm(d, name="s2s_dec_ln_f")
+    logits = ff.dense(d, vocab_size, use_bias=False, name="s2s_lm_head")
+    return src, tgt, logits

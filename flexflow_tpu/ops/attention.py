@@ -277,6 +277,24 @@ class MultiHeadAttention(Op):
             qh, ck[:, :end], cv[:, :end], live[None, None, None, :, :])
         return self._out_proj(params, ctx), {"k": ck, "v": cv}
 
+    def encode_kv(self, params, enc):
+        """Cross-attention's static k/v, projected ONCE from the encoder
+        states at the start of a seq2seq decode (runtime/
+        seq2seq_generation.py) — every decode step reuses them, so the
+        per-token cost of cross-attention is one q projection + one
+        (1 x S_src) attention, never a re-projection of the source."""
+        _, kh, vh = self._project_qkv(params, enc, enc, enc)
+        return {"k": kh, "v": vh}
+
+    def cross_forward_cached(self, params, xs, kv):
+        """Cross-attention over the static encoder k/v (encode_kv) for a
+        (B, C) decoder slab — C = prompt length at prefill, 1 per decode
+        step. Non-causal: every query attends the whole source."""
+        qh, _, _ = self._project_qkv(params, xs[0], xs[0], xs[0])
+        live = jnp.ones((1, 1, 1, 1, kv["k"].shape[1]), bool)
+        ctx = self._grouped_cache_attention(qh, kv["k"], kv["v"], live)
+        return self._out_proj(params, ctx)
+
     def query_forward(self, params, xs, cache, rope_pos, row_lengths):
         """Read-only cache query (ragged CHUNKED prefill's gather pass,
         runtime/generation.py): a (B, 1) slab holding each row's LAST

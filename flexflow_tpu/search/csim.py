@@ -238,6 +238,9 @@ class CompiledSearchProblem:
         return min(results, key=lambda r: r[2])
 
 
+_UNCACHEABLE = object()
+
+
 def _machine_cache_key(machine):
     """Value identity for the machine in the search-table cache key. The
     machine parameters feed every table entry, so two cost models over
@@ -245,8 +248,8 @@ def _machine_cache_key(machine):
     must not share cached tables. Never id()-based: addresses get
     reused. A dataclass repr carries class + every field by value; any
     machine whose repr (or an attribute's) is the default address form
-    is UNCACHEABLE — a fresh sentinel guarantees a rebuild rather than
-    risking stale tables on a recycled address."""
+    is _UNCACHEABLE — the caller bypasses the cache entirely (no stale
+    tables on a recycled address, no unbounded never-matching inserts)."""
     if machine is None:
         return None
     r = repr(machine)
@@ -257,7 +260,7 @@ def _machine_cache_key(machine):
         items = tuple(sorted((k, repr(v)) for k, v in attrs.items()))
         if not any("object at 0x" in v for _, v in items):
             return (type(machine).__qualname__, items)
-    return object()  # unknown value identity: never share cache entries
+    return _UNCACHEABLE
 
 
 def get_search_problem(model, cost, mesh_shape: Dict[str, int],
@@ -269,9 +272,12 @@ def get_search_problem(model, cost, mesh_shape: Dict[str, int],
     twice."""
     measured = getattr(cost, "measured", None)
     machine = getattr(cost, "machine", None)
+    mkey = _machine_cache_key(machine)
+    if mkey is _UNCACHEABLE:
+        return CompiledSearchProblem(model, cost, mesh_shape, epp, eap)
     key = (tuple(op.name for op in model.ops),
            tuple(sorted(mesh_shape.items())), epp, eap,
-           _machine_cache_key(machine),
+           mkey,
            getattr(cost, "fsdp_axis", None),
            getattr(cost, "dtype_bytes", None),
            # content hash of the measured table: a refreshed or in-place
