@@ -105,6 +105,13 @@ class FFModel:
                       dtype: DataType = DataType.DT_FLOAT,
                       name: Optional[str] = None,
                       create_grad: bool = True) -> Tensor:
+        if not isinstance(dtype, DataType):
+            # the classic misuse is passing the NAME positionally where dtype
+            # goes — without this check the string rides the graph and
+            # surfaces as a KeyError deep inside measurement/serialization
+            raise TypeError(
+                f"create_tensor dtype must be a DataType enum, got "
+                f"{dtype!r} — did you mean name={dtype!r}?")
         op = InputOp(self, self._name("input", name), tuple(dims), dtype)
         op.finalize()
         assert self.get_op_by_name(op.name) is None, \

@@ -20,6 +20,19 @@ Design notes:
 Usage:
   FLEXFLOW_FORCE_CPU_DEVICES=8 python scripts/validate_strategies.py \
       [--budget 4000] [--steps 10] [--seq 64] [--hidden 128] [--layers 2]
+
+Single-chip leg (--single-chip): a 1-device attachment cannot run the
+8-device candidate strategies for real, so ranking *strategies* is not
+measurable there. What IS measurable — and is the half of the validation
+the CPU mesh can never give — is calibration of the measured-cost
+pipeline against the real machine: measure per-op costs on the chip,
+compose them through the full simulator (same CostModel/csim path the
+search uses), and compare the predicted whole-program step time against
+a real jitted training run, across several model shapes. Reports
+per-shape sim/real ratio and rank agreement (does the simulator order
+model shapes by real cost?). Together the two legs cover SURVEY §7 hard
+part 5: CPU mesh = multi-device ranking; chip = per-op measurement
+fidelity on the machine that matters.
 """
 
 from __future__ import annotations
@@ -37,13 +50,13 @@ import numpy as np
 MESH = {"data": 4, "model": 2}
 
 
-def build(args, strategies=None):
+def build(args, strategies=None, mesh=None):
     from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
                               SGDOptimizer, SingleDataLoader)
     from flexflow_tpu.models.transformer import build_encoder_classifier
 
     batch = args.batch
-    cfg = FFConfig(batch_size=batch, mesh_shape=dict(MESH), seed=5)
+    cfg = FFConfig(batch_size=batch, mesh_shape=dict(mesh or MESH), seed=5)
     if strategies:
         cfg.strategies.update(strategies)
     ff = FFModel(cfg)
@@ -60,8 +73,25 @@ def build(args, strategies=None):
     return ff
 
 
-def real_time_s(ff, steps: int) -> float:
-    """Best-of-3 whole-program step time (fetch-synced, like bench.py)."""
+def real_time_s(ff, steps: int, scan: bool = False) -> float:
+    """Best-of-3 whole-program step time (fetch-synced, like bench.py).
+    scan=True runs the steps as ONE lax.scan device program — the
+    dispatch-free number, required on the tunneled chip where per-step
+    host dispatch would otherwise dominate small models (the simulator
+    prices compute, not this environment's transport latency)."""
+    if scan:
+        from flexflow_tpu.search.measure import _dispatch_floor
+
+        losses, _ = ff.train_scanned(steps)  # compile + warmup
+        float(losses[-1])
+        floor = _dispatch_floor()  # sampled in the same drift window
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            losses, _ = ff.train_scanned(steps)
+            float(losses[-1])
+            best = min(best, (time.perf_counter() - t0 - floor) / steps)
+        return max(best, 1e-9)
     ff._run_train_step(ff._stage_batch())  # compile + warmup
     ff._run_train_step(ff._stage_batch())
     best = float("inf")
@@ -87,6 +117,70 @@ def kendall_tau(a, b) -> float:
     return (conc - disc) / denom if denom else 1.0
 
 
+# (batch, seq, hidden, layers) ladder for the single-chip calibration:
+# distinct FLOP scales so rank agreement is meaningful, small enough that
+# each compiles in seconds on the tunnel
+CALIB_CONFIGS = [
+    (16, 128, 256, 2),
+    (16, 256, 512, 2),
+    (16, 256, 512, 4),
+    (8, 512, 1024, 4),
+]
+if os.environ.get("FF_VALIDATE_TINY"):  # CPU smoke of the script itself
+    CALIB_CONFIGS = [(4, 16, 32, 1), (4, 32, 64, 1), (4, 32, 64, 2)]
+
+
+def single_chip_calibration(args):
+    import math
+
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.csim import get_search_problem
+    from flexflow_tpu.search.driver import data_parallel_strategy
+    from flexflow_tpu.search.measure import measure_op_costs
+
+    mesh = {"data": 1}
+    rows = []
+    for batch, seq, hidden, layers in CALIB_CONFIGS:
+        c = argparse.Namespace(**{**vars(args), "batch": batch, "seq": seq,
+                                  "hidden": hidden, "layers": layers})
+        ff = build(c, mesh=mesh)
+        print(f"[validate/chip] b{batch} s{seq} h{hidden} L{layers}: "
+              f"measuring...", flush=True)
+        measured = measure_op_costs(ff, mesh)
+        cost = CostModel(ff, mesh, measured=measured)
+        prob = get_search_problem(ff, cost, mesh)
+        sim_s = prob.simulate(
+            prob.choices_for(data_parallel_strategy(ff, mesh)))
+        real_s = real_time_s(ff, args.steps, scan=True)
+        rows.append({"batch": batch, "seq": seq, "hidden": hidden,
+                     "layers": layers, "sim_ms": round(sim_s * 1e3, 3),
+                     "real_ms": round(real_s * 1e3, 3),
+                     "real_over_sim": round(real_s / max(sim_s, 1e-12), 3),
+                     "_sim": sim_s, "_real": real_s})
+        print(f"[validate/chip]   sim {rows[-1]['sim_ms']} ms, "
+              f"real {rows[-1]['real_ms']} ms", flush=True)
+    # stats from UNROUNDED values: 3-dp rounding can collapse a deep sim
+    # undershoot to 0.0 — log(0) would discard the run, and zero-ties would
+    # make kendall_tau report perfect agreement with no ordering information
+    sims = [r.pop("_sim") for r in rows]
+    reals = [r.pop("_real") for r in rows]
+    ratios = [rl / max(s, 1e-12) for s, rl in zip(sims, reals)]
+    result = {
+        "mode": "single_chip_calibration",
+        "rows": rows,
+        "kendall_tau": round(kendall_tau(sims, reals), 3),
+        # geometric stats: the simulator is a *ranker* (reference tolerance,
+        # SURVEY §7 hard part 5) so spread matters more than absolute level
+        "ratio_geomean": round(
+            math.exp(sum(math.log(x) for x in ratios) / len(ratios)), 3),
+        "ratio_spread": round(max(ratios) / min(ratios), 3),
+        "backend": _backend(),
+        "config": vars(args),
+    }
+    print(json.dumps(result), flush=True)
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--budget", type=int, default=4000)
@@ -95,7 +189,11 @@ def main():
     ap.add_argument("--seq", type=int, default=64)
     ap.add_argument("--hidden", type=int, default=128)
     ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--single-chip", action="store_true",
+                    help="1-device calibration leg (see module docstring)")
     args = ap.parse_args()
+    if args.single_chip:
+        return single_chip_calibration(args)
 
     from flexflow_tpu.search.cost_model import CostModel
     from flexflow_tpu.search.csim import get_search_problem, native_optimize
