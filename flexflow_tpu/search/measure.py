@@ -308,19 +308,27 @@ def _log_skip(op: Op, err: Exception):
 def measure_op_costs(model, mesh_shape: Dict[str, int],
                      enable_parameter_parallel: bool = True,
                      enable_attribute_parallel: bool = True,
-                     iters: int = 5, verbose: bool = False) -> Dict:
+                     iters: int = 5, verbose: bool = False,
+                     time_budget_s: Optional[float] = None) -> Dict:
     """Build the `measured` table for CostModel: {(op_name, shard_out_shape):
     seconds}. Measures every distinct per-shard signature reachable by the
     search's proposal space (reference: cache keyed by op+config hash,
-    simulator.cc:298-303)."""
+    simulator.cc:298-303).
+
+    time_budget_s bounds wall-clock: signatures are measured in DESCENDING
+    analytic-impact order (per-shard FLOP estimate), so an exhausted budget
+    leaves only the cheapest tail to the analytic fallback — on the
+    tunneled chip each fresh signature costs a scan-loop compile
+    (~tens of seconds), and an unbounded branchy graph (InceptionV3:
+    hundreds of signatures) cannot finish a bounded session otherwise.
+    The drop is logged, never silent."""
     from flexflow_tpu.search.driver import legal_axis_maps
 
-    measured: Dict = {}
-    n_timed = 0
+    work = []  # (est_flops, op, key, in_shapes, w_shapes)
+    seen_keys = set()
     for op in model.ops:
         if isinstance(op, InputOp):
             continue
-        seen_keys = set()
         for am in legal_axis_maps(op, mesh_shape, enable_parameter_parallel,
                                   enable_attribute_parallel):
             key = choice_key(op.name, op.outputs[0].dims, am, mesh_shape)
@@ -350,13 +358,57 @@ def measure_op_costs(model, mesh_shape: Dict[str, int],
                         if d < len(ws):
                             ws[d] = max(ws[d] // deg, 1)
                 w_shapes.append(tuple(ws))
-            dt = measure_one(op, in_shapes, w_shapes, iters=iters)
-            if dt is not None:
-                measured[key] = dt
-                n_timed += 1
-                if verbose:
-                    print(f"[measure] {op.name} {key[1:]}: "
-                          f"{dt * 1e3:.3f} ms")
+            full_vol = max(float(np.prod(op.outputs[0].dims)), 1.0)
+            shard_vol = max(float(np.prod(
+                shard_shape(op.outputs[0].dims, am, mesh_shape))), 1.0)
+            try:
+                est = float(op.flops()) * (shard_vol / full_vol)
+            except Exception:
+                est = shard_vol
+            work.append((est, op, key, in_shapes, w_shapes))
+    # big shards first; same-signature keys dedup through _SIGNATURE_CACHE,
+    # so later duplicates are free regardless of order
+    work.sort(key=lambda t: -t[0])
+    measured: Dict = {}
+    n_timed = 0
+    stopped_at = None
+    t0 = time.perf_counter()
+    for i, (est, op, key, in_shapes, w_shapes) in enumerate(work):
+        if (time_budget_s is not None
+                and time.perf_counter() - t0 > time_budget_s):
+            stopped_at = i
+            break
+        dt = measure_one(op, in_shapes, w_shapes, iters=iters)
+        if dt is not None:
+            measured[key] = dt
+            n_timed += 1
+            if verbose:
+                print(f"[measure] {op.name} {key[1:]}: "
+                      f"{dt * 1e3:.3f} ms")
+    if stopped_at is not None:
+        from flexflow_tpu.logger import fflogger
+
+        # zero-cost sweep of the tail: a key whose signature twin was
+        # already timed (repeated residual/branch blocks) must carry the
+        # same measured cost, not an analytic one — identical computations
+        # priced inconsistently in one table would skew the MCMC ranking
+        n_swept = 0
+        for est, op, key, in_shapes, w_shapes in work[stopped_at:]:
+            hit = _SIGNATURE_CACHE.get(_op_signature(op, in_shapes,
+                                                     w_shapes))
+            if isinstance(hit, float):
+                measured[key] = hit
+                n_swept += 1
+        est_total = sum(w[0] for w in work) or 1.0
+        est_done = sum(w[0] for w in work[:stopped_at])
+        fflogger.warning(
+            "measure budget %.0fs exhausted after %d/%d signatures "
+            "(impact-ordered: %.1f%% of estimated FLOP mass measured; "
+            "%d tail keys filled from the signature cache); %d signatures "
+            "fall back to analytic costs",
+            time_budget_s, stopped_at, len(work),
+            100.0 * est_done / est_total, n_swept,
+            len(work) - stopped_at - n_swept)
     if verbose:
         print(f"[measure] {n_timed} entries, "
               f"{len(_SIGNATURE_CACHE)} unique signatures timed")

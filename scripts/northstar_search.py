@@ -153,7 +153,7 @@ def build_workload(name: str, batch: Optional[int] = None):
 
 def run_one(name: str, budget: int, seed: int = 0, verbose: bool = True,
             batch: Optional[int] = None, costs: str = "analytic",
-            fsdp: bool = False):
+            fsdp: bool = False, measure_budget_s: Optional[float] = None):
     ff, mesh = build_workload(name, batch)
     if name == "llama8b":
         fsdp = True  # an 8B can't replicate weights per chip: ZeRO-3 regime
@@ -183,7 +183,8 @@ def run_one(name: str, budget: int, seed: int = 0, verbose: bool = True,
         # (simulator.cc:296-316)
         from flexflow_tpu.search.measure import measure_op_costs
 
-        measured = measure_op_costs(ff, mesh)
+        measured = measure_op_costs(ff, mesh,
+                                    time_budget_s=measure_budget_s)
     # dtype_bytes=2: the flagship trains bf16 on the MXU (bench.py config),
     # so strategies are priced at bf16 compute + bf16 activations
     cost = CostModel(ff, mesh, machine=machine, dtype_bytes=2,
@@ -288,6 +289,11 @@ def run_one(name: str, budget: int, seed: int = 0, verbose: bool = True,
         "budget": budget,
         "table_build_s": round(build_s, 1),
         "search_s": round(search_s, 1),
+        # provenance of the cost table: how many signatures carry real
+        # timings vs analytic fallback (measured is None on the pure
+        # analytic tier)
+        "measured_signatures": (len(measured)
+                                if measured is not None else None),
     }
     if verbose:
         print(json.dumps(result), flush=True)
@@ -315,17 +321,23 @@ def main():
                     help="price the search under FSDP over 'data' "
                          "(weight gathers + grad reduce-scatter; no "
                          "placement proposals)")
+    ap.add_argument("--measure-budget", type=float, default=None,
+                    help="wall-clock cap (s) for --costs measure table "
+                         "builds; impact-ordered, tail falls back to "
+                         "analytic (logged)")
     args = ap.parse_args()
 
     names = (["transformer", "bert_fx", "llama", "llama8b", "resnet50",
               "inception", "dlrm"]
              if args.workload == "all" else [args.workload])
     results = [run_one(n, args.budget, args.seed, batch=args.batch,
-                       costs=args.costs, fsdp=args.fsdp)
+                       costs=args.costs, fsdp=args.fsdp,
+                       measure_budget_s=args.measure_budget)
                for n in names]
     if args.large_batch:
         results += [run_one(n, args.budget, args.seed, batch=16 * 32,
-                            costs=args.costs, fsdp=args.fsdp)
+                            costs=args.costs, fsdp=args.fsdp,
+                            measure_budget_s=args.measure_budget)
                     for n in names if n != "dlrm"]
     print("\n== north-star summary (simulated) ==")
     for r in results:
