@@ -81,11 +81,18 @@ TPU_TIERS = [
     # completes; any failure just keeps xl_scan.
     ("xxl_scan", 8, 512, 4096, 6, 32, 8,
      {"scan": True, "master_dtype": "bfloat16"}),
+    # depth extension of xxl (same width/head_dim, L6->L8): bigger model
+    # by the headline key, and deeper amortizes the embed/classifier
+    # overhead across more MXU-saturated blocks. Last tier: pure upside,
+    # any failure keeps xxl_scan.
+    ("x3l_scan", 8, 512, 4096, 8, 32, 6,
+     {"scan": True, "master_dtype": "bfloat16"}),
 ]
 # rough wall-clock needed per tier (compile + run), used by the child to
 # decide whether to start the next tier with the time it has left
 TIER_COST_S = {"tiny": 90, "mid": 150, "full": 240, "full_scan": 180,
                "full_scan_opt": 180, "xl_scan": 260, "xxl_scan": 300,
+               "x3l_scan": 330,
                "cpu_smoke": 30,
                "cpu_smoke_scan": 30}
 
