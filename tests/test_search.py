@@ -19,6 +19,18 @@ def build_wide_mlp(mesh_shape, batch=64):
     return ff
 
 
+def build_small_mlp(mesh_shape, batch=16):
+    """fc1/fc2 share one per-shard signature (same shapes) — the fixture
+    the measurement tests rely on for cache-twin behavior."""
+    cfg = FFConfig(batch_size=batch, mesh_shape=mesh_shape)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([batch, 32], name="x")
+    t = ff.dense(x, 64, ActiMode.AC_MODE_RELU, name="fc1")
+    t = ff.dense(t, 64, ActiMode.AC_MODE_RELU, name="fc2")
+    t = ff.dense(t, 8, name="out")
+    return ff
+
+
 def test_legal_axis_maps_divisibility():
     ff = build_wide_mlp({"data": 4, "model": 2})
     op = ff.get_op_by_name("fc1")
@@ -77,12 +89,7 @@ def test_measured_op_costs_feed_search():
     from flexflow_tpu.search.measure import measure_op_costs
 
     mesh = {"data": 2, "model": 2}
-    cfg = FFConfig(batch_size=16, mesh_shape=mesh)
-    ff = FFModel(cfg)
-    x = ff.create_tensor([16, 32], name="x")
-    t = ff.dense(x, 64, ActiMode.AC_MODE_RELU, name="fc1")
-    t = ff.dense(t, 64, ActiMode.AC_MODE_RELU, name="fc2")  # same signature
-    t = ff.dense(t, 8, name="out")
+    ff = build_small_mlp(mesh)
     measured = measure_op_costs(ff, mesh, iters=2)
     assert measured, "no measurements produced"
     assert all(v > 0 for v in measured.values())
@@ -95,6 +102,39 @@ def test_measured_op_costs_feed_search():
     best = optimize_strategies(ff, budget=30, mesh_shape=mesh,
                                measured=measured, use_native=False)
     assert set(best) == {"fc1", "fc2", "out"}
+
+
+def test_measure_budget_sweeps_cached_twins():
+    """Round-5: time_budget_s bounds wall-clock, but keys whose signature
+    twin is already in the in-process cache must still carry the measured
+    cost (identical computations priced inconsistently in one table would
+    skew the MCMC ranking). With a warm cache, budget=0 must reproduce the
+    unbudgeted table exactly — every entry a zero-cost cache hit."""
+    from flexflow_tpu.search.measure import measure_op_costs
+
+    mesh = {"data": 2, "model": 2}
+    ff = build_small_mlp(mesh)
+    full = measure_op_costs(ff, mesh, iters=1)
+    assert full
+    swept = measure_op_costs(ff, mesh, iters=1, time_budget_s=0.0)
+    assert swept == full
+
+
+def test_measure_loop_env_validation(monkeypatch):
+    """FF_MEASURE_LOOP: integer respected, garbage rejected loudly (a
+    typo'd knob silently defaulting would taint every table row)."""
+    import pytest
+
+    import flexflow_tpu.search.measure as M
+
+    monkeypatch.setattr(M, "_LOOP_COUNT", None)
+    monkeypatch.setenv("FF_MEASURE_LOOP", "7")
+    assert M._loop_count() == 7
+    monkeypatch.setattr(M, "_LOOP_COUNT", None)
+    monkeypatch.setenv("FF_MEASURE_LOOP", "auto")
+    with pytest.raises(ValueError, match="FF_MEASURE_LOOP"):
+        M._loop_count()
+    monkeypatch.setattr(M, "_LOOP_COUNT", None)
 
 
 def test_analyze_costs_end_to_end(tmp_path):
