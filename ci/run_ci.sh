@@ -9,7 +9,7 @@
 #   accuracy  — accuracy-gated training runs (nightly tier)
 #   native    — C shim + C++ apps build & run
 #
-# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|all]
+# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|all]
 set -e
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -37,6 +37,33 @@ run_native()   {
     ./examples/cpp/alexnet 16 1 32
 }
 run_docs()     { make -C docs html; }
+# lint tier: (1) fflint --strict over every shipped example strategy (the
+# MANIFEST pairs each file with its model graph + mesh), (2) ruff over the
+# Python package when the tool is available (config in pyproject.toml; the
+# minimal CI image has no ruff — gate, don't fail, per the no-new-deps rule)
+run_lint()     {
+  local manifest="examples/strategies/MANIFEST"
+  [ -f "$manifest" ] || { echo "lint: $manifest missing"; return 1; }
+  while IFS='|' read -r f m mesh margs; do
+    f=$(echo "$f" | xargs); m=$(echo "$m" | xargs)
+    mesh=$(echo "$mesh" | xargs); margs=$(echo "$margs" | xargs)
+    [ -z "$f" ] && continue
+    case "$f" in \#*) continue ;; esac
+    local extra=""
+    for a in $margs; do extra="$extra --model-arg $a"; done
+    echo "lint: fflint $m examples/strategies/$f (mesh $mesh)"
+    # shellcheck disable=SC2086
+    python -m flexflow_tpu.analysis "$m" "examples/strategies/$f" \
+      --mesh "$mesh" --strict --quiet $extra
+  done < <(grep -v '^#' "$manifest")
+  if command -v ruff >/dev/null 2>&1; then
+    ruff check flexflow_tpu
+  elif python -c "import ruff" >/dev/null 2>&1; then
+    python -m ruff check flexflow_tpu
+  else
+    echo "lint: ruff not installed in this image — skipping style gate"
+  fi
+}
 
 case "$TIER" in
   unit)     run_unit ;;
@@ -44,7 +71,8 @@ case "$TIER" in
   accuracy) run_accuracy ;;
   native)   run_native ;;
   docs)     run_docs ;;
-  all)      run_unit; run_native; run_docs; run_sweep ;;
+  lint)     run_lint ;;
+  all)      run_lint; run_unit; run_native; run_docs; run_sweep ;;
   *) echo "unknown tier $TIER"; exit 2 ;;
 esac
 echo "ci($TIER): PASSED"

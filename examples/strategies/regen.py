@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Regenerate the shipped example strategy files in this directory.
+
+Each file pairs with a model graph + mesh recorded in MANIFEST
+(`file | model | mesh | model-args`, the format tests/test_fflint.py and
+ci/run_ci.sh's lint tier consume). All shipped strategies must lint
+clean under `python -m flexflow_tpu.analysis ... --strict`.
+
+Usage: python examples/strategies/regen.py
+"""
+
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(HERE, "..", ".."))
+
+from flexflow_tpu.analysis.models import build_model  # noqa: E402
+from flexflow_tpu.parallel.pconfig import (CONTRACT, STAGE,  # noqa: E402
+                                           ParallelConfig)
+from flexflow_tpu.parallel.strategy import save_strategies_to_file  # noqa: E402
+
+MESH = {"data": 4, "model": 2}
+
+
+def _pc(ff, name, am, mesh):
+    op = next(o for o in ff.ops if o.name == name)
+    return ParallelConfig.from_axis_map(op.outputs[0].num_dims, mesh, am)
+
+
+def transformer_dp():
+    """Pure data parallelism over the default encoder classifier."""
+    ff = build_model("transformer", MESH, {})
+    from flexflow_tpu.search.driver import data_parallel_strategy
+
+    return ("transformer_dp.ff", "transformer", "data=4,model=2", "",
+            {n: _pc(ff, n, am, MESH)
+             for n, am in data_parallel_strategy(ff, MESH).items()})
+
+
+def transformer_tp():
+    """Megatron pair on the FFN: ffn1 column-parallel (out-features over
+    'model'), ffn2 row-parallel (CONTRACT) — the resharding-free TP idiom
+    the CONTRACT sentinel exists for."""
+    ff = build_model("transformer", MESH, {})
+    strategies = {}
+    for op in ff.ops:
+        if op.name.startswith("ffn1_"):
+            strategies[op.name] = _pc(ff, op.name,
+                                      {"data": 0, "model": 2}, MESH)
+        elif op.name.startswith("ffn2_"):
+            strategies[op.name] = _pc(ff, op.name,
+                                      {"data": 0, "model": CONTRACT}, MESH)
+        elif op.name.startswith(("attn_", "ln", "res", "head", "pool")):
+            strategies[op.name] = _pc(ff, op.name, {"data": 0}, MESH)
+    return ("transformer_tp.ff", "transformer", "data=4,model=2", "",
+            strategies)
+
+
+def pipeline_pp():
+    """Layer-stacked pipeline parallelism: the stack STAGEs over 'pipe',
+    everything else rides data parallelism."""
+    mesh = {"data": 2, "pipe": 2}
+    ff = build_model("pipeline", mesh, {"layers": 4})
+    strategies = {
+        "stack": _pc(ff, "stack", {"data": 0, "pipe": STAGE}, mesh),
+        "pool": _pc(ff, "pool", {"data": 0}, mesh),
+        "head": _pc(ff, "head", {"data": 0}, mesh),
+    }
+    return ("pipeline_pp.ff", "pipeline", "data=2,pipe=2", "layers=4",
+            strategies)
+
+
+def dlrm_dp_tp():
+    """The DLRM reference idiom (examples/native/dlrm_strategy.py):
+    embedding channels over 'model', MLPs data-parallel."""
+    mesh = MESH
+    strategies = {}
+    for i in range(8):
+        strategies[f"emb_{i}"] = ParallelConfig.from_axis_map(
+            2, mesh, {"data": 0, "model": 1})
+    for i in range(3):
+        strategies[f"bot_{i}"] = ParallelConfig.from_axis_map(
+            2, mesh, {"data": 0})
+    for i in range(4):
+        strategies[f"top_{i}"] = ParallelConfig.from_axis_map(
+            2, mesh, {"data": 0})
+    strategies["interact"] = ParallelConfig.from_axis_map(
+        2, mesh, {"data": 0})
+    return ("dlrm_dp_tp.ff", "dlrm", "data=4,model=2", "", strategies)
+
+
+def dlrm_hetero():
+    """Reference dlrm_strategy_hetero.cc: embeddings on the host CPU
+    backend (device-type int 1), MLPs data-parallel on the pool."""
+    mesh = MESH
+    strategies = {}
+    for i in range(8):
+        strategies[f"emb_{i}"] = ParallelConfig.host(2)
+    for i in range(3):
+        strategies[f"bot_{i}"] = ParallelConfig.from_axis_map(
+            2, mesh, {"data": 0})
+    for i in range(4):
+        strategies[f"top_{i}"] = ParallelConfig.from_axis_map(
+            2, mesh, {"data": 0})
+    strategies["interact"] = ParallelConfig.from_axis_map(
+        2, mesh, {"data": 0})
+    return ("dlrm_hetero.ff", "dlrm", "data=4,model=2", "", strategies)
+
+
+def main():
+    rows = []
+    for gen in (transformer_dp, transformer_tp, pipeline_pp, dlrm_dp_tp,
+                dlrm_hetero):
+        fname, model, mesh, margs, strategies = gen()
+        save_strategies_to_file(os.path.join(HERE, fname), strategies)
+        rows.append(f"{fname} | {model} | {mesh} | {margs}")
+        print(f"wrote {fname} ({len(strategies)} ops)")
+    with open(os.path.join(HERE, "MANIFEST"), "w") as f:
+        f.write("# shipped example strategies: file | model | mesh | "
+                "model-args\n# regenerate with examples/strategies/regen.py;"
+                " all must pass fflint --strict\n")
+        f.write("\n".join(rows) + "\n")
+    print("wrote MANIFEST")
+
+
+if __name__ == "__main__":
+    main()

@@ -10,6 +10,17 @@ package import hook (FLEXFLOW_FORCE_CPU_DEVICES), the driver entry
 from __future__ import annotations
 
 
+def _backend_initialized() -> bool:
+    """Whether jax has already created a backend (after which platform /
+    device-count config is a no-op). Best-effort across jax versions."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(getattr(xla_bridge, "_backends", None))
+    except Exception:
+        return False
+
+
 def force_cpu_devices(n: int) -> bool:
     """Point jax at an n-device virtual CPU platform. Must run before the
     first backend query (jax.devices() locks platform selection). Returns
@@ -20,7 +31,28 @@ def force_cpu_devices(n: int) -> bool:
     try:
         jax.config.update("jax_platforms", "cpu")
         if n > 0:
-            jax.config.update("jax_num_cpu_devices", int(n))
+            try:
+                jax.config.update("jax_num_cpu_devices", int(n))
+            except AttributeError:
+                # older jax (e.g. 0.4.37) has no jax_num_cpu_devices; the
+                # XLA flag is the pre-backend-init equivalent. XLA consumed
+                # the flag at backend creation, so if a backend already
+                # exists the count can no longer change — report False per
+                # the docstring contract (caller checks device count)
+                if _backend_initialized():
+                    return False
+                import os
+                import re
+
+                flags = os.environ.get("XLA_FLAGS", "")
+                want = f"--xla_force_host_platform_device_count={int(n)}"
+                flags = re.sub(
+                    r"--xla_force_host_platform_device_count=\d+", "",
+                    flags)
+                # an existing count flag is REPLACED — keeping a stale
+                # different value while returning True would lie
+                os.environ["XLA_FLAGS"] = " ".join(
+                    (flags + " " + want).split())
         return True
     except RuntimeError:
         return False

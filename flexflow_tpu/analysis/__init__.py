@@ -1,0 +1,111 @@
+"""fflint — static strategy & sharding analysis.
+
+Validates an FFModel op graph plus a strategy table WITHOUT building a
+`jax.sharding.Mesh` or tracing a program: strategy legality is a graph
+property ("Beyond Data and Model Parallelism"), so a bad strategy file is
+rejected in milliseconds with a named op + pass + rule instead of a
+40-second collective-rendezvous hang or an XLA compile error with no
+line back to the offending axis.
+
+Three passes (each a module here):
+  legality  — can this strategy execute on this mesh at all?
+  perf      — legal but pathological: ranked reshard collectives,
+              replicated big weights, HBM footprint, pipeline bubbles.
+  schema    — the strategy text file itself + exact save/load round-trip.
+
+Entry points:
+  analyze(model, ...)        -> Report            (library)
+  python -m flexflow_tpu.analysis MODEL FILE      (CLI, see __main__)
+  FFModel.compile()                               (FFConfig.strategy_lint:
+                                                   "off" | "warn" | "strict")
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from flexflow_tpu.analysis.report import (Report, StrategyLintError,
+                                          Violation)
+
+__all__ = ["analyze", "Report", "Violation", "StrategyLintError",
+           "ALL_PASSES"]
+
+ALL_PASSES = ("legality", "perf", "schema")
+
+
+def analyze(model, strategies: Optional[Dict] = None,
+            mesh_shape: Optional[Dict[str, int]] = None,
+            machine=None, passes=ALL_PASSES,
+            strategy_file: Optional[str] = None) -> Report:
+    """Run the requested fflint passes. Pure static analysis: no mesh, no
+    tracing, no device access beyond what importing jax already did.
+
+    strategies defaults to model.config.strategies; mesh_shape to
+    model.config.mesh_shape. strategy_file, when given, is schema-checked
+    and (if strategies wasn't passed) becomes the analyzed table. The
+    analyzer itself never raises on bad strategies — everything is a
+    Violation in the returned Report; an internal analyzer fault degrades
+    to an `internal-error` warning naming the pass.
+    """
+    from flexflow_tpu.analysis.context import AnalysisContext
+    from flexflow_tpu.analysis.legality import check_legality
+    from flexflow_tpu.analysis.perf import check_perf
+    from flexflow_tpu.analysis.schema import check_file, check_roundtrip
+
+    report = Report()
+    if strategy_file is not None:
+        # the file is parsed whichever passes run — a legality-only
+        # invocation must still analyze the NAMED file, not silently fall
+        # back to model.config.strategies; only the schema pass's
+        # violations are gated on pass selection
+        from_file, viol = check_file(strategy_file,
+                                     roundtrip="schema" in passes)
+        if "schema" in passes:
+            report.extend(viol)
+        elif from_file is None:
+            # structurally unreadable: surface the blocking errors even
+            # with the schema pass deselected, or the run would report
+            # clean while having checked nothing
+            report.extend([v for v in viol if v.severity == "error"])
+        if strategies is None:
+            strategies = from_file
+            if strategies is None:
+                return report  # unreadable: nothing to resolve
+    if strategies is None:
+        strategies = getattr(model.config, "strategies", {}) if model else {}
+    if model is None:
+        return report  # schema-only run (CLI MODEL == "none")
+    if mesh_shape is None:
+        mesh_shape = getattr(model.config, "mesh_shape", None) or {}
+
+    try:
+        ctx = AnalysisContext(model, strategies, mesh_shape)
+    except Exception as e:  # never let the analyzer take compile down
+        report.add(Violation(
+            code="internal-error", pass_name="legality", severity="warning",
+            message=f"strategy resolution crashed: {type(e).__name__}: {e}"))
+        return report
+
+    if "legality" in passes:
+        report.extend(ctx.violations)
+        _run_pass(report, "legality", lambda: check_legality(ctx))
+    else:
+        # resolution-time errors (axis-unknown, degree-unresolvable, ...)
+        # mean downstream passes analyzed a STRIPPED axis_map — surface
+        # them even with the legality pass deselected, or a perf-only run
+        # reports clean on a strategy that cannot execute
+        report.extend([v for v in ctx.violations if v.severity == "error"])
+    if "schema" in passes and strategy_file is None:
+        _run_pass(report, "schema", lambda: check_roundtrip(strategies))
+    if "perf" in passes:
+        _run_pass(report, "perf", lambda: check_perf(ctx, machine=machine))
+    return report
+
+
+def _run_pass(report: Report, name: str, fn) -> None:
+    try:
+        report.extend(fn())
+    except Exception as e:
+        report.add(Violation(
+            code="internal-error", pass_name=name, severity="warning",
+            message=f"{name} pass crashed: {type(e).__name__}: {e}"))

@@ -1,0 +1,107 @@
+"""fflint CLI.
+
+    python -m flexflow_tpu.analysis MODEL STRATEGY_FILE \
+        [--mesh data=4,model=2] [--strict] [--json] \
+        [--passes legality,perf,schema] [--model-arg k=v ...]
+
+MODEL: a builtin graph name (mlp | transformer | dlrm | pipeline), a
+`package.module:callable` spec, or `none` for a schema-only check of the
+file. Exit codes: 0 = clean (info notes allowed), 1 = violations found
+(errors; warnings too under --strict), 2 = usage / model-build failure.
+
+Pure static analysis: no jax.sharding.Mesh is built and nothing traces —
+a bad strategy is named in milliseconds, not after a 40 s collective
+rendezvous timeout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from flexflow_tpu.analysis import ALL_PASSES, analyze
+from flexflow_tpu.analysis.models import BUILTIN, build_model
+
+
+def parse_mesh(spec: str):
+    mesh = {}
+    for part in spec.split(","):
+        ax, eq, size = part.partition("=")
+        if not eq or not ax.strip() or not size.strip().isdigit() \
+                or int(size) < 1:
+            raise ValueError(
+                f"bad --mesh entry {part!r}; expected 'axis=size[,...]', "
+                f"e.g. 'data=4,model=2'")
+        mesh[ax.strip()] = int(size)
+    return mesh
+
+
+def _parse_model_args(pairs):
+    out = {}
+    for p in pairs or ():
+        k, eq, v = p.partition("=")
+        if not eq:
+            raise ValueError(f"bad --model-arg {p!r}; expected k=v")
+        try:
+            out[k] = int(v)
+        except ValueError:
+            out[k] = v
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m flexflow_tpu.analysis",
+        description="fflint: static strategy & sharding analyzer")
+    ap.add_argument("model",
+                    help=f"builtin graph ({', '.join(sorted(BUILTIN))}), "
+                         f"'module:callable', or 'none' for schema-only")
+    ap.add_argument("strategy_file", help="strategy file to analyze")
+    ap.add_argument("--mesh", default="data=8",
+                    help="mesh shape, e.g. data=4,model=2 (default data=8)")
+    ap.add_argument("--passes", default=",".join(ALL_PASSES),
+                    help="comma-separated subset of: "
+                         + ",".join(ALL_PASSES))
+    ap.add_argument("--model-arg", action="append", default=[],
+                    metavar="K=V", help="builder kwarg (repeatable), "
+                    "e.g. --model-arg layers=4")
+    ap.add_argument("--strict", action="store_true",
+                    help="warnings also fail (exit 1)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress info notes in text output")
+    args = ap.parse_args(argv)
+
+    passes = tuple(p.strip() for p in args.passes.split(",") if p.strip())
+    unknown = [p for p in passes if p not in ALL_PASSES]
+    if unknown:
+        ap.error(f"unknown pass(es) {unknown}; valid: {ALL_PASSES}")
+    try:
+        mesh = parse_mesh(args.mesh)
+        model_args = _parse_model_args(args.model_arg)
+    except ValueError as e:
+        ap.error(str(e))
+
+    model = None
+    if args.model != "none":
+        try:
+            model = build_model(args.model, mesh, model_args)
+        except Exception as e:
+            print(f"fflint: cannot build model {args.model!r}: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 2
+
+    report = analyze(model, mesh_shape=mesh, passes=passes,
+                     strategy_file=args.strategy_file)
+    if args.as_json:
+        print(report.to_json())
+    else:
+        print(report.format_text(include_notes=not args.quiet))
+    failed = bool(report.errors()) or (args.strict
+                                       and bool(report.warnings()))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

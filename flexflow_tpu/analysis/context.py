@@ -1,0 +1,165 @@
+"""Shared strategy resolution for the analysis passes.
+
+Resolves each op's *effective* ParallelConfig + axis_map the same way the
+executor will (`runtime/executor.py resolve_axis_map`, defaults from
+`GraphExecutor._resolve_strategies`) — but collects problems as Violations
+instead of raising, and NEVER builds a `jax.sharding.Mesh` or traces a
+program. Everything downstream (legality block math, perf costing) reads
+from this one resolution so the analyzer and the executor cannot disagree
+about what a strategy means.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from flexflow_tpu.analysis.report import Violation
+from flexflow_tpu.ops.base import InputOp, Op
+from flexflow_tpu.parallel.pconfig import CONTRACT, STAGE, ParallelConfig
+
+AxisMap = Dict[str, Optional[int]]
+
+
+@dataclasses.dataclass
+class OpResolution:
+    op: Op
+    pc: ParallelConfig
+    axis_map: AxisMap             # validated entries only (bad axes dropped)
+    from_table: bool              # False = default (DP/replicated) applied
+    explicit_axis_map: bool       # pc.axis_map was present (vs degree-derived)
+
+
+class AnalysisContext:
+    """Static view of (op graph, strategy table, mesh shape)."""
+
+    def __init__(self, model, strategies: Dict[str, ParallelConfig],
+                 mesh_shape: Dict[str, int]):
+        self.model = model
+        self.strategies = dict(strategies or {})
+        self.mesh_shape = dict(mesh_shape or {})
+        self.num_devices = 1
+        for v in self.mesh_shape.values():
+            self.num_devices *= v
+        self.ops: List[Op] = [op for op in model.ops
+                              if not isinstance(op, InputOp)]
+        self.op_names = {op.name for op in model.ops}
+        self.resolutions: Dict[str, OpResolution] = {}
+        self.violations: List[Violation] = []
+        self._resolve_all()
+
+    # ---- resolution --------------------------------------------------------
+
+    def _resolve_all(self) -> None:
+        for name in self.strategies:
+            if name not in self.op_names:
+                self.violations.append(Violation(
+                    code="unknown-op", pass_name="legality",
+                    severity="warning", op_name=name,
+                    message=(f"strategy table names {name!r} but the graph "
+                             f"has no such op (graph ops: "
+                             f"{sorted(self.op_names)[:8]}...) — the entry "
+                             f"is dead and will be ignored")))
+        for op in self.ops:
+            self.resolutions[op.name] = self._resolve_op(op)
+
+    def _default_pc(self, ndims: int) -> ParallelConfig:
+        # mirror GraphExecutor._resolve_strategies defaults
+        if "data" in self.mesh_shape:
+            return ParallelConfig.data_parallel(
+                ndims, self.mesh_shape.get("data", 1))
+        return ParallelConfig.replicated(ndims)
+
+    def _resolve_op(self, op: Op) -> OpResolution:
+        ndims = op.outputs[0].num_dims
+        pc = self.strategies.get(op.name)
+        from_table = pc is not None
+        if pc is None:
+            pc = self._default_pc(ndims)
+        if pc.axis_map is not None:
+            am = self._validate_axis_map(op, pc, ndims)
+            return OpResolution(op, pc, am, from_table, True)
+        # degree-only entry (reference-written file): greedy resolution,
+        # identical to the executor's
+        from flexflow_tpu.runtime.executor import resolve_axis_map
+
+        try:
+            # strip the axis_map=None path's validations by construction:
+            # resolve_axis_map only raises for unresolvable degrees here
+            am = resolve_axis_map(pc, self.mesh_shape, ndims)
+        except ValueError as e:
+            self.violations.append(Violation(
+                code="degree-unresolvable", pass_name="legality",
+                severity="error", op_name=op.name, message=str(e)))
+            am = {}
+        return OpResolution(op, pc, am, from_table, False)
+
+    def _validate_axis_map(self, op: Op, pc: ParallelConfig,
+                           ndims: int) -> AxisMap:
+        am: AxisMap = {}
+        for ax, d in pc.axis_map.items():
+            if d is not None and ax not in self.mesh_shape:
+                self.violations.append(Violation(
+                    code="axis-unknown", pass_name="legality",
+                    severity="error", op_name=op.name,
+                    message=(f"axis_map references mesh axis {ax!r} absent "
+                             f"from this mesh {self.mesh_shape} — the "
+                             f"strategy was produced for a different mesh; "
+                             f"regenerate it or rename the mesh axes")))
+                continue
+            if d is not None and d not in (CONTRACT, STAGE) \
+                    and not (0 <= d < ndims):
+                self.violations.append(Violation(
+                    code="dim-out-of-range", pass_name="legality",
+                    severity="error", op_name=op.name,
+                    message=(f"axis_map maps mesh axis {ax!r} to tensor dim "
+                             f"{d}, outside this op's output rank {ndims} "
+                             f"(valid: 0..{ndims - 1} or the CONTRACT/STAGE "
+                             f"sentinels) — the @axismap record is corrupt "
+                             f"or was written for a different operator")))
+                continue
+            am[ax] = d
+        return am
+
+    # ---- derived quantities ------------------------------------------------
+
+    def parts(self, am: AxisMap) -> int:
+        """Total partition count (weights included: CONTRACT/STAGE count)."""
+        n = 1
+        for ax, d in (am or {}).items():
+            if d is not None:
+                n *= self.mesh_shape.get(ax, 1)
+        return n
+
+    def dim_degree(self, am: AxisMap, dim: int) -> int:
+        n = 1
+        for ax, d in (am or {}).items():
+            if d == dim:
+                n *= self.mesh_shape.get(ax, 1)
+        return n
+
+    def axes_of(self, am: AxisMap, dim: int) -> List[str]:
+        return [ax for ax, d in (am or {}).items() if d == dim]
+
+    def op_block(self, res: OpResolution) -> Optional[Tuple[int, int]]:
+        """(place, ndev) the placement lowering would give this op, or None
+        when the device list itself is illegal (a separate violation already
+        covers it). Mirror of parallel/placement.py op_block, minus the
+        raise."""
+        from flexflow_tpu.search.cost_model import align_place
+
+        D = self.num_devices
+        parts = max(1, min(self.parts(res.axis_map), D))
+        ndev = parts
+        place = 0
+        ids = res.pc.device_ids
+        if ids:
+            if len(ids) < parts:
+                return None  # device-block-too-small violation elsewhere
+            place = min(ids)
+            n = len(ids)
+            if 1 <= n <= D and D % n == 0:
+                ndev = n
+        if ndev >= D or D % ndev != 0:
+            return 0, D
+        return align_place(place, ndev, D), ndev

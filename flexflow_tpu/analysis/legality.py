@@ -1,0 +1,234 @@
+"""fflint legality pass: is the strategy executable on this mesh at all?
+
+Checks graph properties the paper frames as checkable without execution
+("Beyond Data and Model Parallelism": strategy legality is a property of
+the op graph + device topology, not of a run): mesh-axis existence,
+degree/axis-map agreement, device-block sanity, CONTRACT/STAGE
+applicability, and shard divisibility. Every rule mirrors the exact spot
+the runtime would otherwise fail (or silently degrade):
+
+  axis-unknown / dim-out-of-range  -> executor.resolve_axis_map raises
+  degree-mismatch                  -> resolve_axis_map's drift warning
+  degree-unresolvable              -> resolve_axis_map raises
+  device-block-too-small           -> placement.op_block raises
+  device-block-overlap             -> groups would fight over chips
+  contract-on-non-contraction      -> weight_partition produces garbage
+  stage-on-non-pipelinable         -> STAGE axis silently ignored
+  stage-indivisible                -> [L,...] stacked weights can't shard
+  single-axis-dim                  -> ring/Ulysses lowering unbuildable
+  shard-indivisible (warning)      -> XLA pads the shard SILENTLY
+  device-count-mismatch (warning)  -> strategy.py save rewrites the list
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from flexflow_tpu.analysis.context import AnalysisContext, OpResolution
+from flexflow_tpu.analysis.report import Violation
+from flexflow_tpu.parallel.pconfig import CONTRACT, STAGE
+
+
+def _v(code: str, op_name: str, message: str,
+       severity: str = "error") -> Violation:
+    return Violation(code=code, pass_name="legality", severity=severity,
+                     op_name=op_name, message=message)
+
+
+def check_legality(ctx: AnalysisContext) -> List[Violation]:
+    out: List[Violation] = []
+    blocks = {}  # op -> (place, ndev) for explicitly placed ops
+    for op in ctx.ops:
+        res = ctx.resolutions[op.name]
+        out.extend(_check_degrees(ctx, res))
+        out.extend(_check_device_ids(ctx, res))
+        out.extend(_check_sentinels(ctx, res))
+        out.extend(_check_divisibility(ctx, res))
+        if _explicitly_placed(ctx, res):
+            blk = ctx.op_block(res)
+            if blk is not None:
+                blocks[op.name] = blk
+    out.extend(_check_block_overlap(blocks))
+    return out
+
+
+# ---- degrees ---------------------------------------------------------------
+
+def _check_degrees(ctx: AnalysisContext, res: OpResolution) -> List[Violation]:
+    """With an explicit axis_map AND a degree list, both must describe the
+    same sharding on this mesh (the serializer keeps degrees for the
+    reference text schema; pconfig.from_axis_map defines the mapping)."""
+    if not (res.explicit_axis_map and res.pc.dims and res.from_table):
+        return []
+    from flexflow_tpu.parallel.pconfig import ParallelConfig
+
+    ndims = res.op.outputs[0].num_dims
+    # only derivable when every axis_map entry survived validation
+    if res.axis_map != {k: v for k, v in (res.pc.axis_map or {}).items()}:
+        return []
+    try:
+        expect = ParallelConfig.from_axis_map(
+            ndims, ctx.mesh_shape, res.axis_map).dims
+    except Exception:
+        return []
+    if tuple(expect) != tuple(res.pc.dims):
+        return [_v("degree-mismatch", res.op.name,
+                   f"axis_map {res.axis_map} on mesh {ctx.mesh_shape} gives "
+                   f"degrees {tuple(expect)} but the strategy records "
+                   f"{tuple(res.pc.dims)} — the mesh axis sizes changed "
+                   f"since the strategy was written; the executor would run "
+                   f"at the NEW degrees")]
+    return []
+
+
+# ---- device ids ------------------------------------------------------------
+
+def _check_device_ids(ctx: AnalysisContext,
+                      res: OpResolution) -> List[Violation]:
+    out: List[Violation] = []
+    ids = res.pc.device_ids
+    if not ids or not res.from_table:
+        return out
+    D = ctx.num_devices
+    bad = [i for i in ids if not (0 <= i < D)]
+    if bad:
+        out.append(_v("device-id-range", res.op.name,
+                      f"device_ids {bad[:6]} outside the mesh's device range "
+                      f"[0, {D}) (mesh {ctx.mesh_shape})"))
+    if len(set(ids)) != len(ids):
+        dups = sorted({i for i in ids if list(ids).count(i) > 1})
+        out.append(_v("device-id-duplicate", res.op.name,
+                      f"device_ids lists devices {dups[:6]} more than once"))
+    parts = ctx.parts(res.axis_map)  # devices occupied, STAGE included
+    n = res.pc.num_parts()
+    has_stage = bool(ctx.axes_of(res.axis_map, STAGE))
+    if 0 < len(ids) < parts:
+        # the mesh-aware check: save (which has no mesh) accepts any
+        # stage-multiple id count; HERE an undersized list is an error
+        out.append(_v("device-block-too-small", res.op.name,
+                      f"strategy places a {parts}-way sharded op on only "
+                      f"{len(ids)} devices ({tuple(ids)[:4]}...) — the "
+                      f"device block must hold the sharding"))
+    elif len(ids) != n and not (has_stage and len(ids) % max(n, 1) == 0):
+        # same consistency predicate as save_strategies_to_file: a
+        # mismatched non-stage list is what save would rewrite
+        out.append(_v("device-count-mismatch", res.op.name,
+                      f"{len(ids)} device_ids for {n} partitions — "
+                      f"strategy save would rewrite the list to "
+                      f"range({n}); fix the entry or drop the ids",
+                      severity="warning"))
+    if ids and not bad and len(ids) > 1:
+        lo, hi = min(ids), max(ids)
+        if hi - lo + 1 != len(set(ids)):
+            out.append(_v("device-block-gap", res.op.name,
+                          f"device_ids [{lo}..{hi}] are non-contiguous — "
+                          f"placement blocks are contiguous aligned ranges; "
+                          f"the lowering would use [{lo}, {lo + len(ids)})",
+                          severity="warning"))
+    return out
+
+
+def _explicitly_placed(ctx: AnalysisContext, res: OpResolution) -> bool:
+    """Mirror of placement.has_placement's per-op rule."""
+    if getattr(res.pc, "device_type", "TPU") == "CPU":
+        return True
+    ids = res.pc.device_ids
+    return bool(ids and min(ids) > 0 and 0 < len(ids) < ctx.num_devices
+                and ctx.num_devices % len(ids) == 0)
+
+
+def _check_block_overlap(blocks) -> List[Violation]:
+    """Two placed ops' blocks must nest exactly or be disjoint: a partial
+    overlap means two sub-mesh programs contend for some chips while each
+    also owns chips the other can't see — the per-group lowering has no
+    schedule for that."""
+    out: List[Violation] = []
+    items = sorted(blocks.items(), key=lambda kv: kv[1])
+    for i, (a_name, (a_p, a_n)) in enumerate(items):
+        for b_name, (b_p, b_n) in items[i + 1:]:
+            a_lo, a_hi = a_p, a_p + a_n
+            b_lo, b_hi = b_p, b_p + b_n
+            disjoint = a_hi <= b_lo or b_hi <= a_lo
+            nested = (a_lo <= b_lo and b_hi <= a_hi) or \
+                     (b_lo <= a_lo and a_hi <= b_hi)
+            if not disjoint and not nested:
+                out.append(_v("device-block-overlap", b_name,
+                              f"device block [{b_lo},{b_hi}) partially "
+                              f"overlaps {a_name!r}'s block [{a_lo},{a_hi}) "
+                              f"— placement blocks must nest or be disjoint"))
+    return out
+
+
+# ---- CONTRACT / STAGE ------------------------------------------------------
+
+def _check_sentinels(ctx: AnalysisContext,
+                     res: OpResolution) -> List[Violation]:
+    out: List[Violation] = []
+    op = res.op
+    contract_axes = ctx.axes_of(res.axis_map, CONTRACT)
+    stage_axes = ctx.axes_of(res.axis_map, STAGE)
+    if contract_axes and op.contract_size() is None:
+        out.append(_v("contract-on-non-contraction", op.name,
+                      f"axis_map marks {contract_axes} CONTRACT "
+                      f"(row-parallel) but {type(op).__name__} has no "
+                      f"contraction dim (contract_size() is None) — only "
+                      f"weight-contraction ops (Linear, Conv2D) accept it"))
+    if stage_axes:
+        stages = op.pipeline_stages()
+        if stages <= 0:
+            out.append(_v("stage-on-non-pipelinable", op.name,
+                          f"axis_map marks {stage_axes} STAGE (pipeline) but "
+                          f"{type(op).__name__} exposes no pipeline_stages() "
+                          f"— only stacked-layer ops "
+                          f"(TransformerPipelineStack) accept it"))
+        else:
+            n = 1
+            for ax in stage_axes:
+                n *= ctx.mesh_shape.get(ax, 1)
+            if n > 0 and stages % n != 0:
+                out.append(_v("stage-indivisible", op.name,
+                              f"STAGE axes {stage_axes} give {n} pipeline "
+                              f"stages but the op stacks {stages} layers — "
+                              f"{stages} % {n} != 0, so the [L, ...] stacked "
+                              f"weights cannot shard into equal stages"))
+    # dims the executor can shard over at most one axis (MHA seq dim)
+    for d in op.single_axis_dims():
+        axes = ctx.axes_of(res.axis_map, d)
+        if len(axes) > 1:
+            out.append(_v("single-axis-dim", op.name,
+                          f"output dim {d} is sharded over {len(axes)} mesh "
+                          f"axes {axes} but this op's lowering supports at "
+                          f"most one axis on that dim"))
+    return out
+
+
+# ---- divisibility ----------------------------------------------------------
+
+def _check_divisibility(ctx: AnalysisContext,
+                        res: OpResolution) -> List[Violation]:
+    """XLA pads non-divisible shards SILENTLY (GSPMD semantics) — correct
+    numerics for most ops but wasted compute and, for ops that reduce over
+    the padded dim, a latent numerics trap. Flag every tensor dim whose
+    size doesn't divide by its shard degree."""
+    out: List[Violation] = []
+    op = res.op
+    dims = op.outputs[0].dims
+    for d in range(len(dims)):
+        deg = ctx.dim_degree(res.axis_map, d)
+        if deg > 1 and dims[d] % deg != 0:
+            axes = ctx.axes_of(res.axis_map, d)
+            out.append(_v("shard-indivisible", op.name,
+                          f"output dim {d} (size {dims[d]}) does not divide "
+                          f"by its shard degree {deg} (axes {axes}) — XLA "
+                          f"will silently pad each shard to "
+                          f"{-(-dims[d] // deg)}", severity="warning"))
+    cdeg = ctx.dim_degree(res.axis_map, CONTRACT)
+    if cdeg > 1:
+        csize = op.contract_size()
+        if csize is not None and csize % cdeg != 0:
+            out.append(_v("shard-indivisible", op.name,
+                          f"contraction dim (size {csize}) does not divide "
+                          f"by the CONTRACT degree {cdeg} — XLA will "
+                          f"silently pad the weight shards",
+                          severity="warning"))
+    return out

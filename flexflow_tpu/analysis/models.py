@@ -1,0 +1,78 @@
+"""Model registry for the fflint CLI.
+
+`python -m flexflow_tpu.analysis MODEL FILE` needs an op graph to check
+the strategy against. MODEL is either a builtin name below (each builds a
+representative graph from the models zoo, sized by --model-arg overrides)
+or a `package.module:callable` spec whose callable receives the FFModel
+and keyword args and adds ops to it. Graph building is pure Python shape
+inference — no mesh, no tracing.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Callable, Dict
+
+
+def _mlp(ff, batch=64, in_dim=64, hidden=256, out_dim=16, layers=2):
+    x = ff.create_tensor([batch, in_dim], name="input")
+    t = x
+    for i in range(layers):
+        t = ff.dense(t, hidden, name=f"fc_{i}")
+    ff.dense(t, out_dim, name="head")
+
+
+def _transformer(ff, batch=32, seq=64, hidden=128, layers=2, heads=4,
+                 classes=16):
+    from flexflow_tpu.models.transformer import build_encoder_classifier
+
+    build_encoder_classifier(ff, batch, seq, hidden, layers, heads,
+                             num_classes=classes)
+
+
+def _dlrm(ff, batch=64, num_tables=8, embedding_size=64, dense_dim=64):
+    from flexflow_tpu.models.dlrm import dlrm
+
+    dlrm(ff, batch, embedding_size=embedding_size, num_tables=num_tables,
+         dense_dim=dense_dim)
+
+
+def _pipeline(ff, batch=32, seq=32, hidden=64, layers=4, heads=4,
+              classes=16, num_microbatches=None):
+    x = ff.create_tensor([batch, seq, hidden], name="input")
+    t = ff.transformer_pipeline_stack(x, layers, heads,
+                                      num_microbatches=num_microbatches,
+                                      name="stack")
+    t = ff.mean(t, dims=[1], name="pool")
+    ff.dense(t, classes, name="head")
+
+
+BUILTIN: Dict[str, Callable] = {
+    "mlp": _mlp,
+    "transformer": _transformer,
+    "dlrm": _dlrm,
+    "pipeline": _pipeline,
+}
+
+
+def build_model(spec: str, mesh_shape: Dict[str, int],
+                model_args: Dict[str, object]):
+    """Build an (uncompiled) FFModel for `spec` over `mesh_shape`."""
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.model import FFModel
+
+    if spec in BUILTIN:
+        builder = BUILTIN[spec]
+    elif ":" in spec:
+        mod_name, _, fn_name = spec.rpartition(":")
+        builder = getattr(importlib.import_module(mod_name), fn_name)
+    else:
+        raise ValueError(
+            f"unknown model {spec!r}: expected one of {sorted(BUILTIN)}, "
+            f"'none', or a 'package.module:callable' spec")
+    batch = int(model_args.get("batch", 0)) or None
+    cfg = FFConfig(mesh_shape=dict(mesh_shape),
+                   **({"batch_size": batch} if batch else {}))
+    ff = FFModel(cfg)
+    builder(ff, **model_args)
+    return ff

@@ -1,0 +1,216 @@
+"""fflint performance pass: legal but pathological strategies.
+
+Costs come from the same machine model the MCMC search ranks with
+(`search/machine.py` ICI/DCN ring collectives, `search/cost_model.py`
+resharding/memory accounting) so the lint and the search cannot disagree
+about what is expensive. Four lints:
+
+  reshard (info; warning above FF_LINT_RESHARD_WARN_BYTES, default 64 MiB)
+      producer/consumer PartitionSpec mismatch on a graph edge implies a
+      GSPMD collective; each is ranked by estimated bytes moved and priced
+      through the ICI/DCN model (the reference's region-intersection comm
+      tasks, simulator.cc:252-285).
+  replicated-weight-no-fsdp (warning above FF_LINT_WEIGHT_WARN_BYTES,
+      default 64 MiB)
+      a weight replicated on every chip of a multi-chip mesh with
+      FFConfig.fsdp_axis unset: per-chip HBM pays the full weight + grad +
+      opt state with no sharding anywhere to claw it back.
+  hbm-over-capacity (warning)
+      per-chip footprint estimate (cost_model.op_mem_bytes accounting)
+      exceeds the machine's HBM capacity — the config would OOM or swap
+      into the reference simulator's memory-penalty regime
+      (simulator.cc:595-620). The peak estimate is always emitted as an
+      info note.
+  pipeline-* (info/warning)
+      per STAGE op: stage count, microbatches, bubble fraction
+      ((n-1)/(m+n-1), GPipe) and per-stage FLOP imbalance when the layer
+      count doesn't split evenly.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from flexflow_tpu.analysis.context import AnalysisContext
+from flexflow_tpu.analysis.report import Violation
+from flexflow_tpu.ops.base import InputOp
+from flexflow_tpu.parallel.pconfig import STAGE
+
+RESHARD_WARN_BYTES = float(
+    os.environ.get("FF_LINT_RESHARD_WARN_BYTES", 64 * 1024 * 1024))
+WEIGHT_WARN_BYTES = float(
+    os.environ.get("FF_LINT_WEIGHT_WARN_BYTES", 64 * 1024 * 1024))
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} GiB"
+
+
+def check_perf(ctx: AnalysisContext, machine=None) -> List[Violation]:
+    from flexflow_tpu.search.cost_model import CostModel
+
+    cost = CostModel(ctx.model, ctx.mesh_shape, machine=machine)
+    out: List[Violation] = []
+    out.extend(_check_resharding(ctx, cost))
+    out.extend(_check_replicated_weights(ctx, cost))
+    out.extend(_check_hbm(ctx, cost))
+    out.extend(_check_pipeline(ctx))
+    return out
+
+
+# ---- resharding ------------------------------------------------------------
+
+def _check_resharding(ctx: AnalysisContext, cost) -> List[Violation]:
+    out: List[Violation] = []
+    for op in ctx.ops:
+        am = ctx.resolutions[op.name].axis_map
+        for input_idx, t in enumerate(op.inputs):
+            if t.owner_op is None or isinstance(t.owner_op, InputOp):
+                continue
+            src = t.owner_op.name
+            if src not in ctx.resolutions:
+                continue
+            pam = t.owner_op.output_axis_map(ctx.resolutions[src].axis_map)
+            try:
+                want = op.input_axis_map(am, input_idx)
+            except Exception:
+                want = am
+            secs = cost.resharding_time(pam, want, t)
+            if secs <= 0.0:
+                continue
+            changed = [ax for ax in ctx.mesh_shape
+                       if pam.get(ax) != want.get(ax)
+                       and ctx.mesh_shape[ax] > 1]
+            nbytes = t.volume() * cost.dtype_bytes
+            sev = "warning" if nbytes >= RESHARD_WARN_BYTES else "info"
+            out.append(Violation(
+                code="reshard", pass_name="perf", severity=sev,
+                op_name=op.name, est_bytes=nbytes, est_seconds=secs,
+                message=(f"input {input_idx} ({t.name}, "
+                         f"{_fmt_bytes(nbytes)}) arrives from {src!r} "
+                         f"sharded {_fmt_map(pam)} but this op constrains "
+                         f"{_fmt_map(want)} — GSPMD inserts a collective "
+                         f"over axes {changed}, est "
+                         f"{secs * 1e3:.3f} ms on this machine model")))
+    # ranked: biggest implied collective first
+    out.sort(key=lambda v: -(v.est_bytes or 0))
+    return out
+
+
+def _fmt_map(am) -> str:
+    live = {ax: d for ax, d in (am or {}).items() if d is not None}
+    return str(live) if live else "{replicated}"
+
+
+# ---- replicated weights ----------------------------------------------------
+
+def _check_replicated_weights(ctx: AnalysisContext, cost) -> List[Violation]:
+    out: List[Violation] = []
+    if ctx.num_devices <= 1:
+        return out
+    fsdp = getattr(getattr(ctx.model, "config", None), "fsdp_axis", "") or ""
+    if fsdp and ctx.mesh_shape.get(fsdp, 1) > 1:
+        return out  # FSDP will shard everything shardable
+    for op in ctx.ops:
+        am = ctx.resolutions[op.name].axis_map
+        try:
+            wp = op.weight_partition(am)
+        except Exception:
+            continue
+        for spec in op.weight_specs():
+            wbytes = 1
+            for d in spec.shape:
+                wbytes *= d
+            wbytes *= cost.dtype_bytes
+            pspec = wp.get(spec.name)
+            sharded = pspec is not None and any(e is not None for e in pspec)
+            if not sharded and wbytes >= WEIGHT_WARN_BYTES:
+                out.append(Violation(
+                    code="replicated-weight-no-fsdp", pass_name="perf",
+                    severity="warning", op_name=op.name, est_bytes=wbytes,
+                    message=(f"weight {spec.name!r} ({_fmt_bytes(wbytes)}) "
+                             f"is replicated on all {ctx.num_devices} chips "
+                             f"and FFConfig.fsdp_axis is unset — with grads "
+                             f"+ optimizer state this costs "
+                             f"~{_fmt_bytes(3 * wbytes)} HBM per chip; "
+                             f"shard it (axis_map) or set fsdp_axis")))
+    return out
+
+
+# ---- HBM footprint ---------------------------------------------------------
+
+def _check_hbm(ctx: AnalysisContext, cost) -> List[Violation]:
+    """Per-chip footprint under the cost model's per-shard accounting,
+    accumulated over the device blocks the placement lowering would use
+    (cost_model.iteration_time's memory bookkeeping, minus the schedule)."""
+    D = ctx.num_devices
+    dev_mem = [0.0] * max(D, 1)
+    for op in ctx.ops:
+        res = ctx.resolutions[op.name]
+        m = cost.op_mem_bytes(op, res.axis_map)
+        blk = ctx.op_block(res) or (0, max(D, 1))
+        place, ndev = blk
+        for d in range(place, min(place + ndev, len(dev_mem))):
+            dev_mem[d] += m
+    peak = max(dev_mem) if dev_mem else 0.0
+    cap = cost.machine.hbm_bytes
+    out = [Violation(
+        code="hbm-footprint", pass_name="perf", severity="info",
+        est_bytes=peak,
+        message=(f"estimated peak per-chip HBM footprint "
+                 f"{_fmt_bytes(peak)} of {_fmt_bytes(cap)} capacity "
+                 f"({100 * peak / cap:.1f}%)"))]
+    if peak > cap:
+        worst = max(range(len(dev_mem)), key=lambda d: dev_mem[d])
+        out.append(Violation(
+            code="hbm-over-capacity", pass_name="perf", severity="warning",
+            est_bytes=peak,
+            message=(f"estimated per-chip HBM footprint {_fmt_bytes(peak)} "
+                     f"exceeds capacity {_fmt_bytes(cap)} (worst chip "
+                     f"{worst}) — the strategy would OOM or thrash; shard "
+                     f"more weights/activations or grow the mesh")))
+    return out
+
+
+# ---- pipeline --------------------------------------------------------------
+
+def _check_pipeline(ctx: AnalysisContext) -> List[Violation]:
+    out: List[Violation] = []
+    for op in ctx.ops:
+        am = ctx.resolutions[op.name].axis_map
+        stage_axes = ctx.axes_of(am, STAGE)
+        if not stage_axes:
+            continue
+        n = 1
+        for ax in stage_axes:
+            n *= ctx.mesh_shape.get(ax, 1)
+        if n <= 1:
+            continue
+        layers = op.pipeline_stages()
+        m = int(getattr(op, "num_microbatches", 0) or 0) or n
+        bubble = (n - 1) / (m + n - 1)
+        if layers > 0 and layers % n != 0:
+            lo, hi = layers // n, -(-layers // n)
+            out.append(Violation(
+                code="pipeline-flop-imbalance", pass_name="perf",
+                severity="warning", op_name=op.name,
+                message=(f"{layers} layers over {n} stages splits "
+                         f"{hi}/{lo} layers per stage — the {hi}-layer "
+                         f"stages gate every tick, wasting "
+                         f"~{100 * (1 - lo / hi):.0f}% of the light "
+                         f"stages' FLOPs")))
+        sev = "warning" if m < n else "info"
+        out.append(Violation(
+            code="pipeline-bubble", pass_name="perf", severity=sev,
+            op_name=op.name,
+            message=(f"{n} pipeline stages with {m} microbatches: bubble "
+                     f"fraction (n-1)/(m+n-1) = {100 * bubble:.0f}%"
+                     + (" — fewer microbatches than stages leaves chips "
+                        "idle most of the schedule; raise num_microbatches"
+                        if m < n else ""))))
+    return out

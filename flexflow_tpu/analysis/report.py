@@ -1,0 +1,120 @@
+"""fflint diagnostics: the Violation/Report data model.
+
+Every pass in `flexflow_tpu/analysis` returns plain data — a list of
+Violations — never raises on bad strategies. This is what makes the
+analyzer usable from three callsites with different failure policies:
+the CLI (exit code), `FFModel.compile` (warn logs vs strict raise), and
+tests (assert on codes). The reference's only diagnostics at this layer
+were asserts deep inside the mapper (src/mapper/mapper.cc:346-424) and
+Legion runtime errors; here a bad strategy names the op, the pass, and
+the rule it broke.
+
+Severity model:
+  error   — the strategy cannot execute correctly (unknown mesh axis,
+            degree/axis-map disagreement, device block too small, ...).
+            `strict` mode fails on these.
+  warning — executes but is suspicious or silently degraded (XLA pad on
+            a non-divisible shard, device-id list rewritten on save, a
+            replicated multi-GiB weight with FSDP off, ...).
+  info    — performance notes with no threshold crossed (the ranked
+            reshard-collective listing). Never fails any mode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional
+
+SEVERITIES = ("error", "warning", "info")
+
+
+@dataclasses.dataclass
+class Violation:
+    code: str            # stable kebab-case rule id, e.g. "axis-unknown"
+    pass_name: str       # "legality" | "perf" | "schema"
+    severity: str        # "error" | "warning" | "info"
+    message: str
+    op_name: Optional[str] = None   # offending op (None for whole-file issues)
+    # perf ranking key: estimated bytes moved by the flagged collective
+    est_bytes: Optional[float] = None
+    est_seconds: Optional[float] = None
+
+    def __post_init__(self):
+        assert self.severity in SEVERITIES, self.severity
+
+    def __str__(self) -> str:
+        where = f" op {self.op_name!r}" if self.op_name else ""
+        return (f"{self.severity}[{self.pass_name}/{self.code}]{where}: "
+                f"{self.message}")
+
+
+class Report:
+    """Ordered collection of violations from one analyze() run."""
+
+    def __init__(self, violations: Optional[List[Violation]] = None):
+        self.violations: List[Violation] = list(violations or [])
+
+    def add(self, v: Violation) -> None:
+        self.violations.append(v)
+
+    def extend(self, vs) -> None:
+        self.violations.extend(vs)
+
+    def errors(self) -> List[Violation]:
+        return [v for v in self.violations if v.severity == "error"]
+
+    def warnings(self) -> List[Violation]:
+        return [v for v in self.violations if v.severity == "warning"]
+
+    def notes(self) -> List[Violation]:
+        return [v for v in self.violations if v.severity == "info"]
+
+    @property
+    def ok(self) -> bool:
+        """No errors and no warnings (info notes don't count)."""
+        return not self.errors() and not self.warnings()
+
+    def codes(self) -> List[str]:
+        return [v.code for v in self.violations]
+
+    def by_code(self, code: str) -> List[Violation]:
+        return [v for v in self.violations if v.code == code]
+
+    def summary(self) -> str:
+        e, w, n = len(self.errors()), len(self.warnings()), len(self.notes())
+        return f"fflint: {e} error(s), {w} warning(s), {n} note(s)"
+
+    def format_text(self, include_notes: bool = True) -> str:
+        order = {"error": 0, "warning": 1, "info": 2}
+        lines = [str(v) for v in sorted(
+            self.violations, key=lambda v: (order[v.severity],
+                                            -(v.est_bytes or 0.0)))
+            if include_notes or v.severity != "info"]
+        lines.append(self.summary())
+        return "\n".join(lines)
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "violations": [dataclasses.asdict(v) for v in self.violations],
+            "num_errors": len(self.errors()),
+            "num_warnings": len(self.warnings()),
+            "num_notes": len(self.notes()),
+        }, indent=2)
+
+    def log(self, logger) -> None:
+        """Emit through a stdlib-style logger (compile's warn mode)."""
+        for v in self.violations:
+            if v.severity == "error":
+                logger.error("%s", v)
+            elif v.severity == "warning":
+                logger.warning("%s", v)
+
+
+class StrategyLintError(ValueError):
+    """Raised by strict-mode compile when fflint finds errors."""
+
+    def __init__(self, report: Report):
+        self.report = report
+        super().__init__(
+            report.summary() + "\n" + report.format_text(include_notes=False))

@@ -1,0 +1,278 @@
+"""fflint schema pass: the strategy file's text format itself.
+
+`parallel/strategy.py`'s loader is deliberately tolerant (token stream,
+reference parity with src/runtime/strategy.cc:95-189) — a truncated or
+corrupt file can half-parse into a plausible-looking table. This pass is
+the strict twin: it re-walks the token stream checking counts and value
+domains, then proves the parsed table round-trips EXACTLY through
+save_strategies_to_file -> load_strategies_from_file (the `@axismap`
+extension records must survive, or a search-discovered CONTRACT/STAGE
+strategy silently degrades to the greedy degree heuristic on its next
+load).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+from flexflow_tpu.analysis.report import Violation
+from flexflow_tpu.config import MAX_TENSOR_DIM
+from flexflow_tpu.parallel.pconfig import CONTRACT, STAGE, ParallelConfig
+
+_SENTINELS = (-1, CONTRACT, STAGE)
+
+
+def _v(code: str, message: str, op_name: Optional[str] = None,
+       severity: str = "error") -> Violation:
+    return Violation(code=code, pass_name="schema", severity=severity,
+                     op_name=op_name, message=message)
+
+
+class _Cursor:
+    def __init__(self, tokens: List[str]):
+        self.tokens = tokens
+        self.pos = 0
+
+    def done(self) -> bool:
+        return self.pos >= len(self.tokens)
+
+    def take(self) -> Optional[str]:
+        if self.done():
+            return None
+        t = self.tokens[self.pos]
+        self.pos += 1
+        return t
+
+    def take_int(self) -> Tuple[Optional[int], Optional[str]]:
+        t = self.take()
+        if t is None:
+            return None, None
+        try:
+            return int(t), t
+        except ValueError:
+            return None, t
+
+
+def check_file(path: str, roundtrip: bool = True
+               ) -> Tuple[Optional[Dict[str, ParallelConfig]],
+                          List[Violation]]:
+    """Strictly parse `path`. Returns (strategies-or-None, violations);
+    strategies is None when the file is structurally unreadable.
+    roundtrip=False skips the save/load round-trip (a tempfile write) —
+    for callers that only need the parse + the structural checks."""
+    out: List[Violation] = []
+    try:
+        with open(path) as f:
+            tokens = f.read().split()
+    except OSError as e:
+        return None, [_v("schema-unreadable", f"cannot read {path!r}: {e}")]
+    cur = _Cursor(tokens)
+    num_ops, raw = cur.take_int()
+    if num_ops is None:
+        return None, [_v("schema-bad-token",
+                         f"expected the op count as the first token, got "
+                         f"{raw!r}")]
+    seen: Dict[str, int] = {}
+    for op_i in range(num_ops):
+        name = cur.take()
+        if name is None:
+            out.append(_v("schema-truncated",
+                          f"file ends after {op_i} of {num_ops} declared "
+                          f"op records"))
+            break
+        if name in seen:
+            out.append(_v("schema-duplicate-op",
+                          f"op record #{op_i} repeats name {name!r} "
+                          f"(first at record #{seen[name]}) — the loader "
+                          f"keeps only the LAST entry", op_name=name))
+        seen[name] = op_i
+        if not _parse_record(cur, name, out):
+            break
+    if not cur.done():
+        out.append(_v("schema-trailing",
+                      f"{len(tokens) - cur.pos} token(s) after the last "
+                      f"declared op record (starting {tokens[cur.pos]!r}) — "
+                      f"the op count header disagrees with the body",
+                      severity="warning"))
+    if any(x.severity == "error" for x in out):
+        return None, out
+
+    from flexflow_tpu.parallel.strategy import load_strategies_from_file
+
+    strategies = load_strategies_from_file(path)
+    if roundtrip:
+        out.extend(check_roundtrip(strategies))
+    return strategies, out
+
+
+def _parse_record(cur: _Cursor, name: str, out: List[Violation]) -> bool:
+    """One op record after its name. False = unrecoverable truncation."""
+    devtype, raw = cur.take_int()
+    if devtype is None:
+        out.append(_v("schema-truncated" if raw is None else
+                      "schema-bad-token",
+                      f"expected the device-type int after the op name, "
+                      f"got {raw!r}", op_name=name))
+        return False
+    if devtype not in (0, 1):
+        out.append(_v("schema-device-type",
+                      f"device type {devtype} is neither 0 (accelerator "
+                      f"pool: reference GPU / our TPU) nor 1 (host CPU) — "
+                      f"the loader will default it to TPU", op_name=name,
+                      severity="warning"))
+    ndims, raw = cur.take_int()
+    if ndims is None:
+        out.append(_v("schema-truncated" if raw is None else
+                      "schema-bad-token",
+                      f"expected nDims, got {raw!r}", op_name=name))
+        return False
+    # +1: a trailing CONTRACT (replica) degree rides beyond the tensor rank
+    if not (1 <= ndims <= MAX_TENSOR_DIM + 1):
+        out.append(_v("schema-ndims",
+                      f"nDims {ndims} outside [1, {MAX_TENSOR_DIM + 1}]",
+                      op_name=name))
+        return False
+    degs = []
+    for _ in range(ndims):
+        d, raw = cur.take_int()
+        if d is None:
+            out.append(_v("schema-truncated" if raw is None else
+                          "schema-bad-token",
+                          f"expected {ndims} partition degrees, got {raw!r} "
+                          f"after {len(degs)}", op_name=name))
+            return False
+        if d < 1:
+            out.append(_v("schema-degree",
+                          f"partition degree {d} must be >= 1",
+                          op_name=name))
+        degs.append(d)
+    nids, raw = cur.take_int()
+    if nids is None:
+        out.append(_v("schema-truncated" if raw is None else
+                      "schema-bad-token",
+                      f"expected the device-id count, got {raw!r}",
+                      op_name=name))
+        return False
+    prod = 1
+    for d in degs:
+        prod *= d
+    for i in range(nids):
+        d, raw = cur.take_int()
+        if d is None:
+            out.append(_v("schema-truncated" if raw is None else
+                          "schema-bad-token",
+                          f"expected {nids} device ids, got {raw!r} after "
+                          f"{i}", op_name=name))
+            return False
+    # optional @axismap extension record
+    has_stage = False
+    if not cur.done() and cur.tokens[cur.pos] == "@axismap":
+        cur.take()
+        k, raw = cur.take_int()
+        if k is None or k < 0:
+            out.append(_v("schema-axismap-truncated",
+                          f"@axismap record: expected the entry count, got "
+                          f"{raw!r}", op_name=name))
+            return False
+        for i in range(k):
+            ax = cur.take()
+            d, raw = cur.take_int()
+            if ax is None or d is None:
+                out.append(_v("schema-axismap-truncated",
+                              f"@axismap record declares {k} entries but "
+                              f"ends after {i} (axis {ax!r}, dim {raw!r})",
+                              op_name=name))
+                return False
+            if d == STAGE:
+                has_stage = True
+            if d < 0 and d not in _SENTINELS:
+                out.append(_v("schema-axismap-dim",
+                              f"@axismap maps axis {ax!r} to {d}; negative "
+                              f"values must be -1 (replicated), "
+                              f"{CONTRACT} (CONTRACT) or {STAGE} (STAGE)",
+                              op_name=name))
+    # STAGE strategies occupy stage_size x num_parts devices while the
+    # degree list (reference schema) excludes the stage axis, so a
+    # stage-multiple id count is the canonical form there
+    if nids != prod and not (has_stage and nids % max(prod, 1) == 0):
+        out.append(_v("schema-ids-count",
+                      f"{nids} device ids declared for {prod} partitions "
+                      f"(degrees {degs}) — the mapper pairs shard i with "
+                      f"device_ids[i]", op_name=name, severity="warning"))
+    return True
+
+
+def check_roundtrip(strategies: Dict[str, ParallelConfig]) -> List[Violation]:
+    """Prove the in-memory table survives save -> load exactly.
+
+    Compared fields: dims, device_type (normalized — reference GPU and our
+    TPU both serialize to the accelerator int 0, so 'GPU' legitimately
+    reloads as 'TPU'), axis_map including CONTRACT/STAGE sentinels, and
+    device_ids whenever the list is consistent (len == num_parts; an
+    inconsistent list is save's documented rewrite, flagged separately by
+    the legality pass as device-count-mismatch)."""
+    from flexflow_tpu.parallel.strategy import (load_strategies_from_file,
+                                                save_strategies_to_file)
+
+    out: List[Violation] = []
+    fd, tmp = tempfile.mkstemp(suffix=".ff", prefix="fflint_rt_")
+    os.close(fd)
+    try:
+        save_strategies_to_file(tmp, strategies)
+        loaded = load_strategies_from_file(tmp)
+    except Exception as e:
+        return [_v("schema-roundtrip",
+                   f"save/load round trip raised {type(e).__name__}: {e}")]
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    for name, pc in strategies.items():
+        got = loaded.get(name)
+        if got is None:
+            out.append(_v("schema-roundtrip",
+                          "op record missing after save/load round trip",
+                          op_name=name))
+            continue
+        diffs = _diff_pc(pc, got)
+        if diffs:
+            out.append(_v("schema-roundtrip",
+                          "strategy does not round-trip through "
+                          "parallel/strategy.py: " + "; ".join(diffs),
+                          op_name=name))
+    for name in loaded:
+        if name not in strategies:
+            out.append(_v("schema-roundtrip",
+                          "op record appeared from nowhere after round trip",
+                          op_name=name))
+    return out
+
+
+def _norm_devtype(dt: str) -> str:
+    # int 0 in the file = "the accelerator pool": reference-written GPU
+    # strategies execute on our TPU backend by design
+    return "TPU" if dt in ("TPU", "GPU") else dt
+
+
+def _diff_pc(a: ParallelConfig, b: ParallelConfig) -> List[str]:
+    diffs = []
+    if tuple(a.dims) != tuple(b.dims):
+        diffs.append(f"dims {tuple(a.dims)} -> {tuple(b.dims)}")
+    if _norm_devtype(a.device_type) != _norm_devtype(b.device_type):
+        diffs.append(f"device_type {a.device_type} -> {b.device_type}")
+    am_a = {k: v for k, v in (a.axis_map or {}).items()}
+    am_b = {k: v for k, v in (b.axis_map or {}).items()}
+    if (a.axis_map is None) != (b.axis_map is None) or am_a != am_b:
+        diffs.append(f"axis_map {a.axis_map} -> {b.axis_map}")
+    n = max(a.num_parts(), 1)
+    stage_ok = bool(a.axis_map) and any(d == STAGE
+                                        for d in a.axis_map.values()) \
+        and len(a.device_ids) % n == 0
+    if a.device_ids and (len(a.device_ids) == a.num_parts() or stage_ok) \
+            and tuple(a.device_ids) != tuple(b.device_ids):
+        diffs.append(f"device_ids {a.device_ids[:4]}... -> "
+                     f"{b.device_ids[:4]}...")
+    return diffs

@@ -98,6 +98,14 @@ class FFConfig:
     # all-gathers at use and reduce-scatters the gradient. Param + opt
     # HBM divides by the axis size. "" = off.
     fsdp_axis: str = ""
+    # fflint (flexflow_tpu/analysis): static strategy validation inside
+    # compile(), after the table is final but before params/programs are
+    # built. "warn" logs violations through fflogger; "strict" raises
+    # StrategyLintError on any error-severity finding (a bad strategy file
+    # is then rejected in milliseconds with the op + rule named, instead
+    # of failing deep inside mesh construction or XLA compile); "off"
+    # skips the analyzer entirely.
+    strategy_lint: str = "warn"
     # label value excluded from token-level accuracy (count AND
     # denominator) — set to the pad id for causal-LM training so padded
     # positions don't dilute the metric; None counts every position
@@ -119,6 +127,10 @@ class FFConfig:
             raise ValueError(
                 f"batch_size {self.batch_size} not divisible by "
                 f"grad_accum_steps {self.grad_accum_steps}")
+        if self.strategy_lint not in ("off", "warn", "strict"):
+            raise ValueError(
+                f"strategy_lint={self.strategy_lint!r}: must be 'off', "
+                f"'warn' or 'strict'")
         for field in ("compute_dtype", "master_dtype"):
             v = getattr(self, field)
             if v not in ("float32", "bfloat16"):

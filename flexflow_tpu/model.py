@@ -485,6 +485,24 @@ class FFModel:
             if cfg.export_strategy_file:
                 save_strategies_to_file(cfg.export_strategy_file, cfg.strategies)
 
+        if cfg.strategy_lint != "off":
+            # fflint (analysis/): static validation of the now-final
+            # strategy table — pure graph+table checks, no tracing. A bad
+            # strategy is named HERE (op + pass + rule) instead of
+            # surfacing as a mesh-build/XLA error with no line back to
+            # the offending axis. The schema pass (text-file round-trip,
+            # a tempfile write per run) is file-facing and stays with the
+            # CLI/scripts callers — compile validates the in-memory table.
+            from flexflow_tpu.analysis import StrategyLintError, analyze
+            from flexflow_tpu.logger import fflogger
+
+            report = analyze(self, strategies=cfg.strategies,
+                             mesh_shape=cfg.mesh_shape,
+                             passes=("legality", "perf"))
+            if cfg.strategy_lint == "strict" and report.errors():
+                raise StrategyLintError(report)
+            report.log(fflogger)
+
         self._final_tensor = final_tensor or self.ops[-1].outputs[0]
         # fused softmax + cross-entropy, the reference semantics: its CE
         # loss kernels consume the Softmax OUTPUT with an identity backward

@@ -81,14 +81,16 @@ class ParallelConfig:
                       axis_map: Dict[str, Optional[int]]) -> "ParallelConfig":
         dims = [1] * ndims
         contract_deg = 1
+        stage_deg = 1
         for ax, d in axis_map.items():
             if d == CONTRACT:
                 contract_deg *= mesh_shape[ax]
             elif d == STAGE:
                 # stage degree shards a WEIGHT dim, not an output dim — it
                 # lives only in the axis_map (degree lists follow the
-                # reference file schema, which has no PP concept)
-                continue
+                # reference file schema, which has no PP concept), but the
+                # op still OCCUPIES the stage devices
+                stage_deg *= mesh_shape[ax]
             elif d is not None:
                 dims[d] *= mesh_shape[ax]
         if contract_deg > 1:
@@ -99,7 +101,13 @@ class ParallelConfig:
         n = 1
         for v in dims:
             n *= v
-        return ParallelConfig(dims=tuple(dims), device_ids=tuple(range(n)),
+        # device_ids covers every device the op runs on, INCLUDING pipeline
+        # stages (matching csim.native_optimize's ndev and what
+        # placement.op_block requires the block to hold); num_parts() stays
+        # the schema's degree product, so for STAGE strategies
+        # len(device_ids) is a stage-size multiple of num_parts()
+        return ParallelConfig(dims=tuple(dims),
+                              device_ids=tuple(range(n * stage_deg)),
                               axis_map=dict(axis_map))
 
     # ---- queries ----------------------------------------------------------

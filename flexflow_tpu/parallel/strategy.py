@@ -32,13 +32,51 @@ from __future__ import annotations
 
 from typing import Dict
 
-from flexflow_tpu.parallel.pconfig import ParallelConfig
+from flexflow_tpu.parallel.pconfig import STAGE, ParallelConfig
 
+# Device-type serialization. The file int is a POOL, not a vendor: int 0
+# means "the accelerator pool" — the reference writes its GPU enum there
+# (strategy.cc device_type), and this rebuild executes the same record on
+# TPU, so a reference-written GPU strategy deliberately loads as "TPU".
+# Int 1 is the host CPU backend (the reference's hetero DLRM embeddings,
+# dlrm_strategy_hetero.cc). Round-trip consequence: "GPU" is write-only —
+# it normalizes to the accelerator int and reloads as "TPU"; everything
+# about the record other than the vendor label survives exactly
+# (tested in tests/test_strategy_schema.py).
 _DEVICE_TYPE_TO_INT = {"GPU": 0, "CPU": 1, "TPU": 0}
 _INT_TO_DEVICE_TYPE = {0: "TPU", 1: "CPU"}
 
 
-def save_strategies_to_file(filename: str, strategies: Dict[str, ParallelConfig]) -> None:
+def _ids_consistent(pc: ParallelConfig) -> bool:
+    """True when pc.device_ids is representable as-is: one id per shard,
+    or a stage-multiple list for STAGE strategies (the stage size itself
+    is unknowable without the mesh — fflint's device-block-too-small is
+    the mesh-aware check)."""
+    n = pc.num_parts()
+    if len(pc.device_ids) == n:
+        return True
+    has_stage = bool(pc.axis_map) and any(
+        d == STAGE for d in pc.axis_map.values())
+    return bool(has_stage and pc.device_ids
+                and len(pc.device_ids) % max(n, 1) == 0)
+
+
+def save_strategies_to_file(filename: str,
+                            strategies: Dict[str, ParallelConfig],
+                            strict: bool = False) -> None:
+    if strict:
+        # validate the WHOLE table before the first byte is written — a
+        # mid-write raise would strand a truncated file whose op-count
+        # header disagrees with its body
+        for name in sorted(strategies):
+            pc = strategies[name]
+            if pc.device_ids and not _ids_consistent(pc):
+                raise ValueError(
+                    f"strategy {name!r}: {len(pc.device_ids)} device_ids "
+                    f"for {pc.num_parts()} partitions (degrees "
+                    f"{tuple(pc.dims)}) — the schema needs exactly one id "
+                    f"per shard; writing range({pc.num_parts()}) instead "
+                    f"(strict mode)")
     with open(filename, "w") as f:
         f.write(f"{len(strategies)}\n")
         for name in sorted(strategies):
@@ -48,16 +86,36 @@ def save_strategies_to_file(filename: str, strategies: Dict[str, ParallelConfig]
             f.write(f"{pc.nDims}\n")
             f.write("\t".join(str(d) for d in reversed(pc.dims)) + "\n")
             n = pc.num_parts()
-            f.write(f"{n}\n")
-            ids = pc.device_ids if len(pc.device_ids) == n else tuple(range(n))
+            ids = pc.device_ids
+            # a stage-multiple id list is the canonical STAGE form
+            # (_ids_consistent); an inconsistent list cannot be
+            # represented (the schema pairs shard i with device_ids[i]) —
+            # name the op and what happens instead of rewriting silently
+            # (strict mode raised on the whole table before writing)
+            if pc.device_ids and not _ids_consistent(pc):
+                from flexflow_tpu.logger import fflogger
+
+                fflogger.warning(
+                    "strategy %r: %d device_ids for %d partitions "
+                    "(degrees %s) — the schema needs exactly one id per "
+                    "shard; writing range(%d) instead",
+                    name, len(pc.device_ids), n, tuple(pc.dims), n)
+                ids = tuple(range(n))
+            elif not ids:
+                ids = tuple(range(n))
+            f.write(f"{len(ids)}\n")
             f.write("\t".join(str(i) for i in ids) + "\n")
-            if pc.axis_map:
+            if pc.axis_map is not None:
+                # an EMPTY axis_map ("explicitly replicated") still writes
+                # a record — omitting it would reload as None and fall
+                # back to the greedy degree->axis heuristic, breaking the
+                # exact round trip the schema lint checks
                 parts = []
                 for ax, d in pc.axis_map.items():
                     parts.append(str(ax))
                     parts.append(str(-1 if d is None else d))
-                f.write(f"@axismap {len(pc.axis_map)} "
-                        + "\t".join(parts) + "\n")
+                f.write(f"@axismap {len(pc.axis_map)}"
+                        + ("\t" + "\t".join(parts) if parts else "") + "\n")
 
 
 def load_strategies_from_file(filename: str) -> Dict[str, ParallelConfig]:

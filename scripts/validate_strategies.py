@@ -105,6 +105,28 @@ def real_time_s(ff, steps: int, scan: bool = False) -> float:
     return best
 
 
+def lint_strategy(ff, strategies, label: str,
+                  mesh: dict = None) -> bool:
+    """fflint gate (flexflow_tpu/analysis): statically validate a candidate
+    before spending real device time on it — a broken candidate is named
+    here in milliseconds instead of hanging a collective rendezvous.
+    Returns False (candidate must be skipped) on error-severity findings."""
+    from flexflow_tpu.analysis import analyze
+
+    report = analyze(ff, strategies=strategies, mesh_shape=mesh or MESH)
+    if report.errors():
+        print(f"[validate] {label}: fflint REJECTED the candidate:")
+        for v in report.errors():
+            print(f"[validate]   {v}")
+        return False
+    if report.warnings():
+        for v in report.warnings():
+            print(f"[validate] {label}: {v}")
+    print(f"[validate] {label}: fflint clean "
+          f"({len(report.notes())} note(s))")
+    return True
+
+
 def kendall_tau(a, b) -> float:
     n = len(a)
     conc = disc = 0
@@ -144,6 +166,8 @@ def single_chip_calibration(args):
         c = argparse.Namespace(**{**vars(args), "batch": batch, "seq": seq,
                                   "hidden": hidden, "layers": layers})
         ff = build(c, mesh=mesh)
+        if not rows:  # same default-DP table for every shape: lint once
+            lint_strategy(ff, {}, "dp", mesh=mesh)
         print(f"[validate/chip] b{batch} s{seq} h{hidden} L{layers}: "
               f"measuring...", flush=True)
         measured = measure_op_costs(ff, mesh)
@@ -226,10 +250,12 @@ def main():
             continue
         seen[key] = label
         sim_s = prob.simulate(prob.choices_for(strat))
+        pcs = {n: _to_pc(ff, n, am, MESH) for n, am in strat.items()}
+        if not lint_strategy(ff, pcs, label):
+            continue
         print(f"[validate] {label}: simulated {sim_s * 1e3:.3f} ms; "
               f"running {args.steps} real steps x3...", flush=True)
-        ff_c = build(args, strategies={
-            n: _to_pc(ff, n, am, MESH) for n, am in strat.items()})
+        ff_c = build(args, strategies=pcs)
         real_s = real_time_s(ff_c, args.steps)
         rows.append({"strategy": label, "sim_ms": round(sim_s * 1e3, 3),
                      "real_ms": round(real_s * 1e3, 3)})

@@ -17,6 +17,12 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (e.g. 0.4.37) has no jax_num_cpu_devices option; the
+    # XLA_FLAGS --xla_force_host_platform_device_count above already
+    # forces the 8-device virtual CPU mesh there
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,402 @@
+"""fflint (flexflow_tpu/analysis): negative-violation corpus, clean passes,
+CLI behavior, compile() integration, and the no-mesh enforcement.
+
+The corpus tests assert BOTH halves of the acceptance contract: every
+seeded violation is caught with an op-name + pass-name diagnostic, and
+every clean strategy the repo actually ships/searches lints with zero
+errors and zero warnings.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flexflow_tpu.analysis import StrategyLintError, analyze
+from flexflow_tpu.analysis.__main__ import main as fflint_main
+from flexflow_tpu.analysis.models import build_model
+from flexflow_tpu.parallel.pconfig import CONTRACT, STAGE, ParallelConfig
+from flexflow_tpu.parallel.strategy import save_strategies_to_file
+
+MESH = {"data": 4, "model": 2}
+
+
+def _transformer(mesh=None, **args):
+    return build_model("transformer", mesh or MESH, args)
+
+
+def _codes(report):
+    return set(report.codes())
+
+
+def _find(report, code):
+    vs = report.by_code(code)
+    assert vs, f"expected a {code!r} violation; got {report.codes()}"
+    return vs
+
+
+# ---------------------------------------------------------------- negative
+
+def test_bad_axis_name():
+    ff = _transformer()
+    rep = analyze(ff, strategies={
+        "ffn1_0": ParallelConfig(axis_map={"modle": 0})}, mesh_shape=MESH)
+    v = _find(rep, "axis-unknown")[0]
+    assert v.severity == "error" and v.pass_name == "legality"
+    assert v.op_name == "ffn1_0" and "modle" in v.message
+
+
+def test_dim_out_of_range():
+    ff = _transformer()
+    rep = analyze(ff, strategies={
+        "ffn1_0": ParallelConfig(axis_map={"model": 7})}, mesh_shape=MESH)
+    v = _find(rep, "dim-out-of-range")[0]
+    assert v.op_name == "ffn1_0" and v.severity == "error"
+
+
+def test_non_divisible_dim():
+    # batch 30 does not divide by the 4-way data axis -> XLA would pad
+    ff = _transformer(batch=30)
+    rep = analyze(ff, strategies={
+        "ffn1_0": ParallelConfig(axis_map={"data": 0})}, mesh_shape=MESH)
+    vs = _find(rep, "shard-indivisible")
+    v = next(v for v in vs if v.op_name == "ffn1_0")
+    assert v.severity == "warning" and "pad" in v.message
+
+
+def test_contract_on_non_contraction_op():
+    ff = _transformer()
+    rep = analyze(ff, strategies={
+        "ln1_0": ParallelConfig(axis_map={"model": CONTRACT})},
+        mesh_shape=MESH)
+    v = _find(rep, "contract-on-non-contraction")[0]
+    assert v.op_name == "ln1_0" and v.pass_name == "legality"
+
+
+def test_stage_on_non_pipelinable_op():
+    ff = _transformer()
+    rep = analyze(ff, strategies={
+        "ffn1_0": ParallelConfig(axis_map={"model": STAGE})}, mesh_shape=MESH)
+    v = _find(rep, "stage-on-non-pipelinable")[0]
+    assert v.op_name == "ffn1_0" and v.severity == "error"
+
+
+def test_stage_indivisible():
+    # 5 layers cannot split over a 2-way stage axis
+    ff = build_model("pipeline", MESH, {"layers": 5})
+    rep = analyze(ff, strategies={
+        "stack": ParallelConfig(axis_map={"model": STAGE})}, mesh_shape=MESH)
+    assert any(v.op_name == "stack" for v in _find(rep, "stage-indivisible"))
+
+
+def test_degree_mismatch():
+    # degrees recorded for a differently-sized mesh
+    ff = _transformer()
+    rep = analyze(ff, strategies={
+        "ffn1_0": ParallelConfig(dims=(2, 1, 1),
+                                 axis_map={"data": 0})}, mesh_shape=MESH)
+    v = _find(rep, "degree-mismatch")[0]
+    assert v.op_name == "ffn1_0" and "(4, 1, 1)" in v.message
+
+
+def test_device_id_range_and_block_too_small():
+    ff = _transformer()
+    rep = analyze(ff, strategies={
+        "ffn1_0": ParallelConfig(dims=(4, 1, 1), axis_map={"data": 0},
+                                 device_ids=(0, 1, 97, 98))},
+        mesh_shape=MESH)
+    assert _find(rep, "device-id-range")[0].op_name == "ffn1_0"
+    rep = analyze(ff, strategies={
+        "ffn1_0": ParallelConfig(dims=(4, 1, 1), axis_map={"data": 0},
+                                 device_ids=(0, 1))}, mesh_shape=MESH)
+    assert _find(rep, "device-block-too-small")[0].op_name == "ffn1_0"
+
+
+def test_overlapping_device_blocks():
+    mesh = {"data": 12}
+    ff = build_model("mlp", mesh, {"batch": 48})
+    rep = analyze(ff, strategies={
+        "fc_0": ParallelConfig(dims=(1, 1), axis_map={},
+                               device_ids=tuple(range(4, 8))),
+        "fc_1": ParallelConfig(dims=(1, 1), axis_map={},
+                               device_ids=tuple(range(6, 12)))},
+        mesh_shape=mesh)
+    v = _find(rep, "device-block-overlap")[0]
+    assert v.severity == "error" and "fc_0" in v.message
+
+
+def test_device_count_mismatch_is_named():
+    ff = _transformer()
+    rep = analyze(ff, strategies={
+        "ffn1_0": ParallelConfig(dims=(4, 1, 1), axis_map={"data": 0},
+                                 device_ids=(0, 1, 2, 3, 4))},
+        mesh_shape=MESH)
+    v = _find(rep, "device-count-mismatch")[0]
+    assert v.severity == "warning" and "range(4)" in v.message
+
+
+def test_truncated_axismap_record(tmp_path):
+    p = tmp_path / "trunc.ff"
+    p.write_text("1\nfoo\n0\n2\n1\t4\n4\n0\t1\t2\t3\n@axismap 2 data 0 model\n")
+    rep = analyze(None, strategy_file=str(p))
+    v = _find(rep, "schema-axismap-truncated")[0]
+    assert v.op_name == "foo" and v.pass_name == "schema"
+
+
+def test_truncated_file(tmp_path):
+    p = tmp_path / "trunc2.ff"
+    p.write_text("3\nfoo\n0\n2\n1\t4\n4\n0\t1\t2\t3\n")
+    rep = analyze(None, strategy_file=str(p))
+    assert "schema-truncated" in _codes(rep)
+
+
+def test_unknown_op_warns():
+    ff = _transformer()
+    rep = analyze(ff, strategies={
+        "no_such_op": ParallelConfig(axis_map={"data": 0})}, mesh_shape=MESH)
+    assert _find(rep, "unknown-op")[0].severity == "warning"
+
+
+# ---------------------------------------------------------------- perf
+
+def test_reshard_ranked_by_bytes():
+    ff = _transformer()
+    strategies = {
+        "ffn1_0": ParallelConfig.from_axis_map(
+            3, MESH, {"data": 0, "model": 2}),
+        "ffn2_0": ParallelConfig.from_axis_map(3, MESH, {"data": 0}),
+    }
+    rep = analyze(ff, strategies=strategies, mesh_shape=MESH)
+    notes = [v for v in rep.notes() if v.code == "reshard"]
+    assert notes, "expected reshard notes for the TP->DP boundary"
+    byte_counts = [v.est_bytes for v in notes]
+    assert byte_counts == sorted(byte_counts, reverse=True)
+    assert all(v.est_seconds is not None and v.est_seconds > 0
+               for v in notes)
+
+
+def test_replicated_weight_no_fsdp(monkeypatch):
+    import flexflow_tpu.analysis.perf as perf
+
+    monkeypatch.setattr(perf, "WEIGHT_WARN_BYTES", 1024.0)
+    ff = _transformer()
+    rep = analyze(ff, mesh_shape=MESH)  # default DP: weights replicated
+    vs = _find(rep, "replicated-weight-no-fsdp")
+    assert all(v.severity == "warning" for v in vs)
+    assert any("fsdp_axis" in v.message for v in vs)
+
+
+def test_hbm_footprint_and_over_capacity():
+    from flexflow_tpu.search.machine import MachineModel
+
+    ff = _transformer()
+    rep = analyze(ff, mesh_shape=MESH)
+    assert "hbm-footprint" in _codes(rep)  # always an info note
+    tiny = MachineModel(hbm_bytes=1024.0)  # 1 KiB chip: everything overflows
+    rep = analyze(ff, mesh_shape=MESH, machine=tiny)
+    assert _find(rep, "hbm-over-capacity")[0].severity == "warning"
+
+
+def test_pipeline_bubble_and_imbalance():
+    ff = build_model("pipeline", {"data": 2, "pipe": 2},
+                     {"layers": 4, "num_microbatches": 1})
+    rep = analyze(ff, strategies={
+        "stack": ParallelConfig(axis_map={"data": 0, "pipe": STAGE})},
+        mesh_shape={"data": 2, "pipe": 2})
+    v = _find(rep, "pipeline-bubble")[0]
+    assert v.severity == "warning"  # m < n
+    # 3 layers over 2 stages: FLOP imbalance
+    ff = build_model("pipeline", {"data": 2, "pipe": 3}, {"layers": 3})
+    rep = analyze(ff, strategies={
+        "stack": ParallelConfig(axis_map={"data": 0, "pipe": STAGE})},
+        mesh_shape={"data": 2, "pipe": 2})
+    assert "pipeline-flop-imbalance" in _codes(rep)
+
+
+# ---------------------------------------------------------------- clean
+
+def _clean_strategies(ff):
+    """The strategy families scripts/validate_strategies.py exercises:
+    data parallelism plus search winners (from_axis_map over the mesh)."""
+    from flexflow_tpu.search.driver import (data_parallel_strategy,
+                                            optimize_strategies)
+
+    dp = {name: ParallelConfig.from_axis_map(
+        next(o for o in ff.ops if o.name == name).outputs[0].num_dims,
+        MESH, am)
+        for name, am in data_parallel_strategy(ff, MESH).items()}
+    searched = optimize_strategies(ff, budget=40, mesh_shape=MESH, seed=1)
+    return {"dp": dp, "searched": searched}
+
+
+def test_clean_strategies_zero_violations():
+    ff = _transformer(batch=32, seq=16, hidden=32, layers=1)
+    for label, strat in _clean_strategies(ff).items():
+        rep = analyze(ff, strategies=strat, mesh_shape=MESH)
+        assert not rep.errors(), (label, [str(v) for v in rep.errors()])
+        assert not rep.warnings(), (label, [str(v) for v in rep.warnings()])
+
+
+def test_shipped_example_strategies_are_clean():
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "examples", "strategies")
+    manifest = os.path.join(root, "MANIFEST")
+    assert os.path.exists(manifest), "examples/strategies/MANIFEST missing"
+    ran = 0
+    with open(manifest) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            fname, model, mesh, margs = line.split("|")
+            rc = fflint_main([model.strip(),
+                              os.path.join(root, fname.strip()),
+                              "--mesh", mesh.strip(), "--strict", "--quiet"]
+                             + sum((["--model-arg", a] for a in
+                                    margs.strip().split() if a), []))
+            assert rc == 0, f"{fname.strip()} failed fflint --strict"
+            ran += 1
+    assert ran >= 2
+
+
+def test_pass_subset_still_analyzes_the_named_file(tmp_path):
+    """A --passes subset must not silently fall back to the model's own
+    (empty) table: the named file is what gets analyzed."""
+    ff = _transformer()
+    p = tmp_path / "bad.ff"
+    save_strategies_to_file(str(p), {
+        "ffn1_0": ParallelConfig(axis_map={"bogus": 0}, dims=(1, 1, 1),
+                                 device_ids=(0,))})
+    rep = analyze(ff, mesh_shape=MESH, strategy_file=str(p),
+                  passes=("legality",))
+    assert "axis-unknown" in _codes(rep)
+    # structurally unreadable + schema deselected: still errors, never
+    # a false clean bill
+    q = tmp_path / "trunc.ff"
+    q.write_text("2\nfoo\n0\n")
+    rep = analyze(ff, mesh_shape=MESH, strategy_file=str(q),
+                  passes=("legality",))
+    assert rep.errors()
+
+
+def test_resolution_errors_survive_pass_deselection():
+    """A perf-only run must not report clean on a strategy whose axis_map
+    could not even resolve (the bad entries are stripped before perf)."""
+    ff = _transformer()
+    rep = analyze(ff, strategies={
+        "ffn1_0": ParallelConfig(axis_map={"modle": 0})}, mesh_shape=MESH,
+        passes=("perf",))
+    assert "axis-unknown" in _codes(rep)
+    assert rep.errors()
+
+
+def test_stage_multiple_ids_not_flagged_as_mismatch():
+    """csim/from_axis_map's canonical stage-inclusive device list is not a
+    device-count-mismatch (save accepts it; legality must agree)."""
+    mesh = {"data": 2, "pipe": 2}
+    ff = build_model("pipeline", mesh, {"layers": 4})
+    pc = ParallelConfig.from_axis_map(3, mesh, {"data": 0, "pipe": STAGE})
+    rep = analyze(ff, strategies={"stack": pc}, mesh_shape=mesh)
+    assert "device-count-mismatch" not in _codes(rep)
+    assert not rep.errors()
+
+
+# ---------------------------------------------------------------- CLI
+
+def test_cli_exit_codes(tmp_path):
+    good = tmp_path / "good.ff"
+    ff = _transformer(batch=32, seq=16, hidden=32, layers=1)
+    strategies = {
+        op.name: ParallelConfig.from_axis_map(
+            op.outputs[0].num_dims, MESH, {"data": 0})
+        for op in ff.ops if op.name.startswith(("ffn", "head"))}
+    save_strategies_to_file(str(good), strategies)
+    rc = fflint_main(["transformer", str(good), "--mesh", "data=4,model=2",
+                      "--strict", "--quiet", "--model-arg", "batch=32",
+                      "--model-arg", "seq=16", "--model-arg", "hidden=32",
+                      "--model-arg", "layers=1"])
+    assert rc == 0
+    bad = tmp_path / "bad.ff"
+    bad.write_text("1\nfoo\n0\n2\n1\t4\n4\n0\t1\t2\t3\n@axismap 1 data\n")
+    rc = fflint_main(["none", str(bad)])
+    assert rc == 1
+    rc = fflint_main(["no-such-model", str(good)])
+    assert rc == 2
+
+
+def test_cli_json_output(tmp_path, capsys):
+    import json
+
+    p = tmp_path / "bad.ff"
+    p.write_text("1\nfoo\n9\n2\n1\t4\n4\n0\t1\t2\t3\n")
+    rc = fflint_main(["none", str(p), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0  # device-type 9 is a warning, not an error
+    assert any(v["code"] == "schema-device-type"
+               for v in out["violations"])
+
+
+# ------------------------------------------------------- static-ness proof
+
+def test_analysis_never_builds_a_mesh(monkeypatch, tmp_path):
+    """The acceptance contract: every pass is pure static analysis. Stub
+    mesh construction to raise — the full analyzer (legality + perf +
+    schema, library AND CLI) must still run."""
+    import jax.sharding
+
+    import flexflow_tpu.parallel.mesh as mesh_mod
+
+    def _boom(*a, **k):
+        raise AssertionError("fflint must not build a jax.sharding.Mesh")
+
+    monkeypatch.setattr(jax.sharding.Mesh, "__init__", _boom)
+    monkeypatch.setattr(mesh_mod, "make_mesh", _boom)
+
+    ff = _transformer()
+    p = tmp_path / "s.ff"
+    save_strategies_to_file(str(p), {
+        "ffn1_0": ParallelConfig.from_axis_map(
+            3, MESH, {"data": 0, "model": CONTRACT}),
+        "stackless": ParallelConfig(axis_map={"bogus": 1})})
+    rep = analyze(ff, mesh_shape=MESH, strategy_file=str(p))
+    assert rep.violations  # it actually analyzed (unknown-op etc.)
+    assert "internal-error" not in _codes(rep)
+    rc = fflint_main(["transformer", str(p), "--mesh", "data=4,model=2"])
+    assert rc in (0, 1)  # ran to completion without touching Mesh
+
+
+# ------------------------------------------------------- compile() modes
+
+def test_compile_strict_rejects_bad_strategy():
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models.transformer import build_encoder_classifier
+
+    cfg = FFConfig(batch_size=8, mesh_shape={"data": 2},
+                   strategy_lint="strict")
+    cfg.strategies["ffn1_0"] = ParallelConfig(axis_map={"bogus_axis": 0})
+    ff = FFModel(cfg)
+    _, out = build_encoder_classifier(ff, 8, 16, 32, 1, 4)
+    with pytest.raises(StrategyLintError) as ei:
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                   final_tensor=out)
+    assert "axis-unknown" in str(ei.value) and "ffn1_0" in str(ei.value)
+
+
+def test_compile_warn_mode_proceeds():
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models.transformer import build_encoder_classifier
+
+    cfg = FFConfig(batch_size=8, mesh_shape={"data": 2},
+                   strategy_lint="warn")
+    # warning-severity finding: device list inconsistent with num_parts
+    cfg.strategies["ffn1_0"] = ParallelConfig(
+        dims=(2, 1, 1), axis_map={"data": 0}, device_ids=(0, 1, 2))
+    ff = FFModel(cfg)
+    _, out = build_encoder_classifier(ff, 8, 16, 32, 1, 4)
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               final_tensor=out)
+    assert ff.executor is not None

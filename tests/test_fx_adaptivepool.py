@@ -1,0 +1,106 @@
+"""Characterization of the adaptive-pool emission in torch/fx.py.
+
+The reference's fx exporter hard-coded `3, 1, 0` (kernel 3, stride 1,
+pad 0) for AdaptiveAvgPool2d/AdaptiveMaxPool2d — a latent FIXME that
+breaks any feature map smaller than 3x3 and silently computes the wrong
+pool on anything that isn't 3x3. This rebuild emits the kernel-0 GLOBAL
+sentinel instead (`0, 1, 0`), which torch/model.py's replayer resolves
+to the input's spatial size at graph build, where shapes are known.
+These tests PIN that contract from both sides: the emitted IR line and
+the replayed graph (including the small-feature-map case the reference
+emission broke).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _export_lines(net):
+    from flexflow_tpu.torch.fx import torch_to_strings
+
+    return torch_to_strings(net)
+
+
+def test_adaptive_pool_emits_global_kernel_sentinel():
+    torch = pytest.importorskip("torch")
+    nn = torch.nn
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.pool = nn.AdaptiveAvgPool2d((1, 1))
+
+        def forward(self, x):
+            return self.pool(x)
+
+    lines = _export_lines(Net())
+    pool_line = next(ln for ln in lines if "POOL2D" in ln)
+    # the contract: kernel 0 (global marker), stride 1, pad 0 — NOT the
+    # reference's hard-coded 3, 1, 0
+    fields = [f.strip() for f in pool_line.split(",")]
+    assert fields[3] == "POOL2D"
+    assert fields[4:7] == ["0", "1", "0"], pool_line
+
+
+def test_adaptive_pool_rejects_non_global_output():
+    torch = pytest.importorskip("torch")
+    nn = torch.nn
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.pool = nn.AdaptiveAvgPool2d((2, 2))
+
+        def forward(self, x):
+            return self.pool(x)
+
+    # only global (1x1) adaptive pooling is expressible in the .ff IR;
+    # anything else must fail loudly at export, not misexecute
+    with pytest.raises(AssertionError, match="output_size"):
+        _export_lines(Net())
+
+
+def test_replayer_resolves_global_pool_on_small_feature_map(tmp_path):
+    """2x2 feature map — the case the reference's kernel-3 emission could
+    not execute. The kernel-0 sentinel must replay as a full 2x2 window
+    (true global average)."""
+    torch = pytest.importorskip("torch")
+    nn = torch.nn
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.ffconst import DataType
+    from flexflow_tpu.torch.fx import torch_to_flexflow
+    from flexflow_tpu.torch.model import PyTorchModel
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.pool = nn.AdaptiveAvgPool2d(1)
+
+        def forward(self, x):
+            return self.pool(x)
+
+    ff_file = str(tmp_path / "pool.ff")
+    torch_to_flexflow(Net(), ff_file)
+
+    ff = FFModel(FFConfig(batch_size=2, mesh_shape={"data": 1}))
+    x = ff.create_tensor([2, 3, 2, 2], DataType.DT_FLOAT, name="x")
+    outs = PyTorchModel(ff_file).apply(ff, [x])
+    pool_op = outs[0].owner_op
+    # kernel resolved to the INPUT spatial size (2x2), not a fixed 3:
+    # with the reference's 3/1/0 this shape would be unbuildable
+    assert tuple(outs[0].dims) == (2, 3, 1, 1)
+
+    # numerics: global average over the 2x2 window
+    xs = np.arange(2 * 3 * 2 * 2, dtype=np.float32).reshape(2, 3, 2, 2)
+    import jax.numpy as jnp
+
+    y = pool_op.forward({}, [jnp.asarray(xs)])[0]
+    np.testing.assert_allclose(np.asarray(y),
+                               xs.mean(axis=(2, 3), keepdims=True),
+                               rtol=1e-6)

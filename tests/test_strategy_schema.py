@@ -1,0 +1,166 @@
+"""Strategy text-schema satellites: the device-type int mapping, the save
+path's device-id diagnostic (no more silent rewrite), and exact @axismap
+round-trips including the explicitly-replicated and STAGE forms.
+"""
+
+import logging
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flexflow_tpu.parallel.pconfig import CONTRACT, STAGE, ParallelConfig
+from flexflow_tpu.parallel.strategy import (load_strategies_from_file,
+                                            save_strategies_to_file)
+
+MESH = {"data": 4, "model": 2}
+
+
+def _roundtrip(tmp_path, strategies):
+    p = str(tmp_path / "s.ff")
+    save_strategies_to_file(p, strategies)
+    return load_strategies_from_file(p)
+
+
+# ------------------------------------------------------------ device types
+
+def test_device_type_roundtrip_cpu_tpu_and_reference_gpu(tmp_path):
+    """Int 0 in the file means 'the accelerator pool': our TPU strategies
+    and reference-written GPU strategies both serialize there, and BOTH
+    load as TPU (the pool this rebuild executes on). CPU (int 1, the
+    reference's hetero-DLRM host embeddings) survives exactly."""
+    strategies = {
+        "tpu_op": ParallelConfig.from_axis_map(2, MESH, {"data": 0}),
+        "cpu_op": ParallelConfig.host(2),
+        "gpu_op": ParallelConfig(device_type="GPU", dims=(4, 1),
+                                 device_ids=tuple(range(4)),
+                                 axis_map={"data": 0}),
+    }
+    loaded = _roundtrip(tmp_path, strategies)
+    assert loaded["tpu_op"].device_type == "TPU"
+    assert loaded["cpu_op"].device_type == "CPU"
+    # reference-written GPU deliberately loads as the accelerator pool
+    assert loaded["gpu_op"].device_type == "TPU"
+    # ... with everything else about the record intact
+    assert loaded["gpu_op"].dims == (4, 1)
+    assert loaded["gpu_op"].axis_map == {"data": 0}
+
+
+def test_reference_written_file_loads_unchanged(tmp_path):
+    """A file with no @axismap records (what the reference writes, int 0
+    device types) parses to degree-only configs."""
+    p = tmp_path / "ref.ff"
+    p.write_text("1\ndense1\n0\n2\n2\t4\n8\n0\t1\t2\t3\t4\t5\t6\t7\n")
+    loaded = load_strategies_from_file(str(p))
+    pc = loaded["dense1"]
+    assert pc.device_type == "TPU" and pc.axis_map is None
+    assert pc.dims == (4, 2)  # file order is reversed (sample last)
+
+
+# ------------------------------------------------------------ save path
+
+def test_save_inconsistent_ids_warns_and_rewrites(tmp_path, caplog):
+    from flexflow_tpu.logger import fflogger
+
+    pc = ParallelConfig(dims=(4, 1), device_ids=(0, 1, 2),
+                        axis_map={"data": 0})
+    # fflogger doesn't propagate to root; capture via caplog's handler
+    fflogger.addHandler(caplog.handler)
+    try:
+        with caplog.at_level(logging.WARNING, logger="flexflow_tpu"):
+            loaded = _roundtrip(tmp_path, {"op": pc})
+    finally:
+        fflogger.removeHandler(caplog.handler)
+    assert any("3 device_ids for 4 partitions" in r.message
+               for r in caplog.records), caplog.records
+    assert loaded["op"].device_ids == (0, 1, 2, 3)  # documented rewrite
+
+
+def test_save_inconsistent_ids_strict_raises(tmp_path):
+    pc = ParallelConfig(dims=(4, 1), device_ids=(0, 1, 2),
+                        axis_map={"data": 0})
+    with pytest.raises(ValueError, match="device_ids"):
+        save_strategies_to_file(str(tmp_path / "s.ff"), {"op": pc},
+                                strict=True)
+
+
+def test_save_strict_never_leaves_a_truncated_file(tmp_path):
+    """strict validates the whole table BEFORE writing: a raise must not
+    strand a half-written file whose header disagrees with its body."""
+    p = tmp_path / "s.ff"
+    strategies = {
+        "aa_fine": ParallelConfig.from_axis_map(2, MESH, {"data": 0}),
+        "mm_bad": ParallelConfig(dims=(4, 1), device_ids=(0, 1, 2),
+                                 axis_map={"data": 0}),
+    }
+    with pytest.raises(ValueError, match="mm_bad"):
+        save_strategies_to_file(str(p), strategies, strict=True)
+    assert not p.exists(), "strict save wrote a truncated file"
+
+
+def test_save_consistent_ids_no_warning(tmp_path, caplog):
+    from flexflow_tpu.logger import fflogger
+
+    pc = ParallelConfig.from_axis_map(2, MESH, {"data": 0, "model": 1})
+    fflogger.addHandler(caplog.handler)
+    try:
+        with caplog.at_level(logging.WARNING, logger="flexflow_tpu"):
+            loaded = _roundtrip(tmp_path, {"op": pc})
+    finally:
+        fflogger.removeHandler(caplog.handler)
+    assert not caplog.records
+    assert loaded["op"].device_ids == tuple(range(8))
+
+
+# ------------------------------------------------------------ round trips
+
+def test_axismap_sentinels_roundtrip_exactly(tmp_path):
+    strategies = {
+        "col": ParallelConfig.from_axis_map(2, MESH,
+                                            {"data": 0, "model": 1}),
+        "row": ParallelConfig.from_axis_map(2, MESH,
+                                            {"data": 0, "model": CONTRACT}),
+        "rep": ParallelConfig.replicated(3),  # explicit empty axis_map
+        "unused": ParallelConfig(axis_map={"data": 0, "model": None},
+                                 dims=(4, 1), device_ids=tuple(range(4))),
+    }
+    loaded = _roundtrip(tmp_path, strategies)
+    for name, pc in strategies.items():
+        assert loaded[name].axis_map == pc.axis_map, name
+        assert loaded[name].dims == pc.dims, name
+    # the explicitly-replicated {} must NOT degrade to None (None means
+    # "derive from degrees via the greedy heuristic")
+    assert loaded["rep"].axis_map == {}
+
+
+def test_stage_strategy_roundtrips_with_stage_devices(tmp_path):
+    """A PP strategy occupies stage_size x num_parts devices; the id list
+    (canonical from_axis_map/csim form) must survive save/load even though
+    the schema's degree product excludes the stage axis."""
+    mesh = {"data": 2, "pipe": 2}
+    pc = ParallelConfig.from_axis_map(3, mesh, {"data": 0, "pipe": STAGE})
+    assert pc.num_parts() == 2 and len(pc.device_ids) == 4
+    loaded = _roundtrip(tmp_path, {"stack": pc})
+    assert loaded["stack"].axis_map == {"data": 0, "pipe": STAGE}
+    assert loaded["stack"].device_ids == (0, 1, 2, 3)
+    assert loaded["stack"].dims == pc.dims
+
+
+def test_schema_pass_agrees_with_loader(tmp_path):
+    """fflint's strict parser accepts everything the tolerant loader
+    accepts on well-formed files (no false positives)."""
+    from flexflow_tpu.analysis.schema import check_file
+
+    strategies = {
+        "a": ParallelConfig.from_axis_map(2, MESH, {"data": 0}),
+        "b": ParallelConfig.host(2),
+        "c": ParallelConfig.from_axis_map(2, MESH,
+                                          {"data": 0, "model": CONTRACT}),
+    }
+    p = str(tmp_path / "s.ff")
+    save_strategies_to_file(p, strategies)
+    parsed, violations = check_file(p)
+    assert parsed is not None and set(parsed) == set(strategies)
+    assert [str(v) for v in violations] == []
