@@ -9,7 +9,11 @@
 #   accuracy  — accuracy-gated training runs (nightly tier)
 #   native    — C shim + C++ apps build & run
 #
-# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|all]
+#   resilience — fault-injection tests (FF_FAULT: kill-and-resume, NaN
+#               skip/rewind, IO retry) + a 2-process multihost resume
+#               smoke when the jax build has gloo CPU collectives
+#
+# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|all]
 set -e
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -65,6 +69,27 @@ run_lint()     {
   fi
 }
 
+# resilience tier: the fault-injection suite (every FF_FAULT path:
+# kill-and-resume bitwise, NaN skip-step + rewind, injected orbax IO
+# failure + retry, SIGTERM checkpoint-then-stop, watchdog), then the
+# 2-process multihost training test as a resume smoke — it round-trips a
+# sharded orbax checkpoint across controllers ("ckpt=ok") through the
+# same atomic save/restore path the supervisor drives. The multihost leg
+# needs gloo CPU collectives; probe and skip (loudly) where this jax
+# build lacks them.
+run_resilience() {
+  python -m pytest tests/test_resilience.py -q
+  if JAX_PLATFORMS="" python -c "
+import jax
+jax.config.update('jax_cpu_collectives_implementation', 'gloo')" \
+      >/dev/null 2>&1; then
+    python -m pytest tests/test_multihost.py -q -k two_process_training
+  else
+    echo "resilience: no gloo CPU collectives in this jax build —" \
+         "skipping the 2-process resume smoke"
+  fi
+}
+
 case "$TIER" in
   unit)     run_unit ;;
   sweep)    run_sweep ;;
@@ -72,7 +97,8 @@ case "$TIER" in
   native)   run_native ;;
   docs)     run_docs ;;
   lint)     run_lint ;;
-  all)      run_lint; run_unit; run_native; run_docs; run_sweep ;;
+  resilience) run_resilience ;;
+  all)      run_lint; run_unit; run_resilience; run_native; run_docs; run_sweep ;;
   *) echo "unknown tier $TIER"; exit 2 ;;
 esac
 echo "ci($TIER): PASSED"

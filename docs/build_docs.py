@@ -27,6 +27,8 @@ PAGES = [("index", os.path.join(ROOT, "README.md"), "Overview"),
           "Architecture"),
          ("migration", os.path.join(DOCS, "migration.md"),
           "Migration from FlexFlow"),
+         ("resilience", os.path.join(DOCS, "resilience.md"),
+          "Fault tolerance"),
          ("analysis", os.path.join(DOCS, "analysis.md"),
           "fflint static analysis"),
          ("install", os.path.join(ROOT, "INSTALL.md"), "Install")]

@@ -50,6 +50,7 @@ from flexflow_tpu.runtime.initializer import (  # noqa: F401
     ConstantInitializer,
 )
 from flexflow_tpu.runtime.dataloader import SingleDataLoader  # noqa: F401
+from flexflow_tpu.runtime.resilience import TrainSupervisor  # noqa: F401
 from flexflow_tpu.parallel.pconfig import ParallelConfig  # noqa: F401
 
 __version__ = "0.1.0"

@@ -58,6 +58,24 @@ def force_cpu_devices(n: int) -> bool:
         return False
 
 
+def lax_axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` with a fallback for jax builds that predate
+    it (e.g. 0.4.37): inside shard_map/pmap the static mapped-axis size is
+    available from ``jax.core.axis_frame`` (which, depending on version,
+    returns the size directly or a frame carrying ``.size``). Every
+    shard_map kernel in the tree (ring attention, pipeline loops) resolves
+    axis sizes through here."""
+    from jax import lax
+
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    import jax.core as jax_core
+
+    frame = jax_core.axis_frame(axis_name)
+    return getattr(frame, "size", frame)
+
+
 def force_cpu_devices_from_env(value: str) -> bool:
     """Env-var flavored wrapper: accepts '8', '1', or truthy junk ('true',
     'yes' -> platform forced, device count left at default)."""

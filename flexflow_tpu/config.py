@@ -116,6 +116,31 @@ class FFConfig:
     device_data_budget_bytes: int = 2 << 30
     seed: int = 0
 
+    # ---- fault tolerance (runtime/resilience.py) ----
+    # checkpoint directory for the TrainSupervisor / fit() auto-resume.
+    # "" = no supervision (fit behaves exactly as before)
+    checkpoint_dir: str = ""
+    # periodic checkpoint cadence in steps (0 = only preemption/final
+    # saves); atomic tmp-dir + rename writes, see runtime/checkpoint.py
+    checkpoint_every: int = 0
+    keep_checkpoints: int = 3  # retention: newest K step dirs survive
+    # divergence guard compiled INTO the train step (one jnp.isfinite
+    # reduction over loss + global grad-norm; skip/keep selected in-graph):
+    #   "none"    — guard off, the step program is byte-identical to before
+    #   "skip"    — non-finite steps leave params/opt state untouched
+    #   "backoff" — skip + halve the loss scale on non-finite, regrow
+    #               after loss_scale_growth_interval clean steps
+    on_nonfinite: str = "none"
+    # rewind-to-last-checkpoint after this many CONSECUTIVE non-finite
+    # steps (0 = never rewind; requires a checkpoint_dir supervisor)
+    nonfinite_rewind_after: int = 0
+    # wall-clock watchdog per train step: dump all thread stacks and abort
+    # when a step's host fetch blocks longer than this (0 = off). Hung
+    # cross-host collectives otherwise block forever with no diagnostics.
+    step_timeout_s: float = 0.0
+    loss_scale: float = 1.0  # initial loss scale ("backoff" mode)
+    loss_scale_growth_interval: int = 200
+
     # populated at FFModel construction
     strategies: Dict[str, "ParallelConfig"] = dataclasses.field(default_factory=dict)
 
@@ -131,6 +156,22 @@ class FFConfig:
             raise ValueError(
                 f"strategy_lint={self.strategy_lint!r}: must be 'off', "
                 f"'warn' or 'strict'")
+        if self.on_nonfinite not in ("none", "skip", "backoff"):
+            raise ValueError(
+                f"on_nonfinite={self.on_nonfinite!r}: must be 'none', "
+                f"'skip' or 'backoff'")
+        if self.nonfinite_rewind_after < 0 or self.checkpoint_every < 0:
+            raise ValueError(
+                "nonfinite_rewind_after and checkpoint_every must be >= 0")
+        if self.loss_scale <= 0:
+            # 0 would make the guard divide by zero and classify EVERY
+            # step non-finite — the run would "complete" training nothing
+            raise ValueError(
+                f"loss_scale={self.loss_scale}: must be > 0")
+        if self.loss_scale_growth_interval < 1:
+            raise ValueError(
+                f"loss_scale_growth_interval="
+                f"{self.loss_scale_growth_interval}: must be >= 1")
         for field in ("compute_dtype", "master_dtype"):
             v = getattr(self, field)
             if v not in ("float32", "bfloat16"):
@@ -185,6 +226,10 @@ class FFConfig:
                        default="", metavar="AXIS",
                        help="shard params+optimizer state over AXIS "
                             "(default 'data') — ZeRO-3 analog")
+        p.add_argument("--checkpoint-dir", type=str, default="",
+                       help="enable the train supervisor: atomic periodic "
+                            "checkpoints + auto-resume + SIGTERM handling")
+        p.add_argument("--checkpoint-every", type=int, default=0)
         # e.g. --mesh data=4,model=2 (replaces -ll:gpu device-count knobs)
         p.add_argument("--mesh", type=str, default="")
         args, _ = p.parse_known_args(argv)
@@ -217,4 +262,6 @@ class FFConfig:
             num_devices=args.num_devices,
             mesh_shape=mesh_shape,
             fsdp_axis=args.fsdp_axis,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
         )

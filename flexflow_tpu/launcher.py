@@ -22,6 +22,20 @@ import runpy
 import sys
 
 
+def _retried_initialize(jax):
+    """jax.distributed.initialize under retry/backoff: on a preempted pool
+    the coordinator host often comes back seconds after the workers, and
+    the raw call fails once and kills the whole relaunch. Attempts/delay
+    tunable for restart loops via FF_INIT_ATTEMPTS / FF_INIT_DELAY_S."""
+    from flexflow_tpu.runtime.resilience import retry
+
+    return retry(attempts=int(os.environ.get("FF_INIT_ATTEMPTS", "3")),
+                 base_delay=float(os.environ.get("FF_INIT_DELAY_S", "2")),
+                 max_delay=30.0, retryable=(RuntimeError, OSError),
+                 name="jax.distributed.initialize")(
+        jax.distributed.initialize)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="flexflow_tpu.launcher")
     p.add_argument("script")
@@ -42,8 +56,13 @@ def main(argv=None):
             + f" --xla_force_host_platform_device_count={args.cpu_devices}")
         import jax
 
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        from flexflow_tpu._env import force_cpu_devices
+
+        # _env handles the jax-version skew: jax_num_cpu_devices where the
+        # build has it, the XLA_FLAGS device-count fallback otherwise
+        # (0.4.37) — an unguarded config.update here killed every worker
+        # at startup on the older builds
+        force_cpu_devices(args.cpu_devices)
         if args.num_processes and args.num_processes > 1:
             # CPU cross-process collectives need an explicit backend
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
@@ -61,7 +80,7 @@ def main(argv=None):
                     "environments)")
         import jax
 
-        jax.distributed.initialize(
+        _retried_initialize(jax)(
             coordinator_address=args.coordinator,
             num_processes=args.num_processes,
             process_id=args.process_id)
@@ -70,7 +89,7 @@ def main(argv=None):
         # auto-detection (docstring's 'TPU pod env detected' path)
         import jax
 
-        jax.distributed.initialize()
+        _retried_initialize(jax)()
 
     sys.argv = [args.script] + rest
     runpy.run_path(args.script, run_name="__main__")

@@ -14,6 +14,7 @@ program; strategies become sharding constraints inside it.
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -66,6 +67,11 @@ class FFModel:
         self._step_count = 0
         self._train_step = None
         self._train_scan = None
+        # divergence-guarded step + its device-resident guard carry
+        # (runtime/resilience.py; built in compile() when
+        # config.on_nonfinite != "none")
+        self._guarded_step = None
+        self._guard_state = None
         self._eval_step = None
         self._predict_fn = None
         self._generators = {}
@@ -559,6 +565,29 @@ class FFModel:
             self._train_step = self.executor.make_train_step(
                 self.optimizer, self.loss_type, self.metric_types,
                 self._loss_tensor)
+            if cfg.on_nonfinite != "none":
+                from flexflow_tpu.logger import fflogger
+
+                if getattr(self.executor, "jits_per_group", False) \
+                        or cfg.grad_accum_steps > 1:
+                    fflogger.warning(
+                        "on_nonfinite=%r: divergence guard unsupported "
+                        "under operator placement / grad accumulation — "
+                        "training runs unguarded", cfg.on_nonfinite)
+                else:
+                    from flexflow_tpu.runtime.resilience import \
+                        init_guard_state
+
+                    self._guarded_step = \
+                        self.executor.make_guarded_train_step(
+                            self.optimizer, self.loss_type,
+                            self.metric_types, self._loss_tensor,
+                            guard_cfg={
+                                "on_nonfinite": cfg.on_nonfinite,
+                                "growth_interval":
+                                    cfg.loss_scale_growth_interval,
+                            })
+                    self._guard_state = init_guard_state(cfg.loss_scale)
         self._eval_step = self.executor.make_eval_step(
             self.loss_type, self.metric_types, self._loss_tensor)
 
@@ -644,12 +673,27 @@ class FFModel:
         batch = self._current_batch or self._stage_batch()
         self._run_train_step(batch)
 
-    def _run_train_step(self, batch: Dict[str, np.ndarray]):
+    def _run_train_step(self, batch: Dict[str, np.ndarray],
+                        inject_nan: bool = False):
         sharded = self.executor.shard_batch(batch)
         self._rng, step_key = jax.random.split(self._rng)
-        (self.params, self.opt_state, self.bn_state, loss, mets) = \
-            self._train_step(self.params, self.opt_state, self.bn_state,
-                             sharded, step_key)
+        if self._guarded_step is not None:
+            # guarded path (config.on_nonfinite != "none"): same RNG
+            # split, bitwise-identical trajectory while finite; non-finite
+            # steps leave params/opt state untouched in-graph. inject_nan
+            # is the FF_FAULT nan_loss hook (a traced arg — no recompile).
+            (self.params, self.opt_state, self.bn_state, loss, mets,
+             self._guard_state) = self._guarded_step(
+                self.params, self.opt_state, self.bn_state, sharded,
+                step_key, self._guard_state, jnp.asarray(bool(inject_nan)))
+        else:
+            if inject_nan:
+                raise RuntimeError(
+                    "nan_loss injection needs the in-graph divergence "
+                    "guard: set FFConfig.on_nonfinite before compile()")
+            (self.params, self.opt_state, self.bn_state, loss, mets) = \
+                self._train_step(self.params, self.opt_state, self.bn_state,
+                                 sharded, step_key)
         self._step_count += 1
         self._last_loss = loss
         self._last_metrics = mets
@@ -660,6 +704,10 @@ class FFModel:
         (PlacementExecutor jits per sub-mesh group) and every dataset
         device-resident in the pre-batched (num_batches, batch, ...) layout."""
         return (self._train_step is not None
+                # the scanned program has no divergence guard; with a
+                # guard compiled in, fit must stay per-step or NaN steps
+                # would commit silently
+                and self._guarded_step is None
                 and self._dataloaders
                 # unequal loader lengths wrap per-loader on the per-step
                 # path; the scanned program has one batch index, so the
@@ -742,6 +790,50 @@ class FFModel:
         # epoch is observationally identical
         use_scan = (self.config.scan_steps > 0 and native_dl is None
                     and staged and self._scan_eligible())
+        # fault tolerance (runtime/resilience.py): when checkpoint_dir is
+        # set, auto-resume from the newest checkpoint (step counter, RNG,
+        # dataloader cursors), checkpoint every checkpoint_every steps,
+        # and turn SIGTERM (the preemption notice) into checkpoint-at-the-
+        # next-step-boundary + graceful stop
+        sup = None
+        start_epoch = it0 = 0
+        if self.config.checkpoint_dir:
+            from flexflow_tpu.runtime.resilience import TrainSupervisor
+
+            sup = TrainSupervisor(self)
+            if sup.rewind_after and native_dl is not None:
+                # the native threaded loader's shuffled cursor cannot seek
+                # backwards, so a rewind would replay steps against the
+                # WRONG batches — skip-step still protects; rewind needs
+                # the deterministic loaders
+                from flexflow_tpu.logger import fflogger
+
+                fflogger.warning(
+                    "nonfinite_rewind_after: rewind disabled under the "
+                    "native dataloader (its cursor cannot rewind); "
+                    "non-finite steps are still skipped in-graph")
+                sup.rewind_after = 0
+            # keep fit's dispatch async: only poll the guard's per-step
+            # flag when prompt rewind is requested; otherwise the device-
+            # side skip counter reconciles at finalize()
+            sup.poll_nonfinite = bool(sup.rewind_after)
+            sup.install()
+            resumed = sup.resume()
+            if resumed:
+                start_epoch = min(resumed // num_batches, epochs)
+                it0 = resumed % num_batches
+            if use_scan and sup._fault_plan().has_step_events(
+                    "nan_loss", "hang"):
+                # per-step injection can't reach inside a scanned chunk —
+                # silently ignoring a scheduled fault would make an
+                # operator drill pass vacuously; run per-step instead
+                from flexflow_tpu.logger import fflogger
+
+                fflogger.warning(
+                    "FF_FAULT schedules nan_loss/hang step events: "
+                    "running per-step (scanned chunks bypass injection)")
+                use_scan = False
+        stopped = False
         warm = None
         for cb in callbacks:
             cb.set_model(self)
@@ -749,20 +841,37 @@ class FFModel:
         t0 = time.time()
         total = 0
         try:
-            for epoch in range(epochs):
+            for epoch in range(start_epoch, epochs):
+                # resuming mid-epoch: loader cursors were just restored —
+                # the usual epoch-start reset would rewind them
+                resuming = (sup is not None and epoch == start_epoch
+                            and it0 > 0)
                 for cb in callbacks:
                     cb.on_epoch_begin(epoch)
                 self._perf = PerfMetrics()
                 if native_dl is not None:
-                    if epoch > 0:
-                        native_dl.reset()  # reshuffle + restart prefetch
-                else:
+                    # reshuffle + restart prefetch each epoch; a resumed
+                    # process's fresh loader sits on its construction-time
+                    # permutation, so resuming into epoch >= 1 must also
+                    # reset (one reshuffle — the uninterrupted run's exact
+                    # permutations are unrecoverable for a shuffled
+                    # loader, which is why bitwise resume is scoped to the
+                    # deterministic loaders)
+                    if epoch > start_epoch or (epoch == start_epoch
+                                               and start_epoch > 0):
+                        native_dl.reset()
+                    if resuming:
+                        # the native loader's shuffled cursor cannot seek:
+                        # discard the already-trained batches
+                        for _ in range(it0):
+                            native_dl.next_batch()
+                elif not resuming:
                     for dl in self._dataloaders:
                         dl.reset()
                 epoch_mets = []  # device scalars; converted once per epoch so
                 # the host never blocks mid-epoch (keeps XLA dispatch async)
                 if use_scan:
-                    it = 0
+                    it = it0
                     while it < num_batches:
                         if num_batches - it >= self.config.scan_steps:
                             chunk = self.config.scan_steps
@@ -783,29 +892,70 @@ class FFModel:
                             jax.block_until_ready(self.params)
                             warm = time.time()  # exclude first-chunk compile
                             total = 0
+                        if sup is not None and sup.after_step():
+                            stopped = True
+                            break
                 else:
-                    for it in range(num_batches):
+                    it = it0
+                    while it < num_batches:
                         batch = (native_dl.next_batch()
                                  if native_dl is not None
                                  else self._stage_batch())
-                        loss, mets = self._run_train_step(batch)
+                        loss, mets = self._run_train_step(
+                            batch, inject_nan=(sup is not None
+                                               and sup.nan_due()))
                         epoch_mets.append((mets, bs, 1))
                         total += bs
                         if warm is None:
                             jax.block_until_ready(self.params)
                             warm = time.time()  # exclude first-step compile
                             total = 0
-                for mets, bs, n in epoch_mets:
-                    # per-step entries hold scalars (n=1); scanned chunks
-                    # hold stacked (n,) arrays — np.asarray unifies both
-                    arrs = {k: np.asarray(v) for k, v in mets.items()}
-                    for j in range(n):
-                        self._perf.update(
-                            {k: float(a[j] if a.ndim else a)
-                             for k, a in arrs.items()}, bs)
+                        if sup is not None:
+                            step_before = self._step_count
+                            if sup.after_step():
+                                stopped = True
+                                break
+                            if self._step_count < step_before:
+                                # divergence rewind: the supervisor rolled
+                                # params/cursors/step back k steps — drop
+                                # the discarded steps from this epoch's
+                                # accounting and re-run them (a rewind
+                                # past the epoch start clamps to it; those
+                                # earlier steps re-run inside this epoch)
+                                k = step_before - self._step_count
+                                drop = min(k, len(epoch_mets))
+                                if drop:
+                                    del epoch_mets[-drop:]
+                                total = max(total - bs * k, 0)
+                                # restore the loop invariant
+                                # _step_count == epoch_base + it: the
+                                # step for index `it` already ran, so the
+                                # next index is it + 1 - k
+                                it = max(it + 1 - k, 0)
+                                continue
+                        it += 1
+                it0 = 0
+                # the epoch-end conversion is fit's big host sync point —
+                # it blocks on every step dispatched since the last sync,
+                # so the supervisor's watchdog (step_timeout_s) arms here,
+                # scaled by the number of steps it waits on
+                with (sup.watchdog.arm(f"epoch {epoch} metrics sync",
+                                       scale=max(len(epoch_mets), 1))
+                      if sup is not None else contextlib.nullcontext()):
+                    for mets, bs, n in epoch_mets:
+                        # per-step entries hold scalars (n=1); scanned
+                        # chunks hold stacked (n,) arrays — np.asarray
+                        # unifies both
+                        arrs = {k: np.asarray(v) for k, v in mets.items()}
+                        for j in range(n):
+                            self._perf.update(
+                                {k: float(a[j] if a.ndim else a)
+                                 for k, a in arrs.items()}, bs)
                 if verbose:
                     print(f"epoch {epoch}: loss={float(self._last_loss):.4f} "
                           + self._perf.report(self.loss_type, self.metric_types))
+                if stopped:  # preemption checkpoint written; partial epoch
+                    break
                 # a callback returning True from on_epoch_end stops training
                 # (reference keras/callbacks.py early_stop semantics)
                 if any(cb.on_epoch_end(epoch) for cb in callbacks):
@@ -813,6 +963,8 @@ class FFModel:
         finally:
             if native_dl is not None:
                 native_dl.close()
+            if sup is not None:
+                sup.finalize()
         jax.block_until_ready(self.params)
         elapsed = time.time() - (warm or t0)
         if total and elapsed > 0 and verbose:
@@ -825,7 +977,17 @@ class FFModel:
     def evaluate(self, batch: Dict[str, np.ndarray]):
         sharded = self.executor.shard_batch(batch)
         loss, mets, logits = self._eval_step(self.params, self.bn_state, sharded)
-        return float(loss), {k: float(v) for k, v in mets.items()}, logits
+        loss = float(loss)
+        if not np.isfinite(loss):
+            # eval already syncs the loss to host — a free divergence
+            # signal (counter + log; resilience.py counters)
+            from flexflow_tpu.logger import fflogger
+            from flexflow_tpu.runtime.resilience import COUNTERS
+
+            COUNTERS["eval_nonfinite"] += 1
+            fflogger.warning("evaluate: non-finite loss %r at step %d",
+                             loss, self._step_count)
+        return loss, {k: float(v) for k, v in mets.items()}, logits
 
     def predict(self, batch: Dict[str, np.ndarray]):
         """Label-free inference through the forward-only program."""

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from flexflow_tpu._env import lax_axis_size
+
 
 def gpipe_loop(stage_fn: Callable, stage_params, x_mb, axis_name: str):
     """Run inside shard_map. stage_params: this device's stage params (pytree,
@@ -38,7 +40,7 @@ def gpipe_loop(stage_fn: Callable, stage_params, x_mb, axis_name: str):
     microbatched input (replicated; only stage 0 reads it). Returns
     (num_micro, mb, ...) outputs (valid on the LAST stage; use
     `pipeline()` below for the replicated gather)."""
-    n_stage = lax.axis_size(axis_name)
+    n_stage = lax_axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     num_micro = x_mb.shape[0]
     steps = num_micro + n_stage - 1
@@ -126,7 +128,7 @@ def _1f1b_loop(stage_fn, loss_fn, params, x_mb, lab_mb, head_params,
     S = min(m, 2n-1) slots is aliasing-safe: a live F(j) and live B(j')
     share a slot only if j - j' is a positive multiple of S, impossible
     with both live (j - j' < m <= S or masked)."""
-    n = lax.axis_size(axis_name)
+    n = lax_axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     m = x_mb.shape[0]
     S = min(m, 2 * n - 1)

@@ -6,11 +6,23 @@ build makes checkpointing first-class: orbax saves the sharded params /
 optimizer state / batch-norm stats / step counter (each chip writes its own
 shard — no host gather), and the strategy table is saved alongside in the
 reference text schema so a resumed job re-shards identically.
+
+Crash consistency (the preemption story, runtime/resilience.py): each save
+lands in ``<dir>/.tmp-step_N`` and becomes ``<dir>/step_N`` via one
+``os.replace`` — a kill mid-save leaves only an ignored tmp dir, never a
+half-written checkpoint. ``ff_meta.json`` (step, layout guards, supervisor
+extras: RNG key, dataloader cursors) is written INSIDE the step dir before
+the rename, so a renamed checkpoint is always self-contained; the top-level
+``meta.json``/``strategy.txt`` mirror the newest step for older readers.
+``latest_step`` scans the ``step_*`` dirs (tmp dirs skipped), and orbax
+save/load run under ``resilience.retry`` with ``io_fail`` fault-injection
+hooks (FF_FAULT) so the retry path is tier-1-testable.
 """
 
 from __future__ import annotations
 
 import json
+import re
 import os
 from typing import Optional
 
@@ -19,6 +31,8 @@ import numpy as np
 
 from flexflow_tpu.parallel.strategy import (load_strategies_from_file,
                                             save_strategies_to_file)
+from flexflow_tpu.runtime import faultinject
+from flexflow_tpu.runtime.resilience import retry
 
 
 def _checkpointer():
@@ -58,8 +72,21 @@ def _is_multihost() -> bool:
     return jax.process_count() > 1
 
 
-def save_checkpoint(model, directory: str, step: Optional[int] = None) -> str:
+def save_checkpoint(model, directory: str, step: Optional[int] = None,
+                    extra_meta: Optional[dict] = None,
+                    keep: Optional[int] = None) -> str:
     """Save model state. Returns the checkpoint path.
+
+    Atomic: orbax writes into ``<directory>/.tmp-step_N``; meta + strategy
+    land inside it; ONE ``os.replace`` publishes ``step_N``. A kill at any
+    point leaves either the previous checkpoints intact plus a stale tmp
+    dir (ignored by latest_step and cleaned on the next save of that
+    step), or the complete new checkpoint — never a torn one.
+
+    ``extra_meta`` merges into the per-step ``ff_meta.json`` (the
+    supervisor records RNG key + dataloader cursors there); ``keep``
+    prunes all but the newest ``keep`` step dirs after a successful
+    publish.
 
     Single-controller: arrays are gathered to host numpy before writing, so
     checkpoints are topology-free — a restore re-shards onto whatever mesh
@@ -69,18 +96,24 @@ def save_checkpoint(model, directory: str, step: Optional[int] = None) -> str:
     as sharded jax.Arrays and EVERY process participates in the save — each
     host writes only its addressable shards (no host gather; a vocab-sharded
     embedding never materializes on one host). All processes must call this
-    collectively. Saving the same step twice overwrites (idempotent)."""
+    collectively; process 0 does the rename/prune between the barriers.
+    Saving the same step twice overwrites (idempotent)."""
     import shutil
 
     directory = os.path.abspath(directory)
     step = step if step is not None else model._step_count
     path = os.path.join(directory, f"step_{step}")
+    tmp = os.path.join(directory, f".tmp-step_{step}")
     multihost = _is_multihost()
-    if not multihost or jax.process_index() == 0:
+    is_writer = not multihost or jax.process_index() == 0
+    if is_writer:
         os.makedirs(directory, exist_ok=True)
-        if os.path.exists(path):
-            # orbax refuses to overwrite; make saves idempotent
-            shutil.rmtree(path)
+        # only the TMP dir is cleared up front (orbax refuses to
+        # overwrite); a pre-existing published step_N stays live until the
+        # new one is ready — clearing it here would lose the checkpoint if
+        # the process dies during the orbax write
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
     if multihost:
         from jax.experimental import multihost_utils
 
@@ -96,9 +129,25 @@ def save_checkpoint(model, directory: str, step: Optional[int] = None) -> str:
         state["opt_state"] = prep(model.opt_state)
     if model.bn_state:
         state["bn_state"] = prep(model.bn_state)
-    _checkpointer().save(path, state)
 
-    if not multihost or jax.process_index() == 0:
+    def _save():
+        faultinject.maybe_fail("io_fail", "save")
+        if is_writer and os.path.exists(tmp):
+            shutil.rmtree(tmp)  # half-written tmp from a failed attempt
+        _checkpointer().save(tmp, state)
+
+    if multihost:
+        # the orbax save is COLLECTIVE: a per-host retry would re-enter
+        # it on one process only (different op counts per host -> the
+        # job deadlocks at orbax's internal syncs, or the writer rmtrees
+        # shards peers just wrote). A failed collective save must be
+        # retried collectively by the caller on every host.
+        _save()
+    else:
+        retry(attempts=3, base_delay=0.05, retryable=(OSError,),
+              name="orbax save")(_save)()
+
+    if is_writer:
         meta = {"step": int(step),
                 "mesh_shape": model.config.mesh_shape,
                 "multihost": multihost,
@@ -107,10 +156,33 @@ def save_checkpoint(model, directory: str, step: Optional[int] = None) -> str:
             meta["opt_layout"] = _opt_layout(model)
             if meta["opt_layout"] == "sharded_fused":
                 meta["opt_state_shardings"] = _sharded_fused_shardings(model)
-        with open(os.path.join(directory, "meta.json"), "w") as f:
+        if extra_meta:
+            meta.update(extra_meta)
+        with open(os.path.join(tmp, "ff_meta.json"), "w") as f:
             json.dump(meta, f)
-        save_strategies_to_file(os.path.join(directory, "strategy.txt"),
+        save_strategies_to_file(os.path.join(tmp, "strategy.txt"),
                                 model.config.strategies)
+        if os.path.exists(path):
+            # same-step overwrite: the old dir must vanish for the rename
+            # (os.replace cannot clobber a non-empty dir). The unprotected
+            # window shrinks to this instant — the complete replacement is
+            # already on disk in tmp, so a kill here leaves tmp salvageable
+            # rather than nothing mid-write
+            shutil.rmtree(path)
+        os.replace(tmp, path)  # the publish point
+        # top-level mirrors (older readers + import_strategy_file): written
+        # atomically too, AFTER the step dir is live
+        mtmp = os.path.join(directory, f".meta.json.tmp-{os.getpid()}")
+        with open(mtmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(mtmp, os.path.join(directory, "meta.json"))
+        stmp = os.path.join(directory, f".strategy.txt.tmp-{os.getpid()}")
+        save_strategies_to_file(stmp, model.config.strategies)
+        os.replace(stmp, os.path.join(directory, "strategy.txt"))
+        if keep is not None and keep > 0:
+            for old in sorted(_step_dirs(directory))[:-keep]:
+                shutil.rmtree(os.path.join(directory, f"step_{old}"),
+                              ignore_errors=True)
     if multihost:
         from jax.experimental import multihost_utils
 
@@ -126,9 +198,12 @@ def restore_checkpoint(model, directory: str, step: Optional[int] = None):
     orbax restores each array directly into the model's current sharding
     (each host reads only its shards)."""
     directory = os.path.abspath(directory)
-    with open(os.path.join(directory, "meta.json")) as f:
-        meta = json.load(f)
-    step = step if step is not None else meta["step"]
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found in {directory}")
+    meta = load_meta(directory, step)
     path = os.path.join(directory, f"step_{step}")
 
     # absent on pre-r5 and params-only checkpoints (no opt state to
@@ -173,6 +248,9 @@ def restore_checkpoint(model, directory: str, step: Optional[int] = None):
         if model.bn_state:
             template["bn_state"] = model.bn_state
         restore_args = ocp.checkpoint_utils.construct_restore_args(template)
+        # no per-host retry around the COLLECTIVE restore (see _save):
+        # one host re-entering it would desync the participants
+        faultinject.maybe_fail("io_fail", "load")
         restored = _checkpointer().restore(path, restore_args=restore_args)
         model.params = restored["params"]
         if "opt_state" in restored and model.optimizer is not None:
@@ -183,7 +261,7 @@ def restore_checkpoint(model, directory: str, step: Optional[int] = None):
         model._step_count = step
         return step
 
-    restored = _checkpointer().restore(path)
+    restored = _orbax_restore(path)
     shardings = model.executor.param_shardings()
 
     def put(tree, shard_map_):
@@ -211,8 +289,10 @@ def restore_checkpoint(model, directory: str, step: Optional[int] = None):
     # strategy, pass import_strategy_file=<dir>/strategy.txt in FFConfig
     # BEFORE compile(). We only warn on divergence here.
     try:
+        per_step = os.path.join(path, "strategy.txt")
         saved = load_strategies_from_file(
-            os.path.join(directory, "strategy.txt"))
+            per_step if os.path.exists(per_step)
+            else os.path.join(directory, "strategy.txt"))
         current = model.config.strategies
         def differs(a, b):
             if a.dims != b.dims:
@@ -239,12 +319,50 @@ def restore_checkpoint(model, directory: str, step: Optional[int] = None):
     return step
 
 
-def latest_step(directory: str) -> Optional[int]:
+@retry(attempts=3, base_delay=0.05, retryable=(OSError,), name="orbax load")
+def _orbax_restore(path, **kw):
+    faultinject.maybe_fail("io_fail", "load")
+    return _checkpointer().restore(path, **kw)
+
+
+def _step_dirs(directory: str):
+    """Published checkpoint step numbers in `directory` (tmp dirs from an
+    interrupted save are skipped — they never became checkpoints)."""
     try:
-        with open(os.path.join(directory, "meta.json")) as f:
-            return json.load(f)["step"]
-    except (FileNotFoundError, KeyError):
-        return None
+        names = os.listdir(directory)
+    except (FileNotFoundError, NotADirectoryError):
+        return []
+    out = []
+    for n in names:
+        m = re.fullmatch(r"step_(\d+)", n)
+        if m and os.path.isdir(os.path.join(directory, n)):
+            out.append(int(m.group(1)))
+    return out
+
+
+def load_meta(directory: str, step: Optional[int] = None) -> dict:
+    """Checkpoint metadata: the per-step ``step_N/ff_meta.json`` when
+    present (self-contained checkpoints), else the top-level ``meta.json``
+    (pre-atomic-write layout)."""
+    directory = os.path.abspath(directory)
+    if step is not None:
+        per_step = os.path.join(directory, f"step_{step}", "ff_meta.json")
+        if os.path.exists(per_step):
+            with open(per_step) as f:
+                return json.load(f)
+    with open(os.path.join(directory, "meta.json")) as f:
+        return json.load(f)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Newest published checkpoint step in `directory`, or None. Scans the
+    ``step_*`` dirs ONLY: trusting ``meta.json`` would return steps whose
+    dir is gone (a kill inside the same-step overwrite window, retention
+    pruning) and turn auto-resume into a restore-of-nothing crash loop —
+    no dir means fresh start. ``.tmp-*`` leftovers from an interrupted
+    save are ignored."""
+    steps = _step_dirs(directory)
+    return max(steps) if steps else None
 
 
 def _strip_none(tree):

@@ -310,15 +310,14 @@ class GraphExecutor:
 
     # ---- compiled steps -----------------------------------------------------
 
-    def _train_step_body(self, optimizer, loss_type: LossType,
-                         metric_types: List[MetricsType], final_tensor,
-                         label_key="label"):
-        """The un-jitted fused fwd+bwd+update body shared by the per-step
-        program and the scanned multi-step program."""
+    def _make_loss_fn(self, loss_type: LossType,
+                      metric_types: List[MetricsType], final_tensor,
+                      label_key="label"):
+        """loss_fn(p, state, batch, rng) -> (loss, (new_state, mets)) —
+        shared by the plain, scanned, and divergence-guarded step
+        builders."""
         input_ops = [op for op in self.model.ops if isinstance(op, InputOp)]
-
         aux_tensors = list(getattr(self.model, "_aux_tensors", ()))
-        accum = max(1, int(getattr(self.model.config, "grad_accum_steps", 1)))
 
         def loss_fn(p, st, batch, rng):
             input_values = {op.outputs[0]: batch[op.name] for op in input_ops}
@@ -333,6 +332,17 @@ class GraphExecutor:
                 ignore_index=getattr(self.model.config,
                                      "metrics_ignore_index", None))
             return loss, (new_state, mets)
+
+        return loss_fn
+
+    def _train_step_body(self, optimizer, loss_type: LossType,
+                         metric_types: List[MetricsType], final_tensor,
+                         label_key="label"):
+        """The un-jitted fused fwd+bwd+update body shared by the per-step
+        program and the scanned multi-step program."""
+        accum = max(1, int(getattr(self.model.config, "grad_accum_steps", 1)))
+        loss_fn = self._make_loss_fn(loss_type, metric_types, final_tensor,
+                                     label_key)
 
         def step(params, opt_state, state, batch, rng):
             (loss, (new_state, mets)), grads = jax.value_and_grad(
@@ -387,6 +397,92 @@ class GraphExecutor:
         step = self._train_step_body(optimizer, loss_type, metric_types,
                                      final_tensor, label_key)
         return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def make_guarded_train_step(self, optimizer, loss_type: LossType,
+                                metric_types: List[MetricsType], final_tensor,
+                                guard_cfg: Dict, label_key="label"):
+        """Divergence-guarded train step (runtime/resilience.py): the
+        finite-loss/grad-norm check and the skip/keep selection are
+        compiled INTO the step — one jnp.isfinite reduction over the loss
+        plus the global grad-norm (f32), a jnp.where per state leaf — so
+        the happy path makes NO device→host round trip the plain step
+        doesn't. With loss_scale == 1.0 and every step finite, the
+        trajectory is bitwise identical to make_train_step's.
+
+        Signature:
+            fn(params, opt_state, state, batch, rng, guard_state,
+               inject_nan)
+              -> (params, opt_state, state, loss, mets, guard_state)
+        guard_state: resilience.init_guard_state() pytree (device-resident
+        streaks / loss scale / skip counter). inject_nan: traced bool —
+        the FF_FAULT nan_loss hook adds NaN to the loss in-graph, so
+        injection reuses the one compiled program.
+
+        Returned mets add: nonfinite (0/1 this step), grad_norm,
+        loss_scale, skipped_total."""
+        mode = guard_cfg.get("on_nonfinite", "skip")
+        backoff = float(guard_cfg.get("backoff", 2.0))
+        growth_interval = int(guard_cfg.get("growth_interval", 200))
+        min_scale = float(guard_cfg.get("min_loss_scale", 2.0 ** -14))
+        max_scale = float(guard_cfg.get("max_loss_scale", 2.0 ** 15))
+        loss_fn = self._make_loss_fn(loss_type, metric_types, final_tensor,
+                                     label_key)
+
+        def gstep(params, opt_state, state, batch, rng, gstate, inject_nan):
+            scale = gstate["loss_scale"]
+
+            def scaled(p, st, b, r):
+                loss, aux = loss_fn(p, st, b, r)
+                loss = loss + jnp.where(inject_nan, jnp.nan, 0.0
+                                        ).astype(loss.dtype)
+                return loss * scale.astype(loss.dtype), (loss, aux)
+
+            (_, (raw_loss, (new_state, mets))), grads = jax.value_and_grad(
+                scaled, has_aux=True)(params, state, batch, rng)
+            inv = (1.0 / scale)
+            grads = jax.tree_util.tree_map(
+                lambda g: (g * inv.astype(g.dtype)), grads)
+            leaves = jax.tree_util.tree_leaves(grads)
+            gnorm_sq = jnp.float32(0.0)
+            for g in leaves:
+                gnorm_sq = gnorm_sq + jnp.sum(
+                    jnp.square(g.astype(jnp.float32)))
+            finite = jnp.isfinite(raw_loss) & jnp.isfinite(gnorm_sq)
+            new_params, new_opt_state = optimizer.update(params, grads,
+                                                         opt_state)
+
+            def sel(new, old):
+                return jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(finite, n, o), new, old)
+
+            params_out = sel(new_params, params)
+            opt_out = sel(new_opt_state, opt_state)
+            state_out = sel(new_state, state)
+            bad = ~finite
+            streak = jnp.where(bad, gstate["bad_streak"] + 1, 0)
+            good = jnp.where(bad, 0, gstate["good_streak"] + 1)
+            if mode == "backoff":
+                down = jnp.maximum(scale / backoff, min_scale)
+                grow = good >= growth_interval
+                up = jnp.where(grow, jnp.minimum(scale * backoff, max_scale),
+                               scale)
+                new_scale = jnp.where(bad, down, up)
+                good = jnp.where(grow & ~bad, 0, good)
+            else:
+                new_scale = scale
+            new_gstate = {"bad_streak": streak, "good_streak": good,
+                          "loss_scale": new_scale,
+                          "skipped": gstate["skipped"]
+                          + bad.astype(jnp.int32)}
+            mets = dict(mets)
+            mets["nonfinite"] = bad.astype(jnp.int32)
+            mets["grad_norm"] = jnp.sqrt(gnorm_sq)
+            mets["loss_scale"] = new_scale
+            mets["skipped_total"] = new_gstate["skipped"]
+            return (params_out, opt_out, state_out, raw_loss, mets,
+                    new_gstate)
+
+        return jax.jit(gstep, donate_argnums=(0, 1, 2, 5))
 
     def make_train_scan(self, optimizer, loss_type: LossType,
                         metric_types: List[MetricsType], final_tensor,

@@ -1,0 +1,161 @@
+"""Deterministic fault injection for resilience testing.
+
+The reference has no failure story at all (SURVEY §5.4) — and code paths
+that only run during a real outage are code paths that have never run.
+This module lets every resilience path (NaN loss, preemption SIGTERM,
+checkpoint IO failure, hung step) be triggered deterministically on CPU in
+tier-1 tests, driven by one env var:
+
+    FF_FAULT=nan_loss@step:7,sigterm@step:12,io_fail@save:1
+
+Grammar: comma-separated ``kind@site:index`` events.
+
+  kind   free-form token consumed by the subsystem that checks it
+         (``nan_loss``, ``sigterm``, ``io_fail``, ``hang`` …)
+  site   where the event fires. ``step`` is special: *index* is the 1-based
+         global training step (compared against the step counter). Every
+         other site (``save``, ``load``, ``data`` …) is occurrence-counted:
+         *index* is the 1-based call count at that site, so
+         ``io_fail@save:1`` fails exactly the first checkpoint save.
+
+Duplicate kinds are allowed (``nan_loss@step:3,nan_loss@step:4`` injects
+two consecutive NaNs); a range ``nan_loss@step:3-5`` expands to one event
+per step.
+
+Consumers:
+  * ``TrainSupervisor`` checks ``at_step("nan_loss"|"sigterm"|"hang", n)``
+    each step (runtime/resilience.py);
+  * ``checkpoint.save_checkpoint``/``restore_checkpoint`` call
+    ``maybe_fail("io_fail", "save"|"load")`` inside their retry wrapper.
+
+The active plan is parsed lazily from ``FF_FAULT`` and re-parsed (with
+occurrence counters reset) whenever the env value changes; tests that
+reuse a spec should call ``reset()`` between runs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+
+class InjectedFault(OSError):
+    """Raised by ``maybe_fail``: an IO-flavored injected failure (OSError
+    subclass so generic retry(retryable=(OSError,)) policies cover it)."""
+
+
+class FaultPlan:
+    def __init__(self, events: List[Tuple[str, str, int]]):
+        # [(kind, site, index), ...] — index is a step number for
+        # site == "step", a 1-based occurrence count otherwise
+        self.events = list(events)
+        self._counts: Dict[Tuple[str, str], int] = {}
+        self._consumed: set = set()
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        events: List[Tuple[str, str, int]] = []
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            kind, at, rest = part.partition("@")
+            site, colon, idx = rest.partition(":")
+            if not at or not colon or not kind or not site:
+                raise ValueError(
+                    f"FF_FAULT entry {part!r}: expected 'kind@site:index' "
+                    f"(e.g. nan_loss@step:7)")
+            lo, dash, hi = idx.partition("-")
+            try:
+                lo_i = int(lo)
+                hi_i = int(hi) if dash else lo_i
+            except ValueError:
+                raise ValueError(
+                    f"FF_FAULT entry {part!r}: index must be an integer "
+                    f"or range 'lo-hi', got {idx!r}") from None
+            if hi_i < lo_i:
+                raise ValueError(f"FF_FAULT entry {part!r}: empty range")
+            for i in range(lo_i, hi_i + 1):
+                events.append((kind, site, i))
+        return cls(events)
+
+    def at_step(self, kind: str, step: int) -> bool:
+        """True when the plan holds ``kind@step:<step>``. One-shot: a
+        fired event is consumed, so a supervisor rewind that re-executes
+        the step does not re-inject (the fault "happened" once)."""
+        ev = (kind, "step", int(step))
+        if ev in self.events and ev not in self._consumed:
+            self._consumed.add(ev)
+            return True
+        return False
+
+    def has_step_events(self, *kinds: str) -> bool:
+        """Does the plan schedule any step-site event of these kinds?
+        (Unconsumed only.) Callers with chunked step counters use this to
+        fall back to per-step execution so injection can actually land."""
+        return any(k in kinds and s == "step" and (k, s, i) not in
+                   self._consumed for k, s, i in self.events)
+
+    def in_step_range(self, kind: str, lo: int, hi: int) -> bool:
+        """True when the plan holds ``kind@step:i`` with lo < i <= hi.
+        Needed by callers whose step counter advances in chunks (fit's
+        scanned multi-step program jumps scan_steps at a time) — exact
+        equality would silently skip events landing inside a chunk.
+        Consumes every matched event (one-shot, like at_step)."""
+        fired = False
+        for ev in self.events:
+            k, s, i = ev
+            if (k == kind and s == "step" and lo < i <= hi
+                    and ev not in self._consumed):
+                self._consumed.add(ev)
+                fired = True
+        return fired
+
+    def fire(self, kind: str, site: str) -> bool:
+        """Occurrence-counted sites: increments the (kind, site) call
+        counter and reports whether this occurrence is scheduled to fail.
+        Only counts when the plan mentions (kind, site) at all, so an
+        unrelated plan never accumulates counters."""
+        if not any(k == kind and s == site for k, s, _ in self.events):
+            return False
+        key = (kind, site)
+        self._counts[key] = n = self._counts.get(key, 0) + 1
+        return (kind, site, n) in self.events
+
+    def __bool__(self) -> bool:
+        return bool(self.events)
+
+    def __repr__(self) -> str:
+        return f"FaultPlan({self.events!r})"
+
+
+_plan: Optional[FaultPlan] = None
+_plan_spec: Optional[str] = None
+
+
+def active_plan() -> FaultPlan:
+    """The process-wide plan from ``FF_FAULT``. Re-parsed (counters reset)
+    whenever the env value changes, so monkeypatched tests see fresh
+    state; identical spec across tests needs an explicit reset()."""
+    global _plan, _plan_spec
+    spec = os.environ.get("FF_FAULT", "")
+    if _plan is None or spec != _plan_spec:
+        _plan = FaultPlan.parse(spec)
+        _plan_spec = spec
+    return _plan
+
+
+def reset():
+    """Drop the cached plan and its occurrence counters."""
+    global _plan, _plan_spec
+    _plan = None
+    _plan_spec = None
+
+
+def maybe_fail(kind: str, site: str):
+    """Raise InjectedFault when the active plan schedules this occurrence
+    of (kind, site). Call sites place this INSIDE their retry wrapper so
+    the retry path itself is what gets exercised."""
+    if active_plan().fire(kind, site):
+        raise InjectedFault(
+            f"injected fault: {kind}@{site} (FF_FAULT)")

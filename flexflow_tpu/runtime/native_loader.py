@@ -36,7 +36,18 @@ def load_lib():
         if _lib is not None:
             return _lib
         src = os.path.join(_CSRC, "dataloader.cc")
-        try:
+        from flexflow_tpu.runtime.resilience import retry
+
+        # a concurrent process can race the build (dlopen of a just-
+        # replaced .so, transient fs errors) — retry once before giving
+        # up; "no g++ at all" (FileNotFoundError) is permanent, not
+        # retryable, and must fall through to the Python loader fast
+        @retry(attempts=2, base_delay=0.1,
+               retryable=lambda e: isinstance(
+                   e, (OSError, subprocess.CalledProcessError))
+               and not isinstance(e, FileNotFoundError),
+               name="native dataloader build")
+        def _build_and_open():
             if (not os.path.exists(_LIB_PATH)
                     or os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)):
                 tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
@@ -45,7 +56,10 @@ def load_lib():
                      "-shared", "-o", tmp, src],
                     check=True, capture_output=True)
                 os.rename(tmp, _LIB_PATH)
-            lib = ctypes.CDLL(_LIB_PATH)
+            return ctypes.CDLL(_LIB_PATH)
+
+        try:
+            lib = _build_and_open()
         except (OSError, subprocess.CalledProcessError):
             _lib = False
             return None

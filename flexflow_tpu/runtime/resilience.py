@@ -1,0 +1,611 @@
+"""Fault-tolerant training: preemption-safe supervisor, divergence guards,
+retry/backoff, and a step watchdog.
+
+The reference FlexFlow has no checkpointing or failure story (SURVEY §5.4)
+— a lost node kills the run. The ROADMAP north-star is a production system
+on preemptible TPU pools, where interruption is the COMMON case, so the
+runtime owns recovery (the TensorFlow-paper position: periodic consistent
+checkpointing + automatic resume is a first-class runtime responsibility):
+
+  * ``TrainSupervisor`` wraps the train loop with periodic + SIGTERM-
+    triggered atomic checkpoints (runtime/checkpoint.py: tmp-dir +
+    os.replace, last-K retention) and automatic resume-from-latest —
+    step counter, RNG key, and dataloader cursors restore so the resumed
+    loss trajectory is bitwise identical to an uninterrupted run.
+  * Divergence guard: a per-step finite-loss/grad-norm check compiled INTO
+    the jitted step (executor.make_guarded_train_step — one jnp.isfinite
+    reduction, skip/keep selected in-graph by jnp.where, no device→host
+    round trip before the update). The supervisor counts consecutive bad
+    steps on the host and rewinds to the last checkpoint after N.
+  * ``retry(attempts, base_delay, retryable=...)``: timeout/backoff
+    decorator applied to jax.distributed.initialize (launcher.py), orbax
+    save/load (checkpoint.py), and the native dataloader build
+    (native_loader.py).
+  * ``Watchdog``: wall-clock step timeout that dumps every thread's stack
+    (faulthandler) before aborting a stuck collective.
+
+Every path is deterministically testable on CPU via runtime/faultinject.py
+(``FF_FAULT=nan_loss@step:7,sigterm@step:12,io_fail@save:1``).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import functools
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from flexflow_tpu.logger import fflogger
+from flexflow_tpu.runtime import faultinject
+
+# process-wide resilience counters (skipped steps / restarts / retries …);
+# read via counters(), cleared via reset_counters()
+COUNTERS: collections.Counter = collections.Counter()
+
+
+def counters() -> Dict[str, int]:
+    return dict(COUNTERS)
+
+
+def reset_counters():
+    COUNTERS.clear()
+
+
+# --------------------------------------------------------------- retry
+
+
+def retry(attempts: int = 3, base_delay: float = 0.1, max_delay: float = 5.0,
+          retryable=(OSError,), name: Optional[str] = None,
+          sleep: Callable[[float], None] = time.sleep):
+    """Exponential-backoff retry decorator for flaky IO/RPC boundaries
+    (orbax save/load, jax.distributed.initialize, native loader build).
+
+    ``retryable`` is an exception class / tuple of classes, or a predicate
+    ``exc -> bool``. Non-retryable and final-attempt failures re-raise
+    unchanged. Each retry increments COUNTERS["retries"] and logs the
+    failure — a silent retry hides a degrading storage layer."""
+    if attempts < 1:
+        # a typo'd knob (FF_INIT_ATTEMPTS=0) must fail loudly, not make
+        # the wrapper silently skip the call and return None
+        raise ValueError(f"retry: attempts must be >= 1, got {attempts}")
+    if isinstance(retryable, (type, tuple)):
+        classes = retryable
+
+        def pred(e):
+            return isinstance(e, classes)
+    else:
+        pred = retryable
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            delay = base_delay
+            for i in range(attempts):
+                try:
+                    return fn(*args, **kwargs)
+                except Exception as e:
+                    if i == attempts - 1 or not pred(e):
+                        raise
+                    COUNTERS["retries"] += 1
+                    fflogger.warning(
+                        "retry %s: attempt %d/%d failed (%s: %s); "
+                        "retrying in %.2fs",
+                        name or getattr(fn, "__name__", "?"), i + 1,
+                        attempts, type(e).__name__, e, delay)
+                    sleep(min(delay, max_delay))
+                    delay *= 2
+        return wrapper
+    return deco
+
+
+# ------------------------------------------------------------- watchdog
+
+
+class Watchdog:
+    """Wall-clock timeout around a blocking section (the host fetch that
+    waits on a step's device work). A hung collective — one host dropped
+    out of a rendezvous — blocks forever with no exception; the watchdog
+    dumps every thread's stack first (the post-mortem that distinguishes
+    'stuck in all-reduce' from 'stuck in the dataloader') and then aborts
+    via ``on_timeout`` (default: KeyboardInterrupt in the main thread).
+
+    ``timeout_s <= 0`` disarms. One Watchdog is reusable across steps."""
+
+    def __init__(self, timeout_s: float, on_timeout: Optional[Callable] = None,
+                 dump_path: Optional[str] = None):
+        self.timeout_s = float(timeout_s)
+        self.on_timeout = on_timeout
+        self.dump_path = dump_path
+        self.fired = False
+
+    def _dump(self, label: str, timeout_s: float):
+        import faulthandler
+
+        msg = (f"\n[resilience] watchdog: {label!r} exceeded "
+               f"{timeout_s:.1f}s wall clock; thread stacks follow\n")
+        if self.dump_path:
+            with open(self.dump_path, "a") as f:
+                f.write(msg)
+                faulthandler.dump_traceback(file=f)
+        else:
+            sys.stderr.write(msg)
+            faulthandler.dump_traceback(file=sys.stderr)
+
+    def _profiler_snapshot(self):
+        """Best-effort device profiler snapshot alongside the stacks
+        (pprof heap profile — which buffers were live when the step
+        wedged). Runs AFTER the abort is signalled: it can be slow, and a
+        fully hung runtime may never answer."""
+        if not self.dump_path:
+            return
+        try:
+            import jax
+
+            jax.profiler.save_device_memory_profile(
+                self.dump_path + ".memprof")
+        except Exception:
+            pass
+
+    @contextlib.contextmanager
+    def arm(self, label: str = "step", scale: float = 1.0):
+        """``scale`` stretches the timeout for syncs that wait on more
+        than one step's async work (fit's epoch-end conversion blocks on
+        every step dispatched since the last sync)."""
+        if self.timeout_s <= 0:
+            yield
+            return
+        timeout_s = self.timeout_s * max(scale, 1.0)
+
+        grace: List[threading.Timer] = []
+        lock = threading.Lock()
+        state = {"active": True}
+
+        def hard_exit():
+            with lock:
+                if not state["active"]:
+                    return  # section completed; interrupt was serviced
+            os._exit(70)
+
+        def fire():
+            # the lock is held through dump + grace registration +
+            # interrupt: arm()'s finally blocks on it, so it can never
+            # observe a half-registered grace timer. A section completing
+            # in the same instant the timer fires reads as "step took
+            # >= timeout" — which is what the watchdog reports.
+            with lock:
+                if not state["active"]:
+                    return  # completed before we fired: healthy run
+                self.fired = True
+                COUNTERS["watchdog_fires"] += 1
+                self._dump(label, timeout_s)  # stacks first, while they
+                # still show the hang; the slow profiler snapshot trails
+                if self.on_timeout is not None:
+                    self.on_timeout(label)
+                else:
+                    import _thread
+
+                    # interrupt_main only raises at the next Python
+                    # bytecode boundary — a main thread wedged inside a
+                    # native device fetch never reaches one. Hard-exit
+                    # backstop: if the interrupt isn't serviced, the
+                    # process is unrecoverable; exit so the launcher /
+                    # scheduler can restart it (auto-resume picks up the
+                    # last checkpoint). hard_exit re-checks liveness, so
+                    # a serviced interrupt always defuses it.
+                    g = threading.Timer(max(timeout_s, 10.0), hard_exit)
+                    g.daemon = True
+                    grace.append(g)
+                    g.start()
+                    _thread.interrupt_main()
+            self._profiler_snapshot()
+
+        t = threading.Timer(timeout_s, fire)
+        t.daemon = True
+        t.start()
+        try:
+            yield
+        finally:
+            t.cancel()
+            with lock:  # blocks until an in-flight fire() finishes, so
+                # the grace list is complete before we cancel
+                state["active"] = False
+            for g in grace:
+                g.cancel()
+
+
+# ---------------------------------------------------------- guard state
+
+
+def init_guard_state(loss_scale: float = 1.0):
+    """Device-resident divergence-guard carry for the guarded train step
+    (executor.make_guarded_train_step): consecutive-bad-step streak,
+    loss-scale, cumulative skip count. Lives on device so the guard makes
+    no host round trip; the supervisor mirrors the streak on host from
+    the step's returned metrics."""
+    import jax.numpy as jnp
+
+    return {"bad_streak": jnp.zeros((), jnp.int32),
+            "good_streak": jnp.zeros((), jnp.int32),
+            "loss_scale": jnp.asarray(loss_scale, jnp.float32),
+            "skipped": jnp.zeros((), jnp.int32)}
+
+
+# ------------------------------------------------------------ supervisor
+
+
+class TrainSupervisor:
+    """Drives a training loop with checkpoint/resume, preemption handling,
+    divergence rewind, and hang detection.
+
+    Lifecycle::
+
+        cfg = FFConfig(checkpoint_dir="ckpt", checkpoint_every=50,
+                       on_nonfinite="skip", nonfinite_rewind_after=3)
+        model.compile(opt, ...)                # builds the guarded step
+        sup = TrainSupervisor(model)
+        status = sup.run(num_steps=1000)       # "completed" | "preempted"
+
+    ``run`` resumes from the newest checkpoint in the directory (fresh
+    start when none), installs a SIGTERM handler (preemption notice →
+    checkpoint at the next step boundary, then stop), checkpoints every
+    ``checkpoint_every`` steps, and — when the divergence guard is
+    compiled in — skips non-finite steps in-graph and rewinds to the last
+    checkpoint after ``rewind_after`` consecutive bad steps.
+
+    ``model.fit`` drives the same machinery through ``install``/
+    ``resume``/``after_step``/``finalize`` when FFConfig.checkpoint_dir
+    is set.
+
+    Multihost: every controller must construct the supervisor and call
+    run() collectively (checkpoint save/restore are collective); SIGTERM
+    must be delivered to all controllers (the typical preemption notice
+    is). See docs/resilience.md for the caveats."""
+
+    def __init__(self, model, directory: Optional[str] = None, *,
+                 checkpoint_every: Optional[int] = None,
+                 keep: Optional[int] = None,
+                 rewind_after: Optional[int] = None,
+                 step_timeout_s: Optional[float] = None,
+                 max_rewinds: int = 3,
+                 faults: Optional[faultinject.FaultPlan] = None,
+                 verbose: bool = False):
+        cfg = model.config
+        self.model = model
+        self.directory = directory or getattr(cfg, "checkpoint_dir", "")
+        if not self.directory:
+            raise ValueError(
+                "TrainSupervisor needs a checkpoint directory: pass one or "
+                "set FFConfig.checkpoint_dir")
+        self.checkpoint_every = (checkpoint_every
+                                 if checkpoint_every is not None
+                                 else getattr(cfg, "checkpoint_every", 0))
+        self.keep = keep if keep is not None else getattr(
+            cfg, "keep_checkpoints", 3)
+        self.rewind_after = (rewind_after if rewind_after is not None
+                             else getattr(cfg, "nonfinite_rewind_after", 0))
+        self.watchdog = Watchdog(step_timeout_s if step_timeout_s is not None
+                                 else getattr(cfg, "step_timeout_s", 0.0))
+        self.faults = faults  # None -> the FF_FAULT env plan, read lazily
+        self.verbose = verbose
+        # poll the guard's per-step nonfinite flag on the host? True for
+        # the step-driven run() loop (it syncs the loss anyway); fit()
+        # turns it off unless rewind_after needs prompt streak tracking,
+        # keeping its dispatch async (skips reconcile from the device
+        # counter at finalize)
+        self.poll_nonfinite = True
+        self.losses: List[float] = []
+        self._loss_base = model._step_count  # step number of losses[0] - 1
+        self._bad_streak = 0
+        self._skips_counted = 0  # host-observed skips (vs device counter)
+        self._fault_mark = model._step_count  # last step-fault boundary
+        # livelock guard: rewinding to the SAME checkpoint restores the
+        # same params/RNG/cursors, so a deterministic NaN (bad data, not
+        # a transient) replays identically — cap repeats and abort loudly
+        self.max_rewinds = max_rewinds
+        self._last_rewind_step: Optional[int] = None
+        self._same_rewinds = 0
+        self._last_saved_step: Optional[int] = None
+        self._resumed: Optional[int] = None
+        self._preempted = threading.Event()
+        self._prev_sigterm = None
+        self._installed = False
+
+    # ---- signal handling -------------------------------------------------
+
+    def install(self):
+        """Install the SIGTERM handler (preemption notice). Main thread
+        only; idempotent. The handler just sets a flag — the checkpoint
+        happens at the next step boundary, where params are consistent."""
+        if self._installed:
+            return
+        try:
+            self._prev_sigterm = signal.signal(
+                signal.SIGTERM, self._on_sigterm)
+            self._installed = True
+        except ValueError:
+            # not the main thread: preemption must then be signalled by
+            # calling request_preempt() from whoever owns the signal
+            fflogger.warning(
+                "TrainSupervisor: cannot install SIGTERM handler outside "
+                "the main thread; call request_preempt() instead")
+
+    def close(self):
+        """Restore the previous SIGTERM disposition."""
+        if self._installed:
+            try:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+            except ValueError:
+                pass
+            self._installed = False
+
+    def _on_sigterm(self, signum, frame):
+        self._preempted.set()
+
+    def request_preempt(self):
+        """Programmatic preemption notice (same effect as SIGTERM)."""
+        self._preempted.set()
+
+    @property
+    def preempted(self) -> bool:
+        return self._preempted.is_set()
+
+    # ---- checkpoint / resume ---------------------------------------------
+
+    def _fault_plan(self) -> faultinject.FaultPlan:
+        return self.faults if self.faults is not None \
+            else faultinject.active_plan()
+
+    def _extra_meta(self) -> dict:
+        meta = {
+            "rng_key": np.asarray(self.model._rng).tolist(),
+            "dataloaders": {dl.name: int(dl.next_index)
+                            for dl in self.model._dataloaders},
+        }
+        gs = getattr(self.model, "_guard_state", None)
+        if gs is not None:
+            meta["loss_scale"] = float(np.asarray(gs["loss_scale"]))
+        return meta
+
+    def save(self, reason: str = "periodic") -> Optional[str]:
+        """Atomic checkpoint of params/opt/bn + step + RNG + dataloader
+        cursors. Skips when the current step is already saved (a preempt
+        right after a periodic save must not write twice)."""
+        from flexflow_tpu.runtime.checkpoint import save_checkpoint
+
+        step = self.model._step_count
+        if self._last_saved_step == step:
+            return None
+        extra = self._extra_meta()
+        extra["reason"] = reason
+        path = save_checkpoint(self.model, self.directory, step=step,
+                               extra_meta=extra, keep=self.keep)
+        self._last_saved_step = step
+        COUNTERS["checkpoints_saved"] += 1
+        if self.verbose:
+            fflogger.info("supervisor: checkpoint step %d (%s) -> %s",
+                          step, reason, path)
+        return path
+
+    def _restore(self, step: int):
+        from flexflow_tpu.runtime.checkpoint import (load_meta,
+                                                     restore_checkpoint)
+
+        restore_checkpoint(self.model, self.directory, step=step)
+        meta = load_meta(self.directory, step)
+        rng = meta.get("rng_key")
+        if rng is not None:
+            import jax.numpy as jnp
+
+            self.model._rng = jnp.asarray(np.asarray(rng, np.uint32))
+        cursors = meta.get("dataloaders") or {}
+        for dl in self.model._dataloaders:
+            if dl.name in cursors:
+                dl.next_index = int(cursors[dl.name])
+        if getattr(self.model, "_guard_state", None) is not None:
+            # fresh streaks; keep the backed-off loss scale — restoring a
+            # pre-divergence scale would walk straight back into the
+            # cliff. A checkpoint without a recorded scale (pre-supervisor
+            # or unguarded-run save) falls back to the CONFIGURED scale,
+            # not 1.0
+            self.model._guard_state = init_guard_state(
+                meta.get("loss_scale",
+                         getattr(self.model.config, "loss_scale", 1.0)))
+        self._bad_streak = 0
+        self._skips_counted = 0  # device skip counter was re-initialized
+        self._last_saved_step = step
+
+    def resume(self) -> int:
+        """Restore the newest checkpoint in the directory (0 = fresh
+        start). On a fresh start with rewind enabled, takes an initial
+        step-0 checkpoint so a rewind target always exists."""
+        from flexflow_tpu.runtime.checkpoint import latest_step
+
+        step = latest_step(self.directory)
+        if step is None:
+            self._resumed = 0
+            if self.rewind_after:
+                self.save(reason="initial")
+            return 0
+        self._restore(step)
+        self.losses.clear()
+        self._loss_base = step
+        self._fault_mark = step
+        self._resumed = step
+        COUNTERS["resumes"] += 1
+        fflogger.info("supervisor: resumed from step %d in %s", step,
+                      self.directory)
+        return step
+
+    def rewind(self):
+        """Divergence recovery: back to the last checkpoint (params, opt
+        state, step counter, RNG, dataloader cursors)."""
+        from flexflow_tpu.runtime.checkpoint import latest_step
+
+        step = latest_step(self.directory)
+        if step is None:
+            raise RuntimeError(
+                f"rewind requested but no checkpoint exists in "
+                f"{self.directory}")
+        if step == self._last_rewind_step:
+            self._same_rewinds += 1
+        else:
+            self._last_rewind_step = step
+            self._same_rewinds = 1
+        if self._same_rewinds > self.max_rewinds:
+            raise RuntimeError(
+                f"supervisor: rewound to checkpoint step {step} "
+                f"{self._same_rewinds} times with no progress — a rewind "
+                f"replays identical params/RNG/batches, so this "
+                f"non-finite condition is deterministic (bad data or a "
+                f"diverged config), not transient; aborting instead of "
+                f"livelocking")
+        fflogger.warning(
+            "supervisor: %d consecutive non-finite steps at step %d — "
+            "rewinding to checkpoint step %d", self._bad_streak,
+            self.model._step_count, step)
+        # losses[i] is the loss of step _loss_base + i + 1: truncate the
+        # steps being discarded (index relative to the resume offset)
+        del self.losses[max(step - self._loss_base, 0):]
+        self._restore(step)
+        COUNTERS["rewinds"] += 1
+
+    # ---- stepping ---------------------------------------------------------
+
+    def _deliver_step_faults(self, step_no: int):
+        # range match, not equality: fit's scanned program advances the
+        # step counter scan_steps at a time, and an event landing inside
+        # a chunk must still fire at the next boundary
+        plan = self._fault_plan()
+        lo = min(self._fault_mark, step_no)
+        self._fault_mark = step_no
+        if plan.in_step_range("sigterm", lo, step_no):
+            os.kill(os.getpid(), signal.SIGTERM)
+            # signal delivery is asynchronous; give the interpreter a
+            # moment to run the handler before the boundary check
+            self._preempted.wait(timeout=1.0)
+
+    def after_step(self, nonfinite: Optional[bool] = None) -> bool:
+        """Step-boundary bookkeeping shared by run() and model.fit():
+        divergence streak/rewind, injected + real preemption, periodic
+        checkpointing. Returns True when the caller must stop (a
+        preemption checkpoint was written)."""
+        step_no = self.model._step_count
+        if nonfinite is None and self.poll_nonfinite \
+                and self.model._guard_state is not None:
+            lm = getattr(self.model, "_last_metrics", None) or {}
+            if "nonfinite" in lm:
+                # this fetch blocks on the step's device work — the spot
+                # where a hung collective surfaces on the fit path
+                with self.watchdog.arm(f"step {step_no} guard poll"):
+                    nonfinite = bool(int(np.asarray(lm["nonfinite"])))
+        if nonfinite:
+            self._bad_streak += 1
+            self._skips_counted += 1
+            COUNTERS["steps_skipped"] += 1
+            if self.rewind_after and self._bad_streak >= self.rewind_after:
+                self.rewind()
+                return False
+        elif nonfinite is not None:
+            self._bad_streak = 0
+        self._deliver_step_faults(step_no)
+        if self._preempted.is_set():
+            self.save(reason="preempt")
+            COUNTERS["preempt_stops"] += 1
+            fflogger.warning(
+                "supervisor: preemption notice — checkpointed step %d, "
+                "stopping", self.model._step_count)
+            return True
+        if (self.checkpoint_every
+                and (self._last_saved_step is None
+                     or step_no - self._last_saved_step
+                     >= self.checkpoint_every)):
+            self.save(reason="periodic")
+        return False
+
+    def nan_due(self) -> bool:
+        """Is a nan_loss fault scheduled for the step about to run? Used
+        by both run() and fit() so the injection path is identical."""
+        due = self._fault_plan().at_step("nan_loss",
+                                         self.model._step_count + 1)
+        if due and self.model._guard_state is None:
+            raise RuntimeError(
+                "FF_FAULT nan_loss injection requires the divergence guard "
+                "(set FFConfig.on_nonfinite='skip' or 'backoff' before "
+                "compile())")
+        return due
+
+    def step(self) -> float:
+        """One supervised training step on the next staged batch: injects
+        scheduled NaNs in-graph, arms the watchdog around the blocking
+        loss fetch, records the loss."""
+        model = self.model
+        step_no = model._step_count + 1  # 1-based index of this step
+        inject = self.nan_due()
+        hang = self._fault_plan().at_step("hang", step_no)
+        batch = model._stage_batch()
+        loss, _ = model._run_train_step(batch, inject_nan=inject)
+        with self.watchdog.arm(f"train step {step_no}"):
+            if hang and self.watchdog.timeout_s > 0:
+                # simulate a stuck collective: block well past the
+                # watchdog so its dump+abort path runs
+                time.sleep(self.watchdog.timeout_s * 3)
+            loss_f = float(loss)
+        self.losses.append(loss_f)
+        return loss_f
+
+    def run(self, num_steps: int) -> str:
+        """Supervised loop until ``model._step_count == num_steps``.
+        Resumes from the newest checkpoint first (no-op when fresh).
+        Returns "completed" or "preempted" (after writing the preemption
+        checkpoint — process exit is the caller's call, so tests can
+        resume in-process)."""
+        assert self.model._train_step is not None or \
+            self.model._guard_state is not None, \
+            "compile() with an optimizer first"
+        assert self.model._dataloaders, "attach SingleDataLoader(s) first"
+        self.install()
+        try:
+            if self._resumed is None:
+                self.resume()
+            while self.model._step_count < num_steps:
+                self.step()
+                if self.after_step():
+                    return "preempted"
+            self.save(reason="final")
+            return "completed"
+        finally:
+            self.close()
+
+    def finalize(self):
+        """End-of-fit hook: final checkpoint (checkpoint_dir being set IS
+        the request to persist — checkpoint_every == 0 just means no
+        periodic saves in between, matching run()'s final save), skip-
+        counter reconciliation, handler restore, counter report."""
+        try:
+            # after a watchdog abort the runtime is wedged — a final save
+            # would block forever on the same hung device work (and the
+            # hard-exit backstop is gone once the interrupt is serviced);
+            # the last periodic/preempt checkpoint stands instead
+            if not self.watchdog.fired:
+                self.save(reason="final")
+        finally:
+            self.close()
+        gs = getattr(self.model, "_guard_state", None)
+        if gs is not None:
+            # when per-step polling was off (async fit), the device-side
+            # skip counter still has the truth — fold in what the host
+            # didn't observe
+            skipped = int(np.asarray(gs["skipped"]))
+            if skipped > self._skips_counted:
+                COUNTERS["steps_skipped"] += skipped - self._skips_counted
+                self._skips_counted = skipped
+        snap = {k: v for k, v in COUNTERS.items() if v}
+        if snap:
+            fflogger.info("resilience counters: %s", snap)

@@ -29,6 +29,16 @@ from flexflow_tpu.ops.base import InputOp, Op
 _SIGNATURE_CACHE: Dict[Tuple, float] = {}
 
 
+class MeasuredTable(dict):
+    """The cost table measure_op_costs returns: a plain {key: seconds}
+    dict (drop-in for every CostModel consumer) that also records how
+    many DISTINCT signatures back its keys — twins share one timing, so
+    len(table) >= signatures_timed. scripts/northstar_search.py reports
+    both for cost-table provenance."""
+
+    signatures_timed: int = 0
+
+
 def shard_shape(dims, axis_map, mesh_shape) -> Tuple[int, ...]:
     """Per-shard shape of a tensor partitioned by axis_map."""
     out = list(dims)
@@ -322,6 +332,7 @@ def measure_op_costs(model, mesh_shape: Dict[str, int],
     (~tens of seconds), and an unbounded branchy graph (InceptionV3:
     hundreds of signatures) cannot finish a bounded session otherwise.
     The drop is logged, never silent."""
+    from flexflow_tpu.parallel.pconfig import CONTRACT, STAGE
     from flexflow_tpu.search.driver import legal_axis_maps
 
     work = []  # (est_flops, op, key, in_shapes, w_shapes)
@@ -361,15 +372,26 @@ def measure_op_costs(model, mesh_shape: Dict[str, int],
             full_vol = max(float(np.prod(op.outputs[0].dims)), 1.0)
             shard_vol = max(float(np.prod(
                 shard_shape(op.outputs[0].dims, am, mesh_shape))), 1.0)
+            # CONTRACT/STAGE axes shard the weights/inputs, not the output
+            # (choice_key appends their degrees for exactly this reason) —
+            # the output-volume ratio alone would price a row-parallel or
+            # staged shard at the FULL op's FLOPs, overestimating
+            # contracted ops in the impact ordering and in the
+            # "% FLOP mass measured" budget log
+            wdeg = 1
+            for ax, d in (am or {}).items():
+                if d in (CONTRACT, STAGE):
+                    wdeg *= mesh_shape.get(ax, 1)
             try:
-                est = float(op.flops()) * (shard_vol / full_vol)
+                est = float(op.flops()) * (shard_vol / full_vol) / wdeg
             except Exception:
-                est = shard_vol
+                est = shard_vol / wdeg
             work.append((est, op, key, in_shapes, w_shapes))
     # big shards first; same-signature keys dedup through _SIGNATURE_CACHE,
     # so later duplicates are free regardless of order
     work.sort(key=lambda t: -t[0])
-    measured: Dict = {}
+    measured: Dict = MeasuredTable()
+    sigs = set()  # distinct signatures behind this table's keys
     n_timed = 0
     stopped_at = None
     t0 = time.perf_counter()
@@ -381,6 +403,7 @@ def measure_op_costs(model, mesh_shape: Dict[str, int],
         dt = measure_one(op, in_shapes, w_shapes, iters=iters)
         if dt is not None:
             measured[key] = dt
+            sigs.add(_op_signature(op, in_shapes, w_shapes))
             n_timed += 1
             if verbose:
                 print(f"[measure] {op.name} {key[1:]}: "
@@ -394,10 +417,11 @@ def measure_op_costs(model, mesh_shape: Dict[str, int],
         # priced inconsistently in one table would skew the MCMC ranking
         n_swept = 0
         for est, op, key, in_shapes, w_shapes in work[stopped_at:]:
-            hit = _SIGNATURE_CACHE.get(_op_signature(op, in_shapes,
-                                                     w_shapes))
+            sig = _op_signature(op, in_shapes, w_shapes)
+            hit = _SIGNATURE_CACHE.get(sig)
             if isinstance(hit, float):
                 measured[key] = hit
+                sigs.add(sig)
                 n_swept += 1
         est_total = sum(w[0] for w in work) or 1.0
         est_done = sum(w[0] for w in work[:stopped_at])
@@ -409,9 +433,10 @@ def measure_op_costs(model, mesh_shape: Dict[str, int],
             time_budget_s, stopped_at, len(work),
             100.0 * est_done / est_total, n_swept,
             len(work) - stopped_at - n_swept)
+    measured.signatures_timed = len(sigs)
     if verbose:
         print(f"[measure] {n_timed} entries, "
-              f"{len(_SIGNATURE_CACHE)} unique signatures timed")
+              f"{measured.signatures_timed} distinct signatures")
     return measured
 
 

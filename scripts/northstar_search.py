@@ -289,11 +289,18 @@ def run_one(name: str, budget: int, seed: int = 0, verbose: bool = True,
         "budget": budget,
         "table_build_s": round(build_s, 1),
         "search_s": round(search_s, 1),
-        # provenance of the cost table: how many signatures carry real
-        # timings vs analytic fallback (measured is None on the pure
-        # analytic tier)
-        "measured_signatures": (len(measured)
-                                if measured is not None else None),
+        # provenance of the cost table (measured is None on the pure
+        # analytic tier): measured_entries counts cost-table keys
+        # (op + sharding choices); measured_signatures counts DISTINCT
+        # timed signatures (MeasuredTable.signatures_timed) — twins fill
+        # from _SIGNATURE_CACHE and share one timing, so entries >=
+        # signatures. The analyze tier has no signature dedup: every
+        # entry is its own compile, so the counts coincide there.
+        "measured_entries": (len(measured)
+                             if measured is not None else None),
+        "measured_signatures": (
+            getattr(measured, "signatures_timed", len(measured))
+            if measured is not None else None),
     }
     if verbose:
         print(json.dumps(result), flush=True)
