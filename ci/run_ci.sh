@@ -12,8 +12,11 @@
 #   resilience — fault-injection tests (FF_FAULT: kill-and-resume, NaN
 #               skip/rewind, IO retry) + a 2-process multihost resume
 #               smoke when the jax build has gloo CPU collectives
+#   serving   — continuous-batching engine tests + a 200-request CPU
+#               smoke with FF_FAULT=nan_loss injection (a poisoned
+#               request must retire without stalling the batch)
 #
-# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|all]
+# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|all]
 set -e
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -90,6 +93,16 @@ jax.config.update('jax_cpu_collectives_implementation', 'gloo')" \
   fi
 }
 
+# serving tier: the continuous-batching test file (token-identity vs
+# sequential decode, bitwise paged-vs-dense attention, early-exit parity,
+# recompile-counter flatness), then the 200-request smoke with an
+# injected nan_loss fault — request 37 is poisoned in-graph and must be
+# retired as failed while the other 199 complete (no batch stall).
+run_serving() {
+  python -m pytest tests/test_serving.py -q
+  FF_FAULT="nan_loss@serve:37" python scripts/serve_smoke.py 200
+}
+
 case "$TIER" in
   unit)     run_unit ;;
   sweep)    run_sweep ;;
@@ -98,7 +111,8 @@ case "$TIER" in
   docs)     run_docs ;;
   lint)     run_lint ;;
   resilience) run_resilience ;;
-  all)      run_lint; run_unit; run_resilience; run_native; run_docs; run_sweep ;;
+  serving)  run_serving ;;
+  all)      run_lint; run_unit; run_resilience; run_serving; run_native; run_docs; run_sweep ;;
   *) echo "unknown tier $TIER"; exit 2 ;;
 esac
 echo "ci($TIER): PASSED"

@@ -29,6 +29,8 @@ PAGES = [("index", os.path.join(ROOT, "README.md"), "Overview"),
           "Migration from FlexFlow"),
          ("resilience", os.path.join(DOCS, "resilience.md"),
           "Fault tolerance"),
+         ("serving", os.path.join(DOCS, "serving.md"),
+          "Serving (continuous batching)"),
          ("analysis", os.path.join(DOCS, "analysis.md"),
           "fflint static analysis"),
          ("install", os.path.join(ROOT, "INSTALL.md"), "Install")]

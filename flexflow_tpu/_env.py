@@ -58,6 +58,72 @@ def force_cpu_devices(n: int) -> bool:
         return False
 
 
+def enable_sharding_invariant_rng() -> None:
+    """Force partitionable threefry, making every `jax.random` draw a pure
+    function of (key, shape) independent of the out_sharding it is jitted
+    under. On jax <= 0.4.x the default (False) generates DIFFERENT bits
+    when GSPMD partitions dim 0 of the draw — so a CONTRACT/FSDP-sharded
+    weight initialized via `jit(init, out_shardings=...)` silently started
+    from different values than its replicated twin (the root cause of the
+    long-standing test_contract_tp / test_fsdp "numerics drift": the drift
+    was in the INIT, not the psum). Newer jax flipped the default to True;
+    setting it is then a no-op. Tracing-time flag: safe after backend init."""
+    import jax
+
+    try:
+        jax.config.update("jax_threefry_partitionable", True)
+    except Exception:  # future jax: flag removed once True is the only impl
+        pass
+
+
+def enable_compilation_cache(cache_dir: str) -> bool:
+    """Point jax's persistent compilation cache at `cache_dir` (created if
+    missing) so repeated runs skip recompiles; returns False (with the
+    reason logged) when this jax build lacks the option. Must run before
+    the first trace to cover it — FFModel.compile() and the launcher both
+    call this from FFConfig.compilation_cache_dir."""
+    import os
+
+    import jax
+
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # low threshold: serving programs on CPU compile in 0.1-1 s and
+        # they are exactly the recompiles the cache exists to absorb
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        try:
+            # jax latches a cache-unused decision at the FIRST compile of
+            # the process; any jit before this call (graph-build helpers,
+            # warmup probes) would silently disable persistence for good.
+            # reset_cache clears the latch so the next compile re-checks.
+            from jax._src import compilation_cache
+
+            compilation_cache.reset_cache()
+        except Exception:
+            pass
+        return True
+    except Exception as e:  # unsupported build or unwritable dir
+        from flexflow_tpu.logger import fflogger
+
+        fflogger.warning("compilation cache at %s unavailable: %s",
+                         cache_dir, e)
+        return False
+
+
+def compilation_cache_entries(cache_dir: str) -> int:
+    """Number of entries in the persistent compilation cache directory —
+    sampled before/after a compile to log hit (count unchanged) vs miss
+    (new entry written). Zero for a missing dir."""
+    import os
+
+    try:
+        return sum(1 for n in os.listdir(cache_dir)
+                   if not n.startswith("."))
+    except OSError:
+        return 0
+
+
 def lax_axis_size(axis_name) -> int:
     """``jax.lax.axis_size`` with a fallback for jax builds that predate
     it (e.g. 0.4.37): inside shard_map/pmap the static mapped-axis size is

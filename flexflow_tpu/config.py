@@ -141,6 +141,24 @@ class FFConfig:
     loss_scale: float = 1.0  # initial loss scale ("backoff" mode)
     loss_scale_growth_interval: int = 200
 
+    # ---- serving (runtime/serving.py: continuous batching) ----
+    # decode slots in the ONE compiled slot-decode program; the host
+    # scheduler admits/retires requests per slot
+    serve_slots: int = 4
+    # paged KV cache: pool of (kv_pages, kv_page_size, KVH, Dh) blocks
+    # shared by all slots through per-slot page tables. kv_pages = 0
+    # derives 1 + serve_slots * ceil(max_seq_len / kv_page_size)
+    kv_page_size: int = 128
+    kv_pages: int = 0
+    # prompt-length admission buckets (ascending ints); None = powers of
+    # two from 8 — warm prefill programs are reused within a bucket, and
+    # ServingEngine.recompile_count proves it
+    decode_buckets: Optional[List[int]] = None
+    # jax persistent compilation cache directory ("" = off): set before
+    # the first trace (FFModel.compile / launcher) so repeated runs skip
+    # recompiles; serving logs hit/miss per program build
+    compilation_cache_dir: str = ""
+
     # populated at FFModel construction
     strategies: Dict[str, "ParallelConfig"] = dataclasses.field(default_factory=dict)
 
@@ -172,6 +190,19 @@ class FFConfig:
             raise ValueError(
                 f"loss_scale_growth_interval="
                 f"{self.loss_scale_growth_interval}: must be >= 1")
+        if self.serve_slots < 1 or self.kv_page_size < 1 \
+                or self.kv_pages < 0:
+            raise ValueError(
+                f"serve_slots={self.serve_slots} (>= 1), "
+                f"kv_page_size={self.kv_page_size} (>= 1), "
+                f"kv_pages={self.kv_pages} (>= 0, 0 = derive)")
+        if self.decode_buckets is not None:
+            bs = list(self.decode_buckets)
+            if not bs or any(int(b) < 1 for b in bs) \
+                    or sorted(set(int(b) for b in bs)) != [int(b) for b in bs]:
+                raise ValueError(
+                    f"decode_buckets={self.decode_buckets!r}: must be a "
+                    f"strictly ascending list of positive ints")
         for field in ("compute_dtype", "master_dtype"):
             v = getattr(self, field)
             if v not in ("float32", "bfloat16"):

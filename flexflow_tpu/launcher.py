@@ -91,6 +91,15 @@ def main(argv=None):
 
         _retried_initialize(jax)()
 
+    cache_dir = os.environ.get("FF_COMPILATION_CACHE_DIR", "")
+    if cache_dir:
+        # persistent compilation cache for the launched script: enabled
+        # HERE, before the script's first trace, so even programs built
+        # ahead of FFModel.compile() (warmup probes, custom jits) hit it
+        from flexflow_tpu._env import enable_compilation_cache
+
+        enable_compilation_cache(cache_dir)
+
     sys.argv = [args.script] + rest
     runpy.run_path(args.script, run_name="__main__")
 

@@ -451,6 +451,19 @@ class FFModel:
         self.loss_type = loss_type_from_name(loss_type)
         self.metric_types = metrics_from_names(metrics)
         self.comp_mode = comp_mode
+        if cfg.compilation_cache_dir:
+            # persistent compilation cache: must be on BEFORE the first
+            # trace so the train/serve programs are covered; repeated runs
+            # then load executables instead of recompiling
+            from flexflow_tpu._env import (compilation_cache_entries,
+                                           enable_compilation_cache)
+            from flexflow_tpu.logger import fflogger
+
+            if enable_compilation_cache(cfg.compilation_cache_dir):
+                fflogger.info(
+                    "persistent compilation cache: %s (%d entries)",
+                    cfg.compilation_cache_dir,
+                    compilation_cache_entries(cfg.compilation_cache_dir))
         self.mesh = make_mesh(cfg.mesh_shape)
 
         if cfg.import_strategy_file:
@@ -1005,7 +1018,7 @@ class FFModel:
                  num_beams: int = 1, length_penalty: float = 0.0,
                  prompt_lengths=None, quantize=None,
                  prefill_chunk: int = 0, return_scores: bool = False,
-                 seed: int = 0):
+                 seed: int = 0, early_exit: bool = False):
         """KV-cache autoregressive decoding for decoder-only LM graphs
         (runtime/generation.py). tokens: (B, S0) int32 prompts; returns
         (B, S0 + max_new_tokens) int32 with generated tokens in columns
@@ -1022,6 +1035,9 @@ class FFModel:
         normalization is length_penalty=1.0). quantize="int8" decodes
         with weight-only int8 (lossy; halves weight HBM traffic vs
         bf16). prefill_chunk=N bounds prefill score memory.
+        early_exit=True decodes through a while_loop that stops once
+        every row has emitted eos — identical tokens to the full-length
+        scan, fewer steps when rows finish early (greedy/sampling only).
 
         Compilation caching: each distinct (sampling config) keeps a
         Generator, and each distinct (max_new_tokens, ragged,
@@ -1057,7 +1073,30 @@ class FFModel:
         return gen(tokens, max_new_tokens, seed=seed,
                    prompt_lengths=prompt_lengths,
                    prefill_chunk=prefill_chunk,
-                   return_scores=return_scores)
+                   return_scores=return_scores, early_exit=early_exit)
+
+    def make_serving_engine(self, **kwargs):
+        """Continuous-batching serving engine (runtime/serving.py): one
+        fixed-shape slot-decode program + a paged KV cache shared by all
+        slots; the host scheduler admits queued prompts into freed slots
+        and retires rows on eos/length. Knobs default to this model's
+        FFConfig (serve_slots, kv_page_size, kv_pages, decode_buckets);
+        kwargs override per engine (see ServingEngine)."""
+        from flexflow_tpu.runtime.serving import ServingEngine
+
+        return ServingEngine(self, **kwargs)
+
+    def serve(self, prompts, max_new_tokens: int = 32, **kwargs):
+        """One-shot continuous-batching serve: run `prompts` (list of 1-D
+        int32 token arrays, any mix of lengths) to completion and return
+        (outputs, stats) — outputs[i] is prompt + generated tokens for
+        prompts[i] (None for a failed request), stats the engine's
+        throughput/latency/occupancy summary. Greedy continuous batching
+        is token-identical to per-request generate()."""
+        eng = self.make_serving_engine(**kwargs)
+        reqs = eng.run(prompts, max_new_tokens=max_new_tokens)
+        outs = [r.output if r.state == "done" else None for r in reqs]
+        return outs, eng.stats()
 
     def generate_seq2seq(self, src_tokens, tgt_prompt=None,
                          max_new_tokens: int = 32, bos_token_id: int = 1,

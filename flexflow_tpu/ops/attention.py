@@ -339,6 +339,84 @@ class MultiHeadAttention(Op):
             qh, ck, cv, live[:, None, None, None, :])
         return self._out_proj(params, ctx), {"k": ck, "v": cv}
 
+    # ---- paged KV cache (runtime/serving.py) ------------------------------
+    #
+    # Continuous-batching serving splits the cache into a POOL of fixed
+    # (page_size, KVH, Dh) blocks shared by every slot; a per-slot page
+    # table maps logical position j to pool page table[j // page_size],
+    # offset j % page_size. Long and short requests then share HBM instead
+    # of every slot preallocating max_len — the serving-side analog of the
+    # partition-don't-pad philosophy the training side applies to sharding.
+
+    def init_paged_cache(self, num_pages: int, page_size: int, dtype):
+        """A pool of `num_pages` KV pages. Page 0 is reserved by the
+        serving engine as a scratch page (inactive slots write there), so
+        callers size num_pages as 1 + worst-case live pages."""
+        return {
+            "k": jnp.zeros((num_pages, page_size, self.num_kv_heads,
+                            self.qk_head_dim), dtype),
+            "v": jnp.zeros((num_pages, page_size, self.num_kv_heads,
+                            self.v_head_dim), dtype),
+        }
+
+    def paged_prefill_write(self, cache, kh, vh, pages):
+        """Scatter a slot's contiguous prefill k/v (1, L, KVH, Dh) into
+        pool pages `pages` ((n_pages,) int32, n_pages = ceil(L /
+        page_size)). The tail of the last page beyond L holds junk; it is
+        either overwritten by decode tokens or masked by the live rule."""
+        page_size = cache["k"].shape[1]
+        n_pages = pages.shape[0]
+        pad = n_pages * page_size - kh.shape[1]
+
+        def put(pool, x):
+            x = x[0].astype(pool.dtype)                     # (L, KVH, Dh)
+            if pad:
+                x = jnp.pad(x, ((0, pad), (0, 0), (0, 0)))
+            return pool.at[pages].set(
+                x.reshape(n_pages, page_size, *x.shape[1:]))
+
+        return {"k": put(cache["k"], kh), "v": put(cache["v"], vh)}
+
+    def paged_decode_forward(self, params, xs, cache, page_table, write_pos,
+                             rope_pos, row_len, prompt_pad):
+        """One continuous-batching decode step over the paged pool.
+
+        xs[0]: (B_slots, 1, D) — each slot's last sampled token embedding
+        path. Per-slot (B,) int32 arrays: `write_pos` the logical cache
+        position this token occupies, `rope_pos` its LOGICAL sequence
+        position (true prompt length + emitted count — bucket padding does
+        not shift RoPE), `row_len` the true prompt length and `prompt_pad`
+        the bucket-padded prompt width. Live rule per slot (identical to
+        decode_forward's ragged rule, per-slot prompt_pad instead of a
+        shared prompt_len): j < row_len  OR  prompt_pad <= j <= write_pos.
+
+        The new token's k/v scatters into the pool at (page_table[b,
+        write_pos // page_size], write_pos % page_size); attention gathers
+        the slot's pages back into logical order — on the einsum path this
+        is bitwise the dense-cache computation (tests/test_serving.py)."""
+        b = xs[0].shape[0]
+        page_size = cache["k"].shape[1]
+        qh, kh, vh = self._project_qkv(params, xs[0], xs[1], xs[2],
+                                       rope_offset=rope_pos)
+        page_ids = jnp.take_along_axis(
+            page_table, (write_pos // page_size)[:, None], axis=1)[:, 0]
+        offs = write_pos % page_size
+        ck = cache["k"].at[page_ids, offs].set(
+            kh[:, 0].astype(cache["k"].dtype))
+        cv = cache["v"].at[page_ids, offs].set(
+            vh[:, 0].astype(cache["v"].dtype))
+        # gather the slot's pages into logical layout (B, L_max, KVH, Dh)
+        max_len = page_table.shape[1] * page_size
+        gk = ck[page_table].reshape(b, max_len, *ck.shape[2:])
+        gv = cv[page_table].reshape(b, max_len, *cv.shape[2:])
+        idx = jnp.arange(max_len)
+        live = (idx[None, :] < row_len[:, None]) \
+            | ((idx[None, :] >= prompt_pad[:, None])
+               & (idx[None, :] <= write_pos[:, None]))
+        ctx = self._grouped_cache_attention(
+            qh, gk, gv, live[:, None, None, None, :])
+        return self._out_proj(params, ctx), {"k": ck, "v": cv}
+
     def _flash_ok(self, qh, kh) -> bool:
         """Use the hand-tiled Pallas flash kernel (ops/pallas_kernels.py) on
         the dense path when the backend runs it natively and the block grid

@@ -219,7 +219,8 @@ class Generator:
 
     def _walk(self, params, state, tokens, caches, pos, last_only=False,
               rope_pos=None, row_lengths=None, prompt_len=None,
-              chunk_start=None, skip_tail=False, gather_last=False):
+              chunk_start=None, skip_tail=False, gather_last=False,
+              paged=None):
         """Interpret the graph on a (B, S) token slab. pos=None means
         prefill (positions 0..S-1, fills cache); otherwise S == 1 and pos
         is the traced cache slot of the token. last_only=True narrows the
@@ -279,7 +280,15 @@ class Generator:
             with jax.named_scope(op.name):
                 if isinstance(op, MultiHeadAttention):
                     cache = caches[op.name]
-                    if pos is None:
+                    if paged is not None:
+                        # continuous-batching slot decode over the paged
+                        # pool (runtime/serving.py): per-slot positions,
+                        # page-table gather instead of a contiguous cache
+                        out, nc = op.paged_decode_forward(
+                            p, xs, cache, paged["page_table"],
+                            paged["write_pos"], paged["rope_pos"],
+                            paged["row_len"], paged["prompt_pad"])
+                    elif pos is None:
                         if gather_last:
                             # ragged chunked prefill: read-only query of
                             # each row's last prompt position against the
@@ -405,7 +414,8 @@ class Generator:
     # ---- the compiled program ---------------------------------------------
 
     def _build(self, max_new_tokens: int, ragged: bool = False,
-               prefill_chunk: int = 0, with_scores: bool = False):
+               prefill_chunk: int = 0, with_scores: bool = False,
+               early_exit: bool = False):
         cdtype = self._compute_dtype()
 
         def gen(params, state, tokens, key, lengths):
@@ -423,8 +433,9 @@ class Generator:
             if self.eos_id is not None:
                 done = tok == self.eos_id
 
-            def body(carry, i):
-                caches, tok, done, key = carry
+            def step(caches, tok, done, key, i):
+                """Shared decode-step body for the scan and while paths —
+                i is the 0-based index of the NEXT token to produce."""
                 logits, caches = self._walk(
                     params, state, tok[:, None], caches, s0 + i,
                     rope_pos=(row_lengths + i) if ragged else None,
@@ -437,10 +448,48 @@ class Generator:
                     if with_scores:
                         sc = jnp.where(done, 0.0, sc)  # pads score 0
                     done = done | (nxt == self.eos_id)
+                return caches, nxt, sc, done, key
+
+            def body(carry, i):
+                caches, tok, done, key = carry
+                caches, nxt, sc, done, key = step(caches, tok, done, key, i)
                 ys = (nxt, sc) if with_scores else nxt
                 return (caches, nxt, done, key), ys
 
-            if max_new_tokens > 1:
+            if max_new_tokens > 1 and early_exit:
+                # while_loop wrapper: stop as soon as every live row has
+                # emitted eos. Token-identical to the full-length scan —
+                # the skipped iterations would only have appended pads
+                # (which the output buffers are pre-filled with). Costs
+                # one extra (i, buffers) carry vs the scan; wins whenever
+                # rows finish early. No eos_id => done never flips and the
+                # loop runs the full length, same as the scan.
+                buf = jnp.full((b, max_new_tokens), self.pad_id, jnp.int32)
+                buf = buf.at[:, 0].set(tok)
+                sbuf = jnp.zeros((b, max_new_tokens), jnp.float32)
+                if with_scores:
+                    sbuf = sbuf.at[:, 0].set(score)
+
+                def cond(carry):
+                    i = carry[0]
+                    done = carry[4]
+                    return (i < max_new_tokens - 1) & ~jnp.all(done)
+
+                def wbody(carry):
+                    i, caches, tok, (buf, sbuf), done, key = carry
+                    caches, nxt, sc, done, key = step(caches, tok, done,
+                                                      key, i)
+                    buf = buf.at[:, i + 1].set(nxt)
+                    if with_scores:
+                        sbuf = sbuf.at[:, i + 1].set(sc)
+                    return (i + 1, caches, nxt, (buf, sbuf), done, key)
+
+                carry = (jnp.asarray(0, jnp.int32), caches, tok,
+                         (buf, sbuf), done, key)
+                _, _, _, (buf, sbuf), _, _ = jax.lax.while_loop(
+                    cond, wbody, carry)
+                new, scores = buf, sbuf
+            elif max_new_tokens > 1:
                 _, ys = jax.lax.scan(
                     body, (caches, tok, done, key),
                     jnp.arange(max_new_tokens - 1, dtype=jnp.int32))
@@ -615,14 +664,18 @@ class Generator:
 
     def __call__(self, tokens: np.ndarray, max_new_tokens: int,
                  seed: int = 0, prompt_lengths=None,
-                 prefill_chunk: int = 0, return_scores: bool = False):
+                 prefill_chunk: int = 0, return_scores: bool = False,
+                 early_exit: bool = False):
         """tokens (B, S0) int32 prompts -> (B, S0 + max_new_tokens) int32
         with the generated tokens in columns S0 onward. Uniform-length
         prompts by default; `prompt_lengths` (B,) enables ragged RIGHT-
         padded prompts — row b's prompt is tokens[b, :prompt_lengths[b]],
         pad slots are masked out of attention and RoPE continues from each
         row's true length. `prefill_chunk` > 0 prefills the prompt in
-        chunks of that many positions (O(chunk * S) score memory)."""
+        chunks of that many positions (O(chunk * S) score memory).
+        `early_exit` swaps the fixed-length decode scan for a while_loop
+        that stops once every row has emitted eos — identical tokens,
+        fewer steps whenever rows finish early."""
         tokens = jnp.asarray(tokens, jnp.int32)
         lengths, ragged = self._check_lengths(tokens, prompt_lengths)
         if prefill_chunk < 0:
@@ -631,10 +684,10 @@ class Generator:
         # prompt shape in the key: see beam_search — makes LRU eviction
         # actually bound compiled executables, not just jit wrappers
         cache_key = (max_new_tokens, ragged, prefill_chunk, return_scores,
-                     tuple(tokens.shape))
+                     early_exit, tuple(tokens.shape))
         fn = self._cached_program(cache_key, lambda: self._build(
             max_new_tokens, ragged, prefill_chunk,
-            with_scores=return_scores))
+            with_scores=return_scores, early_exit=early_exit))
         key = jax.random.PRNGKey(seed)
         res = fn(self._params(), self.model.bn_state, tokens, key, lengths)
         if return_scores:

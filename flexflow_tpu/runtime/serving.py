@@ -1,0 +1,502 @@
+"""Continuous-batching serving runtime: slot decode over a paged KV cache.
+
+The reference's only inference story is the training graph run forward-only
+(CompMode::COMP_MODE_INFERENCE); runtime/generation.py added the modern
+one-program KV-cache decode, but as a FIXED batch: finished rows burn full
+decode steps emitting pads, a new request cannot start until the whole
+batch retires, and every (prompt shape, max_new_tokens) pair compiles its
+own program. This module is the serving-side performance subsystem on top
+of it:
+
+  * ONE jitted slot-decode step of fixed shape ``(serve_slots, 1)`` runs
+    for the life of the engine — the compiled program never changes shape,
+    the HOST scheduler moves work in and out of slots (the partition-
+    don't-pad philosophy applied to serving: keep XLA static, move the
+    raggedness to the host).
+  * The KV cache is a POOL of ``(kv_pages, kv_page_size, KVH, Dh)`` blocks
+    with a per-slot page table (ops/attention.py paged_decode_forward):
+    long and short requests share HBM instead of every slot preallocating
+    ``max_seq_len``. Pages are allocated at admission and freed at
+    retirement; page 0 is a scratch page inactive slots harmlessly write.
+  * Admission prefills the prompt into the slot's pages through the
+    EXISTING prefill path (Generator._prefill, chunked via chunk_forward
+    when ``prefill_chunk`` is set) on a contiguous per-request cache, then
+    scatters that k/v into the pool — prefill numerics are therefore
+    identical to batch generate's, and greedy continuous batching is
+    token-identical to per-request Generator.generate
+    (tests/test_serving.py).
+  * Prompt lengths are rounded up to SHAPE BUCKETS (powers of two by
+    default, ``decode_buckets`` to pin explicit boundaries) so warm
+    prefill programs are reused across mixed lengths; ``recompile_count``
+    exposes every program build, and after bucket warmup it stays flat.
+  * Every compiled program returns a per-slot finiteness flag computed
+    in-graph; a request whose logits go non-finite (e.g. FF_FAULT
+    ``nan_loss@serve:<n>`` poisons the n-th admitted request) is retired
+    as ``failed`` without stalling the other slots — serving inherits the
+    fault-injection story of runtime/faultinject.py.
+
+Per-slot cache layout (identical to the ragged rule of
+MultiHeadAttention.decode_forward, with a per-slot prompt pad width):
+logical positions ``[0, row_len)`` hold the true prompt, ``[row_len,
+prompt_pad)`` hold masked bucket-pad garbage, decode tokens append from
+``prompt_pad``; RoPE positions stay LOGICAL (``row_len + emitted``).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flexflow_tpu._env import compilation_cache_entries
+from flexflow_tpu.logger import fflogger
+from flexflow_tpu.runtime import faultinject
+from flexflow_tpu.runtime.generation import Generator
+
+
+@dataclass
+class Request:
+    """One serving request and its full lifecycle record."""
+
+    rid: int
+    prompt: np.ndarray              # (S,) int32, true (unpadded) prompt
+    max_new_tokens: int
+    state: str = "queued"           # queued | running | done | failed
+    tokens: List[int] = field(default_factory=list)  # emitted tokens
+    slot: int = -1
+    bucket: int = 0
+    pages: List[int] = field(default_factory=list)
+    t_submit: float = 0.0
+    ttft: float = 0.0               # submit -> first emitted token (s)
+    t_done: float = 0.0
+    error: str = ""
+
+    @property
+    def output(self) -> np.ndarray:
+        """prompt + emitted tokens, the shape generate() would return
+        for this request alone (minus trailing pads it never emitted)."""
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)])
+
+
+def _pow2_bucket(n: int, lo: int = 8) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class ServingEngine:
+    """Continuous-batching engine over a compiled FFModel decoder LM.
+
+    Build once (after model.compile()); ``submit()`` requests and drive
+    ``step()`` yourself, or hand ``run()`` a list of prompts. Construction
+    knobs default to the model's FFConfig (serve_slots, kv_page_size,
+    kv_pages, decode_buckets)."""
+
+    def __init__(self, model, serve_slots: Optional[int] = None,
+                 kv_page_size: Optional[int] = None,
+                 kv_pages: Optional[int] = None,
+                 decode_buckets: Optional[List[int]] = None,
+                 max_seq_len: int = 1024, temperature: float = 0.0,
+                 top_k: int = 0, eos_id: Optional[int] = None,
+                 pad_id: int = 0, prefill_chunk: int = 0,
+                 decode_chunk: int = 8,
+                 quantize: Optional[str] = None, seed: int = 0):
+        cfg = model.config
+        self.model = model
+        self.slots = int(serve_slots or getattr(cfg, "serve_slots", 4))
+        # decode steps per device dispatch (an in-graph lax.scan): host
+        # round-trips amortize over the chunk — the per-token dispatch of
+        # chunk=1 dominates small-model decode. Retirement granularity
+        # coarsens to the chunk; tokens a slot computes past its own
+        # eos/length are truncated by the host, so outputs are identical
+        # at any chunk (tests/test_serving.py). Waste is bounded by
+        # chunk-1 steps per retirement, idle-slot time by chunk-1 per
+        # admission — keep it well under typical max_new_tokens.
+        self.decode_chunk = max(1, int(decode_chunk))
+        self.page_size = int(kv_page_size
+                             or getattr(cfg, "kv_page_size", 128))
+        buckets = (decode_buckets
+                   if decode_buckets is not None
+                   else getattr(cfg, "decode_buckets", None))
+        self.buckets = sorted(int(b) for b in buckets) if buckets else None
+        self.max_seq_len = int(max_seq_len)
+        self.prefill_chunk = int(prefill_chunk)
+        if self.slots < 1 or self.page_size < 1 or self.max_seq_len < 2:
+            raise ValueError(
+                f"serve_slots={self.slots}, kv_page_size={self.page_size},"
+                f" max_seq_len={self.max_seq_len}: all must be positive "
+                f"(max_seq_len >= 2)")
+        self.pages_per_slot = math.ceil(self.max_seq_len / self.page_size)
+        want_pages = 1 + self.slots * self.pages_per_slot  # +1: scratch
+        self.num_pages = int(kv_pages or getattr(cfg, "kv_pages", 0)
+                             or want_pages)
+        if self.num_pages < 1 + self.pages_per_slot:
+            raise ValueError(
+                f"kv_pages={self.num_pages} cannot hold even one "
+                f"max_seq_len={self.max_seq_len} request "
+                f"(needs {1 + self.pages_per_slot} incl. scratch page 0)")
+
+        # Generator supplies graph validation, the graph walk, prefill and
+        # sampling — serving adds scheduling + the paged pool around them
+        self.gen = Generator(model, temperature=temperature, top_k=top_k,
+                             eos_id=eos_id, pad_id=pad_id, quantize=quantize)
+        self.eos_id = eos_id
+        self.pad_id = pad_id
+        cdtype = self.gen._compute_dtype()
+        # the pool is COMMITTED (replicated on the model's mesh) up front:
+        # an uncommitted fresh pool has a different pjit signature
+        # (UnspecifiedValue) than the committed arrays every program
+        # RETURNS, so the second call to each warm program would silently
+        # retrace and recompile it — a ~0.5 s stall in the serving loop
+        # that the recompile counter could not see
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        repl = NamedSharding(model.mesh, PartitionSpec(None, None, None,
+                                                       None))
+        self.pool = {
+            op.name: jax.tree.map(
+                lambda a: jax.device_put(a, repl),
+                op.init_paged_cache(self.num_pages, self.page_size,
+                                    cdtype))
+            for op in self.gen.attn_ops}
+        self._free_pages = list(range(self.num_pages - 1, 0, -1))
+
+        # per-slot scheduler state (host side, shipped to device each step)
+        n = self.slots
+        self.page_tables = np.zeros((n, self.pages_per_slot), np.int32)
+        self.row_len = np.zeros((n,), np.int32)
+        self.prompt_pad = np.zeros((n,), np.int32)
+        self.emitted = np.zeros((n,), np.int32)
+        self.last_tok = np.zeros((n,), np.int32)
+        self.active = np.zeros((n,), bool)
+        self.poison = np.zeros((n,), np.float32)
+        self.slot_req: List[Optional[Request]] = [None] * n
+
+        self._queue: List[Request] = []
+        self._programs: Dict = {}
+        self._key = jax.random.PRNGKey(seed)
+        self._next_rid = 0
+        self.recompile_count = 0
+        self.decode_steps = 0
+        self._occupancy_sum = 0
+        # aggregate counters instead of retaining every Request: a
+        # long-lived engine must not grow memory with total traffic.
+        # Retired Request objects are dropped (callers keep their own
+        # handles — submit()/run() return them); TTFT percentiles come
+        # from a bounded window of recent completions
+        self._submitted = 0
+        self._completed = 0
+        self._failed = 0
+        self._tokens_emitted = 0
+        import collections
+
+        self._ttfts = collections.deque(maxlen=4096)
+
+    # ---- request lifecycle --------------------------------------------------
+
+    def _bucket(self, prompt_len: int) -> int:
+        if self.buckets:
+            for b in self.buckets:
+                if b >= prompt_len:
+                    return b
+            raise ValueError(
+                f"prompt length {prompt_len} exceeds the largest decode "
+                f"bucket {self.buckets[-1]}")
+        return _pow2_bucket(prompt_len)
+
+    def submit(self, prompt, max_new_tokens: int) -> Request:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens={max_new_tokens}: must be >= 1")
+        bucket = self._bucket(prompt.size)
+        if bucket + max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"bucketed prompt ({bucket}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_seq_len {self.max_seq_len}")
+        req = Request(rid=self._next_rid, prompt=prompt,
+                      max_new_tokens=int(max_new_tokens), bucket=bucket,
+                      t_submit=time.perf_counter())
+        self._next_rid += 1
+        self._submitted += 1
+        self._queue.append(req)
+        return req
+
+    def pending(self) -> bool:
+        return bool(self._queue) or bool(self.active.any())
+
+    def _retire(self, slot: int, state: str, error: str = ""):
+        req = self.slot_req[slot]
+        req.state = state
+        req.error = error
+        req.t_done = time.perf_counter()
+        if state == "done":
+            self._completed += 1
+        else:
+            self._failed += 1
+        if req.ttft:
+            self._ttfts.append(req.ttft)
+        self._free_pages.extend(req.pages)
+        req.slot = -1
+        self.slot_req[slot] = None
+        self.active[slot] = False
+        self.poison[slot] = 0.0
+        self.page_tables[slot, :] = 0   # scratch page: dead writes land there
+        self.row_len[slot] = 0
+        self.prompt_pad[slot] = 0
+        self.emitted[slot] = 0
+
+    def _record_token(self, slot: int, tok: int, ok: bool):
+        """Append a sampled token to the slot's request and retire on
+        non-finite logits, eos, or length — shared by prefill/decode."""
+        req = self.slot_req[slot]
+        if not ok:
+            self._retire(slot, "failed", "non-finite logits")
+            return
+        req.tokens.append(int(tok))
+        self._tokens_emitted += 1
+        if not req.ttft:
+            req.ttft = time.perf_counter() - req.t_submit
+        self.emitted[slot] += 1
+        self.last_tok[slot] = tok
+        if (self.eos_id is not None and tok == self.eos_id) \
+                or len(req.tokens) >= req.max_new_tokens:
+            self._retire(slot, "done")
+
+    # ---- compiled programs --------------------------------------------------
+
+    def _compiled_call(self, key, build, *args):
+        """Program-cache lookup; a miss builds + runs the program and
+        bumps recompile_count, logging whether jax's persistent
+        compilation cache (FFConfig.compilation_cache_dir) absorbed the
+        compile. Every shape-affecting datum is part of `key`, so this
+        counter is exactly the number of XLA compiles the engine caused."""
+        fn = self._programs.get(key)
+        if fn is not None:
+            return fn(*args)
+        fn = self._programs[key] = build()
+        self.recompile_count += 1
+        cache_dir = getattr(self.model.config, "compilation_cache_dir", "")
+        before = compilation_cache_entries(cache_dir) if cache_dir else 0
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        if cache_dir:
+            grew = compilation_cache_entries(cache_dir) - before
+            fflogger.info(
+                "serving: compiled %r in %.2fs — persistent cache %s",
+                key, dt, f"MISS (+{grew} entries)" if grew > 0 else "HIT")
+        else:
+            fflogger.info("serving: compiled %r in %.2fs", key, dt)
+        return out
+
+    def _build_prefill(self, bucket: int, n_pages: int):
+        gen = self.gen
+        cdtype = gen._compute_dtype()
+
+        def prefill(params, state, tokens, length, pool, pages, poison,
+                    key):
+            caches = {op.name: op.init_cache(1, bucket, cdtype)
+                      for op in gen.attn_ops}
+            logits, caches = gen._prefill(params, state, tokens, caches,
+                                          length, self.prefill_chunk)
+            logits = logits[:, -1] + poison            # (1, V)
+            ok = jnp.isfinite(logits).all(axis=-1)
+            tok, _ = gen._sample(logits, key)
+            new_pool = {
+                op.name: op.paged_prefill_write(
+                    pool[op.name], caches[op.name]["k"],
+                    caches[op.name]["v"], pages)
+                for op in gen.attn_ops}
+            return tok, ok, new_pool
+
+        return jax.jit(prefill, donate_argnums=(4,))
+
+    def _build_decode(self, n_steps: int):
+        gen = self.gen
+
+        def decode(params, state, pool, page_table, last_tok, write_pos0,
+                   rope_pos0, row_len, prompt_pad, budget, poison, key):
+            """`n_steps` slot-decode steps as ONE in-graph scan. Past a
+            slot's own budget (prompt_pad + its max_new_tokens) the write
+            position and RoPE clamp to the final allocated slot — those
+            steps only produce tokens the host truncates, and the
+            repeated overwrite stays inside the slot's own pages."""
+            rope_cap = budget - prompt_pad + row_len - 1
+
+            def body(carry, i):
+                pool, tok, key = carry
+                paged = {
+                    "page_table": page_table,
+                    "write_pos": jnp.minimum(write_pos0 + i, budget - 1),
+                    "rope_pos": jnp.minimum(rope_pos0 + i, rope_cap),
+                    "row_len": row_len, "prompt_pad": prompt_pad}
+                logits, pool = gen._walk(params, state, tok[:, None],
+                                         pool, None, paged=paged)
+                logits = logits[:, 0] + poison[:, None]  # (B_slots, V)
+                ok = jnp.isfinite(logits).all(axis=-1)
+                key, sub = jax.random.split(key)
+                nxt, _ = gen._sample(logits, sub)
+                return (pool, nxt, key), (nxt, ok)
+
+            (pool, _, _), (toks, oks) = jax.lax.scan(
+                body, (pool, last_tok, key),
+                jnp.arange(n_steps, dtype=jnp.int32))
+            return toks, oks, pool                     # (n_steps, B_slots)
+
+        return jax.jit(decode, donate_argnums=(2,))
+
+    def _split_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # ---- the scheduler loop -------------------------------------------------
+
+    def _admit(self):
+        """Move queued requests into free slots: allocate pages, prefill
+        the prompt (bucket-shaped program) into them, seed the slot."""
+        while self._queue:
+            try:
+                slot = next(i for i in range(self.slots)
+                            if not self.active[i])
+            except StopIteration:
+                return
+            req = self._queue[0]
+            total = req.bucket + req.max_new_tokens
+            n_total = math.ceil(total / self.page_size)
+            if len(self._free_pages) < n_total:
+                # HBM pressure: wait for a retirement to free pages. Head-
+                # of-line blocking is deliberate — FIFO admission keeps
+                # TTFT fairness; submit() already guarantees the request
+                # fits an EMPTY pool, so progress is always possible.
+                return
+            self._queue.pop(0)
+            req.pages = [self._free_pages.pop() for _ in range(n_total)]
+            req.slot = slot
+            req.state = "running"
+            self.slot_req[slot] = req
+
+            n_prefill = math.ceil(req.bucket / self.page_size)
+            padded = np.full((1, req.bucket), self.pad_id, np.int32)
+            padded[0, :req.prompt.size] = req.prompt
+            # fault injection: FF_FAULT=nan_loss@serve:<n> poisons the
+            # n-th ADMITTED request in-graph (NaN added to its logits), so
+            # the detect-and-retire path runs end to end, not a host stub
+            if faultinject.active_plan().fire("nan_loss", "serve"):
+                self.poison[slot] = np.float32(np.nan)
+            table = np.zeros((self.pages_per_slot,), np.int32)
+            table[:n_total] = req.pages
+            self.page_tables[slot] = table
+            self.row_len[slot] = req.prompt.size
+            self.prompt_pad[slot] = req.bucket
+            self.emitted[slot] = 0
+
+            tok, ok, self.pool = self._compiled_call(
+                ("prefill", req.bucket, n_prefill, self.prefill_chunk),
+                lambda: self._build_prefill(req.bucket, n_prefill),
+                self.gen._params(), self.model.bn_state, padded,
+                np.asarray([req.prompt.size], np.int32), self.pool,
+                np.asarray(req.pages[:n_prefill], np.int32),
+                np.float32(self.poison[slot]), self._split_key())
+            self.active[slot] = True
+            self._record_token(slot, int(np.asarray(tok)[0]),
+                               bool(np.asarray(ok)[0]))
+
+    def _decode_step(self):
+        k = self.decode_chunk
+        write_pos = self.prompt_pad + self.emitted - 1
+        rope_pos = self.row_len + self.emitted - 1
+        # inactive slots: state arrays are zeroed, so write_pos = -1 would
+        # index page -1; clamp to 0 — the write lands in scratch page 0
+        write_pos = np.maximum(write_pos, 0).astype(np.int32)
+        rope_pos = np.maximum(rope_pos, 0).astype(np.int32)
+        # per-slot decode budget: last legal write position + 1. Inactive
+        # slots get 1, clamping their scratch writes to position 0
+        budget = np.ones((self.slots,), np.int32)
+        for slot in range(self.slots):
+            req = self.slot_req[slot]
+            if req is not None:
+                budget[slot] = req.bucket + req.max_new_tokens
+        toks, oks, self.pool = self._compiled_call(
+            ("decode", k), lambda: self._build_decode(k),
+            self.gen._params(), self.model.bn_state, self.pool,
+            self.page_tables, self.last_tok, write_pos, rope_pos,
+            self.row_len, self.prompt_pad, budget, self.poison,
+            self._split_key())
+        toks = np.asarray(toks)                        # (k, B_slots)
+        oks = np.asarray(oks)
+        self.decode_steps += k
+        for slot in range(self.slots):
+            for t in range(k):
+                if not self.active[slot]:
+                    break  # retired mid-chunk: later tokens are truncated
+                # occupancy counts USEFUL slot-steps only — a slot that
+                # retires mid-chunk stops counting, so the metric is not
+                # inflated by the truncated past-retirement steps
+                self._occupancy_sum += 1
+                self._record_token(slot, int(toks[t, slot]),
+                                   bool(oks[t, slot]))
+
+    def step(self) -> bool:
+        """One scheduler tick: admit what fits, then one slot-decode step
+        if any slot is live. Returns whether work remains."""
+        self._admit()
+        if self.active.any():
+            self._decode_step()
+        return self.pending()
+
+    def run(self, prompts=None, max_new_tokens: int = 32) -> List[Request]:
+        """Submit `prompts` (list of 1-D int32 arrays) and drive the
+        scheduler until the engine is idle; returns THIS call's requests
+        in submission order (with prompts=None: whatever was pending at
+        entry). The engine holds no reference to retired requests."""
+        if prompts is not None:
+            batch = [self.submit(p, max_new_tokens) for p in prompts]
+        else:
+            batch = [r for r in self.slot_req if r is not None] \
+                + list(self._queue)
+        while self.step():
+            pass
+        return batch
+
+    # ---- metrics ------------------------------------------------------------
+
+    def stats(self) -> Dict:
+        ttfts = sorted(self._ttfts)  # bounded window of completions
+
+        def pct(p):
+            if not ttfts:
+                return 0.0
+            return ttfts[min(len(ttfts) - 1, int(p * len(ttfts)))]
+
+        return {
+            "requests": self._submitted,
+            "completed": self._completed,
+            "failed": self._failed,
+            "tokens_generated": self._tokens_emitted,
+            "decode_steps": self.decode_steps,
+            "recompiles": self.recompile_count,
+            # mean fraction of slots doing USEFUL work per decode step
+            # (mid-chunk retirements stop counting) — the engine's
+            # steady-state utilization headline. occupied_slot_steps is
+            # the raw numerator so callers can compute occupancy over a
+            # WINDOW from two stats() snapshots
+            "occupancy": (self._occupancy_sum
+                          / max(1, self.decode_steps) / self.slots),
+            "occupied_slot_steps": self._occupancy_sum,
+            "ttft_p50_ms": round(pct(0.50) * 1e3, 3),
+            "ttft_p99_ms": round(pct(0.99) * 1e3, 3),
+            "free_pages": len(self._free_pages),
+            "kv_pages": self.num_pages,
+            "kv_page_size": self.page_size,
+            "serve_slots": self.slots,
+        }

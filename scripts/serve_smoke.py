@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""CI serving smoke (ci/run_ci.sh `serving` tier): 200 mixed-length
+requests through the continuous-batching engine on CPU, with FF_FAULT
+nan_loss injection poisoning one request mid-stream — the poisoned
+request must retire as `failed` while every other request completes,
+proving a bad request can never stall the batch. Also asserts the
+recompile counter stays flat after bucket warmup.
+
+Usage: [FF_FAULT=nan_loss@serve:37] python scripts/serve_smoke.py [N]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flexflow_tpu._env import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(1)
+
+import numpy as np  # noqa: E402
+
+from flexflow_tpu import FFConfig, FFModel  # noqa: E402
+from flexflow_tpu.models.llama import llama_lm  # noqa: E402
+
+
+def main():
+    n_requests = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+    vocab = 128
+    cfg = FFConfig(batch_size=2, mesh_shape={"data": 1}, serve_slots=4,
+                   kv_page_size=8)
+    ff = FFModel(cfg)
+    _, logits = llama_lm(ff, 2, seq_len=16, hidden=64, layers=1, heads=4,
+                         kv_heads=2, vocab_size=vocab)
+    ff.compile(final_tensor=logits)
+
+    rs = np.random.RandomState(0)
+    lens = [int(rs.randint(3, 25)) for _ in range(n_requests)]
+    prompts = [rs.randint(1, vocab, (n,)).astype(np.int32) for n in lens]
+
+    eng = ff.make_serving_engine(max_seq_len=64)
+    # warmup: one request per bucket the lengths can hit (8, 16, 32).
+    # Warmup admissions CONSUME FF_FAULT serve occurrences, so the fault
+    # index in ci/run_ci.sh must exceed N_WARM — asserted below, loudly,
+    # instead of leaving the coupling implicit
+    warm_prompts = [rs.randint(1, vocab, (n,)).astype(np.int32)
+                    for n in (8, 16, 24)]
+    eng.run(warm_prompts, max_new_tokens=4)
+    n_warm = len(warm_prompts)
+    warm = eng.recompile_count
+
+    t0 = time.perf_counter()
+    reqs = eng.run(prompts, max_new_tokens=4)  # this call's requests only
+    dt = time.perf_counter() - t0
+    st = eng.stats()
+
+    fault = os.environ.get("FF_FAULT", "")
+    failed = [r for r in reqs if r.state == "failed"]
+    done = [r for r in reqs if r.state == "done"]
+    print(f"serve_smoke: {len(done)} done, {len(failed)} failed of "
+          f"{n_requests} in {dt:.1f}s "
+          f"({st['tokens_generated'] / dt:.0f} tok/s incl. warmup tokens), "
+          f"occupancy {st['occupancy']:.2f}, "
+          f"recompiles after warmup {eng.recompile_count - warm}")
+
+    assert len(done) + len(failed) == n_requests, "requests lost"
+    assert eng.recompile_count == warm, (
+        f"recompile leak: {eng.recompile_count - warm} programs built "
+        f"after bucket warmup")
+    if "nan_loss@serve" in fault:
+        # the FF_FAULT occurrence index is 1-based over ADMITTED requests
+        # (warmup included): occurrence k poisons measured request
+        # k - n_warm - 1 (0-based). Guard the coupling explicitly.
+        k = int(fault.split("nan_loss@serve:")[1].split(",")[0])
+        assert n_warm < k <= n_warm + n_requests, (
+            f"FF_FAULT serve occurrence {k} must land in the measured "
+            f"batch ({n_warm} warmup admissions precede it)")
+        assert len(failed) == 1, (
+            f"expected exactly 1 poisoned failure under FF_FAULT={fault}, "
+            f"got {len(failed)}")
+        assert failed[0].error == "non-finite logits", failed[0].error
+        assert failed[0].rid == k - 1, (
+            f"poison landed on rid {failed[0].rid}, expected {k - 1}")
+        print(f"serve_smoke: poisoned request rid={failed[0].rid} retired "
+              f"as failed without stalling the batch")
+    else:
+        assert not failed, f"unexpected failures: {[r.rid for r in failed]}"
+    print("serve_smoke: PASSED")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,290 @@
+"""Continuous-batching serving runtime (runtime/serving.py).
+
+Correctness anchors:
+  * greedy continuous batching is TOKEN-IDENTICAL to sequential
+    per-request Generator.generate — the slot scheduler, shape buckets and
+    paged cache are pure performance mechanics, never semantics;
+  * the page-table gather is BITWISE the dense-cache attention;
+  * decode early-exit returns exactly the full-length scan's tokens;
+  * warm buckets never recompile (the counter proves it);
+  * a poisoned request (FF_FAULT nan_loss@serve) retires as failed
+    without stalling the rest of the batch.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel
+from flexflow_tpu.models.llama import llama_lm
+from flexflow_tpu.runtime import faultinject
+
+VOCAB = 89
+
+
+@pytest.fixture(scope="module")
+def ff():
+    cfg = FFConfig(batch_size=2, mesh_shape={"data": 1})
+    model = FFModel(cfg)
+    _, logits = llama_lm(model, 2, seq_len=16, hidden=64, layers=2,
+                         heads=4, kv_heads=2, vocab_size=VOCAB)
+    model.compile(final_tensor=logits)
+    return model
+
+
+def _prompts(seed, lengths):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(1, VOCAB, (L,)).astype(np.int32) for L in lengths]
+
+
+def test_continuous_batching_token_identical_to_sequential(ff):
+    """More requests than slots, mixed lengths spanning several buckets:
+    every request's emitted tokens equal its SOLO (one-request-at-a-time)
+    generate run — admission order, bucket padding, page allocation and
+    slot reuse never leak into the tokens."""
+    prompts = _prompts(0, [5, 9, 3, 12, 7, 6, 17, 2, 11])
+    eng = ff.make_serving_engine(serve_slots=3, kv_page_size=4,
+                                 max_seq_len=64)
+    reqs = eng.run(prompts, max_new_tokens=6)
+    assert [r.state for r in reqs] == ["done"] * len(prompts)
+    for r in reqs:
+        solo = ff.generate(r.prompt[None, :], max_new_tokens=6)
+        np.testing.assert_array_equal(
+            np.asarray(r.tokens, np.int32), solo[0, r.prompt.size:],
+            err_msg=f"request {r.rid} (len {r.prompt.size}) diverged "
+                    f"from its solo run")
+    st = eng.stats()
+    assert st["completed"] == len(prompts)
+    assert st["free_pages"] == st["kv_pages"] - 1  # all pages returned
+    assert 0.0 < st["occupancy"] <= 1.0
+
+
+def test_serve_api_and_eos_retirement(ff):
+    """FFModel.serve: eos retires a slot early (freeing it for the queue)
+    and outputs match per-request generate with the same eos."""
+    prompts = _prompts(1, [4, 6, 5, 8])
+    probe = ff.generate(prompts[0][None, :], max_new_tokens=8)
+    eos = int(probe[0, prompts[0].size])  # first emitted token of req 0
+    outs, st = ff.serve(prompts, max_new_tokens=8, serve_slots=2,
+                        kv_page_size=4, max_seq_len=64, eos_id=eos)
+    assert st["completed"] == 4 and st["failed"] == 0
+    for p, out in zip(prompts, outs):
+        solo = ff.generate(p[None, :], max_new_tokens=8, eos_token_id=eos)
+        new = solo[0, p.size:]
+        hits = np.where(new == eos)[0]
+        want = new[:hits[0] + 1] if hits.size else new
+        np.testing.assert_array_equal(out[p.size:], want)
+
+
+def test_paged_gather_matches_dense_cache_bitwise(ff):
+    """paged_decode_forward through a SCRAMBLED page table must equal
+    decode_forward on the equivalent contiguous cache bitwise: the gather
+    reassembles the identical (B, L, KVH, Dh) operand, and the attention
+    math after it is the same einsum program."""
+    op = ff.make_serving_engine(max_seq_len=32).gen.attn_ops[0]
+    params = {k: jnp.asarray(v) for k, v in ff.params[op.name].items()}
+    rs = np.random.RandomState(3)
+    b, page, n_pages = 2, 4, 4
+    max_len = page * n_pages
+    kvh, dqk, dv = op.num_kv_heads, op.qk_head_dim, op.v_head_dim
+    dense = {
+        "k": jnp.asarray(rs.randn(b, max_len, kvh, dqk), jnp.float32),
+        "v": jnp.asarray(rs.randn(b, max_len, kvh, dv), jnp.float32),
+    }
+    x = jnp.asarray(rs.randn(b, 1, op.q_in), jnp.float32)
+    pos, prompt_pad = 9, 8
+    rope_pos = jnp.asarray([4, 7], jnp.int32)   # logical, not slot, pos
+    row_len = jnp.asarray([3, 7], jnp.int32)
+
+    # pool with a deliberately non-identity slot->page mapping
+    table = np.array([[5, 2, 7, 1], [3, 6, 4, 8]], np.int32)
+    pool = {
+        "k": jnp.zeros((10, page, kvh, dqk), jnp.float32),
+        "v": jnp.zeros((10, page, kvh, dv), jnp.float32),
+    }
+    for row in range(b):
+        for p in range(n_pages):
+            for name in ("k", "v"):
+                pool[name] = pool[name].at[table[row, p]].set(
+                    dense[name][row, p * page:(p + 1) * page])
+
+    out_d, cache_d = op.decode_forward(
+        params, [x, x, x], dense, pos, rope_pos=rope_pos,
+        row_lengths=row_len, prompt_len=prompt_pad)
+    out_p, cache_p = op.paged_decode_forward(
+        params, [x, x, x], pool, jnp.asarray(table),
+        jnp.full((b,), pos, jnp.int32), rope_pos, row_len,
+        jnp.full((b,), prompt_pad, jnp.int32))
+    np.testing.assert_array_equal(np.asarray(out_d), np.asarray(out_p))
+    # and the scatter wrote the SAME k/v the contiguous cache holds
+    for name in ("k", "v"):
+        gathered = np.asarray(cache_p[name])[table].reshape(
+            b, max_len, kvh, -1)
+        np.testing.assert_array_equal(np.asarray(cache_d[name]), gathered)
+
+
+def test_early_exit_identical_to_full_scan(ff):
+    """The while_loop early-exit path: identical tokens (and scores) to
+    the full-length scan, with and without eos; without eos_id it simply
+    runs the full length."""
+    rs = np.random.RandomState(5)
+    prompt = rs.randint(1, VOCAB, (2, 5)).astype(np.int32)
+    probe = ff.generate(prompt, max_new_tokens=8)
+    eos = int(probe[0, 5])
+    full = ff.generate(prompt, max_new_tokens=8, eos_token_id=eos)
+    fast = ff.generate(prompt, max_new_tokens=8, eos_token_id=eos,
+                       early_exit=True)
+    np.testing.assert_array_equal(full, fast)
+
+    a, sa = ff.generate(prompt, max_new_tokens=6, eos_token_id=eos,
+                        return_scores=True)
+    b, sb = ff.generate(prompt, max_new_tokens=6, eos_token_id=eos,
+                        return_scores=True, early_exit=True)
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_allclose(sa, sb, rtol=0, atol=0)
+
+    no_eos = ff.generate(prompt, max_new_tokens=5, early_exit=True)
+    np.testing.assert_array_equal(no_eos,
+                                  ff.generate(prompt, max_new_tokens=5))
+
+    # ragged prompts ride the same step body
+    lengths = np.array([3, 5], np.int32)
+    r_full = ff.generate(prompt, 6, eos_token_id=eos,
+                         prompt_lengths=lengths)
+    r_fast = ff.generate(prompt, 6, eos_token_id=eos,
+                         prompt_lengths=lengths, early_exit=True)
+    np.testing.assert_array_equal(r_full, r_fast)
+
+
+def test_recompile_counter_flat_within_buckets(ff):
+    """Power-of-two buckets: after one request has warmed a bucket, any
+    mix of prompt lengths inside it (and any max_new_tokens) reuses the
+    warm programs — the recompile counter must not move."""
+    eng = ff.make_serving_engine(serve_slots=2, kv_page_size=4,
+                                 max_seq_len=64)
+    eng.run(_prompts(7, [5, 12]), max_new_tokens=4)   # warm buckets 8, 16
+    warm = eng.recompile_count
+    assert warm == 3  # prefill(8) + prefill(16) + the one decode program
+    eng.run(_prompts(8, [6, 8, 3, 9, 16, 11, 2, 13]), max_new_tokens=7)
+    assert eng.recompile_count == warm, \
+        "mixed lengths within warm buckets must not recompile"
+    # a NEW bucket is exactly one more prefill program
+    eng.run(_prompts(9, [20]), max_new_tokens=4)
+    assert eng.recompile_count == warm + 1
+
+
+def test_poisoned_request_retired_without_stalling(ff, monkeypatch):
+    """FF_FAULT=nan_loss@serve:3 poisons the 3rd admitted request's
+    logits in-graph; the engine must retire exactly that request as
+    failed (non-finite logits) while every other request completes with
+    its solo-run tokens."""
+    monkeypatch.setenv("FF_FAULT", "nan_loss@serve:3")
+    faultinject.reset()
+    try:
+        prompts = _prompts(11, [5, 9, 3, 12, 7, 6])
+        eng = ff.make_serving_engine(serve_slots=3, kv_page_size=4,
+                                     max_seq_len=64)
+        reqs = eng.run(prompts, max_new_tokens=5)
+    finally:
+        monkeypatch.delenv("FF_FAULT")
+        faultinject.reset()
+    states = [r.state for r in reqs]
+    assert states[2] == "failed" and reqs[2].error == "non-finite logits"
+    for i, r in enumerate(reqs):
+        if i == 2:
+            continue
+        assert r.state == "done"
+        solo = ff.generate(r.prompt[None, :], max_new_tokens=5)
+        np.testing.assert_array_equal(np.asarray(r.tokens, np.int32),
+                                      solo[0, r.prompt.size:])
+    # the poisoned slot's pages were freed for reuse
+    st = eng.stats()
+    assert st["failed"] == 1 and st["free_pages"] == st["kv_pages"] - 1
+
+
+def test_page_pool_pressure_blocks_admission_not_progress(ff):
+    """A pool too small for all slots at once: admission waits for
+    retirements instead of deadlocking, and every request still finishes
+    with its solo tokens."""
+    # 2 slots x ceil(64/4)=16 pages would want 33; grant 21 — enough for
+    # one max-size request (16+1) plus a small one, never two max-size
+    eng = ff.make_serving_engine(serve_slots=2, kv_page_size=4,
+                                 max_seq_len=64, kv_pages=21)
+    prompts = _prompts(13, [30, 25, 6, 28])
+    reqs = eng.run(prompts, max_new_tokens=4)
+    assert [r.state for r in reqs] == ["done"] * 4
+    for r in reqs:
+        solo = ff.generate(r.prompt[None, :], max_new_tokens=4)
+        np.testing.assert_array_equal(np.asarray(r.tokens, np.int32),
+                                      solo[0, r.prompt.size:])
+
+
+def test_serving_validation(ff):
+    eng = ff.make_serving_engine(serve_slots=2, kv_page_size=4,
+                                 max_seq_len=32)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        eng.submit(np.arange(1, 30, dtype=np.int32), max_new_tokens=16)
+    with pytest.raises(ValueError, match="empty"):
+        eng.submit(np.zeros((0,), np.int32), max_new_tokens=4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit(np.arange(1, 5, dtype=np.int32), max_new_tokens=0)
+    with pytest.raises(ValueError, match="kv_pages"):
+        ff.make_serving_engine(serve_slots=2, kv_page_size=4,
+                               max_seq_len=32, kv_pages=4)
+    with pytest.raises(ValueError, match="bucket"):
+        eng2 = ff.make_serving_engine(decode_buckets=[8, 16],
+                                      kv_page_size=4, max_seq_len=64)
+        eng2.submit(np.arange(1, 20, dtype=np.int32), max_new_tokens=4)
+    with pytest.raises(ValueError):
+        FFConfig(batch_size=2, mesh_shape={"data": 1}, serve_slots=0)
+    with pytest.raises(ValueError):
+        FFConfig(batch_size=2, mesh_shape={"data": 1},
+                 decode_buckets=[16, 8])
+
+
+def test_decode_chunk_invariance(ff):
+    """decode_chunk trades dispatch overhead for retirement granularity
+    ONLY: any chunk size produces identical tokens — including requests
+    whose eos lands mid-chunk (the in-graph over-decode is truncated by
+    the host) and whose max_new_tokens is not a chunk multiple."""
+    prompts = _prompts(19, [5, 9, 3, 12])
+    probe = ff.generate(prompts[0][None, :], max_new_tokens=10)
+    eos = int(probe[0, prompts[0].size + 2])  # eos somewhere mid-stream
+    outs = {}
+    for chunk in (1, 3, 16):
+        eng = ff.make_serving_engine(serve_slots=2, kv_page_size=4,
+                                     max_seq_len=64, decode_chunk=chunk,
+                                     eos_id=eos)
+        reqs = eng.run(prompts, max_new_tokens=10)
+        assert [r.state for r in reqs] == ["done"] * 4
+        outs[chunk] = [np.asarray(r.tokens, np.int32) for r in reqs]
+    for chunk in (3, 16):
+        for a, b in zip(outs[1], outs[chunk]):
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"decode_chunk={chunk} changed tokens")
+    # and chunk=1 equals the solo batch path under the same eos
+    for p, got in zip(prompts, outs[1]):
+        solo = ff.generate(p[None, :], max_new_tokens=10, eos_token_id=eos)
+        new = solo[0, p.size:]
+        hits = np.where(new == eos)[0]
+        want = new[:hits[0] + 1] if hits.size else new
+        np.testing.assert_array_equal(got, want)
+
+
+def test_explicit_buckets_and_per_request_max_new(ff):
+    """Pinned decode_buckets honor their boundaries; per-request
+    max_new_tokens mixes freely in one batch."""
+    eng = ff.make_serving_engine(serve_slots=2, kv_page_size=4,
+                                 max_seq_len=64, decode_buckets=[8, 24])
+    rs = np.random.RandomState(17)
+    reqs = [eng.submit(rs.randint(1, VOCAB, (L,)).astype(np.int32), m)
+            for L, m in [(5, 3), (20, 6), (8, 2), (11, 5)]]
+    assert [r.bucket for r in reqs] == [8, 24, 8, 24]
+    while eng.step():
+        pass
+    for r in reqs:
+        assert r.state == "done" and len(r.tokens) == r.max_new_tokens
+        solo = ff.generate(r.prompt[None, :],
+                           max_new_tokens=r.max_new_tokens)
+        np.testing.assert_array_equal(np.asarray(r.tokens, np.int32),
+                                      solo[0, r.prompt.size:])
