@@ -15,8 +15,11 @@
 #   serving   — continuous-batching engine tests + a 200-request CPU
 #               smoke with FF_FAULT=nan_loss injection (a poisoned
 #               request must retire without stalling the batch)
+#   overlap   — host-overlap step engine tests (prefetch pipeline +
+#               dispatch-ahead fit) + a slow-loader smoke asserting
+#               throughput improves and host_wait drops
 #
-# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|all]
+# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|all]
 set -e
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -103,6 +106,15 @@ run_serving() {
   FF_FAULT="nan_loss@serve:37" python scripts/serve_smoke.py 200
 }
 
+# overlap tier: the host-overlap step engine suite (bitwise identity vs
+# the sync loop, checkpoint-cursor exactness under prefetch, io_fail
+# retry inside the worker, retrace flatness), then the slow-loader smoke
+# asserting throughput improves and the host_wait fraction drops.
+run_overlap() {
+  python -m pytest tests/test_overlap.py tests/test_pipeline_loader.py -q
+  python scripts/overlap_smoke.py
+}
+
 case "$TIER" in
   unit)     run_unit ;;
   sweep)    run_sweep ;;
@@ -112,7 +124,8 @@ case "$TIER" in
   lint)     run_lint ;;
   resilience) run_resilience ;;
   serving)  run_serving ;;
-  all)      run_lint; run_unit; run_resilience; run_serving; run_native; run_docs; run_sweep ;;
+  overlap)  run_overlap ;;
+  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_native; run_docs; run_sweep ;;
   *) echo "unknown tier $TIER"; exit 2 ;;
 esac
 echo "ci($TIER): PASSED"

@@ -31,6 +31,8 @@ PAGES = [("index", os.path.join(ROOT, "README.md"), "Overview"),
           "Fault tolerance"),
          ("serving", os.path.join(DOCS, "serving.md"),
           "Serving (continuous batching)"),
+         ("performance", os.path.join(DOCS, "performance.md"),
+          "Performance (host overlap)"),
          ("analysis", os.path.join(DOCS, "analysis.md"),
           "fflint static analysis"),
          ("install", os.path.join(ROOT, "INSTALL.md"), "Install")]

@@ -116,6 +116,20 @@ class FFConfig:
     device_data_budget_bytes: int = 2 << 30
     seed: int = 0
 
+    # ---- host-overlap step engine (runtime/pipeline_loader.py) ----
+    # bounded background prefetch for host-resident data in fit(): a
+    # worker thread pulls batches and device_puts them (committed) up to
+    # this many ahead, so the hot loop's batch is already on device.
+    # 0 = synchronous staging (the old loop). Device-resident datasets
+    # bypass this (their next_batch is already an on-device slice).
+    prefetch_depth: int = 2
+    # max training steps in flight before fit() blocks on the OLDEST
+    # step's loss scalar (a device-progress wait, not a host sync on the
+    # current step). Bounds queued work + host memory; losses/metrics
+    # still drain asynchronously at epoch boundaries. 0 = wait for each
+    # step's own loss (fully synchronous device progress, for debugging).
+    dispatch_ahead: int = 2
+
     # ---- fault tolerance (runtime/resilience.py) ----
     # checkpoint directory for the TrainSupervisor / fit() auto-resume.
     # "" = no supervision (fit behaves exactly as before)
@@ -181,6 +195,10 @@ class FFConfig:
         if self.nonfinite_rewind_after < 0 or self.checkpoint_every < 0:
             raise ValueError(
                 "nonfinite_rewind_after and checkpoint_every must be >= 0")
+        if self.prefetch_depth < 0 or self.dispatch_ahead < 0:
+            raise ValueError(
+                f"prefetch_depth={self.prefetch_depth} and dispatch_ahead="
+                f"{self.dispatch_ahead} must be >= 0")
         if self.loss_scale <= 0:
             # 0 would make the guard divide by zero and classify EVERY
             # step non-finite — the run would "complete" training nothing

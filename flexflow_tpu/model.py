@@ -14,6 +14,7 @@ program; strategies become sharding constraints inside it.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import time
 from typing import Any, Dict, List, Optional, Sequence, Union
@@ -82,6 +83,12 @@ class FFModel:
         self._aux_tensors: List[Tensor] = []  # scalar losses (MoE balance)
         self._cached_backward = None
         self._perf = PerfMetrics()
+        # host-overlap step engine (runtime/pipeline_loader.py): the live
+        # prefetch pipeline while fit() runs one (the supervisor reads
+        # checkpoint cursors through it), and the last fit's per-step
+        # host_wait/h2d/dispatch/device breakdown
+        self._pipeline = None
+        self.last_step_breakdown: Optional[Dict[str, float]] = None
 
     @property
     def params(self):
@@ -664,6 +671,10 @@ class FFModel:
             batch[dl.name] = dl.next_batch()
         return batch
 
+    def _reset_dataloaders(self):
+        for dl in self._dataloaders:
+            dl.reset()
+
     def init_layers(self):
         """API parity (reference FFModel::init_layers model.cc:1342); params
         are initialized in compile(), so this is a barrier only."""
@@ -848,6 +859,42 @@ class FFModel:
                 use_scan = False
         stopped = False
         warm = None
+        # per-step wall breakdown (host_wait / h2d / dispatch / device),
+        # reset at the warmup barrier so compile never pollutes it; logged
+        # and stored on self.last_step_breakdown at the end of fit
+        bd = {"host_wait": 0.0, "h2d": 0.0, "dispatch": 0.0,
+              "device": 0.0, "steps": 0}
+        # host-overlap step engine (runtime/pipeline_loader.py): a worker
+        # thread prefetches + commits batches to device ahead of the loop,
+        # and a dispatch-ahead ring below keeps up to
+        # config.dispatch_ahead steps in flight. Host-resident data only
+        # (device-resident loaders already slice on device, so there is
+        # nothing to overlap); excluded under per-step guard polling
+        # (prompt rewind syncs the loss every step anyway) and per-group
+        # placement programs (their batches materialize inside the step).
+        use_overlap = (not use_scan and not staged
+                       and self.config.prefetch_depth > 0
+                       and not getattr(self.executor, "jits_per_group", False)
+                       and (sup is None or not sup.poll_nonfinite))
+        pipe = None
+        ring = collections.deque()  # in-flight step losses (device scalars)
+
+        def _note_warm(first_loss):
+            # ONE warmup barrier shared by all loop flavors: block on the
+            # first step's own loss scalar — an output of the step
+            # program, so it transitively waits on everything the step
+            # produced; a second full-params sync was pure redundancy.
+            # Excludes compile from the throughput window and resets the
+            # breakdown counters.
+            nonlocal warm, total
+            jax.block_until_ready(first_loss)
+            warm = time.time()
+            total = 0
+            for k in bd:
+                bd[k] = 0 if k == "steps" else 0.0
+            if pipe is not None:
+                pipe.reset_stats()
+
         for cb in callbacks:
             cb.set_model(self)
             cb.on_train_begin()
@@ -872,15 +919,36 @@ class FFModel:
                     # deterministic loaders)
                     if epoch > start_epoch or (epoch == start_epoch
                                                and start_epoch > 0):
-                        native_dl.reset()
+                        if pipe is not None:
+                            # quiesce first: prefetched batches from the
+                            # old epoch are discarded, the reset runs with
+                            # the worker idle
+                            pipe.epoch_break(native_dl.reset)
+                        else:
+                            native_dl.reset()
                     if resuming:
                         # the native loader's shuffled cursor cannot seek:
-                        # discard the already-trained batches
+                        # discard the already-trained batches (pipe is
+                        # still None here — it starts below, after the
+                        # skip, so it never prefetches discarded batches)
                         for _ in range(it0):
                             native_dl.next_batch()
                 elif not resuming:
-                    for dl in self._dataloaders:
-                        dl.reset()
+                    if pipe is not None:
+                        pipe.epoch_break(self._reset_dataloaders)
+                    else:
+                        self._reset_dataloaders()
+                if use_overlap and pipe is None:
+                    from flexflow_tpu.runtime.pipeline_loader import \
+                        PipelineLoader
+
+                    depth = self.config.prefetch_depth
+                    pipe = (PipelineLoader.from_native(native_dl, self,
+                                                       depth=depth)
+                            if native_dl is not None else
+                            PipelineLoader.from_loaders(self, depth=depth))
+                    pipe.start()
+                    self._pipeline = pipe
                 epoch_mets = []  # device scalars; converted once per epoch so
                 # the host never blocks mid-epoch (keeps XLA dispatch async)
                 if use_scan:
@@ -902,27 +970,58 @@ class FFModel:
                         total += bs * chunk
                         it += chunk
                         if warm is None:
-                            jax.block_until_ready(self.params)
-                            warm = time.time()  # exclude first-chunk compile
-                            total = 0
+                            _note_warm(self._last_loss)
                         if sup is not None and sup.after_step():
                             stopped = True
                             break
                 else:
                     it = it0
                     while it < num_batches:
-                        batch = (native_dl.next_batch()
-                                 if native_dl is not None
-                                 else self._stage_batch())
+                        t_b = time.perf_counter()
+                        if pipe is not None:
+                            # already sharded + committed by the worker:
+                            # this wait is pure "input not ready yet"
+                            batch = pipe.get()
+                            t_h = t_s = time.perf_counter()
+                        else:
+                            batch = (native_dl.next_batch()
+                                     if native_dl is not None
+                                     else self._stage_batch())
+                            t_h = time.perf_counter()
+                            batch = self.executor.shard_batch(batch)
+                            t_s = time.perf_counter()
                         loss, mets = self._run_train_step(
                             batch, inject_nan=(sup is not None
                                                and sup.nan_due()))
+                        t_d = time.perf_counter()
+                        bd["host_wait"] += t_h - t_b
+                        bd["h2d"] += t_s - t_h
+                        bd["dispatch"] += t_d - t_s
+                        bd["steps"] += 1
                         epoch_mets.append((mets, bs, 1))
                         total += bs
                         if warm is None:
-                            jax.block_until_ready(self.params)
-                            warm = time.time()  # exclude first-step compile
-                            total = 0
+                            _note_warm(loss)
+                        elif pipe is not None:
+                            # dispatch-ahead ring: block on the OLDEST
+                            # in-flight step's loss once more than
+                            # config.dispatch_ahead steps are outstanding.
+                            # This waits on DEVICE progress (that step was
+                            # dispatched dispatch_ahead steps ago), which
+                            # is exactly what the supervisor's watchdog
+                            # must time — not host dispatch
+                            ring.append(loss)
+                            if len(ring) > self.config.dispatch_ahead:
+                                old = ring.popleft()
+                                t_w = time.perf_counter()
+                                with (sup.watchdog.arm(
+                                        f"step {self._step_count} device "
+                                        f"progress",
+                                        scale=self.config.dispatch_ahead + 1)
+                                      if sup is not None
+                                      else contextlib.nullcontext()):
+                                    jax.block_until_ready(old)
+                                bd["device"] += time.perf_counter() - t_w
                         if sup is not None:
                             step_before = self._step_count
                             if sup.after_step():
@@ -952,6 +1051,7 @@ class FFModel:
                 # it blocks on every step dispatched since the last sync,
                 # so the supervisor's watchdog (step_timeout_s) arms here,
                 # scaled by the number of steps it waits on
+                t_sync = time.perf_counter()
                 with (sup.watchdog.arm(f"epoch {epoch} metrics sync",
                                        scale=max(len(epoch_mets), 1))
                       if sup is not None else contextlib.nullcontext()):
@@ -964,6 +1064,8 @@ class FFModel:
                             self._perf.update(
                                 {k: float(a[j] if a.ndim else a)
                                  for k, a in arrs.items()}, bs)
+                bd["device"] += time.perf_counter() - t_sync
+                ring.clear()  # everything in flight just synced above
                 if verbose:
                     print(f"epoch {epoch}: loss={float(self._last_loss):.4f} "
                           + self._perf.report(self.loss_type, self.metric_types))
@@ -974,12 +1076,39 @@ class FFModel:
                 if any(cb.on_epoch_end(epoch) for cb in callbacks):
                     break
         finally:
+            if pipe is not None:
+                # quiesce BEFORE the supervisor's final checkpoint: stop()
+                # discards prefetched-but-untrained batches and rewinds
+                # the loader cursors to the consumed position, so the
+                # final save records exactly the synchronous loop's state
+                pipe.stop()
+                self._pipeline = None
             if native_dl is not None:
                 native_dl.close()
             if sup is not None:
                 sup.finalize()
         jax.block_until_ready(self.params)
         elapsed = time.time() - (warm or t0)
+        if bd["steps"]:
+            from flexflow_tpu.logger import fflogger
+
+            wall = max(elapsed, 1e-9)
+            if pipe is not None:
+                # h2d ran on the worker thread — overlapped with device
+                # compute, so it is reported but not part of loop wall
+                bd["h2d"] = pipe.stats["h2d_s"]
+            self.last_step_breakdown = dict(
+                bd, wall_s=wall, overlap=pipe is not None,
+                host_wait_fraction=min(bd["host_wait"] / wall, 1.0))
+            fflogger.info(
+                "fit step breakdown (%d steps, overlap=%s): host_wait "
+                "%.1f%% | h2d %.1f%%%s | dispatch %.1f%% | device %.1f%% "
+                "of %.3fs wall",
+                bd["steps"], pipe is not None,
+                100 * bd["host_wait"] / wall, 100 * bd["h2d"] / wall,
+                " (worker, overlapped)" if pipe is not None else "",
+                100 * bd["dispatch"] / wall, 100 * bd["device"] / wall,
+                wall)
         if total and elapsed > 0 and verbose:
             print(f"epochs {epochs}, ELAPSED TIME = {elapsed:.4f}s, "
                   f"THROUGHPUT = {total / elapsed:.2f} samples/s")

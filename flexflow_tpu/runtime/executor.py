@@ -133,6 +133,7 @@ class GraphExecutor:
         self.mesh: Mesh = model.mesh
         self.mesh_shape = mesh_shape_dict(self.mesh)
         self._op_axis_maps: Dict[str, Dict[str, Optional[int]]] = {}
+        self._batch_sharding_cache: Dict[Tuple[str, int], NamedSharding] = {}
         self._resolve_strategies()
 
     # ---- strategy resolution ------------------------------------------------
@@ -567,20 +568,47 @@ class GraphExecutor:
 
         return fwd
 
-    def shard_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jnp.ndarray]:
-        out = {}
-        input_by_name = {op.name: op.outputs[0]
-                         for op in self.model.ops if isinstance(op, InputOp)}
-        for k, v in batch.items():
-            if k in input_by_name:
-                sh = self.input_sharding(input_by_name[k])
+    def batch_sharding(self, name: str, ndim: int) -> NamedSharding:
+        """The committed placement for one batch entry, CACHED per
+        (name, ndim) — building a fresh NamedSharding (and walking the op
+        list) every step was pure hot-path overhead, and the prefetch
+        pipeline (runtime/pipeline_loader.py) needs the same object so
+        ahead-of-time puts and in-step puts agree exactly."""
+        key = (name, ndim)
+        sh = self._batch_sharding_cache.get(key)
+        if sh is None:
+            input_by_name = {op.name: op.outputs[0]
+                             for op in self.model.ops
+                             if isinstance(op, InputOp)}
+            if name in input_by_name:
+                sh = self.input_sharding(input_by_name[name])
             else:
-                nd = v.ndim
-                entries = [None] * nd
+                entries = [None] * ndim
                 if "data" in self.mesh_shape and self.mesh_shape["data"] > 1:
                     entries[0] = "data"
                 sh = NamedSharding(self.mesh, P(*entries))
-            out[k] = jax.device_put(v, sh)
+            self._batch_sharding_cache[key] = sh
+        return sh
+
+    def shard_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jnp.ndarray]:
+        """Commit every batch entry to its cached NamedSharding. Entries
+        that are ALREADY committed to the right sharding (a prefetched
+        batch, or the device-resident loader's jitted slice) pass through
+        untouched — the put is skipped, so calling this on a pre-sharded
+        batch is a dict walk, not a transfer. Committed (not just
+        correctly-placed) matters: an uncommitted array changes the warm
+        step program's pjit signature and silently retraces it."""
+        out = {}
+        for k, v in batch.items():
+            if not hasattr(v, "ndim"):  # plain list/scalar callers
+                v = np.asarray(v)
+            sh = self.batch_sharding(k, v.ndim)
+            if (isinstance(v, jax.Array)
+                    and getattr(v, "committed", False)
+                    and v.sharding.is_equivalent_to(sh, v.ndim)):
+                out[k] = v
+            else:
+                out[k] = jax.device_put(v, sh)
         return out
 
 

@@ -1,0 +1,281 @@
+"""Host-overlap input pipeline: bounded background prefetch + ahead-of-time
+committed sharding.
+
+The reference hides data movement behind compute with Legion's deferred
+execution (every `next_batch` is an index-launch the runtime overlaps with
+whatever compute is outstanding); the TensorFlow-paper input pipeline gets
+the same effect with an explicit prefetch queue. Our synchronous `fit()`
+loop had neither: each step pulled a batch on the host, `device_put` it,
+and only then dispatched — TPU idle during host work, host idle during
+device work.
+
+``PipelineLoader`` closes that gap: a daemon worker thread pulls batches
+from any source (the model's ``SingleDataLoader``s, or a
+``NativeBatchLoader``), shards each one to its cached ``NamedSharding``
+with a **committed** ``jax.device_put`` (committed placement matters: an
+uncommitted batch gives the warm step program a different pjit signature
+and silently retraces it — the PR-3 serving-pool lesson), and parks up to
+``depth`` ready batches in a bounded buffer. The training loop's
+``get()`` then returns an already-device-resident batch, so the hot path
+does no host slicing and no H2D wait.
+
+Exactness contracts (what makes overlap safe to turn on by default):
+
+  * **Order**: batches are pulled, sharded, and buffered strictly in
+    source order by ONE worker; ``get()`` pops FIFO — the overlap loop
+    trains the exact batch sequence the synchronous loop would.
+  * **Cursor accounting**: the worker advances the source's cursor
+    (``dl.next_index``) ahead of training. ``consumed_cursors()`` always
+    reports the position as of the last batch actually HANDED to the
+    training loop, and every quiesce (epoch break, stop) rewinds the
+    source cursors to that consumed position — so a checkpoint taken at
+    any step boundary records exactly the synchronous loop's cursor and
+    resume stays bitwise-identical (runtime/resilience.py reads cursors
+    through this when a pipeline is active).
+  * **Fault semantics**: the pull runs inside ``resilience.retry`` with
+    ``faultinject.maybe_fail("io_fail", "loader")`` checked BEFORE the
+    cursor advances, so an injected ``FF_FAULT=io_fail@loader:n`` retries
+    the same batch — no reorder, no skip, no deadlock. A worker error
+    that exhausts retries is parked and re-raised from ``get()`` on the
+    training thread.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from flexflow_tpu.logger import fflogger
+from flexflow_tpu.runtime import faultinject
+from flexflow_tpu.runtime.resilience import retry
+
+
+class PipelineLoader:
+    """Bounded background prefetch queue over a batch source.
+
+    ``pull() -> batch dict | None`` (None = end of epoch, native loader
+    semantics), ``shard(batch) -> device batch`` (the executor's cached
+    committed sharding), optional ``cursors()/restore(snapshot)`` for
+    sources with seekable cursors (the deterministic loaders)."""
+
+    def __init__(self, pull: Callable[[], Optional[Dict]],
+                 shard: Callable[[Dict], Dict], *, depth: int = 2,
+                 cursors: Optional[Callable[[], Dict]] = None,
+                 restore: Optional[Callable[[Dict], None]] = None):
+        if depth < 1:
+            raise ValueError(f"PipelineLoader depth must be >= 1, got {depth}")
+        self._shard = shard
+        self._cursors = cursors
+        self._restore = restore
+        self.depth = depth
+        self._cv = threading.Condition()
+        self._buf: collections.deque = collections.deque()
+        self._paused = False
+        self._stopped = False
+        self._pulling = False
+        self._eos = False
+        self._gen = 0  # bumped at every quiesce; stale pulls must not buffer
+        self._exc: Optional[BaseException] = None
+        self._consumed = cursors() if cursors is not None else None
+        # h2d_s accumulates INSIDE the worker — time the training thread
+        # never sees (that is the point of the pipeline); pulls/retries
+        # are visible through resilience.COUNTERS as usual
+        self.stats = {"h2d_s": 0.0, "pull_s": 0.0, "batches": 0}
+        # maybe_fail runs BEFORE the underlying pull so a scheduled
+        # io_fail@loader fires without advancing any cursor; the retry
+        # then re-pulls the SAME batch
+        @retry(attempts=3, base_delay=0.05, retryable=(OSError,),
+               name="prefetch pull")
+        def _pull_retry():
+            faultinject.maybe_fail("io_fail", "loader")
+            return pull()
+
+        self._pull = _pull_retry
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ff-prefetch")
+        self._started = False
+
+    # ---- constructors ------------------------------------------------------
+
+    @classmethod
+    def from_loaders(cls, model, depth: int = 2) -> "PipelineLoader":
+        """Prefetch from the model's attached SingleDataLoaders (seekable
+        cursors -> exact quiesce/checkpoint accounting)."""
+        dls = list(model._dataloaders)
+
+        def pull():
+            return {dl.name: dl.next_batch() for dl in dls}
+
+        def cursors():
+            return {dl.name: int(dl.next_index) for dl in dls}
+
+        def restore(snap):
+            for dl in dls:
+                if dl.name in snap:
+                    dl.next_index = int(snap[dl.name])
+
+        return cls(pull, model.executor.shard_batch, depth=depth,
+                   cursors=cursors, restore=restore)
+
+    @classmethod
+    def from_native(cls, native_dl, model, depth: int = 2) -> "PipelineLoader":
+        """Prefetch-shard on top of the native threaded loader (it already
+        overlaps host batch ASSEMBLY; this adds the H2D put). Its shuffled
+        cursor cannot seek, so there is no cursor contract — resume under
+        the native loader replays batches by count, exactly as before."""
+        return cls(native_dl.next_batch, model.executor.shard_batch,
+                   depth=depth)
+
+    # ---- worker ------------------------------------------------------------
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while not self._stopped and (
+                        self._paused or self._eos
+                        or len(self._buf) >= self.depth):
+                    self._cv.wait()
+                if self._stopped:
+                    return
+                self._pulling = True
+                gen = self._gen
+            try:
+                t0 = time.perf_counter()
+                batch = self._pull()
+                t1 = time.perf_counter()
+                if batch is None:  # end of epoch (native loader)
+                    with self._cv:
+                        self._pulling = False
+                        self._eos = True
+                        self._cv.notify_all()
+                    continue
+                sharded = self._shard(batch)
+                t2 = time.perf_counter()
+                snap = self._cursors() if self._cursors is not None else None
+            except BaseException as e:  # noqa: BLE001 — parked for get()
+                with self._cv:
+                    self._pulling = False
+                    self._exc = e
+                    self._cv.notify_all()
+                return
+            with self._cv:
+                self._pulling = False
+                # a quiesce that raced this pull rewinds the cursor past
+                # it — the batch must be dropped, not buffered stale (the
+                # generation check also covers a pull the quiesce gave up
+                # waiting on, which completes only after resume)
+                if not (self._paused or self._stopped) and gen == self._gen:
+                    self._buf.append((sharded, snap))
+                    self.stats["pull_s"] += t1 - t0
+                    self.stats["h2d_s"] += t2 - t1
+                    self.stats["batches"] += 1
+                self._cv.notify_all()
+
+    # ---- training-thread API ----------------------------------------------
+
+    def start(self):
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self
+
+    def get(self, timeout: Optional[float] = None) -> Dict:
+        """Next sharded batch, FIFO. Blocks until the worker delivers;
+        re-raises a worker error here (the training thread) instead of
+        deadlocking on an empty queue."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                if self._buf:
+                    sharded, snap = self._buf.popleft()
+                    if snap is not None:
+                        self._consumed = snap
+                    self._cv.notify_all()
+                    return sharded
+                if self._exc is not None:
+                    raise RuntimeError(
+                        "prefetch worker died") from self._exc
+                if self._eos:
+                    raise RuntimeError(
+                        "prefetch source exhausted mid-epoch (loader "
+                        "num_batches disagrees with the training loop)")
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"prefetch get() timed out after {timeout}s")
+                self._cv.wait(timeout=0.5)
+
+    def consumed_cursors(self) -> Optional[Dict]:
+        """Source cursor position as of the last batch handed to the
+        training loop (None for unseekable sources). This — not the
+        source's own pulled-ahead cursor — is what a checkpoint must
+        record."""
+        with self._cv:
+            return dict(self._consumed) if self._consumed is not None else None
+
+    def reset_stats(self):
+        """Zero the accumulated counters under the worker's lock (the
+        worker read-modify-writes them under the same lock mid-prefetch,
+        so an unlocked reset could be lost)."""
+        with self._cv:
+            for k in self.stats:
+                self.stats[k] = 0 if k == "batches" else 0.0
+
+    def _quiesce_locked(self, timeout: float = 10.0):
+        self._paused = True
+        self._cv.notify_all()
+        deadline = time.monotonic() + timeout
+        while self._pulling:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:  # pragma: no cover — diagnostics
+                # a pull stuck on a dead source would otherwise hang this
+                # quiesce forever — and a SIGTERM stop() would never reach
+                # its timed join or the preemption checkpoint. Abandon the
+                # daemon worker; the generation bump guarantees its batch
+                # is dropped if it ever completes.
+                fflogger.warning(
+                    "prefetch worker still mid-pull after %.0fs quiesce "
+                    "wait — abandoning it (source may be hung)", timeout)
+                break
+            self._cv.wait(timeout=min(remaining, 0.5))
+        self._gen += 1
+        self._buf.clear()
+        self._eos = False
+        if self._restore is not None and self._consumed is not None:
+            # rewind the source to the consumed position: prefetched-but-
+            # untrained batches are discarded and will be re-pulled
+            self._restore(self._consumed)
+
+    def epoch_break(self, reset: Optional[Callable[[], None]] = None):
+        """Epoch boundary: pause the worker, discard prefetched batches,
+        rewind cursors to consumed, run the loader ``reset`` with the
+        worker idle, re-snapshot, resume. Leaves source state exactly
+        where the synchronous loop's epoch boundary would."""
+        with self._cv:
+            self._quiesce_locked()
+            if reset is not None:
+                reset()
+            if self._cursors is not None:
+                self._consumed = self._cursors()
+            self._paused = False
+            self._cv.notify_all()
+
+    def stop(self):
+        """Terminate the worker and rewind cursors to the consumed
+        position (so ``dl.next_index`` after fit equals the synchronous
+        loop's). Idempotent; never raises — a parked worker error has
+        already surfaced (or will be moot) on the training thread."""
+        with self._cv:
+            if self._stopped:
+                return
+            try:
+                self._quiesce_locked()
+            finally:
+                self._stopped = True
+                self._cv.notify_all()
+        if self._started:
+            self._thread.join(timeout=10.0)
+            if self._thread.is_alive():  # pragma: no cover — diagnostics
+                fflogger.warning(
+                    "prefetch worker did not exit within 10s at stop()")

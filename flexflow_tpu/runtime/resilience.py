@@ -363,10 +363,19 @@ class TrainSupervisor:
             else faultinject.active_plan()
 
     def _extra_meta(self) -> dict:
+        # with the host-overlap pipeline active, dl.next_index has been
+        # advanced by the prefetch worker PAST the last trained batch —
+        # the checkpoint must record the CONSUMED cursor (the position
+        # the synchronous loop would be at), which the pipeline tracks
+        # per handed-out batch (runtime/pipeline_loader.py)
+        pipe = getattr(self.model, "_pipeline", None)
+        cursors = pipe.consumed_cursors() if pipe is not None else None
+        if cursors is None:
+            cursors = {dl.name: int(dl.next_index)
+                       for dl in self.model._dataloaders}
         meta = {
             "rng_key": np.asarray(self.model._rng).tolist(),
-            "dataloaders": {dl.name: int(dl.next_index)
-                            for dl in self.model._dataloaders},
+            "dataloaders": cursors,
         }
         gs = getattr(self.model, "_guard_state", None)
         if gs is not None:

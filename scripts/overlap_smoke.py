@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""CI overlap smoke (ci/run_ci.sh `overlap` tier): the host-overlap step
+engine (runtime/pipeline_loader.py prefetch + dispatch-ahead fit loop)
+vs the synchronous loop under a deliberately slow host loader. Asserts
+the two properties the engine exists for:
+
+  * throughput improves (the loader sleep overlaps device compute), and
+  * the measured host_wait fraction drops (the hot loop stops waiting
+    on input).
+
+The ratio bar here is deliberately looser than the bench tier's 1.3x
+acceptance line — CI boxes are small and noisy; the bench row is where
+the headline number is recorded.
+
+Usage: python scripts/overlap_smoke.py [loader_delay_ms]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flexflow_tpu._env import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(1)
+
+import numpy as np  # noqa: E402
+
+from flexflow_tpu import (ActiMode, FFConfig, FFModel, LossType,  # noqa: E402
+                          MetricsType, SGDOptimizer, SingleDataLoader)
+
+
+class SlowLoader(SingleDataLoader):
+    delay_s = 0.0
+
+    def next_batch(self):
+        time.sleep(SlowLoader.delay_s)
+        return super().next_batch()
+
+
+def main():
+    delay_ms = float(sys.argv[1]) if len(sys.argv) > 1 else 40.0
+    batch, n_batches, epochs = 32, 8, 2
+    cfg = FFConfig(batch_size=batch, mesh_shape={"data": 1},
+                   device_resident_data=False, native_dataloader=False,
+                   prefetch_depth=0, dispatch_ahead=4)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([batch, 256], name="x")
+    t = ff.dense(x, 2048, ActiMode.AC_MODE_RELU)
+    t = ff.dense(t, 2048, ActiMode.AC_MODE_RELU)
+    ff.dense(t, 16, name="out")
+    ff.compile(SGDOptimizer(lr=0.01),
+               LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+               [MetricsType.METRICS_ACCURACY])
+    rs = np.random.RandomState(0)
+    n = batch * n_batches
+    SlowLoader(ff, x, rs.randn(n, 256).astype(np.float32))
+    SingleDataLoader(ff, ff.label_tensor,
+                     rs.randint(0, 16, (n, 1)).astype(np.int32))
+    ff.fit(epochs=1, verbose=False)  # compile + warm (fast loader)
+    SlowLoader.delay_s = delay_ms / 1e3
+
+    def timed(prefetch_depth):
+        ff.config.prefetch_depth = prefetch_depth
+        best, hw = None, None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            ff.fit(epochs=epochs, verbose=False)
+            dt = time.perf_counter() - t0
+            if best is None or dt < best:
+                best = dt
+                hw = ff.last_step_breakdown["host_wait_fraction"]
+        return batch * n_batches * epochs / best, hw
+
+    sync_sps, hw_sync = timed(0)
+    overlap_sps, hw_overlap = timed(3)
+    ratio = overlap_sps / sync_sps
+    print(f"[overlap_smoke] loader_delay={delay_ms:.0f}ms  "
+          f"sync={sync_sps:.0f} samples/s (host_wait {hw_sync:.0%})  "
+          f"overlap={overlap_sps:.0f} samples/s (host_wait "
+          f"{hw_overlap:.0%})  speedup={ratio:.2f}x")
+    assert ratio > 1.1, \
+        f"overlap engine did not beat the sync loop: {ratio:.3f}x"
+    assert hw_overlap < hw_sync, \
+        f"host_wait fraction did not drop: {hw_sync:.3f} -> {hw_overlap:.3f}"
+    print("[overlap_smoke] PASSED")
+
+
+if __name__ == "__main__":
+    main()

@@ -25,4 +25,11 @@ except AttributeError:
     # forces the 8-device virtual CPU mesh there
     pass
 
+# NOTE (round 6): enabling jax's persistent compilation cache here looked
+# like a free suite-wide speedup (identical tiny models recompile across
+# files constantly), but on this jax (0.4.37) a warm cache returned a
+# WRONG executable for test_grad_accum (loss mismatch — stale/colliding
+# entry class of bug), so the suite must NOT use it. Serving/bench keep
+# their opt-in caches (multi-second compiles, distinct program shapes).
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -18,6 +18,7 @@ CPP = os.path.join(REPO, "examples", "cpp")
 @pytest.mark.skipif(shutil.which("g++") is None
                     or shutil.which("python3-config") is None,
                     reason="no C++ toolchain or Python dev headers")
+@pytest.mark.slow  # 16 s; the native CI tier builds and drives the C-API alexnet app
 def test_capi_alexnet_end_to_end():
     subprocess.run(["make"], cwd=CAPI, check=True, capture_output=True)
     subprocess.run(["make"], cwd=CPP, check=True, capture_output=True)
@@ -37,6 +38,7 @@ def test_capi_alexnet_end_to_end():
 @pytest.mark.skipif(shutil.which("g++") is None
                     or shutil.which("python3-config") is None,
                     reason="no C++ toolchain or Python dev headers")
+@pytest.mark.slow  # 8 s; the native CI tier drives the C API, alexnet e2e stays
 def test_capi_dlrm_end_to_end():
     subprocess.run(["make"], cwd=CAPI, check=True, capture_output=True)
     subprocess.run(["make"], cwd=CPP, check=True, capture_output=True)
@@ -55,6 +57,7 @@ def test_capi_dlrm_end_to_end():
 @pytest.mark.skipif(shutil.which("g++") is None
                     or shutil.which("python3-config") is None,
                     reason="no C++ toolchain or Python dev headers")
+@pytest.mark.slow  # 12 s; the native CI tier drives the C API, alexnet e2e stays
 def test_capi_transformer_end_to_end():
     subprocess.run(["make"], cwd=CAPI, check=True, capture_output=True)
     subprocess.run(["make"], cwd=CPP, check=True, capture_output=True)

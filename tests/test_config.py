@@ -1,0 +1,161 @@
+"""FFConfig validation + parse_args coverage (config.py).
+
+Every `__post_init__` guard exists because a typo'd knob used to surface
+as an opaque failure deep inside compile/XLA (or worse, silently ran the
+wrong configuration); each one gets a pinned test so a refactor cannot
+drop the guard. All host-side, sub-second."""
+
+import pytest
+
+from flexflow_tpu.config import FFConfig
+
+
+def _ok(**kw):
+    return FFConfig(mesh_shape={"data": 1}, **kw)
+
+
+# ------------------------------------------------------------- validation
+
+
+def test_defaults_valid():
+    cfg = _ok()
+    assert cfg.batch_size == 64 and cfg.num_devices == 1
+
+
+def test_grad_accum_validation():
+    with pytest.raises(ValueError, match="grad_accum_steps"):
+        _ok(grad_accum_steps=0)
+    with pytest.raises(ValueError, match="not divisible"):
+        _ok(batch_size=10, grad_accum_steps=3)
+    assert _ok(batch_size=12, grad_accum_steps=3).grad_accum_steps == 3
+
+
+def test_strategy_lint_validation():
+    with pytest.raises(ValueError, match="strategy_lint"):
+        _ok(strategy_lint="aggressive")
+    for mode in ("off", "warn", "strict"):
+        assert _ok(strategy_lint=mode).strategy_lint == mode
+
+
+def test_on_nonfinite_validation():
+    with pytest.raises(ValueError, match="on_nonfinite"):
+        _ok(on_nonfinite="retry")
+    for mode in ("none", "skip", "backoff"):
+        assert _ok(on_nonfinite=mode).on_nonfinite == mode
+
+
+def test_negative_resilience_knobs_rejected():
+    with pytest.raises(ValueError):
+        _ok(nonfinite_rewind_after=-1)
+    with pytest.raises(ValueError):
+        _ok(checkpoint_every=-1)
+
+
+def test_overlap_knob_validation():
+    with pytest.raises(ValueError, match="prefetch_depth"):
+        _ok(prefetch_depth=-1)
+    with pytest.raises(ValueError, match="dispatch_ahead"):
+        _ok(dispatch_ahead=-2)
+    cfg = _ok(prefetch_depth=0, dispatch_ahead=0)  # both legal: sync mode
+    assert cfg.prefetch_depth == 0 and cfg.dispatch_ahead == 0
+
+
+def test_loss_scale_validation():
+    with pytest.raises(ValueError, match="loss_scale"):
+        _ok(loss_scale=0.0)
+    with pytest.raises(ValueError, match="loss_scale"):
+        _ok(loss_scale=-2.0)
+    with pytest.raises(ValueError, match="growth_interval"):
+        _ok(loss_scale_growth_interval=0)
+
+
+def test_serving_knob_validation():
+    with pytest.raises(ValueError):
+        _ok(serve_slots=0)
+    with pytest.raises(ValueError):
+        _ok(kv_page_size=0)
+    with pytest.raises(ValueError):
+        _ok(kv_pages=-1)
+    assert _ok(kv_pages=0).kv_pages == 0  # 0 = derive
+
+
+def test_decode_buckets_validation():
+    for bad in ([], [0, 8], [8, 8], [16, 8]):
+        with pytest.raises(ValueError, match="decode_buckets"):
+            _ok(decode_buckets=bad)
+    assert _ok(decode_buckets=[8, 16, 64]).decode_buckets == [8, 16, 64]
+
+
+def test_dtype_validation():
+    with pytest.raises(ValueError, match="compute_dtype"):
+        _ok(compute_dtype="fp16")
+    with pytest.raises(ValueError, match="master_dtype"):
+        _ok(master_dtype="bf16")  # exact spelling required
+    cfg = _ok(compute_dtype="bfloat16", master_dtype="bfloat16")
+    assert cfg.compute_dtype == cfg.master_dtype == "bfloat16"
+
+
+def test_num_devices_derived_from_mesh_without_backend():
+    cfg = FFConfig(mesh_shape={"data": 4, "model": 2})
+    assert cfg.num_devices == 8
+    assert cfg.workers_per_node == 8 and cfg.num_nodes == 1
+
+
+def test_default_mesh_from_num_devices():
+    cfg = FFConfig(num_devices=4)
+    assert cfg.mesh_shape == {"data": 4}
+
+
+# ------------------------------------------------------------- parse_args
+
+
+def test_parse_args_defaults():
+    cfg = FFConfig.parse_args([])
+    assert cfg.batch_size == 64 and cfg.epochs == 1
+    assert cfg.search_budget == 0 and cfg.fsdp_axis == ""
+
+
+def test_parse_args_training_flags():
+    cfg = FFConfig.parse_args(["-e", "3", "-b", "32", "--lr", "0.5",
+                               "--wd", "0.01"])
+    assert (cfg.epochs, cfg.batch_size) == (3, 32)
+    assert cfg.learning_rate == 0.5 and cfg.weight_decay == 0.01
+
+
+def test_parse_args_mesh():
+    cfg = FFConfig.parse_args(["--mesh", "data=4,model=2"])
+    assert cfg.mesh_shape == {"data": 4, "model": 2}
+    assert cfg.num_devices == 8
+
+
+def test_parse_args_bad_mesh_errors():
+    for bad in ("data", "data=", "data=0", "data=x", "=4"):
+        with pytest.raises(SystemExit):
+            FFConfig.parse_args(["--mesh", bad])
+
+
+def test_parse_args_fsdp_const():
+    assert FFConfig.parse_args(["--fsdp"]).fsdp_axis == "data"
+    assert FFConfig.parse_args(["--fsdp", "model"]).fsdp_axis == "model"
+    assert FFConfig.parse_args([]).fsdp_axis == ""
+
+
+def test_parse_args_search_and_cost_modes():
+    cfg = FFConfig.parse_args(["--budget", "10", "--alpha", "0.1"])
+    assert cfg.search_budget == 10 and cfg.search_alpha == 0.1
+    assert FFConfig.parse_args(["--measure-costs"]).measure_search_costs \
+        == "measure"
+    assert FFConfig.parse_args(["--analyze-costs"]).measure_search_costs \
+        == "analyze"
+    assert FFConfig.parse_args([]).measure_search_costs is False
+
+
+def test_parse_args_checkpoint_flags():
+    cfg = FFConfig.parse_args(["--checkpoint-dir", "/tmp/ck",
+                               "--checkpoint-every", "5"])
+    assert cfg.checkpoint_dir == "/tmp/ck" and cfg.checkpoint_every == 5
+
+
+def test_parse_args_ignores_unknown():
+    cfg = FFConfig.parse_args(["--totally-unknown-flag", "x", "-e", "2"])
+    assert cfg.epochs == 2

@@ -142,6 +142,7 @@ def test_mha_bias_no_rope_decoder():
     np.testing.assert_array_equal(out, seq)
 
 
+@pytest.mark.slow  # 8 s; eos draws sampled by the sweep
 def test_eos_padding_and_sampling_shapes():
     ff = build_llama({"data": 2})
     rs = np.random.RandomState(3)
@@ -196,6 +197,7 @@ def test_beam_search_finds_higher_likelihood_than_greedy():
     assert (lp_beam >= lp_greedy - 1e-4).all(), (lp_beam, lp_greedy)
 
 
+@pytest.mark.slow  # 7 s; ragged draws sampled by the sweep
 def test_ragged_prompts_match_per_row_runs():
     """Ragged right-padded prompts: each row's generation must equal the
     run of that row alone at its true (unpadded) length — pad k/v slots
@@ -229,6 +231,7 @@ def test_ragged_prompts_match_per_row_runs():
                     prompt_lengths=np.array([5], np.int32))
 
 
+@pytest.mark.slow  # 16 s; beam+ragged sampled by the sweep
 def test_ragged_beam_matches_per_row_uniform_beam():
     """VERDICT r4 #4: beam search over ragged prompts. Each ragged row's
     beam decode must equal running that row ALONE with its true (unpadded)
@@ -280,6 +283,7 @@ def _moe_decoder(batch, cap):
     return ff
 
 
+@pytest.mark.slow  # 11 s; the sweep's gpt+MoE draws decode every run
 def test_moe_decoder_generates():
     """Mixtral-style decoder (attention + MoE FFN blocks) decodes: with
     capacity high enough that the full forward drops nothing, teacher-
@@ -315,6 +319,7 @@ def test_moe_decode_rows_independent_under_tight_capacity():
                                       err_msg=f"row {b} coupled")
 
 
+@pytest.mark.slow  # 19 s; int8 sampled by the sweep
 def test_int8_weight_only_decode():
     """quantize='int8': decodes with int8 weights + per-channel scales.
     Lossy by design — assert the quantized greedy path produces valid
@@ -367,6 +372,7 @@ def test_int8_weight_only_decode():
         "in-place params mutation did not invalidate the int8 cache"
 
 
+@pytest.mark.slow  # 12 s; per-token scores are oracle-rescored by every sweep config
 def test_return_scores():
     """return_scores: greedy scores are the model's own logp of each
     chosen token — rescoring with the full forward must reproduce them;
@@ -401,6 +407,7 @@ def test_return_scores():
                                    err_msg=f"beam row {b}")
 
 
+@pytest.mark.slow  # 7 s; the sweep alternates modes against shared cached models
 def test_beam_with_temperature_does_not_poison_greedy_cache():
     """A beam call keys temperature/top_k out of the Generator cache; the
     cached Generator must therefore BE greedy, or a later num_beams=1
@@ -414,6 +421,7 @@ def test_beam_with_temperature_does_not_poison_greedy_cache():
     np.testing.assert_array_equal(g1, g2)
 
 
+@pytest.mark.slow  # 16 s; chunk sampled by the sweep, ragged_chunked kept
 def test_chunked_prefill_matches_whole_prompt():
     """prefill_chunk: chunk-by-chunk prefill (incl. an uneven tail chunk)
     must produce EXACTLY the whole-prompt generation — same causal mask,
@@ -631,6 +639,7 @@ def test_seq2seq_generate_matches_naive_rescoring():
     np.testing.assert_array_equal(out, tgt)
 
 
+@pytest.mark.slow  # 15 s; seq2seq rescoring + trains_then_decodes stay tier-1
 def test_seq2seq_generate_eos_and_sampling():
     vocab = 61
     ff = _seq2seq_model(vocab=vocab)

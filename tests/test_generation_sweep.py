@@ -25,6 +25,13 @@ from flexflow_tpu import FFConfig, FFModel
 VOCAB = 61
 B, S0, NEW = 2, 8, 5
 N_CONFIGS = int(os.environ.get("FF_GEN_SWEEP_N", "220"))
+# tier-1 budget: the full 220-config sweep alone ate the entire 870 s
+# tier-1 window (the suite never reached the files after it). The first
+# TIER1_CONFIGS samples stay in tier-1 (every mode/arch lands several
+# times in 32 draws); the tail carries the `slow` marker and runs in the
+# nightly/`unit` tiers. Each i seeds its own RandomState, so the subset
+# is the same configs tier-1 always ran.
+TIER1_CONFIGS = int(os.environ.get("FF_GEN_SWEEP_TIER1", "32"))
 
 _MODELS = {}
 
@@ -151,7 +158,9 @@ def _oracle_rows(ff, prompt, lengths, out_tokens):
     return rows
 
 
-@pytest.mark.parametrize("i", range(N_CONFIGS))
+@pytest.mark.parametrize(
+    "i", [pytest.param(j, marks=[pytest.mark.slow] * (j >= TIER1_CONFIGS))
+          for j in range(N_CONFIGS)])
 def test_generation_sweep(i):
     rs = np.random.RandomState(1000 + i)
     c = _sample_config(rs)

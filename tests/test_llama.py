@@ -6,6 +6,7 @@ llama_lm trains on a synthetic next-token task.
 """
 
 import numpy as np
+import pytest
 import jax.numpy as jnp
 
 from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
@@ -139,6 +140,7 @@ def test_gqa_tp_degree_exceeding_kv_heads_replicates_kv():
     assert np.isfinite(np.asarray(losses)).all()
 
 
+@pytest.mark.slow  # 13 s; llama graphs train in the generation/serving suites
 def test_llama_lm_trains():
     # tiny next-token task: constant successor mapping is learnable
     vocab, seq, batch = 64, 16, 8

@@ -34,6 +34,7 @@ def _train(master_dtype="float32", use_fused_ln=False, steps=3,
     return losses, ff
 
 
+@pytest.mark.slow  # 21 s; bf16 master also pinned by fused_optimizer_scanned_training_bitwise
 def test_bf16_master_weights_train_and_store_bf16():
     losses, ff = _train(master_dtype="bfloat16", compute="bfloat16")
     kernels = [v for op in ff.params.values() for k, v in op.items()
@@ -81,6 +82,7 @@ def test_fused_add_layernorm_matches_unfused_ops():
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # 14 s; fused-LN is opt-in (benched as a loss at h=1024), kernel parity test stays
 def test_fused_ln_transformer_trains():
     losses, ff = _train(use_fused_ln=True)
     assert losses[-1] < losses[0]
@@ -95,6 +97,7 @@ def test_fused_ln_transformer_trains():
     assert n_norm_params == n_ref
 
 
+@pytest.mark.slow  # 14 s; fused-LN is opt-in, kernel parity test stays
 def test_fused_ln_shard_mapped_under_dp(monkeypatch):
     """Multi-chip fused LN: the Pallas kernel runs per-shard inside
     shard_map under a sharded strategy (GSPMD cannot partition a Mosaic

@@ -106,6 +106,7 @@ def test_packed_varint_fields_parse():
 # ---- keras_exp: GENUINE tf.keras bytes (VERDICT r4 #6) ----------------------
 
 
+@pytest.mark.slow  # 17 s real-TF-bytes variant; codec covered by the other tests
 def test_keras_exp_real_tf_keras_bytes_through_minionnx():
     """The keras_exp loop on REAL tf.keras state: a live Keras model's
     layers + weights are exported to ONNX protobuf bytes, those exact

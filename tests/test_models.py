@@ -30,6 +30,7 @@ def test_alexnet_cifar10_builds_and_steps():
              final=out)
 
 
+@pytest.mark.slow  # 49 s: the conv zoo is covered by alexnet/inception
 def test_resnet50_builds_and_steps():
     from flexflow_tpu.models.cnn import resnet50
 
@@ -43,6 +44,7 @@ def test_resnet50_builds_and_steps():
              final=out)
 
 
+@pytest.mark.slow  # 8 s zoo build
 def test_vit_builds_and_steps():
     from flexflow_tpu.models.vit import vit
 
@@ -68,6 +70,7 @@ def test_inception_builds_and_steps():
              final=out)
 
 
+@pytest.mark.slow  # 46 s: the smaller inception build stays in tier-1
 def test_inception_v3_full_builds_and_steps():
     from flexflow_tpu.models.cnn import inception_v3
 
@@ -130,6 +133,7 @@ def test_nmt_builds_and_steps():
              final=logits)
 
 
+@pytest.mark.slow  # 10 s zoo build; transformer coverage stays via inception/gpt tests
 def test_bert_base_builds_and_steps():
     from flexflow_tpu.models.bert import bert_base
 
@@ -144,6 +148,7 @@ def test_bert_base_builds_and_steps():
              final=out)
 
 
+@pytest.mark.slow  # 8 s zoo build; MoE pinned by test_moe_numerics/test_pipeline_moe
 def test_gpt_moe_builds_and_steps():
     from flexflow_tpu.models.bert import gpt_lm
 
@@ -171,6 +176,7 @@ def test_gpt_pipelined_builds_and_steps():
              final=logits, optimizer=AdamOptimizer(alpha=1e-3))
 
 
+@pytest.mark.slow  # 15 s; the seq2seq graph builds+trains in test_generation's seq2seq tests
 def test_seq2seq_transformer_builds_and_steps():
     """Encoder-decoder with DISTINCT src/tgt lengths: causal decoder
     self-attn + sq != sk cross-attention (the flash cross-attn workload,

@@ -54,6 +54,7 @@ def _run_workers(worker, ckpt, timeout=400):
     return outs
 
 
+@pytest.mark.slow  # 10 s 2-process smoke; the resilience CI tier runs it by name
 def test_two_process_training_via_launcher(tmp_path):
     outs = _run_workers(WORKER, str(tmp_path / "ckpt"))
     losses = []
@@ -66,6 +67,7 @@ def test_two_process_training_via_launcher(tmp_path):
     assert losses[0] == pytest.approx(losses[1], rel=1e-6)
 
 
+@pytest.mark.slow  # 34 s 2-process smoke; training variant stays tier-1
 def test_two_process_serving_restore_and_decode(tmp_path):
     """Multi-host SERVING leg (VERDICT r3 #9): train -> sharded checkpoint
     -> restore into a fresh model on the 2-process mesh -> KV-cache greedy

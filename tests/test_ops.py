@@ -248,6 +248,7 @@ def test_gradients_match_torch_mlp():
                                tb2.grad.numpy(), rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # 17 s torch-parity one-off
 def test_sgd_momentum_step_matches_torch():
     """One SGD+momentum+weight-decay step matches torch.optim.SGD (reference
     harness compares manual SGD update sequences)."""

@@ -132,6 +132,7 @@ def _alternating_a_block_strategies():
     return out
 
 
+@pytest.mark.slow  # 28 s InceptionV3-scale; small-graph packing stays tier-1
 def test_inception_full_tower_group_packing():
     """VERDICT r2 #6 (structure): the dependency-safe packer on the FULL
     InceptionV3 tower (75x75, the smallest input the D-block grid reduction
@@ -160,6 +161,7 @@ def test_inception_full_tower_group_packing():
     assert len(groups) <= 8, [repr(g) for g in groups]
 
 
+@pytest.mark.slow  # 29 s InceptionV3-scale; parity pinned by the small graphs
 def test_inception_branchy_placement_grad_parity():
     """VERDICT r2 #6 (numerics): search-shaped placement training on the
     branchy InceptionV3 stem+3xA section (64x64 keeps two full train runs

@@ -45,6 +45,7 @@ def test_large_batch_regime_is_honest():
     assert 1.0 <= r["speedup_vs_dp"] < 1.5, r
 
 
+@pytest.mark.slow  # 13 s 64-chip scale variant; smaller search tests stay tier-1
 def test_llama8b_64chip_search_combines_parallelism_axes():
     """VERDICT r4 #7, the scale-shaped joint search: the REAL Llama-8B
     shape (hidden 4096, 32 layers, GQA 32/8, ffn 14336, vocab 128k) over

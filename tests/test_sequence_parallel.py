@@ -28,7 +28,13 @@ def dense_reference(q, k, v, causal=False):
 
 
 def _shard_map():
-    return jax.shard_map
+    # jax 0.4.x has no top-level jax.shard_map (its module __getattr__
+    # raises); fall back to the experimental spelling there
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map
 
 
 def make_qkv(b=2, s=32, h=4, d=8, seed=0):

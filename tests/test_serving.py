@@ -37,6 +37,7 @@ def _prompts(seed, lengths):
     return [rs.randint(1, VOCAB, (L,)).astype(np.int32) for L in lengths]
 
 
+@pytest.mark.slow  # 17 s; the serving CI tier + serve_smoke drive continuous batching
 def test_continuous_batching_token_identical_to_sequential(ff):
     """More requests than slots, mixed lengths spanning several buckets:
     every request's emitted tokens equal its SOLO (one-request-at-a-time)
@@ -59,6 +60,7 @@ def test_continuous_batching_token_identical_to_sequential(ff):
     assert 0.0 < st["occupancy"] <= 1.0
 
 
+@pytest.mark.slow  # 8 s; serving CI tier runs the full file
 def test_serve_api_and_eos_retirement(ff):
     """FFModel.serve: eos retires a slot early (freeing it for the queue)
     and outputs match per-request generate with the same eos."""
@@ -123,6 +125,7 @@ def test_paged_gather_matches_dense_cache_bitwise(ff):
         np.testing.assert_array_equal(np.asarray(cache_d[name]), gathered)
 
 
+@pytest.mark.slow  # 11 s; serving CI tier runs the full file
 def test_early_exit_identical_to_full_scan(ff):
     """The while_loop early-exit path: identical tokens (and scores) to
     the full-length scan, with and without eos; without eos_id it simply
@@ -202,6 +205,7 @@ def test_poisoned_request_retired_without_stalling(ff, monkeypatch):
     assert st["failed"] == 1 and st["free_pages"] == st["kv_pages"] - 1
 
 
+@pytest.mark.slow  # 7 s; serving CI tier runs the full file
 def test_page_pool_pressure_blocks_admission_not_progress(ff):
     """A pool too small for all slots at once: admission waits for
     retirements instead of deadlocking, and every request still finishes
@@ -242,6 +246,7 @@ def test_serving_validation(ff):
                  decode_buckets=[16, 8])
 
 
+@pytest.mark.slow  # 17 s; serving CI tier runs the full file
 def test_decode_chunk_invariance(ff):
     """decode_chunk trades dispatch overhead for retirement granularity
     ONLY: any chunk size produces identical tokens — including requests
@@ -271,6 +276,7 @@ def test_decode_chunk_invariance(ff):
         np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.slow  # 7 s; serving CI tier runs the full file
 def test_explicit_buckets_and_per_request_max_new(ff):
     """Pinned decode_buckets honor their boundaries; per-request
     max_new_tokens mixes freely in one batch."""
