@@ -18,8 +18,13 @@
 #   overlap   — host-overlap step engine tests (prefetch pipeline +
 #               dispatch-ahead fit) + a slow-loader smoke asserting
 #               throughput improves and host_wait drops
+#   elastic   — elastic-recovery tests (topology-change resume, integrity
+#               manifests, serving drain) + the corruption-injection
+#               resume smoke + a 2-process run killed mid-epoch and
+#               resumed SINGLE-process with on_topology_change=
+#               resume_resharded (gloo-gated)
 #
-# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|all]
+# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|all]
 set -e
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -83,12 +88,18 @@ run_lint()     {
 # same atomic save/restore path the supervisor drives. The multihost leg
 # needs gloo CPU collectives; probe and skip (loudly) where this jax
 # build lacks them.
-run_resilience() {
-  python -m pytest tests/test_resilience.py -q
-  if JAX_PLATFORMS="" python -c "
+# gloo probe shared by every multihost smoke: the 2-process legs need
+# CPU collectives, which some jax builds lack.
+has_gloo() {
+  JAX_PLATFORMS="" python -c "
 import jax
 jax.config.update('jax_cpu_collectives_implementation', 'gloo')" \
-      >/dev/null 2>&1; then
+      >/dev/null 2>&1
+}
+
+run_resilience() {
+  python -m pytest tests/test_resilience.py -q
+  if has_gloo; then
     python -m pytest tests/test_multihost.py -q -k two_process_training
   else
     echo "resilience: no gloo CPU collectives in this jax build —" \
@@ -115,6 +126,25 @@ run_overlap() {
   python scripts/overlap_smoke.py
 }
 
+# elastic tier: the recovery suite (resume onto fewer devices /
+# differently-shaped meshes, manifest verification + corrupted-latest
+# fallback, retention sparing the last intact step, drain/health), the
+# single-process corruption-injection resume smoke, and — where this jax
+# build has gloo CPU collectives — the full changed-topology drill: a
+# 2-process multihost run preempted mid-epoch, then relaunched as ONE
+# surviving process that reshards onto 4 devices with the global batch
+# preserved via grad-accum.
+run_elastic() {
+  python -m pytest tests/test_elastic.py -q
+  python scripts/elastic_smoke.py corrupt
+  if has_gloo; then
+    python scripts/elastic_smoke.py shrink
+  else
+    echo "elastic: no gloo CPU collectives in this jax build —" \
+         "skipping the 2-process shrink smoke"
+  fi
+}
+
 case "$TIER" in
   unit)     run_unit ;;
   sweep)    run_sweep ;;
@@ -125,7 +155,8 @@ case "$TIER" in
   resilience) run_resilience ;;
   serving)  run_serving ;;
   overlap)  run_overlap ;;
-  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_native; run_docs; run_sweep ;;
+  elastic)  run_elastic ;;
+  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_native; run_docs; run_sweep ;;
   *) echo "unknown tier $TIER"; exit 2 ;;
 esac
 echo "ci($TIER): PASSED"

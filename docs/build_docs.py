@@ -28,7 +28,7 @@ PAGES = [("index", os.path.join(ROOT, "README.md"), "Overview"),
          ("migration", os.path.join(DOCS, "migration.md"),
           "Migration from FlexFlow"),
          ("resilience", os.path.join(DOCS, "resilience.md"),
-          "Fault tolerance"),
+          "Fault tolerance & elastic recovery"),
          ("serving", os.path.join(DOCS, "serving.md"),
           "Serving (continuous batching)"),
          ("performance", os.path.join(DOCS, "performance.md"),

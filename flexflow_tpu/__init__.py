@@ -60,6 +60,10 @@ from flexflow_tpu.runtime.initializer import (  # noqa: F401
 )
 from flexflow_tpu.runtime.dataloader import SingleDataLoader  # noqa: F401
 from flexflow_tpu.runtime.resilience import TrainSupervisor  # noqa: F401
+from flexflow_tpu.runtime.elastic import TopologyChangedError  # noqa: F401
+from flexflow_tpu.runtime.checkpoint import (  # noqa: F401
+    CheckpointCorruptError,
+)
 from flexflow_tpu.parallel.pconfig import ParallelConfig  # noqa: F401
 
 __version__ = "0.1.0"

@@ -155,6 +155,27 @@ class FFConfig:
     loss_scale: float = 1.0  # initial loss scale ("backoff" mode)
     loss_scale_growth_interval: int = 200
 
+    # ---- elastic recovery (runtime/elastic.py) ----
+    # what a resuming process does when its actual topology (visible
+    # devices / mesh) differs from the checkpoint's:
+    #   "resume_resharded" — refit the mesh to the surviving devices
+    #       (csim-ranked candidates over the saved axes), re-shard the
+    #       saved params/opt-state onto it, and preserve the GLOBAL batch
+    #       by scaling grad_accum_steps with the data-degree change
+    #   "research"        — same mesh refit, then re-run the MCMC strategy
+    #       search at the new device count (budget: search_budget, else a
+    #       small default) instead of re-deriving the saved strategy
+    #   "abort"           — raise TopologyChangedError (the pre-elastic
+    #       behavior, for jobs whose semantics pin the topology)
+    on_topology_change: str = "resume_resharded"
+    # verify the content-hash manifest (ff_manifest.json) of a checkpoint
+    # before restoring, and fall back to the newest INTACT step when the
+    # latest fails (torn write, bitrot, injected corruption)
+    verify_checkpoints: bool = True
+    # refuse to resume-reshard below this many devices (a 256-chip job
+    # "recovering" onto 2 chips is an outage, not elasticity)
+    elastic_min_devices: int = 1
+
     # ---- serving (runtime/serving.py: continuous batching) ----
     # decode slots in the ONE compiled slot-decode program; the host
     # scheduler admits/retires requests per slot
@@ -208,6 +229,15 @@ class FFConfig:
             raise ValueError(
                 f"loss_scale_growth_interval="
                 f"{self.loss_scale_growth_interval}: must be >= 1")
+        if self.on_topology_change not in ("resume_resharded", "research",
+                                           "abort"):
+            raise ValueError(
+                f"on_topology_change={self.on_topology_change!r}: must be "
+                f"'resume_resharded', 'research' or 'abort'")
+        if self.elastic_min_devices < 1:
+            raise ValueError(
+                f"elastic_min_devices={self.elastic_min_devices}: "
+                f"must be >= 1")
         if self.serve_slots < 1 or self.kv_page_size < 1 \
                 or self.kv_pages < 0:
             raise ValueError(
@@ -279,6 +309,15 @@ class FFConfig:
                        help="enable the train supervisor: atomic periodic "
                             "checkpoints + auto-resume + SIGTERM handling")
         p.add_argument("--checkpoint-every", type=int, default=0)
+        p.add_argument("--on-topology-change", type=str,
+                       default="resume_resharded",
+                       choices=("resume_resharded", "research", "abort"),
+                       help="elastic resume policy when the visible "
+                            "topology differs from the checkpoint's")
+        p.add_argument("--no-verify-checkpoints", action="store_true",
+                       help="skip content-hash manifest verification at "
+                            "restore (on by default)")
+        p.add_argument("--elastic-min-devices", type=int, default=1)
         # e.g. --mesh data=4,model=2 (replaces -ll:gpu device-count knobs)
         p.add_argument("--mesh", type=str, default="")
         args, _ = p.parse_known_args(argv)
@@ -313,4 +352,7 @@ class FFConfig:
             fsdp_axis=args.fsdp_axis,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,
+            on_topology_change=args.on_topology_change,
+            verify_checkpoints=not args.no_verify_checkpoints,
+            elastic_min_devices=args.elastic_min_devices,
         )

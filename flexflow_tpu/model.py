@@ -458,6 +458,25 @@ class FFModel:
         self.loss_type = loss_type_from_name(loss_type)
         self.metric_types = metrics_from_names(metrics)
         self.comp_mode = comp_mode
+        # strategy import must land BEFORE the elastic hook: on a relaunch
+        # with the original flags the imported file describes the OLD
+        # topology, and the hook's mesh-refit re-derivation has to win over
+        # it, not be clobbered by it
+        if cfg.import_strategy_file:
+            cfg.strategies.update(
+                load_strategies_from_file(cfg.import_strategy_file))
+        # elastic recovery (runtime/elastic.py): with a checkpoint_dir set,
+        # compare the newest intact checkpoint's recorded topology against
+        # what this process actually has BEFORE the mesh is built — a
+        # restart on fewer devices refits the mesh (csim-ranked), re-derives
+        # the saved strategy, and preserves the global batch via grad-accum,
+        # per cfg.on_topology_change; the later restore then re-shards the
+        # saved params onto whatever mesh this compile produces
+        self._elastic = None
+        if cfg.checkpoint_dir:
+            from flexflow_tpu.runtime.elastic import apply_elastic_policy
+
+            self._elastic = apply_elastic_policy(self)
         if cfg.compilation_cache_dir:
             # persistent compilation cache: must be on BEFORE the first
             # trace so the train/serve programs are covered; repeated runs
@@ -473,9 +492,6 @@ class FFModel:
                     compilation_cache_entries(cfg.compilation_cache_dir))
         self.mesh = make_mesh(cfg.mesh_shape)
 
-        if cfg.import_strategy_file:
-            cfg.strategies.update(
-                load_strategies_from_file(cfg.import_strategy_file))
         if cfg.search_budget > 0:
             from flexflow_tpu.search.driver import optimize_strategies
 

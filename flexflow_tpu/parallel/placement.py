@@ -374,6 +374,13 @@ class PlacementExecutor:
                             for name, ps in wp.items()}
         return out
 
+    def reshard_params(self, host_tree):
+        """Checkpoint-restore placement: each op's weights land on its own
+        group's sub-mesh (see executor.reshard_tree)."""
+        from flexflow_tpu.runtime.executor import reshard_tree
+
+        return reshard_tree(host_tree, self.param_shardings())
+
     def init_params(self, rng_key):
         from flexflow_tpu.runtime.executor import _stable_hash
         from flexflow_tpu.runtime.initializer import init_weight

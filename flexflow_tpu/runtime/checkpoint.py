@@ -17,14 +17,32 @@ the rename, so a renamed checkpoint is always self-contained; the top-level
 ``latest_step`` scans the ``step_*`` dirs (tmp dirs skipped), and orbax
 save/load run under ``resilience.retry`` with ``io_fail`` fault-injection
 hooks (FF_FAULT) so the retry path is tier-1-testable.
+
+Integrity (the elastic-recovery story, runtime/elastic.py): every step dir
+carries a content-hash manifest ``ff_manifest.json`` (relative path ->
+sha256 + byte size over every other file in the dir), written INSIDE the
+tmp dir before the publish rename so a published checkpoint always carries
+its own proof. ``verify_step`` recomputes the hashes; resume paths
+(``auto_resume``, ``TrainSupervisor.resume``, ``restore_checkpoint`` with
+``step=None``) fall back to the newest *intact* step when the latest one
+fails verification (torn write, bitrot, FF_FAULT ``corrupt_ckpt@save:<n>``
+injection), and keep-K retention never deletes the last intact checkpoint
+even when every newer step is corrupt.
+
+Topology: single-controller checkpoints are host numpy, so a restore
+re-shards onto whatever mesh the restoring model compiled with
+(``executor.reshard_params``) — the checkpoint itself is topology-free and
+a job killed on N devices resumes on N-1 (see runtime/elastic.py for the
+policy and mesh-refit side).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import re
 import os
-from typing import Optional
+from typing import List, Optional
 
 import jax
 import numpy as np
@@ -35,10 +53,224 @@ from flexflow_tpu.runtime import faultinject
 from flexflow_tpu.runtime.resilience import retry
 
 
+MANIFEST_NAME = "ff_manifest.json"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint's payload no longer matches its content-hash manifest
+    (torn write, bitrot, injected corruption). Resume paths catch this and
+    fall back to the newest intact step."""
+
+
 def _checkpointer():
     import orbax.checkpoint as ocp
 
     return ocp.PyTreeCheckpointer()
+
+
+# ------------------------------------------------------ integrity manifest
+
+
+def _manifest_files(step_dir: str):
+    """Every regular file under `step_dir` except the manifest itself, as
+    (relative posix path, absolute path) sorted for determinism."""
+    out = []
+    for root, _dirs, files in os.walk(step_dir):
+        for name in files:
+            full = os.path.join(root, name)
+            rel = os.path.relpath(full, step_dir).replace(os.sep, "/")
+            if rel == MANIFEST_NAME:
+                continue
+            out.append((rel, full))
+    out.sort()
+    return out
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def write_manifest(step_dir: str) -> str:
+    """Write the content-hash manifest for a (not yet published) step dir:
+    ``{"algo": "sha256", "files": {relpath: {"sha256": ..., "bytes": n}}}``.
+    Called inside the tmp dir BEFORE the publish rename, so every published
+    checkpoint is born with its proof."""
+    manifest = {"algo": "sha256", "files": {}}
+    for rel, full in _manifest_files(step_dir):
+        manifest["files"][rel] = {"sha256": _sha256(full),
+                                  "bytes": os.path.getsize(full)}
+    path = os.path.join(step_dir, MANIFEST_NAME)
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+    return path
+
+
+def verify_checkpoint(directory: str, step: int):
+    """Recompute the manifest hashes of ``step_<step>`` and raise
+    ``CheckpointCorruptError`` naming the first mismatching file. A
+    checkpoint predating the manifest layer (no ff_manifest.json) passes —
+    there is nothing to verify it against, and refusing every pre-existing
+    checkpoint would turn an upgrade into data loss."""
+    step_dir = os.path.join(os.path.abspath(directory), f"step_{step}")
+    mpath = os.path.join(step_dir, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        return
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"checkpoint step {step}: unreadable manifest {mpath}: {e}")
+    files = manifest.get("files", {})
+    present = {rel: full for rel, full in _manifest_files(step_dir)}
+    missing = [rel for rel in files if rel not in present]
+    if missing:
+        raise CheckpointCorruptError(
+            f"checkpoint step {step}: {len(missing)} manifest file(s) "
+            f"missing, first {missing[0]!r}")
+    for rel, rec in files.items():
+        full = present[rel]
+        if os.path.getsize(full) != rec.get("bytes"):
+            raise CheckpointCorruptError(
+                f"checkpoint step {step}: {rel!r} is "
+                f"{os.path.getsize(full)} bytes, manifest records "
+                f"{rec.get('bytes')}")
+        if _sha256(full) != rec.get("sha256"):
+            raise CheckpointCorruptError(
+                f"checkpoint step {step}: content hash mismatch on {rel!r} "
+                f"— payload corrupted after save")
+
+
+def verify_step(directory: str, step: int) -> bool:
+    """Boolean flavor of verify_checkpoint (plus meta readability) for
+    scan loops; corruption details go through verify_checkpoint."""
+    if not _meta_readable(directory, step):
+        return False
+    try:
+        verify_checkpoint(directory, step)
+        return True
+    except CheckpointCorruptError:
+        return False
+
+
+def _meta_readable(directory: str, step: int) -> bool:
+    """Is the step's metadata usable? A per-step ff_meta.json that exists
+    but fails to parse marks a damaged dir; a dir with NO per-step meta is
+    only usable through a readable top-level meta.json (pre-atomic-write
+    layout)."""
+    per_step = os.path.join(directory, f"step_{step}", "ff_meta.json")
+    target = per_step if os.path.exists(per_step) \
+        else os.path.join(directory, "meta.json")
+    try:
+        with open(target) as f:
+            json.load(f)
+        return True
+    except (OSError, ValueError):
+        return False
+
+
+def _intact_with_warning(directory: str, step: int, verify: bool) -> bool:
+    from flexflow_tpu.logger import fflogger
+
+    if not _meta_readable(directory, step):
+        fflogger.warning(
+            "checkpoint step %d in %s: unreadable metadata — skipping "
+            "(torn write or damaged dir)", step, directory)
+        return False
+    if verify:
+        try:
+            verify_checkpoint(directory, step)
+        except CheckpointCorruptError as e:
+            fflogger.warning(
+                "checkpoint step %d in %s failed integrity "
+                "verification — skipping: %s", step, directory, e)
+            return False
+    return True
+
+
+def iter_intact_steps(directory: str, verify: bool = True, on_skip=None,
+                      trusted_step: Optional[int] = None):
+    """Lazily yield published checkpoint steps newest-first, skipping
+    (with a warning, and an ``on_skip(step)`` callback for counters) any
+    whose metadata is unreadable or — when `verify` — whose manifest
+    fails verification. LAZY on purpose: verification hashes the full
+    payload, so the resume paths (which stop at the first restorable
+    step) pay one hash pass over one checkpoint, not K. ``trusted_step``
+    names a step the caller already verified in this process (the
+    compile-time elastic hook records one) — its payload is not hashed
+    again, only its metadata re-checked."""
+    directory = os.path.abspath(directory)
+    for step in sorted(_step_dirs(directory), reverse=True):
+        if _intact_with_warning(directory, step,
+                                verify and step != trusted_step):
+            yield step
+        elif on_skip is not None:
+            on_skip(step)
+
+
+def trusted_step_for(model, directory: str) -> Optional[int]:
+    """The step the compile-time elastic hook verified, or None — honored
+    ONLY when ``directory`` is the one the hook actually hashed, so a
+    resume pointed at a different directory never inherits the trust."""
+    step = getattr(model, "_elastic_verified_step", None)
+    if step is None:
+        return None
+    recorded = getattr(model, "_elastic_verified_dir", None)
+    if recorded is not None and \
+            os.path.abspath(recorded) != os.path.abspath(directory):
+        return None
+    return step
+
+
+def has_checkpoints(directory: str) -> bool:
+    """Any published step dir at all in `directory`, intact or not — the
+    'is there evidence of prior training' test the resume paths use to
+    distinguish a fresh start from a directory of damaged checkpoints."""
+    return bool(_step_dirs(os.path.abspath(directory)))
+
+
+def intact_steps(directory: str, verify: bool = True) -> List[int]:
+    """Eager flavor of ``iter_intact_steps`` — the full fallback chain,
+    for callers that genuinely need every intact step."""
+    return list(iter_intact_steps(directory, verify=verify))
+
+
+def latest_intact_step(directory: str, verify: bool = True) -> Optional[int]:
+    return next(iter_intact_steps(directory, verify=verify), None)
+
+
+def _inject_corruption(step_dir: str):
+    """FF_FAULT ``corrupt_ckpt@save:<n>``: flip bytes in the middle of the
+    step's largest payload file AFTER the publish rename — the
+    deterministic stand-in for bitrot / a torn write that slipped past
+    rename atomicity. The manifest is left intact so verification can
+    catch the damage."""
+    from flexflow_tpu.logger import fflogger
+
+    skip = {MANIFEST_NAME, "ff_meta.json", "strategy.txt"}
+    candidates = [(os.path.getsize(full), rel, full)
+                  for rel, full in _manifest_files(step_dir)
+                  if rel.split("/")[-1] not in skip
+                  and os.path.getsize(full) > 0]
+    if not candidates:  # nothing but metadata: corrupt the meta instead
+        candidates = [(os.path.getsize(full), rel, full)
+                      for rel, full in _manifest_files(step_dir)
+                      if os.path.getsize(full) > 0]
+    if not candidates:
+        return
+    size, rel, full = max(candidates)
+    with open(full, "r+b") as f:
+        f.seek(size // 2)
+        chunk = f.read(8) or b"\x00"
+        f.seek(size // 2)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+    fflogger.warning(
+        "faultinject: corrupted checkpoint payload %s in %s (FF_FAULT "
+        "corrupt_ckpt@save)", rel, step_dir)
 
 
 def _opt_layout(model) -> str:
@@ -148,8 +380,17 @@ def save_checkpoint(model, directory: str, step: Optional[int] = None,
               name="orbax save")(_save)()
 
     if is_writer:
+        # topology + batch math recorded for elastic resume
+        # (runtime/elastic.py): a restart on a different device count reads
+        # these to refit the mesh and preserve the global batch via
+        # grad-accum adjustment
         meta = {"step": int(step),
                 "mesh_shape": model.config.mesh_shape,
+                "num_devices": int(model.config.num_devices or 0),
+                "process_count": jax.process_count(),
+                "batch_size": int(model.config.batch_size),
+                "grad_accum_steps": int(getattr(model.config,
+                                                "grad_accum_steps", 1)),
                 "multihost": multihost,
                 "loss_type": model.loss_type.name if model.loss_type else None}
         if "opt_state" in state:  # layout only meaningful when state saved
@@ -162,6 +403,10 @@ def save_checkpoint(model, directory: str, step: Optional[int] = None,
             json.dump(meta, f)
         save_strategies_to_file(os.path.join(tmp, "strategy.txt"),
                                 model.config.strategies)
+        # the manifest is the LAST write into tmp: it covers every other
+        # file (orbax payload, meta, strategy), so a published dir always
+        # carries a complete proof of its own contents
+        write_manifest(tmp)
         if os.path.exists(path):
             # same-step overwrite: the old dir must vanish for the rename
             # (os.replace cannot clobber a non-empty dir). The unprotected
@@ -179,8 +424,46 @@ def save_checkpoint(model, directory: str, step: Optional[int] = None,
         stmp = os.path.join(directory, f".strategy.txt.tmp-{os.getpid()}")
         save_strategies_to_file(stmp, model.config.strategies)
         os.replace(stmp, os.path.join(directory, "strategy.txt"))
+        if faultinject.active_plan().fire("corrupt_ckpt", "save"):
+            # deterministic bitrot drill: damage the JUST-PUBLISHED payload
+            # (before retention runs, so the intact-preservation rule below
+            # is what keeps an older recoverable step alive)
+            _inject_corruption(path)
         if keep is not None and keep > 0:
-            for old in sorted(_step_dirs(directory))[:-keep]:
+            steps_sorted = sorted(_step_dirs(directory))
+            doomed = steps_sorted[:-keep]
+
+            # the step THIS call just wrote (and fully hashed in
+            # write_manifest) is intact by construction — don't pay a
+            # second hash pass on the save critical path. The exception is
+            # the corruption drill, whose whole point is that the fresh
+            # step may no longer match its manifest.
+            drill = any(k == "corrupt_ckpt"
+                        for k, _s, _i in faultinject.active_plan().events)
+
+            def _survivor_intact(s: int) -> bool:
+                if s == int(step) and not drill:
+                    return True
+                return verify_step(directory, s)
+
+            # newest-first so an intact newest survivor short-circuits
+            if doomed and not any(_survivor_intact(s)
+                                  for s in reversed(steps_sorted[-keep:])):
+                # every survivor is corrupt/unreadable: deleting the whole
+                # tail would leave NO restorable checkpoint — spare the
+                # newest intact one (retention resumes normally once an
+                # intact step re-enters the survivor window)
+                for s in reversed(doomed):
+                    if verify_step(directory, s):
+                        doomed.remove(s)
+                        from flexflow_tpu.logger import fflogger
+
+                        fflogger.warning(
+                            "checkpoint retention: every surviving step of "
+                            "keep=%d fails verification — keeping intact "
+                            "step %d beyond the retention window", keep, s)
+                        break
+            for old in doomed:
                 shutil.rmtree(os.path.join(directory, f"step_{old}"),
                               ignore_errors=True)
     if multihost:
@@ -190,19 +473,72 @@ def save_checkpoint(model, directory: str, step: Optional[int] = None,
     return path
 
 
-def restore_checkpoint(model, directory: str, step: Optional[int] = None):
+def restore_checkpoint(model, directory: str, step: Optional[int] = None,
+                       verify: Optional[bool] = None):
     """Restore into a compiled model. Single-controller checkpoints are
     stored as host numpy (see save_checkpoint), so restore re-shards onto
     the restoring model's own mesh regardless of the topology that saved
-    them. Under multi-controller, every process calls this collectively and
-    orbax restores each array directly into the model's current sharding
-    (each host reads only its shards)."""
+    them — including a DIFFERENT device count (the elastic path,
+    runtime/elastic.py). Under multi-controller, every process calls this
+    collectively and orbax restores each array directly into the model's
+    current sharding (each host reads only its shards).
+
+    ``verify`` (default: FFConfig.verify_checkpoints) recomputes the step's
+    content-hash manifest first and raises ``CheckpointCorruptError`` on a
+    mismatch; with ``step=None`` the newest INTACT step is chosen, so a
+    corrupted latest falls back automatically. Even with ``verify=False``
+    a restore that fails mid-read is re-checked against the manifest: if
+    the step no longer verifies (damage or retention raced the caller's
+    intact scan) the failure is reclassified as ``CheckpointCorruptError``
+    so the resume fallback chains engage; a genuine error over an intact
+    step propagates untouched."""
     directory = os.path.abspath(directory)
+    if verify is None:
+        verify = bool(getattr(model.config, "verify_checkpoints", True))
     if step is None:
-        step = latest_step(directory)
+        step = latest_intact_step(directory, verify=verify)
         if step is None:
             raise FileNotFoundError(
-                f"no checkpoint found in {directory}")
+                f"no (intact) checkpoint found in {directory}")
+    elif verify:
+        verify_checkpoint(directory, step)
+    try:
+        return _restore_into(model, directory, step)
+    except CheckpointCorruptError:
+        raise
+    except Exception as err:
+        _reclassify_raced_damage(directory, step, err)
+        raise
+
+
+def _reclassify_raced_damage(directory: str, step: int, err: Exception):
+    """A restore that failed AFTER the caller's intact scan may be raced
+    damage (concurrent retention pruned the step, corruption landed after
+    the hash pass) rather than a code bug. Re-check the step: a vanished
+    dir, unreadable metadata, or a manifest that no longer verifies
+    reclassifies the failure as ``CheckpointCorruptError`` — the exception
+    the documented fallbacks (auto_resume, TrainSupervisor.resume) catch.
+    An intact step means the error is real; return and let it propagate."""
+    step_dir = os.path.join(directory, f"step_{step}")
+    if not os.path.isdir(step_dir):
+        raise CheckpointCorruptError(
+            f"checkpoint step {step} disappeared mid-restore "
+            f"({type(err).__name__}: {err})") from err
+    if not _meta_readable(directory, step):
+        raise CheckpointCorruptError(
+            f"checkpoint step {step}: metadata became unreadable "
+            f"mid-restore ({type(err).__name__}: {err})") from err
+    try:
+        verify_checkpoint(directory, step)
+    except CheckpointCorruptError as ce:
+        raise CheckpointCorruptError(
+            f"{ce} (surfaced as {type(err).__name__} mid-restore)") from err
+
+
+def _restore_into(model, directory: str, step: int) -> int:
+    """Read + re-shard a chosen, published step into the model — the body
+    of ``restore_checkpoint`` after step selection/verification, separated
+    so the wrapper can reclassify raced-damage read failures."""
     meta = load_meta(directory, step)
     path = os.path.join(directory, f"step_{step}")
 
@@ -261,21 +597,16 @@ def restore_checkpoint(model, directory: str, step: Optional[int] = None):
         model._step_count = step
         return step
 
-    restored = _orbax_restore(path)
-    shardings = model.executor.param_shardings()
-
-    def put(tree, shard_map_):
-        out = {}
-        for op_name, ws in tree.items():
-            out[op_name] = {
-                name: jax.device_put(np.asarray(v),
-                                     shard_map_.get(op_name, {}).get(name))
-                if shard_map_.get(op_name, {}).get(name) is not None
-                else jax.device_put(np.asarray(v))
-                for name, v in ws.items()}
-        return out
-
-    model.params = put(restored["params"], shardings)
+    # a checkpoint written by a multi-controller job stores SHARDED jax
+    # arrays; deserializing those into a single-controller process needs
+    # explicit numpy restore args (orbax refuses without a sharding) —
+    # the N-hosts -> 1-host elastic resume path
+    restored = (_orbax_restore_as_numpy(path) if meta.get("multihost")
+                else _orbax_restore(path))
+    # re-shard the host tree onto the CURRENT executor's placement — the
+    # mesh the restoring process actually built, which need not match the
+    # one that saved (executor.reshard_params; elastic resume rides this)
+    model.params = model.executor.reshard_params(restored["params"])
     if "opt_state" in restored and model.optimizer is not None:
         fresh = model.optimizer.init_state(model.params)
         model.opt_state = _merge_restored(fresh, restored["opt_state"])
@@ -325,6 +656,23 @@ def _orbax_restore(path, **kw):
     return _checkpointer().restore(path, **kw)
 
 
+@retry(attempts=3, base_delay=0.05, retryable=(OSError,), name="orbax load")
+def _orbax_restore_as_numpy(path):
+    """Restore a multi-controller (sharded-array) checkpoint as plain host
+    numpy: every leaf gets RestoreArgs(restore_type=np.ndarray), built
+    from the checkpoint's own structure metadata. The full arrays
+    materialize on this host — exactly what the cross-topology re-shard
+    needs."""
+    faultinject.maybe_fail("io_fail", "load")
+    import orbax.checkpoint as ocp
+
+    ckptr = _checkpointer()
+    structure = ckptr.metadata(path)
+    restore_args = jax.tree_util.tree_map(
+        lambda _m: ocp.RestoreArgs(restore_type=np.ndarray), structure)
+    return ckptr.restore(path, restore_args=restore_args)
+
+
 def _step_dirs(directory: str):
     """Published checkpoint step numbers in `directory` (tmp dirs from an
     interrupted save are skipped — they never became checkpoints)."""
@@ -355,14 +703,20 @@ def load_meta(directory: str, step: Optional[int] = None) -> dict:
 
 
 def latest_step(directory: str) -> Optional[int]:
-    """Newest published checkpoint step in `directory`, or None. Scans the
-    ``step_*`` dirs ONLY: trusting ``meta.json`` would return steps whose
-    dir is gone (a kill inside the same-step overwrite window, retention
-    pruning) and turn auto-resume into a restore-of-nothing crash loop —
-    no dir means fresh start. ``.tmp-*`` leftovers from an interrupted
-    save are ignored."""
-    steps = _step_dirs(directory)
-    return max(steps) if steps else None
+    """Newest published checkpoint step in `directory` with READABLE
+    metadata, or None. Scans the ``step_*`` dirs ONLY: trusting
+    ``meta.json`` would return steps whose dir is gone (a kill inside the
+    same-step overwrite window, retention pruning) and turn auto-resume
+    into a restore-of-nothing crash loop — no dir means fresh start.
+    ``.tmp-*`` leftovers from an interrupted save are ignored, and a dir
+    whose ``ff_meta.json`` exists but no longer parses is skipped (a
+    damaged dir used to raise mid-resume here) — payload verification is
+    ``latest_intact_step``'s stricter job."""
+    directory = os.path.abspath(directory)
+    for step in sorted(_step_dirs(directory), reverse=True):
+        if _meta_readable(directory, step):
+            return step
+    return None
 
 
 def _strip_none(tree):
@@ -400,13 +754,57 @@ def _merge_restored(fresh, restored):
     return jnp.asarray(arr)
 
 
+def scan_and_restore(model, directory: str, *, restore, on_skip=None,
+                     who: str = "auto_resume") -> Optional[int]:
+    """The ONE newest-intact-first resume policy (``auto_resume`` and
+    ``TrainSupervisor.resume`` both ride it): lazily scan intact steps
+    (one payload hash per step actually examined, none for the step the
+    compile-time elastic hook verified for this directory), call
+    ``restore(step)`` on each candidate, fall back past raced
+    mid-restore damage with a warning (and ``on_skip``), and return the
+    restored step. Returns None when the directory holds no steps at
+    all; raises CheckpointCorruptError when every existing step fails —
+    silently starting fresh over damaged checkpoints would destroy the
+    evidence."""
+    from flexflow_tpu.logger import fflogger
+
+    verify = bool(getattr(model.config, "verify_checkpoints", True))
+    for step in iter_intact_steps(
+            directory, verify=verify, on_skip=on_skip,
+            trusted_step=trusted_step_for(model, directory)):
+        try:
+            restore(step)
+            return step
+        except CheckpointCorruptError as e:
+            # raced corruption between the scan's hash pass and the
+            # restore itself
+            fflogger.warning(
+                "%s: checkpoint step %d became unreadable mid-restore "
+                "(%s); falling back to the next intact step", who, step, e)
+            if on_skip is not None:
+                on_skip(step)
+    if _step_dirs(directory):
+        raise CheckpointCorruptError(
+            f"every checkpoint in {directory} fails metadata/manifest "
+            f"verification — refusing to silently start fresh over "
+            f"damaged checkpoints")
+    return None
+
+
 def auto_resume(model, directory: str) -> int:
     """Slice-preemption recovery (the capability gap SURVEY §5.3 notes in the
     reference: a failed node kills the job with no recovery). Call after
-    compile(): restores the newest checkpoint in `directory` when one exists
-    and returns its step; returns 0 on a fresh start."""
-    step = latest_step(directory)
-    if step is None:
-        return 0
-    restore_checkpoint(model, directory, step=step)
-    return step
+    compile(): restores the newest INTACT checkpoint in `directory` when
+    one exists and returns its step; returns 0 on a fresh start (no step
+    dirs at all). A corrupted/unreadable newer step is skipped with a
+    warning instead of raising mid-resume; when every existing step fails
+    verification the corruption error propagates — silently training from
+    scratch on top of a directory full of damaged checkpoints would
+    destroy the evidence."""
+    def _restore(step):
+        # the scan just verified this step — don't hash it again; raced
+        # damage inside the restore itself still surfaces
+        restore_checkpoint(model, directory, step=step, verify=False)
+
+    step = scan_and_restore(model, directory, restore=_restore)
+    return 0 if step is None else step

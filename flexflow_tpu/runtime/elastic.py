@@ -1,0 +1,345 @@
+"""Elastic recovery: resume a checkpointed run on a changed topology.
+
+The paper's core position is that the parallelization strategy is a
+*searchable artifact* of (graph, machine), not a fixed property of the job
+— so losing a host of a preemptible pool must not end the run when a
+perfectly good strategy exists for the surviving chips. PR 2's supervisor
+could only resume onto the exact mesh it died on; this module makes the
+restart topology-agnostic:
+
+  * checkpoints already store per-step ``ff_meta.json`` (mesh shape,
+    device/process count, batch size, grad-accum factor) and
+    ``strategy.txt``; single-controller payloads are host numpy, so the
+    bytes themselves are placement-free;
+  * ``apply_elastic_policy(model)`` runs at the top of ``FFModel.compile``
+    whenever ``checkpoint_dir`` is set: it compares the newest *intact*
+    checkpoint's topology against what the restarting process actually
+    has, and applies ``FFConfig.on_topology_change``:
+
+      resume_resharded  refit the mesh to the surviving devices (candidate
+                        factorizations over the saved axis names, ranked
+                        by the search cost model under a re-partition of
+                        the saved strategy — search.driver
+                        .rank_mesh_candidates), re-derive the saved
+                        strategy's axis maps on it, and preserve the
+                        GLOBAL batch by scaling grad_accum_steps with the
+                        data-degree change (optimizer trajectory stays
+                        comparable at N-1 devices);
+      research          same refit, then re-run the MCMC strategy search
+                        at the new device count (the machine changed, so
+                        the strategy is re-searched — the paper's thesis
+                        applied to recovery);
+      abort             raise TopologyChangedError.
+
+  * the actual restore then rides the ordinary path: params/opt-state
+    re-shard onto the new mesh in ``executor.reshard_params`` via
+    ``restore_checkpoint`` — bitwise the saved values, new placement.
+
+Deterministic drills (runtime/faultinject.py): ``shrink(<k>)@resume:<n>``
+presents only k visible devices on the n-th resume
+(``_env.force_cpu_devices`` in a fresh process, a capped count when the
+backend is already up), and ``corrupt_ckpt@save:<n>`` flips payload bytes
+after the n-th save publishes so the integrity-manifest fallback runs end
+to end (``ci/run_ci.sh elastic``, tests/test_elastic.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional
+
+from flexflow_tpu.logger import fflogger
+from flexflow_tpu.runtime import faultinject
+
+
+class TopologyChangedError(RuntimeError):
+    """The resuming process's topology differs from the checkpoint's and
+    the configured policy refuses to adapt (``on_topology_change="abort"``
+    or fewer than ``elastic_min_devices`` survivors)."""
+
+
+@dataclasses.dataclass
+class ElasticDecision:
+    """What the elastic policy did at compile time — stored on
+    ``model._elastic`` for tests/telemetry and logged once."""
+
+    policy: str
+    step: int                       # checkpoint step the decision read
+    saved_mesh: Dict[str, int]
+    new_mesh: Dict[str, int]
+    changed: bool                   # topology actually differed
+    saved_grad_accum: int
+    grad_accum: int                 # factor after global-batch preservation
+    strategy_source: str            # "checkpoint" | "research" | "default"
+    ranked_candidates: int = 0      # meshes scored during the refit
+
+    @property
+    def saved_devices(self) -> int:
+        return _prod(self.saved_mesh)
+
+    @property
+    def devices(self) -> int:
+        return _prod(self.new_mesh)
+
+
+def _prod(shape: Dict[str, int]) -> int:
+    n = 1
+    for v in shape.values():
+        n *= int(v)
+    return n
+
+
+def visible_device_count() -> int:
+    """How many devices this process can actually use. Consumes a
+    scheduled ``shrink(<k>)@resume`` fault first: in a fresh process
+    ``force_cpu_devices`` genuinely shrinks the platform; with a live
+    backend the count is capped instead, so in-process tests exercise the
+    same policy arithmetic the real restart does."""
+    import jax
+
+    plan = faultinject.active_plan()
+    if plan.fire("shrink", "resume"):
+        k = plan.last_value
+        if k:
+            from flexflow_tpu._env import force_cpu_devices
+
+            force_cpu_devices(int(k))
+            n = len(jax.devices())
+            fflogger.warning(
+                "faultinject: shrink@resume — presenting %d of %d visible "
+                "devices (FF_FAULT)", min(int(k), n), n)
+            return min(int(k), n)
+    return len(jax.devices())
+
+
+def mesh_candidates(saved_mesh: Dict[str, int], num_devices: int,
+                    cap: int = 64) -> List[Dict[str, int]]:
+    """All factorizations of ``num_devices`` over the saved mesh's axis
+    names (axis order preserved; size-1 axes kept so saved axis maps stay
+    name-valid). The refit search space for a changed device count."""
+    axes = [a for a in saved_mesh] or ["data"]
+    out: List[Dict[str, int]] = []
+
+    def rec(i: int, remaining: int, acc: Dict[str, int]):
+        if len(out) >= cap:
+            return
+        if i == len(axes) - 1:
+            out.append({**acc, axes[i]: remaining})
+            return
+        d = 1
+        while d <= remaining:
+            if remaining % d == 0:
+                rec(i + 1, remaining // d, {**acc, axes[i]: d})
+            d += 1
+
+    rec(0, max(1, int(num_devices)), {})
+    return out
+
+
+def _saved_strategies(model, directory: str, step: int):
+    """The checkpoint's strategy table (per-step ``strategy.txt``, falling
+    back to the top-level mirror), or {} when unreadable."""
+    from flexflow_tpu.parallel.strategy import load_strategies_from_file
+
+    per_step = os.path.join(os.path.abspath(directory), f"step_{step}",
+                            "strategy.txt")
+    path = per_step if os.path.exists(per_step) \
+        else os.path.join(os.path.abspath(directory), "strategy.txt")
+    try:
+        return load_strategies_from_file(path)
+    except (FileNotFoundError, ValueError) as e:
+        fflogger.warning("elastic: checkpoint strategy file unreadable "
+                         "(%s) — resuming with default strategies", e)
+        return {}
+
+
+def _rederive_strategies(model, saved, new_mesh: Dict[str, int]):
+    """Re-partition: each op keeps its saved axis map, restricted to the
+    new mesh's axes, with degrees RE-DERIVED from the new axis sizes
+    (``ParallelConfig.from_axis_map``) — the same names on a smaller mesh
+    are the shrunk strategy. Ops whose map no longer divides cleanly fall
+    back to default resolution, named in the log."""
+    from flexflow_tpu.ops.base import InputOp
+    from flexflow_tpu.parallel.pconfig import ParallelConfig
+
+    out = {}
+    for op in model.ops:
+        if isinstance(op, InputOp):
+            continue
+        pc = saved.get(op.name)
+        am = getattr(pc, "axis_map", None) if pc is not None else None
+        if not am:
+            continue
+        am = {ax: d for ax, d in am.items() if ax in new_mesh}
+        try:
+            out[op.name] = ParallelConfig.from_axis_map(
+                op.outputs[0].num_dims, new_mesh, am)
+        except Exception as e:
+            fflogger.warning(
+                "elastic: saved strategy for %r does not re-derive on "
+                "mesh %s (%s) — using the default for this op",
+                op.name, new_mesh, e)
+    return out
+
+
+def _preserve_global_batch(cfg, meta, saved_mesh: Dict[str, int],
+                           new_mesh: Dict[str, int]) -> int:
+    """Global-batch preservation: ``batch_size`` (the GLOBAL batch) stays
+    what it was, and the per-device microbatch stays constant by scaling
+    the grad-accum factor with the data-degree change —
+
+        rows/device/microstep = B / (accum * d_data)
+        accum' = accum_saved * d_old / d_new
+
+    so the optimizer sees the same effective batch per update and the
+    surviving devices see the same activation memory. Returns the new
+    accum factor (cfg is updated); falls back with a warning when the
+    ratio is not integral or B stops dividing."""
+    saved_accum = int(meta.get("grad_accum_steps", 1))
+    saved_bs = int(meta.get("batch_size", cfg.batch_size))
+    if int(cfg.batch_size) != saved_bs:
+        fflogger.warning(
+            "elastic: config batch_size %d differs from the checkpoint's "
+            "%d — the global batch is NOT preserved across this resume "
+            "(explicit config change wins)", cfg.batch_size, saved_bs)
+        return cfg.grad_accum_steps
+    d_old = int(saved_mesh.get("data", 1))
+    d_new = int(new_mesh.get("data", 1))
+    num = saved_accum * d_old
+    if num % d_new == 0 and cfg.batch_size % (num // d_new) == 0:
+        new_accum = num // d_new
+        if new_accum != cfg.grad_accum_steps:
+            if d_old == d_new:
+                # same data degree but the checkpoint's accum differs from
+                # the config's: the saved factor may itself be the product
+                # of an EARLIER elastic resume (8 devs -> 4 doubled it) —
+                # adopt it, or the second restart would silently halve the
+                # effective batch the trajectory was trained at
+                fflogger.info(
+                    "elastic: adopting the checkpoint's grad_accum_steps "
+                    "%d over the config's %d (same data degree %d; the "
+                    "saved factor keeps the optimizer trajectory "
+                    "comparable)", new_accum, cfg.grad_accum_steps, d_new)
+            else:
+                fflogger.info(
+                    "elastic: data degree %d -> %d; grad_accum_steps "
+                    "%d -> %d keeps the global batch at %d with an "
+                    "unchanged per-device microbatch", d_old, d_new,
+                    cfg.grad_accum_steps, new_accum, cfg.batch_size)
+            cfg.grad_accum_steps = new_accum
+        return new_accum
+    fflogger.warning(
+        "elastic: cannot scale grad_accum_steps for data degree %d -> %d "
+        "(saved accum %d, batch %d): ratio not integral — global batch is "
+        "preserved but the per-device microbatch changes",
+        d_old, d_new, saved_accum, cfg.batch_size)
+    return cfg.grad_accum_steps
+
+
+def apply_elastic_policy(model) -> Optional[ElasticDecision]:
+    """Compile-time elastic hook (called from ``FFModel.compile`` before
+    the mesh is built, whenever ``checkpoint_dir`` is set). Reads the
+    newest intact checkpoint's recorded topology, compares it with what
+    this process actually has, and mutates ``model.config`` (mesh shape,
+    strategies, grad-accum) per ``on_topology_change``. Returns the
+    decision record, or None when there is nothing to resume or nothing
+    changed."""
+    cfg = model.config
+    directory = getattr(cfg, "checkpoint_dir", "")
+    if not directory:
+        return None
+    from flexflow_tpu.runtime.checkpoint import (latest_intact_step,
+                                                 load_meta)
+
+    verify = bool(getattr(cfg, "verify_checkpoints", True))
+    step = latest_intact_step(directory, verify=verify)
+    if step is None:
+        return None
+    if verify:
+        # the resume paths (supervisor.resume / auto_resume) skip
+        # re-hashing the step this hook just verified — but the trust is
+        # scoped to THIS directory (checkpoint.trusted_step_for): a
+        # supervisor pointed somewhere else must re-verify
+        model._elastic_verified_step = step
+        model._elastic_verified_dir = os.path.abspath(directory)
+    meta = load_meta(directory, step)
+    saved_mesh = {k: int(v)
+                  for k, v in (meta.get("mesh_shape") or {}).items()}
+    if not saved_mesh:
+        return None
+    avail = visible_device_count()
+    want = {k: int(v) for k, v in (cfg.mesh_shape or {}).items()}
+    saved = None
+    ranked_n = 0
+    if _prod(want) <= avail:
+        # the requested mesh is buildable: it stands, changed or not —
+        # an explicit differently-shaped mesh is itself a topology change
+        new_mesh = want
+    else:
+        # the requested mesh no longer fits (the classic restart: config
+        # still says 8 devices, one host is gone): refit over the saved
+        # axis names at the surviving count, cheapest candidate first
+        saved = _saved_strategies(model, directory, step)
+        from flexflow_tpu.search.driver import rank_mesh_candidates
+
+        cands = mesh_candidates(saved_mesh, avail)
+        ranked = rank_mesh_candidates(model, cands, strategies=saved)
+        ranked_n = len(ranked)
+        new_mesh = dict(ranked[0][1])
+        fflogger.warning(
+            "elastic: configured mesh %s needs %d devices but only %d are "
+            "visible — refit to %s (best of %d csim-ranked candidates)",
+            want, _prod(want), avail, new_mesh, ranked_n)
+    changed = new_mesh != saved_mesh
+    decision = ElasticDecision(
+        policy=cfg.on_topology_change, step=step, saved_mesh=saved_mesh,
+        new_mesh=dict(new_mesh), changed=changed,
+        saved_grad_accum=int(meta.get("grad_accum_steps", 1)),
+        grad_accum=cfg.grad_accum_steps, strategy_source="default",
+        ranked_candidates=ranked_n)
+    if not changed:
+        # still apply the refit (the config asked for more devices than
+        # exist) and keep the checkpoint's batch math: a run that already
+        # resumed elastically once records its ADJUSTED grad-accum, which
+        # the next same-topology restart must adopt, not reset
+        cfg.mesh_shape = dict(new_mesh)
+        cfg.num_devices = _prod(new_mesh)
+        decision.grad_accum = _preserve_global_batch(cfg, meta, saved_mesh,
+                                                     new_mesh)
+        return decision
+    if cfg.on_topology_change == "abort":
+        raise TopologyChangedError(
+            f"checkpoint at {directory} (step {step}) was saved on mesh "
+            f"{saved_mesh} ({_prod(saved_mesh)} devices) but this process "
+            f"has mesh {new_mesh} ({_prod(new_mesh)} devices) and "
+            f"on_topology_change='abort' — re-provision the original "
+            f"topology or set the policy to 'resume_resharded'")
+    if _prod(new_mesh) < int(getattr(cfg, "elastic_min_devices", 1)):
+        raise TopologyChangedError(
+            f"elastic resume refused: {_prod(new_mesh)} surviving devices "
+            f"< elastic_min_devices={cfg.elastic_min_devices} (checkpoint "
+            f"was saved on {_prod(saved_mesh)})")
+    if cfg.on_topology_change == "research":
+        from flexflow_tpu.search.driver import research_strategies
+
+        cfg.strategies.update(research_strategies(model, new_mesh))
+        decision.strategy_source = "research"
+    else:  # resume_resharded: re-derive the saved table on the new mesh
+        if saved is None:
+            saved = _saved_strategies(model, directory, step)
+        rederived = _rederive_strategies(model, saved, new_mesh)
+        if rederived:
+            cfg.strategies.update(rederived)
+            decision.strategy_source = "checkpoint"
+    decision.grad_accum = _preserve_global_batch(cfg, meta, saved_mesh,
+                                                 new_mesh)
+    cfg.mesh_shape = dict(new_mesh)
+    cfg.num_devices = _prod(new_mesh)
+    fflogger.warning(
+        "elastic: topology changed %s (%d devices) -> %s (%d devices); "
+        "policy=%s, strategies=%s, grad_accum %d -> %d (global batch %d "
+        "preserved)", saved_mesh, _prod(saved_mesh), new_mesh,
+        _prod(new_mesh), decision.policy, decision.strategy_source,
+        decision.saved_grad_accum, decision.grad_accum, cfg.batch_size)
+    return decision

@@ -590,6 +590,15 @@ class GraphExecutor:
             self._batch_sharding_cache[key] = sh
         return sh
 
+    def reshard_params(self, host_tree):
+        """Place a host (numpy) params tree onto THIS executor's mesh —
+        the restore half of topology-free checkpoints: the saved arrays
+        are placement-less bytes, so whatever mesh the restoring process
+        compiled with (same, differently shaped, or a different device
+        count entirely — the elastic path) determines the layout here,
+        not the mesh that saved them."""
+        return reshard_tree(host_tree, self.param_shardings())
+
     def shard_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jnp.ndarray]:
         """Commit every batch entry to its cached NamedSharding. Entries
         that are ALREADY committed to the right sharding (a prefetched
@@ -610,6 +619,22 @@ class GraphExecutor:
             else:
                 out[k] = jax.device_put(v, sh)
         return out
+
+
+def reshard_tree(host_tree, shardings):
+    """device_put a {op: {weight: array}} host tree leaf-by-leaf onto the
+    given ``param_shardings()``-style placement map (leaves without an
+    entry get default placement). Shared by GraphExecutor and
+    PlacementExecutor so every restore path re-shards identically."""
+    out = {}
+    for op_name, ws in host_tree.items():
+        per_op = shardings.get(op_name, {})
+        out[op_name] = {
+            name: jax.device_put(np.asarray(v), per_op.get(name))
+            if per_op.get(name) is not None
+            else jax.device_put(np.asarray(v))
+            for name, v in ws.items()}
+    return out
 
 
 def _with_fsdp(ps, shape, axis: str, axis_size: int):

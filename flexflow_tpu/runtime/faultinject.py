@@ -8,15 +8,19 @@ tier-1 tests, driven by one env var:
 
     FF_FAULT=nan_loss@step:7,sigterm@step:12,io_fail@save:1
 
-Grammar: comma-separated ``kind@site:index`` events.
+Grammar: comma-separated ``kind[(value)]@site:index`` events.
 
   kind   free-form token consumed by the subsystem that checks it
-         (``nan_loss``, ``sigterm``, ``io_fail``, ``hang`` …)
+         (``nan_loss``, ``sigterm``, ``io_fail``, ``hang``,
+         ``corrupt_ckpt``, ``shrink`` …), optionally carrying one integer
+         parameter in parentheses (``shrink(2)`` = shrink to 2 devices) —
+         read back via ``FaultPlan.last_value`` after a match
   site   where the event fires. ``step`` is special: *index* is the 1-based
          global training step (compared against the step counter). Every
-         other site (``save``, ``load``, ``data`` …) is occurrence-counted:
-         *index* is the 1-based call count at that site, so
-         ``io_fail@save:1`` fails exactly the first checkpoint save.
+         other site (``save``, ``load``, ``data``, ``resume`` …) is
+         occurrence-counted: *index* is the 1-based call count at that
+         site, so ``io_fail@save:1`` fails exactly the first checkpoint
+         save.
 
 Duplicate kinds are allowed (``nan_loss@step:3,nan_loss@step:4`` injects
 two consecutive NaNs); a range ``nan_loss@step:3-5`` expands to one event
@@ -26,7 +30,14 @@ Consumers:
   * ``TrainSupervisor`` checks ``at_step("nan_loss"|"sigterm"|"hang", n)``
     each step (runtime/resilience.py);
   * ``checkpoint.save_checkpoint``/``restore_checkpoint`` call
-    ``maybe_fail("io_fail", "save"|"load")`` inside their retry wrapper.
+    ``maybe_fail("io_fail", "save"|"load")`` inside their retry wrapper;
+  * ``checkpoint.save_checkpoint`` checks ``corrupt_ckpt@save:<n>`` AFTER
+    the n-th save publishes and flips bytes in its payload (bitrot /
+    torn-write drill for the integrity manifest, runtime/elastic story);
+  * the launcher and ``runtime/elastic.py`` check ``shrink(<k>)@resume:<n>``
+    on the n-th resume and present only ``k`` visible devices
+    (``_env.force_cpu_devices`` in a fresh process; a capped count when
+    the backend is already up) — the changed-topology drill.
 
 The active plan is parsed lazily from ``FF_FAULT`` and re-parsed (with
 occurrence counters reset) whenever the env value changes; tests that
@@ -45,16 +56,27 @@ class InjectedFault(OSError):
 
 
 class FaultPlan:
-    def __init__(self, events: List[Tuple[str, str, int]]):
+    def __init__(self, events: List[Tuple[str, str, int]],
+                 values: Optional[Dict[Tuple[str, str, int], int]] = None):
         # [(kind, site, index), ...] — index is a step number for
-        # site == "step", a 1-based occurrence count otherwise
+        # site == "step", a 1-based occurrence count otherwise. Events
+        # stay 3-tuples (existing consumers pattern-match them); an
+        # optional integer parameter (``shrink(2)@resume:1``) rides in
+        # `values`, surfaced through `last_value` after a match.
         self.events = list(events)
+        self.values: Dict[Tuple[str, str, int], int] = dict(values or {})
+        # parameter of the most recent matched event (at_step/fire); None
+        # when the event carried no parameter
+        self.last_value: Optional[int] = None
         self._counts: Dict[Tuple[str, str], int] = {}
         self._consumed: set = set()
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":
+        import re
+
         events: List[Tuple[str, str, int]] = []
+        values: Dict[Tuple[str, str, int], int] = {}
         for part in (spec or "").split(","):
             part = part.strip()
             if not part:
@@ -65,6 +87,16 @@ class FaultPlan:
                 raise ValueError(
                     f"FF_FAULT entry {part!r}: expected 'kind@site:index' "
                     f"(e.g. nan_loss@step:7)")
+            value = None
+            m = re.fullmatch(r"([A-Za-z_][\w-]*)(?:\((\d+)\))?", kind)
+            if not m:
+                raise ValueError(
+                    f"FF_FAULT entry {part!r}: kind must be a bare token "
+                    f"or 'kind(value)' with an integer value "
+                    f"(e.g. shrink(2)@resume:1), got {kind!r}")
+            kind = m.group(1)
+            if m.group(2) is not None:
+                value = int(m.group(2))
             lo, dash, hi = idx.partition("-")
             try:
                 lo_i = int(lo)
@@ -77,7 +109,9 @@ class FaultPlan:
                 raise ValueError(f"FF_FAULT entry {part!r}: empty range")
             for i in range(lo_i, hi_i + 1):
                 events.append((kind, site, i))
-        return cls(events)
+                if value is not None:
+                    values[(kind, site, i)] = value
+        return cls(events, values)
 
     def at_step(self, kind: str, step: int) -> bool:
         """True when the plan holds ``kind@step:<step>``. One-shot: a
@@ -86,6 +120,7 @@ class FaultPlan:
         ev = (kind, "step", int(step))
         if ev in self.events and ev not in self._consumed:
             self._consumed.add(ev)
+            self.last_value = self.values.get(ev)
             return True
         return False
 
@@ -120,7 +155,10 @@ class FaultPlan:
             return False
         key = (kind, site)
         self._counts[key] = n = self._counts.get(key, 0) + 1
-        return (kind, site, n) in self.events
+        if (kind, site, n) in self.events:
+            self.last_value = self.values.get((kind, site, n))
+            return True
+        return False
 
     def __bool__(self) -> bool:
         return bool(self.events)

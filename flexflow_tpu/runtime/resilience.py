@@ -23,6 +23,12 @@ checkpointing + automatic resume is a first-class runtime responsibility):
     (native_loader.py).
   * ``Watchdog``: wall-clock step timeout that dumps every thread's stack
     (faulthandler) before aborting a stuck collective.
+  * Elastic recovery (runtime/elastic.py): resume() restores the newest
+    *intact* checkpoint (content-hash manifest verification, falling back
+    past corrupted steps) and tolerates a CHANGED topology — the compile-
+    time policy hook refit the mesh, and the restore re-shards the saved
+    state onto it (``on_topology_change`` = resume_resharded | research |
+    abort).
 
 Every path is deterministically testable on CPU via runtime/faultinject.py
 (``FF_FAULT=nan_loss@step:7,sigterm@step:12,io_fail@save:1``).
@@ -406,7 +412,11 @@ class TrainSupervisor:
         from flexflow_tpu.runtime.checkpoint import (load_meta,
                                                      restore_checkpoint)
 
-        restore_checkpoint(self.model, self.directory, step=step)
+        # both callers (resume's lazy scan, rewind via latest_intact_step)
+        # verified this step's manifest moments ago — don't hash the
+        # payload a second time
+        restore_checkpoint(self.model, self.directory, step=step,
+                           verify=False)
         meta = load_meta(self.directory, step)
         rng = meta.get("rng_key")
         if rng is not None:
@@ -430,19 +440,66 @@ class TrainSupervisor:
         self._skips_counted = 0  # device skip counter was re-initialized
         self._last_saved_step = step
 
-    def resume(self) -> int:
-        """Restore the newest checkpoint in the directory (0 = fresh
-        start). On a fresh start with rewind enabled, takes an initial
-        step-0 checkpoint so a rewind target always exists."""
-        from flexflow_tpu.runtime.checkpoint import latest_step
+    def _check_topology(self, step: int):
+        """Elastic policy safety net at resume time: the compile-time hook
+        (runtime/elastic.py) normally refit the mesh already, but a
+        supervisor pointed at a directory the config did not name skips
+        that path — so enforce 'abort' here too, and log every cross-
+        topology resume (the restore itself re-shards regardless)."""
+        from flexflow_tpu.runtime.checkpoint import load_meta
 
-        step = latest_step(self.directory)
+        saved = {k: int(v) for k, v in
+                 (load_meta(self.directory, step).get("mesh_shape")
+                  or {}).items()}
+        current = {k: int(v) for k, v in
+                   (self.model.config.mesh_shape or {}).items()}
+        if not saved or saved == current:
+            return
+        policy = getattr(self.model.config, "on_topology_change",
+                         "resume_resharded")
+        if policy == "abort":
+            from flexflow_tpu.runtime.elastic import TopologyChangedError
+
+            raise TopologyChangedError(
+                f"checkpoint step {step} in {self.directory} was saved on "
+                f"mesh {saved} but this model compiled mesh {current} and "
+                f"on_topology_change='abort'")
+        COUNTERS["elastic_resumes"] += 1
+        fflogger.warning(
+            "supervisor: resuming across a topology change — checkpoint "
+            "mesh %s -> current mesh %s (params/opt-state re-shard onto "
+            "the new placement; policy=%s)", saved, current, policy)
+
+    def resume(self) -> int:
+        """Restore the newest INTACT checkpoint in the directory (0 =
+        fresh start). A corrupted or unreadable newer step is skipped
+        with a warning (lazy manifest verification, one payload hash per
+        step actually examined); when every existing step fails, the
+        corruption error propagates — silently starting fresh over
+        damaged checkpoints would destroy the evidence. On a fresh start
+        with rewind enabled, takes an initial step-0 checkpoint so a
+        rewind target always exists."""
+        from flexflow_tpu.runtime.checkpoint import scan_and_restore
+
+        def _count_skip(_s):
+            COUNTERS["corrupt_checkpoints_skipped"] += 1
+
+        def _restore_cand(cand):
+            self._check_topology(cand)
+            self._restore(cand)
+
+        # checkpoint.scan_and_restore is the ONE newest-intact-first
+        # resume policy (auto_resume rides the same one): lazy, so the
+        # normal resume pays one hash pass over one checkpoint — and none
+        # at all for the step the compile-time elastic hook just verified
+        step = scan_and_restore(self.model, self.directory,
+                                restore=_restore_cand, on_skip=_count_skip,
+                                who="supervisor")
         if step is None:
             self._resumed = 0
             if self.rewind_after:
                 self.save(reason="initial")
             return 0
-        self._restore(step)
         self.losses.clear()
         self._loss_base = step
         self._fault_mark = step
@@ -455,13 +512,16 @@ class TrainSupervisor:
     def rewind(self):
         """Divergence recovery: back to the last checkpoint (params, opt
         state, step counter, RNG, dataloader cursors)."""
-        from flexflow_tpu.runtime.checkpoint import latest_step
+        from flexflow_tpu.runtime.checkpoint import latest_intact_step
 
-        step = latest_step(self.directory)
+        step = latest_intact_step(
+            self.directory,
+            verify=bool(getattr(self.model.config, "verify_checkpoints",
+                                True)))
         if step is None:
             raise RuntimeError(
-                f"rewind requested but no checkpoint exists in "
-                f"{self.directory}")
+                f"rewind requested but no checkpoint (passing integrity "
+                f"verification) exists in {self.directory}")
         if step == self._last_rewind_step:
             self._same_rewinds += 1
         else:

@@ -34,6 +34,10 @@ of it:
     ``nan_loss@serve:<n>`` poisons the n-th admitted request) is retired
     as ``failed`` without stalling the other slots — serving inherits the
     fault-injection story of runtime/faultinject.py.
+  * ``drain()``/``health()``: graceful shutdown for deploys and elastic
+    topology changes (docs/resilience.md) — stop admitting, finish the
+    in-flight slots, final stats snapshot; queued-but-unadmitted requests
+    stay queued for re-submission to the replacement engine.
 
 Per-slot cache layout (identical to the ragged rule of
 MultiHeadAttention.decode_forward, with a per-slot prompt pad width):
@@ -180,6 +184,7 @@ class ServingEngine:
         self.slot_req: List[Optional[Request]] = [None] * n
 
         self._queue: List[Request] = []
+        self._draining = False
         self._programs: Dict = {}
         self._key = jax.random.PRNGKey(seed)
         self._next_rid = 0
@@ -212,6 +217,13 @@ class ServingEngine:
         return _pow2_bucket(prompt_len)
 
     def submit(self, prompt, max_new_tokens: int) -> Request:
+        if self._draining:
+            # the serving-side preemption notice: a draining engine is on
+            # its way down (elastic restart / deploy) — callers must
+            # route new traffic elsewhere, not queue behind a shutdown
+            raise RuntimeError(
+                "ServingEngine is draining: new requests are not admitted "
+                "(health()['status'] exposes this to the router)")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -447,11 +459,17 @@ class ServingEngine:
                                    bool(oks[t, slot]))
 
     def step(self) -> bool:
-        """One scheduler tick: admit what fits, then one slot-decode step
-        if any slot is live. Returns whether work remains."""
-        self._admit()
+        """One scheduler tick: admit what fits (unless draining), then one
+        slot-decode step if any slot is live. Returns whether
+        PROGRESSABLE work remains — on a draining engine only live slots
+        count (the frozen queue can never be admitted here), so a
+        while-step loop always terminates."""
+        if not self._draining:
+            self._admit()
         if self.active.any():
             self._decode_step()
+        if self._draining:
+            return bool(self.active.any())
         return self.pending()
 
     def run(self, prompts=None, max_new_tokens: int = 32) -> List[Request]:
@@ -467,6 +485,55 @@ class ServingEngine:
         while self.step():
             pass
         return batch
+
+    # ---- graceful shutdown --------------------------------------------------
+
+    def drain(self) -> Dict:
+        """Graceful shutdown (the serving half of elastic recovery: a
+        preemption notice or planned restart must not drop tokens already
+        being decoded): stop admitting new requests, run the decode loop
+        until every in-flight slot retires on eos/length/failure, and
+        return a final stats snapshot. Requests still QUEUED (never
+        admitted) stay queued untouched — the caller re-submits them to
+        the replacement engine; their count rides the snapshot. Idempotent
+        — a second drain() finds no live slots and returns the snapshot
+        again."""
+        self._draining = True
+        while self.active.any():
+            self._decode_step()
+        snap = self.stats()
+        snap["drained"] = True
+        snap["queued"] = len(self._queue)
+        fflogger.info(
+            "serving: drained — %d completed, %d failed, %d still queued "
+            "(re-submit to the replacement engine), occupancy %.2f, "
+            "%d recompiles", snap["completed"], snap["failed"],
+            snap["queued"], snap["occupancy"], snap["recompiles"])
+        return snap
+
+    def health(self) -> Dict:
+        """Cheap liveness/readiness probe for a router: admission status
+        plus the load counters a balancer steers by, sliced from the one
+        ``stats()`` snapshot so the two probes share every formula and
+        key name. Never compiles or touches the device."""
+        active = int(self.active.sum())
+        if self._draining:
+            # the frozen queue does not hold "draining": those requests
+            # can never be admitted here (they belong to the replacement
+            # engine), so the drain is over when the live slots are
+            status = "draining" if active else "drained"
+        else:
+            status = "busy" if (active or self._queue) else "idle"
+        snap = self.stats()
+        return {
+            "status": status,
+            "admitting": not self._draining,
+            "active_slots": active,
+            "queued": len(self._queue),
+            **{k: snap[k] for k in ("serve_slots", "free_pages",
+                                    "completed", "failed", "occupancy",
+                                    "recompiles")},
+        }
 
     # ---- metrics ------------------------------------------------------------
 

@@ -104,6 +104,56 @@ def data_parallel_strategy(model, mesh_shape: Dict[str, int]) -> Dict[str, AxisM
     return out
 
 
+def rank_mesh_candidates(model, candidates, strategies=None):
+    """Elastic-recovery helper (runtime/elastic.py): score candidate mesh
+    shapes — factorizations of the SURVIVING device count over the saved
+    axis names — by the cost model's iteration time under a re-partition
+    of the saved strategy (each op keeps its saved axis map, restricted to
+    the candidate's axes; ops without a usable saved map fall back to data
+    parallel). Returns [(seconds, mesh_shape), ...] cheapest first; an
+    infeasible candidate scores inf rather than raising, so the caller
+    always gets a usable ranking. This is the "fast csim-ranked
+    re-partition" path — a full re-search at the new count is
+    ``research_strategies``."""
+    ops = [op for op in model.ops if not isinstance(op, InputOp)]
+    scored = []
+    for idx, mesh_shape in enumerate(candidates):
+        try:
+            cost = CostModel(model, mesh_shape)
+            amaps: Dict[str, AxisMap] = {}
+            dp = data_parallel_strategy(model, mesh_shape)
+            for op in ops:
+                pc = (strategies or {}).get(op.name)
+                am = None
+                if pc is not None and getattr(pc, "axis_map", None):
+                    am = {ax: d for ax, d in pc.axis_map.items()
+                          if ax in mesh_shape}
+                amaps[op.name] = am if am else dp.get(op.name, {})
+            scored.append((cost.iteration_time(amaps), idx, mesh_shape))
+        except Exception:
+            scored.append((float("inf"), idx, mesh_shape))
+    scored.sort(key=lambda s: (s[0], s[1]))
+    return [(t, shape) for t, _i, shape in scored]
+
+
+def research_strategies(model, mesh_shape: Dict[str, int],
+                        budget: int = 0) -> Dict[str, ParallelConfig]:
+    """Re-run the strategy search at an explicit mesh — the elastic
+    ``on_topology_change="research"`` entry point: the checkpointed
+    strategy was searched for the OLD device count, and the paper's whole
+    point is that the strategy is a searchable artifact of the machine,
+    so a changed machine gets a fresh search. Budget defaults to the
+    model's configured search_budget, else a small fixed sweep (the
+    resumed job should start training again in seconds, not re-pay the
+    original search)."""
+    if budget <= 0:
+        budget = getattr(model.config, "search_budget", 0) or 100
+    return optimize_strategies(model, budget=budget,
+                               alpha=getattr(model.config, "search_alpha",
+                                             0.05),
+                               mesh_shape=mesh_shape)
+
+
 def optimize_strategies(model, budget: int = 1000, alpha: float = 0.05,
                         mesh_shape: Optional[Dict[str, int]] = None,
                         machine: Optional[MachineModel] = None,

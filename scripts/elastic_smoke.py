@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""CI elastic-recovery smoke (ci/run_ci.sh `elastic` tier).
+
+Two legs, both deterministic on CPU:
+
+  corrupt  — single process: a supervised run checkpoints periodically
+             with FF_FAULT=corrupt_ckpt@save:<last> flipping bytes in the
+             final save's payload after commit; the restart must FAIL the
+             latest step's manifest verification, fall back to the
+             previous intact step with a logged warning, and complete.
+
+  shrink   — the changed-topology drill: phase 1 trains on TWO controller
+             processes (8-device global mesh) through
+             flexflow_tpu.launcher and is preempted mid-epoch
+             (FF_FAULT=sigterm@step:5 -> collective checkpoint + stop);
+             phase 2 relaunches ONE process whose multi-host rendezvous
+             fails fast (dead peer + FF_INIT_TIMEOUT_S) — the launcher's
+             --elastic fallback continues single-process, the
+             FF_FAULT=shrink(4)@resume:1 fault presents 4 surviving
+             devices, and the worker resumes with
+             on_topology_change=resume_resharded: mesh refit to data=4,
+             grad_accum doubled (global batch preserved), loss still
+             decreasing. Needs gloo CPU collectives (the CI tier probes).
+
+Usage: python scripts/elastic_smoke.py [corrupt|shrink|all]
+"""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+WORKER = os.path.join(REPO, "tests", "elastic_worker.py")
+
+
+def run_corrupt_leg():
+    from flexflow_tpu._env import force_cpu_devices
+
+    force_cpu_devices(2)
+
+    import numpy as np
+
+    from flexflow_tpu import (ActiMode, FFConfig, FFModel, LossType,
+                              MetricsType, SGDOptimizer, SingleDataLoader,
+                              TrainSupervisor)
+    from flexflow_tpu.runtime import faultinject
+    from flexflow_tpu.runtime.checkpoint import (latest_intact_step,
+                                                 latest_step)
+
+    ckpt = tempfile.mkdtemp(prefix="ff_elastic_corrupt_")
+
+    def build():
+        cfg = FFConfig(batch_size=16, epochs=1, seed=3, checkpoint_dir=ckpt,
+                       checkpoint_every=2, mesh_shape={"data": 2})
+        ff = FFModel(cfg)
+        x = ff.create_tensor([16, 8], name="x")
+        t = ff.dense(x, 16, ActiMode.AC_MODE_RELU, name="fc1")
+        ff.dense(t, 4, name="out")
+        ff.compile(SGDOptimizer(lr=0.1),
+                   LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                   [MetricsType.METRICS_ACCURACY])
+        rs = np.random.RandomState(7)
+        SingleDataLoader(ff, x, rs.randn(64, 8).astype(np.float32))
+        SingleDataLoader(ff, ff.label_tensor,
+                         rs.randint(0, 4, (64, 1)).astype(np.int32))
+        return ff
+
+    # saves land at steps 1, 3, 5 (periodic) + 6 (final) — occurrence 4,
+    # the LATEST checkpoint, is corrupted AFTER it publishes
+    os.environ["FF_FAULT"] = "corrupt_ckpt@save:4"
+    faultinject.reset()
+    ff = build()
+    sup = TrainSupervisor(ff, ckpt)
+    assert sup.run(6) == "completed"
+    os.environ.pop("FF_FAULT")
+    faultinject.reset()
+    assert latest_step(ckpt) == 6
+    intact = latest_intact_step(ckpt)
+    assert intact == 5, f"expected intact step 5 behind corrupt 6, got {intact}"
+
+    # the restart: verification rejects step 6, resume falls back to 5
+    ff2 = build()
+    sup2 = TrainSupervisor(ff2, ckpt)
+    resumed = sup2.resume()
+    assert resumed == 5, f"resumed from {resumed}, wanted intact step 5"
+    assert sup2.run(10) == "completed"
+    assert ff2._step_count == 10
+    assert np.isfinite(sup2.losses).all()
+    print(f"elastic_smoke[corrupt]: latest=6 corrupt -> resumed from "
+          f"intact step {resumed}, completed to step 10  PASSED")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env(**extra):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers set their own device counts
+    env.pop("FF_FAULT", None)
+    env["JAX_PLATFORMS"] = ""
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra)
+    return env
+
+
+def _parse_marker(out: str) -> dict:
+    m = re.search(r"ELASTIC pid=(\d+) status=(\w+) resumed=(\w+) "
+                  r"step=(\d+) mesh=(\S+) accum=(\d+) procs=(\d+) "
+                  r"loss_ok=(\d)", out)
+    assert m, f"no ELASTIC marker in output:\n{out[-4000:]}"
+    return {"pid": int(m.group(1)), "status": m.group(2),
+            "resumed": m.group(3), "step": int(m.group(4)),
+            "mesh": m.group(5), "accum": int(m.group(6)),
+            "procs": int(m.group(7)), "loss_ok": int(m.group(8))}
+
+
+def run_shrink_leg():
+    ckpt = tempfile.mkdtemp(prefix="ff_elastic_shrink_")
+
+    # ---- phase 1: 2-process run on the 8-device mesh, preempted at step 5
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "flexflow_tpu.launcher", WORKER,
+             "--num-processes", "2", "--process-id", str(pid),
+             "--coordinator", f"127.0.0.1:{port}",
+             "--cpu-devices", "4", "--", ckpt, "10"],
+            env=_worker_env(FF_FAULT="sigterm@step:5"), cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=400)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"phase-1 worker {pid} failed:\n" \
+                                  f"{out[-4000:]}"
+        mk = _parse_marker(out)
+        assert mk["status"] == "preempted" and mk["step"] == 5, mk
+        assert mk["procs"] == 2 and mk["mesh"] == "data=8", mk
+    print("elastic_smoke[shrink]: phase 1 OK — 2-process mesh data=8 "
+          "preempted at step 5, collective checkpoint written")
+
+    # ---- phase 2: the surviving host relaunches with its OLD multi-host
+    # flags; the coordinator is gone, the launcher's elastic probe detects
+    # that fast (a real initialize would hard-terminate the process on
+    # this jax build), continues single-process, and shrink(4)@resume
+    # presents the 4 surviving devices
+    dead_port = _free_port()
+    p = subprocess.Popen(
+        [sys.executable, "-m", "flexflow_tpu.launcher", WORKER,
+         "--num-processes", "2", "--process-id", "1",
+         "--coordinator", f"127.0.0.1:{dead_port}",
+         "--cpu-devices", "8", "--elastic", "--", ckpt, "10"],
+        env=_worker_env(FF_FAULT="shrink(4)@resume:1",
+                        FF_INIT_ATTEMPTS="1", FF_INIT_TIMEOUT_S="5"),
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    out, _ = p.communicate(timeout=400)
+    assert p.returncode == 0, f"phase-2 worker failed:\n{out[-4000:]}"
+    assert "continuing SINGLE-process" in out, out[-4000:]
+    assert "shrink@resume" in out, out[-4000:]
+    mk = _parse_marker(out)
+    assert mk["status"] == "completed" and mk["step"] == 10, mk
+    assert mk["resumed"] == "5", mk
+    assert mk["procs"] == 1 and mk["mesh"] == "data=4", mk
+    assert mk["accum"] == 2, f"grad accum must double to preserve the " \
+                             f"global batch: {mk}"
+    assert mk["loss_ok"] == 1, f"post-resume loss not decreasing: {mk}"
+    print("elastic_smoke[shrink]: phase 2 OK — rendezvous failed fast, "
+          "single-process resume resharded data=8 -> data=4, accum 1 -> 2, "
+          "loss decreasing")
+
+    # ---- phase 3: the COORDINATOR host (process 0) is the survivor this
+    # time. It has nothing to probe (it IS the rendezvous address), so the
+    # elastic path listens for a peer knock instead; none comes, it
+    # continues single-process. The checkpoints now record mesh data=4
+    # with accum=2 — a same-topology restart must ADOPT the saved accum
+    # (the product of phase 2's elastic resume), not reset it to the
+    # config default of 1.
+    p = subprocess.Popen(
+        [sys.executable, "-m", "flexflow_tpu.launcher", WORKER,
+         "--num-processes", "2", "--process-id", "0",
+         "--coordinator", f"127.0.0.1:{_free_port()}",
+         "--cpu-devices", "4", "--elastic", "--", ckpt, "12"],
+        env=_worker_env(FF_INIT_ATTEMPTS="1", FF_INIT_TIMEOUT_S="5"),
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    out, _ = p.communicate(timeout=400)
+    assert p.returncode == 0, f"phase-3 worker failed:\n{out[-4000:]}"
+    assert "no peer knocked" in out, out[-4000:]
+    mk = _parse_marker(out)
+    assert mk["status"] == "completed" and mk["step"] == 12, mk
+    assert mk["resumed"] == "10" and mk["procs"] == 1, mk
+    assert mk["mesh"] == "data=4", mk
+    assert mk["accum"] == 2, f"same-topology restart must adopt the " \
+                             f"checkpoint's accum, not reset it: {mk}"
+    print("elastic_smoke[shrink]: phase 3 OK — surviving coordinator "
+          "heard no peer knock, continued single-process, adopted the "
+          "checkpoint's accum=2 on the unchanged mesh  PASSED")
+
+
+def main():
+    leg = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if leg in ("corrupt", "all"):
+        run_corrupt_leg()
+    if leg in ("shrink", "all"):
+        run_shrink_leg()
+    print(f"elastic_smoke({leg}): PASSED")
+
+
+if __name__ == "__main__":
+    main()

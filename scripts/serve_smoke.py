@@ -4,7 +4,10 @@ requests through the continuous-batching engine on CPU, with FF_FAULT
 nan_loss injection poisoning one request mid-stream — the poisoned
 request must retire as `failed` while every other request completes,
 proving a bad request can never stall the batch. Also asserts the
-recompile counter stays flat after bucket warmup.
+recompile counter stays flat after bucket warmup, and ends through
+ServingEngine.drain() — stop admitting, finish the in-flight slots, final
+snapshot — instead of a hard stop (the graceful-shutdown half of elastic
+recovery, docs/resilience.md).
 
 Usage: [FF_FAULT=nan_loss@serve:37] python scripts/serve_smoke.py [N]
 """
@@ -51,9 +54,29 @@ def main():
     warm = eng.recompile_count
 
     t0 = time.perf_counter()
-    reqs = eng.run(prompts, max_new_tokens=4)  # this call's requests only
+    # submit + drive by hand instead of run(): once the queue has fully
+    # admitted, DRAIN the engine — the graceful-shutdown path (stop
+    # admitting, finish the in-flight slots) is what a real deploy or
+    # preemption uses instead of a hard stop, so the smoke proves it
+    # end-to-end with real in-flight work
+    # max_new_tokens spans >1 decode chunk (12 > decode_chunk=8) so slots
+    # are guaranteed mid-flight when the queue empties — drain() below
+    # finishes REAL in-flight work, not an already-idle engine
+    reqs = [eng.submit(p, max_new_tokens=12) for p in prompts]
+    while eng.health()["queued"]:
+        eng.step()
+    assert eng.health()["status"] == "busy"
+    st = eng.drain()  # finishes the in-flight slots, final snapshot
     dt = time.perf_counter() - t0
-    st = eng.stats()
+    health = eng.health()
+    assert health["status"] == "drained" and not health["admitting"], health
+    assert st["drained"] and st["queued"] == 0, st
+    try:
+        eng.submit(prompts[0], max_new_tokens=1)
+    except RuntimeError:
+        pass
+    else:
+        raise AssertionError("draining engine must refuse new requests")
 
     fault = os.environ.get("FF_FAULT", "")
     failed = [r for r in reqs if r.state == "failed"]
@@ -62,7 +85,8 @@ def main():
           f"{n_requests} in {dt:.1f}s "
           f"({st['tokens_generated'] / dt:.0f} tok/s incl. warmup tokens), "
           f"occupancy {st['occupancy']:.2f}, "
-          f"recompiles after warmup {eng.recompile_count - warm}")
+          f"recompiles after warmup {eng.recompile_count - warm}, "
+          f"drained with {st['queued']} queued")
 
     assert len(done) + len(failed) == n_requests, "requests lost"
     assert eng.recompile_count == warm, (
