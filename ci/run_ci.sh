@@ -12,9 +12,10 @@
 #   resilience — fault-injection tests (FF_FAULT: kill-and-resume, NaN
 #               skip/rewind, IO retry) + a 2-process multihost resume
 #               smoke when the jax build has gloo CPU collectives
-#   serving   — continuous-batching engine tests + a 200-request CPU
-#               smoke with FF_FAULT=nan_loss injection (a poisoned
-#               request must retire without stalling the batch)
+#   serving   — continuous-batching engine tests (incl. radix prefix
+#               cache + speculative decoding) + a 200-request CPU smoke
+#               with FF_FAULT=nan_loss injection and a skewed
+#               shared-prefix phase (hits, 0 recompiles, no page leaks)
 #   overlap   — host-overlap step engine tests (prefetch pipeline +
 #               dispatch-ahead fit) + a slow-loader smoke asserting
 #               throughput improves and host_wait drops
@@ -109,9 +110,13 @@ run_resilience() {
 
 # serving tier: the continuous-batching test file (token-identity vs
 # sequential decode, bitwise paged-vs-dense attention, early-exit parity,
-# recompile-counter flatness), then the 200-request smoke with an
+# recompile-counter flatness, prefix-cache COW/eviction/refcounts,
+# speculative greedy identity), then the 200-request smoke with an
 # injected nan_loss fault — request 37 is poisoned in-graph and must be
-# retired as failed while the other 199 complete (no batch stall).
+# retired as failed while the other 199 complete (no batch stall) —
+# followed by its skewed shared-prefix phase (80% of requests share a
+# 64-token system prompt: hits fire, warm window compiles nothing, and
+# drain + flush leave zero leaked pages).
 run_serving() {
   python -m pytest tests/test_serving.py -q
   FF_FAULT="nan_loss@serve:37" python scripts/serve_smoke.py 200

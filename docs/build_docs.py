@@ -30,7 +30,7 @@ PAGES = [("index", os.path.join(ROOT, "README.md"), "Overview"),
          ("resilience", os.path.join(DOCS, "resilience.md"),
           "Fault tolerance & elastic recovery"),
          ("serving", os.path.join(DOCS, "serving.md"),
-          "Serving (continuous batching)"),
+          "Serving (continuous batching, prefix cache, speculation)"),
          ("performance", os.path.join(DOCS, "performance.md"),
           "Performance (host overlap)"),
          ("analysis", os.path.join(DOCS, "analysis.md"),

@@ -189,6 +189,21 @@ class FFConfig:
     # two from 8 — warm prefill programs are reused within a bucket, and
     # ServingEngine.recompile_count proves it
     decode_buckets: Optional[List[int]] = None
+    # radix prefix cache (runtime/serving.py RadixPrefixCache): share KV
+    # pages across requests whose prompts start with the same page-aligned
+    # token prefix — admission mounts the cached pages read-only and
+    # prefills only the tail (copy-on-write: shared pages are never
+    # written). False = the PR-3 allocate-everything path.
+    serve_prefix_cache: bool = True
+    # speculative decoding: the draft model proposes this many greedy
+    # tokens per slot per iteration; one fixed-shape verify program
+    # scores all K+1 positions in a single dispatch. 0 = off. Greedy
+    # streams stay token-identical to non-speculative decode.
+    serve_speculate_k: int = 0
+    # the compiled draft FFModel (same vocab as the target — validated at
+    # engine construction). A runtime object, not a flag: pass it
+    # programmatically or via make_serving_engine(draft_model=...)
+    draft_model: Optional[object] = None
     # jax persistent compilation cache directory ("" = off): set before
     # the first trace (FFModel.compile / launcher) so repeated runs skip
     # recompiles; serving logs hit/miss per program build
@@ -244,6 +259,19 @@ class FFConfig:
                 f"serve_slots={self.serve_slots} (>= 1), "
                 f"kv_page_size={self.kv_page_size} (>= 1), "
                 f"kv_pages={self.kv_pages} (>= 0, 0 = derive)")
+        if self.kv_page_size & (self.kv_page_size - 1):
+            # pow2 keeps position->page arithmetic exact under the pow2
+            # prompt buckets AND keeps the radix chunk boundary aligned
+            # with every bucket boundary (a non-pow2 page would let a
+            # bucket end mid-page, splitting prefix chunks across
+            # programs)
+            raise ValueError(
+                f"kv_page_size={self.kv_page_size}: must be a power of "
+                f"two")
+        if self.serve_speculate_k < 0:
+            raise ValueError(
+                f"serve_speculate_k={self.serve_speculate_k}: must be "
+                f">= 0 (0 = speculative decoding off)")
         if self.decode_buckets is not None:
             bs = list(self.decode_buckets)
             if not bs or any(int(b) < 1 for b in bs) \
@@ -318,6 +346,22 @@ class FFConfig:
                        help="skip content-hash manifest verification at "
                             "restore (on by default)")
         p.add_argument("--elastic-min-devices", type=int, default=1)
+        p.add_argument("--serve-slots", type=int, default=4,
+                       help="decode slots in the one compiled "
+                            "slot-decode serving program")
+        p.add_argument("--kv-page-size", type=int, default=128,
+                       help="positions per paged-KV pool page "
+                            "(power of two)")
+        p.add_argument("--kv-pages", type=int, default=0,
+                       help="KV pool pages (0 = derive the "
+                            "no-pressure size)")
+        p.add_argument("--no-prefix-cache", action="store_true",
+                       help="disable the radix prefix cache "
+                            "(on by default)")
+        p.add_argument("--serve-speculate-k", type=int, default=0,
+                       help="draft tokens proposed per speculative "
+                            "decode iteration (0 = off; needs a "
+                            "draft model)")
         # e.g. --mesh data=4,model=2 (replaces -ll:gpu device-count knobs)
         p.add_argument("--mesh", type=str, default="")
         args, _ = p.parse_known_args(argv)
@@ -355,4 +399,9 @@ class FFConfig:
             on_topology_change=args.on_topology_change,
             verify_checkpoints=not args.no_verify_checkpoints,
             elastic_min_devices=args.elastic_min_devices,
+            serve_slots=args.serve_slots,
+            kv_page_size=args.kv_page_size,
+            kv_pages=args.kv_pages,
+            serve_prefix_cache=not args.no_prefix_cache,
+            serve_speculate_k=args.serve_speculate_k,
         )

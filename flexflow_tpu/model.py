@@ -1224,9 +1224,15 @@ class FFModel:
         """Continuous-batching serving engine (runtime/serving.py): one
         fixed-shape slot-decode program + a paged KV cache shared by all
         slots; the host scheduler admits queued prompts into freed slots
-        and retires rows on eos/length. Knobs default to this model's
-        FFConfig (serve_slots, kv_page_size, kv_pages, decode_buckets);
-        kwargs override per engine (see ServingEngine)."""
+        and retires rows on eos/length. A radix prefix cache shares the
+        KV pages of identical page-aligned prompt prefixes across
+        requests (copy-on-write; on by default), and a draft model
+        (``draft_model=`` + ``speculate_k=``) enables speculative
+        decoding — token-identical greedy output, several tokens per
+        verify dispatch. Knobs default to this model's FFConfig
+        (serve_slots, kv_page_size, kv_pages, decode_buckets,
+        serve_prefix_cache, serve_speculate_k, draft_model); kwargs
+        override per engine (see ServingEngine)."""
         from flexflow_tpu.runtime.serving import ServingEngine
 
         return ServingEngine(self, **kwargs)

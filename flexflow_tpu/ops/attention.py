@@ -417,6 +417,48 @@ class MultiHeadAttention(Op):
             qh, gk, gv, live[:, None, None, None, :])
         return self._out_proj(params, ctx), {"k": ck, "v": cv}
 
+    def paged_verify_forward(self, params, xs, cache, page_table, write_pos,
+                             rope_pos0, row_len, prompt_pad):
+        """Speculative-decode verify: a (B, S) slab of candidate tokens
+        (S = K draft proposals + 1) scored against the paged pool in ONE
+        dispatch (runtime/serving.py).
+
+        Position i of the slab writes its k/v at logical position
+        ``write_pos[b, i]`` (the host pre-computes write_pos0 + i clamped
+        to the slot's budget) and attends with the decode live rule at its
+        own frontier: j < row_len OR prompt_pad <= j <= write_pos[b, i] —
+        causality within the slab falls out of the frontier, since slab
+        position i's window includes exactly the slab writes <= i plus the
+        committed history. k/v written for positions the host later
+        REJECTS stay inside the slot's own pages past its write frontier;
+        the next dispatch (verify or decode) overwrites them before any
+        accepted position can attend them, so rejected-draft garbage is
+        never observable. ``rope_pos0`` (B,) is the slab's first LOGICAL
+        position; position i rotates at rope_pos0 + i. The page gather is
+        the same reassembly as paged_decode_forward — bitwise the dense
+        cache operand (tests/test_serving.py)."""
+        b, s = xs[0].shape[0], xs[0].shape[1]
+        page_size = cache["k"].shape[1]
+        qh, kh, vh = self._project_qkv(params, xs[0], xs[1], xs[2],
+                                       rope_offset=rope_pos0)
+        page_ids = jnp.take_along_axis(
+            page_table, write_pos // page_size, axis=1)       # (B, S)
+        offs = write_pos % page_size
+        ck = cache["k"].at[page_ids, offs].set(
+            kh.astype(cache["k"].dtype))
+        cv = cache["v"].at[page_ids, offs].set(
+            vh.astype(cache["v"].dtype))
+        max_len = page_table.shape[1] * page_size
+        gk = ck[page_table].reshape(b, max_len, *ck.shape[2:])
+        gv = cv[page_table].reshape(b, max_len, *cv.shape[2:])
+        idx = jnp.arange(max_len)
+        live = (idx[None, None, :] < row_len[:, None, None]) \
+            | ((idx[None, None, :] >= prompt_pad[:, None, None])
+               & (idx[None, None, :] <= write_pos[:, :, None]))
+        ctx = self._grouped_cache_attention(
+            qh, gk, gv, live[:, None, None, :, :])
+        return self._out_proj(params, ctx), {"k": ck, "v": cv}
+
     def _flash_ok(self, qh, kh) -> bool:
         """Use the hand-tiled Pallas flash kernel (ops/pallas_kernels.py) on
         the dense path when the backend runs it natively and the block grid

@@ -283,11 +283,19 @@ class Generator:
                     if paged is not None:
                         # continuous-batching slot decode over the paged
                         # pool (runtime/serving.py): per-slot positions,
-                        # page-table gather instead of a contiguous cache
-                        out, nc = op.paged_decode_forward(
-                            p, xs, cache, paged["page_table"],
-                            paged["write_pos"], paged["rope_pos"],
-                            paged["row_len"], paged["prompt_pad"])
+                        # page-table gather instead of a contiguous cache.
+                        # A (B, S>1) slab is the speculative-decode verify
+                        # pass: write_pos is (B, S) per-position.
+                        if tokens.shape[1] > 1:
+                            out, nc = op.paged_verify_forward(
+                                p, xs, cache, paged["page_table"],
+                                paged["write_pos"], paged["rope_pos"],
+                                paged["row_len"], paged["prompt_pad"])
+                        else:
+                            out, nc = op.paged_decode_forward(
+                                p, xs, cache, paged["page_table"],
+                                paged["write_pos"], paged["rope_pos"],
+                                paged["row_len"], paged["prompt_pad"])
                     elif pos is None:
                         if gather_last:
                             # ragged chunked prefill: read-only query of

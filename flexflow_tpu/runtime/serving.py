@@ -38,6 +38,29 @@ of it:
     topology changes (docs/resilience.md) — stop admitting, finish the
     in-flight slots, final stats snapshot; queued-but-unadmitted requests
     stay queued for re-submission to the replacement engine.
+  * RADIX PREFIX CACHE (RadixPrefixCache): a trie over page-aligned
+    prompt token chunks maps each full KV page a finished prefill
+    produced to its pool page id, with a per-page refcount of the live
+    requests referencing it. Admission looks up the longest cached
+    page-aligned prefix, bumps refcounts, and prefills ONLY the tail —
+    page writes are copy-on-write: a shared page is never written in
+    place (the tail, including the recompute of the matched prefix's
+    partial last page, scatters into fresh pages; decode appends land
+    past the prompt bucket, also in the request's own pages).
+    Retirement decrefs; refcount-0 pages stay cached for future hits
+    until an LRU evictor reclaims them under pool pressure. Identical
+    prompts across millions of requests then share prefill compute AND
+    the HBM pages it produced (ROADMAP item 1).
+  * SPECULATIVE DECODING (``draft_model`` + ``speculate_k``): a small
+    draft model proposes K greedy tokens per slot from its own paged
+    pool (same page ids — the prefix cache shares draft pages too), and
+    ONE fixed-shape verify program scores all K+1 positions against the
+    target in a single dispatch
+    (MultiHeadAttention.paged_verify_forward). The host accepts the
+    longest prefix of proposals matching the target's greedy argmax and
+    emits accepted + 1 tokens — every emitted token is the TARGET's
+    greedy token, so the stream is token-identical to non-speculative
+    greedy decode; the accept rate rides ``stats()``.
 
 Per-slot cache layout (identical to the ragged rule of
 MultiHeadAttention.decode_forward, with a per-slot prompt pad width):
@@ -74,7 +97,13 @@ class Request:
     tokens: List[int] = field(default_factory=list)  # emitted tokens
     slot: int = -1
     bucket: int = 0
-    pages: List[int] = field(default_factory=list)
+    pages: List[int] = field(default_factory=list)   # full logical table
+    # prefix-cache bookkeeping: trie nodes whose refcount this request
+    # holds (shared prefix pages + pages it published), and the pages it
+    # owns outright (freed at retirement; trie pages are only decref'd)
+    trie_nodes: List = field(default_factory=list)
+    private_pages: List[int] = field(default_factory=list)
+    prefix_tokens: int = 0          # prefill positions served from cache
     t_submit: float = 0.0
     ttft: float = 0.0               # submit -> first emitted token (s)
     t_done: float = 0.0
@@ -95,6 +124,182 @@ def _pow2_bucket(n: int, lo: int = 8) -> int:
     return b
 
 
+class _TrieNode:
+    """One cached KV page: the page_size-token chunk it encodes (its edge
+    label from the parent), the pool page id holding its k/v, and the
+    refcount of live requests whose page tables reference it."""
+
+    __slots__ = ("chunk", "page", "parent", "children", "ref", "last_use")
+
+    def __init__(self, chunk, page, parent):
+        self.chunk = chunk
+        self.page = page
+        self.parent = parent
+        self.children = {}
+        self.ref = 0
+        self.last_use = 0
+
+
+class RadixPrefixCache:
+    """Radix/trie index over prompt token prefixes at PAGE granularity.
+
+    Each trie edge is exactly ``page_size`` tokens, so a path of depth d
+    names a d-page prompt prefix and maps it to the d pool pages holding
+    its KV — the page, not the token, is the unit of sharing because the
+    pool scatters, gathers and refcounts pages. A page's KV at position j
+    depends only on tokens [0..j] (causal attention), so any request
+    whose prompt starts with the same ``d * page_size`` tokens can mount
+    those pages read-only and prefill just its tail.
+
+    Ownership protocol (the copy-on-write rule lives HERE, not in the
+    kernels): a page in the trie is never written again — its producer
+    published it only after prefill, and every borrower's tail/decode
+    writes land in freshly allocated pages past the matched prefix.
+    ``ref`` counts live requests mounting the page; retirement decrefs.
+    A refcount-0 page stays cached (warm for the next hit) until
+    ``evict()`` reclaims it under pool pressure, LRU-first and leaves
+    only — an interior page must outlive its children, since a match
+    walks through it. All host-side, O(prompt/page_size) per lookup;
+    ``evict()`` walks the whole trie per pressure call, which is fine at
+    the pool sizes this engine runs (hundreds of pages) — a
+    persistently-maintained ref-0-leaf LRU makes reclaim O(need) if
+    pool sizes grow by orders of magnitude."""
+
+    def __init__(self, page_size: int):
+        self.page_size = int(page_size)
+        self.root = _TrieNode(None, -1, None)
+        self.pages = 0          # page-holding nodes currently cached
+        self.lookups = 0
+        self.hits = 0
+        self.tokens_saved = 0   # prefill positions served from cache
+        self.evictions = 0      # PRESSURE evictions only (flushes don't
+        #                         count — they are not a pool signal)
+        self._tick = 0          # monotonic LRU clock (bumped per lookup)
+        # incremental mirrors of the trie's refcount state, so stats()
+        # and the per-tick health() probe never walk the trie
+        self._live_refs = 0     # sum of node.ref
+        self._shared = 0        # nodes with ref > 1 right now
+
+    def _chunk(self, prompt, i: int):
+        ps = self.page_size
+        return tuple(int(t) for t in prompt[i * ps:(i + 1) * ps])
+
+    def match(self, prompt, max_pages: int) -> List[_TrieNode]:
+        """Longest cached page-aligned prefix of ``prompt``, capped at
+        ``max_pages``; returns the node path root-down (possibly empty).
+        Does NOT take references or bump hit statistics — the caller
+        commits with acquire()/note_admitted() only once admission is
+        certain (a request that stays queued on pool pressure re-matches
+        every tick and must leave refcounts AND counters untouched)."""
+        self._tick += 1
+        node, path = self.root, []
+        limit = min(int(max_pages), len(prompt) // self.page_size)
+        for i in range(limit):
+            child = node.children.get(self._chunk(prompt, i))
+            if child is None:
+                break
+            path.append(child)
+            node = child
+        for n in path:
+            n.last_use = self._tick
+        return path
+
+    def note_admitted(self, matched_pages: int):
+        """Commit one admission's lookup to the hit statistics — called
+        exactly once per ADMITTED request, never for retried matches."""
+        self.lookups += 1
+        if matched_pages:
+            self.hits += 1
+            self.tokens_saved += matched_pages * self.page_size
+
+    def acquire(self, nodes):
+        for n in nodes:
+            n.ref += 1
+            self._live_refs += 1
+            if n.ref == 2:
+                self._shared += 1
+
+    def release(self, nodes):
+        for n in nodes:
+            n.ref -= 1
+            self._live_refs -= 1
+            if n.ref == 1:
+                self._shared -= 1
+            if n.ref < 0:  # accounting bug, not a recoverable state
+                raise AssertionError(
+                    f"prefix-cache refcount underflow on page {n.page}")
+
+    def insert(self, prompt, matched, start: int,
+               pages: List[int]) -> List[_TrieNode]:
+        """Publish a finished prefill's full-prompt pages: ``pages[j]``
+        holds chunk ``start + j`` of ``prompt``, appended under the
+        ``matched`` path. Each created node starts at ref 1 (the
+        publishing request still mounts it). Stops at the first chunk
+        that already exists — the caller's duplicate page for it stays
+        private (only possible when the match was capped below an
+        existing deeper path)."""
+        node = matched[-1] if matched else self.root
+        created = []
+        for j, page in enumerate(pages):
+            chunk = self._chunk(prompt, start + j)
+            if chunk in node.children:
+                break
+            child = _TrieNode(chunk, page, node)
+            child.ref = 1
+            self._live_refs += 1
+            child.last_use = self._tick
+            node.children[chunk] = child
+            node = child
+            created.append(child)
+            self.pages += 1
+        return created
+
+    def _iter_nodes(self):
+        stack = list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            yield n
+            stack.extend(n.children.values())
+
+    def evict(self, need: int, protect=(), pressure: bool = True) \
+            -> List[int]:
+        """Reclaim up to ``need`` pages from refcount-0 LEAVES, oldest
+        last_use first; returns the freed page ids. ``protect`` excludes
+        a just-matched path the caller is about to acquire. Evicting a
+        leaf may expose its parent — the sweep cascades.
+        ``pressure=False`` (hot-swap flush, leak accounting) keeps the
+        reclaim out of the ``evictions`` pool-pressure signal."""
+        import heapq
+
+        keep = set(id(n) for n in protect)
+
+        def evictable(n):
+            return not n.children and n.ref == 0 and id(n) not in keep
+
+        heap = [(n.last_use, id(n), n) for n in self._iter_nodes()
+                if evictable(n)]
+        heapq.heapify(heap)
+        freed: List[int] = []
+        while heap and len(freed) < need:
+            _, _, n = heapq.heappop(heap)
+            del n.parent.children[n.chunk]
+            freed.append(n.page)
+            self.pages -= 1
+            if pressure:
+                self.evictions += 1
+            parent = n.parent
+            if parent is not self.root and evictable(parent):
+                heapq.heappush(heap, (parent.last_use, id(parent), parent))
+        return freed
+
+    def live_refs(self) -> int:
+        return self._live_refs
+
+    def shared_pages(self) -> int:
+        """Pages mounted by more than one live request right now."""
+        return self._shared
+
+
 class ServingEngine:
     """Continuous-batching engine over a compiled FFModel decoder LM.
 
@@ -111,7 +316,9 @@ class ServingEngine:
                  top_k: int = 0, eos_id: Optional[int] = None,
                  pad_id: int = 0, prefill_chunk: int = 0,
                  decode_chunk: int = 8,
-                 quantize: Optional[str] = None, seed: int = 0):
+                 quantize: Optional[str] = None, seed: int = 0,
+                 prefix_cache: Optional[bool] = None,
+                 draft_model=None, speculate_k: Optional[int] = None):
         cfg = model.config
         self.model = model
         self.slots = int(serve_slots or getattr(cfg, "serve_slots", 4))
@@ -172,6 +379,65 @@ class ServingEngine:
             for op in self.gen.attn_ops}
         self._free_pages = list(range(self.num_pages - 1, 0, -1))
 
+        # radix prefix cache: page-granular prompt-prefix sharing with
+        # copy-on-write allocation (shared pages are read-only; every
+        # tail/decode write goes to the request's own fresh pages)
+        enable_prefix = (prefix_cache if prefix_cache is not None
+                         else getattr(cfg, "serve_prefix_cache", True))
+        self.prefix_cache = (RadixPrefixCache(self.page_size)
+                             if enable_prefix else None)
+
+        # speculative decoding: a draft model proposes K greedy tokens
+        # per slot; one fixed-shape verify program scores all K+1
+        # positions in a single dispatch. Greedy-only: every emitted
+        # token is the TARGET's argmax, so the stream is token-identical
+        # to non-speculative decode by construction.
+        self.speculate_k = int(speculate_k if speculate_k is not None
+                               else getattr(cfg, "serve_speculate_k", 0))
+        self.draft_model = (draft_model if draft_model is not None
+                            else getattr(cfg, "draft_model", None))
+        if self.speculate_k < 0:
+            raise ValueError(
+                f"speculate_k={self.speculate_k}: must be >= 0")
+        self.draft_gen = None
+        self.draft_pool = None
+        if self.speculate_k > 0:
+            if self.draft_model is None:
+                raise ValueError(
+                    "speculate_k > 0 needs a draft model (FFConfig."
+                    "draft_model or the draft_model constructor arg): "
+                    "speculative decoding verifies a DRAFT's proposals")
+            if temperature > 0.0:
+                raise ValueError(
+                    "speculative decoding is greedy-only (temperature="
+                    f"{temperature}): the accept rule compares the "
+                    "draft's proposal to the target's argmax; a sampled "
+                    "path needs rejection sampling, which this engine "
+                    "does not implement")
+            tgt_v = int(model._final_tensor.dims[-1])
+            dft_v = int(self.draft_model._final_tensor.dims[-1])
+            if tgt_v != dft_v:
+                raise ValueError(
+                    f"draft/target vocab mismatch: draft emits {dft_v} "
+                    f"logits, target {tgt_v} — the accept rule compares "
+                    f"token ids, so the vocabularies must be identical")
+            self.draft_gen = Generator(
+                self.draft_model, temperature=0.0, top_k=0, eos_id=eos_id,
+                pad_id=pad_id, quantize=quantize)
+            ddtype = self.draft_gen._compute_dtype()
+            drepl = NamedSharding(self.draft_model.mesh,
+                                  PartitionSpec(None, None, None, None))
+            # the draft pool mirrors the target pool's page GEOMETRY and
+            # page IDS (its own KVH/Dh): one allocator, one page table,
+            # one radix trie govern both — a shared prefix page id means
+            # target AND draft prefix KV are both resident
+            self.draft_pool = {
+                op.name: jax.tree.map(
+                    lambda a: jax.device_put(a, drepl),
+                    op.init_paged_cache(self.num_pages, self.page_size,
+                                        ddtype))
+                for op in self.draft_gen.attn_ops}
+
         # per-slot scheduler state (host side, shipped to device each step)
         n = self.slots
         self.page_tables = np.zeros((n, self.pages_per_slot), np.int32)
@@ -200,6 +466,9 @@ class ServingEngine:
         self._completed = 0
         self._failed = 0
         self._tokens_emitted = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_dispatches = 0
         import collections
 
         self._ttfts = collections.deque(maxlen=4096)
@@ -256,7 +525,16 @@ class ServingEngine:
             self._failed += 1
         if req.ttft:
             self._ttfts.append(req.ttft)
-        self._free_pages.extend(req.pages)
+        # COW teardown: pages the trie owns (matched prefix + the pages
+        # this request published) are DECREF'd — they stay cached, warm
+        # for the next hit, until the evictor needs them. Only the
+        # request's private pages (partial prompt page, bucket padding,
+        # decode appends) return to the free list.
+        if req.trie_nodes:
+            self.prefix_cache.release(req.trie_nodes)
+            req.trie_nodes = []
+        self._free_pages.extend(req.private_pages)
+        req.private_pages = []
         req.slot = -1
         self.slot_req[slot] = None
         self.active[slot] = False
@@ -311,6 +589,35 @@ class ServingEngine:
             fflogger.info("serving: compiled %r in %.2fs", key, dt)
         return out
 
+    @staticmethod
+    def _seed_prefix_caches(gen, bucket: int, p0: int, pool, prefix_pages):
+        """Gather ``p0`` positions of cached prefix KV READ-ONLY into
+        the front of a fresh contiguous per-request cache for every
+        attention op — the shared half of every hit prefill. Target and
+        draft builders use this one helper so the two pools (which share
+        page ids) can never drift apart."""
+        cdtype = gen._compute_dtype()
+        caches = {}
+        for op in gen.attn_ops:
+            c = op.init_cache(1, bucket, cdtype)
+            caches[op.name] = {
+                name: c[name].at[:, :p0].set(
+                    pool[op.name][name][prefix_pages].reshape(
+                        1, p0, *c[name].shape[2:]))
+                for name in ("k", "v")}
+        return caches
+
+    @staticmethod
+    def _scatter_tail(gen, pool, caches, pages, p0: int = 0):
+        """COW scatter: write the contiguous cache's positions past
+        ``p0`` into ``pages`` — the request's own fresh pages, never the
+        shared ones. ``p0=0`` is the cold (whole-bucket) case."""
+        return {
+            op.name: op.paged_prefill_write(
+                pool[op.name], caches[op.name]["k"][:, p0:],
+                caches[op.name]["v"][:, p0:], pages)
+            for op in gen.attn_ops}
+
     def _build_prefill(self, bucket: int, n_pages: int):
         gen = self.gen
         cdtype = gen._compute_dtype()
@@ -324,17 +631,102 @@ class ServingEngine:
             logits = logits[:, -1] + poison            # (1, V)
             ok = jnp.isfinite(logits).all(axis=-1)
             tok, _ = gen._sample(logits, key)
-            new_pool = {
-                op.name: op.paged_prefill_write(
-                    pool[op.name], caches[op.name]["k"],
-                    caches[op.name]["v"], pages)
-                for op in gen.attn_ops}
-            return tok, ok, new_pool
+            return tok, ok, self._scatter_tail(gen, pool, caches, pages)
 
         return jax.jit(prefill, donate_argnums=(4,))
 
-    def _build_decode(self, n_steps: int):
+    def _build_prefill_hit(self, bucket: int, full: int):
+        """Prefix-hit prefill: ``full`` cached pages are gathered
+        READ-ONLY into the front of a contiguous per-request cache, the
+        tail slab [full*ps, bucket) runs as one chunk_forward pass (each
+        tail position attends the gathered prefix + the tail's own causal
+        window — bitwise the whole-prompt einsum, runtime/generation.py),
+        a gather-last query scores the prompt's true last position, and
+        ONLY the tail k/v scatters out — into the request's fresh pages,
+        never the shared ones (the copy-on-write rule; the matched
+        prefix's partial last page is re-materialized here too)."""
         gen = self.gen
+        p0 = full * self.page_size
+
+        def prefill(params, state, tokens_tail, tok_last, length, pool,
+                    prefix_pages, tail_pages, poison, key):
+            caches = self._seed_prefix_caches(gen, bucket, p0, pool,
+                                              prefix_pages)
+            _, caches = gen._walk(params, state, tokens_tail, caches,
+                                  None, chunk_start=p0, skip_tail=True)
+            logits, caches = gen._walk(params, state, tok_last, caches,
+                                       None, last_only=True,
+                                       row_lengths=length,
+                                       gather_last=True)
+            logits = logits[:, -1] + poison            # (1, V)
+            ok = jnp.isfinite(logits).all(axis=-1)
+            tok, _ = gen._sample(logits, key)
+            return tok, ok, self._scatter_tail(gen, pool, caches,
+                                               tail_pages, p0)
+
+        return jax.jit(prefill, donate_argnums=(5,))
+
+    def _build_draft_prefill(self, bucket: int, n_pages: int):
+        """Cold draft prefill: fill the draft pool's pages for the whole
+        bucket. Cache-only (skip_tail) — the draft's first proposal is
+        sampled by the draft-decode scan, so its prefill logits are
+        never needed."""
+        gen = self.draft_gen
+        cdtype = gen._compute_dtype()
+
+        def prefill(params, state, tokens, pool, pages):
+            caches = {op.name: op.init_cache(1, bucket, cdtype)
+                      for op in gen.attn_ops}
+            _, caches = gen._walk(params, state, tokens, caches, None,
+                                  skip_tail=True)
+            return self._scatter_tail(gen, pool, caches, pages)
+
+        return jax.jit(prefill, donate_argnums=(3,))
+
+    def _build_draft_prefill_hit(self, bucket: int, full: int):
+        """Prefix-hit draft prefill: same gather + tail-chunk + COW
+        scatter as the target's hit program (the shared helpers), minus
+        the logits tail."""
+        gen = self.draft_gen
+        p0 = full * self.page_size
+
+        def prefill(params, state, tokens_tail, pool, prefix_pages,
+                    tail_pages):
+            caches = self._seed_prefix_caches(gen, bucket, p0, pool,
+                                              prefix_pages)
+            _, caches = gen._walk(params, state, tokens_tail, caches,
+                                  None, chunk_start=p0, skip_tail=True)
+            return self._scatter_tail(gen, pool, caches, tail_pages, p0)
+
+        return jax.jit(prefill, donate_argnums=(3,))
+
+    def _build_verify(self, k: int):
+        """Speculative verify: ONE dispatch scores all K+1 candidate
+        positions per slot — the slab [last_tok, d_1..d_K] flows through
+        the target graph with paged_verify_forward writing each
+        position's k/v at its own (host-clamped) slot and attending at
+        its own frontier. Returns the target's greedy argmax at every
+        position plus per-position finiteness; acceptance is host-side
+        (compare proposals to argmax, emit the matching prefix + 1)."""
+        gen = self.gen
+
+        def verify(params, state, pool, page_table, slab, write_pos,
+                   rope_pos0, row_len, prompt_pad, poison):
+            paged = {"page_table": page_table, "write_pos": write_pos,
+                     "rope_pos": rope_pos0, "row_len": row_len,
+                     "prompt_pad": prompt_pad}
+            logits, pool = gen._walk(params, state, slab, pool, None,
+                                     paged=paged)
+            logits = logits.astype(jnp.float32) \
+                + poison[:, None, None]                # (B, K+1, V)
+            ok = jnp.isfinite(logits).all(axis=-1)     # (B, K+1)
+            toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return toks, ok, pool
+
+        return jax.jit(verify, donate_argnums=(2,))
+
+    def _build_decode(self, n_steps: int, gen=None):
+        gen = gen or self.gen
 
         def decode(params, state, pool, page_table, last_tok, write_pos0,
                    rope_pos0, row_len, prompt_pad, budget, poison, key):
@@ -374,8 +766,10 @@ class ServingEngine:
     # ---- the scheduler loop -------------------------------------------------
 
     def _admit(self):
-        """Move queued requests into free slots: allocate pages, prefill
-        the prompt (bucket-shaped program) into them, seed the slot."""
+        """Move queued requests into free slots: look up the longest
+        cached prompt prefix, allocate fresh pages for everything past it
+        (copy-on-write — shared pages are never written), prefill the
+        tail (bucket-shaped program) and seed the slot."""
         while self._queue:
             try:
                 slot = next(i for i in range(self.slots)
@@ -385,21 +779,47 @@ class ServingEngine:
             req = self._queue[0]
             total = req.bucket + req.max_new_tokens
             n_total = math.ceil(total / self.page_size)
-            if len(self._free_pages) < n_total:
-                # HBM pressure: wait for a retirement to free pages. Head-
-                # of-line blocking is deliberate — FIFO admission keeps
-                # TTFT fairness; submit() already guarantees the request
-                # fits an EMPTY pool, so progress is always possible.
-                return
+            # longest cached page-aligned prefix, capped so at least the
+            # prompt's LAST token is always prefilled (its logits seed
+            # the first emitted token). No refcounts move until the
+            # admission is certain.
+            matched: List[_TrieNode] = []
+            if self.prefix_cache is not None:
+                cap = (req.prompt.size - 1) // self.page_size
+                matched = self.prefix_cache.match(req.prompt, cap)
+            full = len(matched)
+            need = n_total - full
+            if len(self._free_pages) < need:
+                if self.prefix_cache is not None:
+                    # pool pressure: reclaim cold cached pages (LRU,
+                    # refcount-0 leaves only; the just-matched path is
+                    # protected — it is about to be mounted)
+                    self._free_pages.extend(self.prefix_cache.evict(
+                        need - len(self._free_pages), protect=matched))
+                if len(self._free_pages) < need:
+                    # still short: wait for a retirement to free pages.
+                    # Head-of-line blocking is deliberate — FIFO
+                    # admission keeps TTFT fairness; submit() already
+                    # guarantees the request fits an EMPTY pool (the
+                    # trie is fully evictable once its users retire),
+                    # so progress is always possible. The request stays
+                    # QUEUED with no refcounts or pages held.
+                    return
             self._queue.pop(0)
-            req.pages = [self._free_pages.pop() for _ in range(n_total)]
+            fresh = [self._free_pages.pop() for _ in range(need)]
+            if self.prefix_cache is not None:
+                self.prefix_cache.note_admitted(full)
+            if matched:
+                self.prefix_cache.acquire(matched)
+                req.trie_nodes = list(matched)
+                req.prefix_tokens = full * self.page_size
+            req.private_pages = list(fresh)
+            req.pages = [n.page for n in matched] + fresh
             req.slot = slot
             req.state = "running"
             self.slot_req[slot] = req
 
             n_prefill = math.ceil(req.bucket / self.page_size)
-            padded = np.full((1, req.bucket), self.pad_id, np.int32)
-            padded[0, :req.prompt.size] = req.prompt
             # fault injection: FF_FAULT=nan_loss@serve:<n> poisons the
             # n-th ADMITTED request in-graph (NaN added to its logits), so
             # the detect-and-retire path runs end to end, not a host stub
@@ -412,32 +832,99 @@ class ServingEngine:
             self.prompt_pad[slot] = req.bucket
             self.emitted[slot] = 0
 
-            tok, ok, self.pool = self._compiled_call(
-                ("prefill", req.bucket, n_prefill, self.prefill_chunk),
-                lambda: self._build_prefill(req.bucket, n_prefill),
-                self.gen._params(), self.model.bn_state, padded,
-                np.asarray([req.prompt.size], np.int32), self.pool,
-                np.asarray(req.pages[:n_prefill], np.int32),
-                np.float32(self.poison[slot]), self._split_key())
+            if full:
+                # prefix hit: gather the matched pages read-only, prefill
+                # only the tail slab [full*ps, bucket) into FRESH pages —
+                # the matched prefix's partial last page (tokens past
+                # full*ps) is re-materialized into the request's own
+                # first tail page, never written in the donor's (the COW
+                # rule). One program per (bucket, full): bounded like the
+                # buckets themselves, flat after warmup.
+                p0 = full * self.page_size
+                padded_tail = np.full((1, req.bucket - p0), self.pad_id,
+                                      np.int32)
+                tail = req.prompt[p0:]
+                padded_tail[0, :tail.size] = tail
+                tok_last = np.asarray([[req.prompt[-1]]], np.int32)
+                tok, ok, self.pool = self._compiled_call(
+                    ("prefill_hit", req.bucket, full),
+                    lambda: self._build_prefill_hit(req.bucket, full),
+                    self.gen._params(), self.model.bn_state, padded_tail,
+                    tok_last, np.asarray([req.prompt.size], np.int32),
+                    self.pool, np.asarray(req.pages[:full], np.int32),
+                    np.asarray(req.pages[full:n_prefill], np.int32),
+                    np.float32(self.poison[slot]), self._split_key())
+            else:
+                padded = np.full((1, req.bucket), self.pad_id, np.int32)
+                padded[0, :req.prompt.size] = req.prompt
+                tok, ok, self.pool = self._compiled_call(
+                    ("prefill", req.bucket, n_prefill, self.prefill_chunk),
+                    lambda: self._build_prefill(req.bucket, n_prefill),
+                    self.gen._params(), self.model.bn_state, padded,
+                    np.asarray([req.prompt.size], np.int32), self.pool,
+                    np.asarray(req.pages[:n_prefill], np.int32),
+                    np.float32(self.poison[slot]), self._split_key())
+            if self.draft_gen is not None:
+                # the draft model's prefix KV rides the same page ids, so
+                # its prefill mirrors the target's hit/cold split exactly
+                if full:
+                    self.draft_pool = self._compiled_call(
+                        ("draft_prefill_hit", req.bucket, full),
+                        lambda: self._build_draft_prefill_hit(req.bucket,
+                                                              full),
+                        self.draft_gen._params(), self.draft_model.bn_state,
+                        padded_tail, self.draft_pool,
+                        np.asarray(req.pages[:full], np.int32),
+                        np.asarray(req.pages[full:n_prefill], np.int32))
+                else:
+                    self.draft_pool = self._compiled_call(
+                        ("draft_prefill", req.bucket, n_prefill),
+                        lambda: self._build_draft_prefill(req.bucket,
+                                                          n_prefill),
+                        self.draft_gen._params(), self.draft_model.bn_state,
+                        padded, self.draft_pool,
+                        np.asarray(req.pages[:n_prefill], np.int32))
+            ok_host = bool(np.asarray(ok)[0])
+            if self.prefix_cache is not None and ok_host:
+                # publish this prompt's FULL pages beyond the matched
+                # prefix for future sharing (poisoned/non-finite prefills
+                # are never published — a NaN prompt cache must not
+                # infect later requests). Published pages move from
+                # private to trie-owned: decref'd at retirement, freed
+                # only by eviction.
+                last = req.prompt.size // self.page_size
+                if last > full:
+                    created = self.prefix_cache.insert(
+                        req.prompt, matched, full, req.pages[full:last])
+                    if created:
+                        adopted = {n.page for n in created}
+                        req.trie_nodes.extend(created)
+                        req.private_pages = [p for p in req.private_pages
+                                             if p not in adopted]
             self.active[slot] = True
-            self._record_token(slot, int(np.asarray(tok)[0]),
-                               bool(np.asarray(ok)[0]))
+            self._record_token(slot, int(np.asarray(tok)[0]), ok_host)
 
-    def _decode_step(self):
-        k = self.decode_chunk
-        write_pos = self.prompt_pad + self.emitted - 1
-        rope_pos = self.row_len + self.emitted - 1
-        # inactive slots: state arrays are zeroed, so write_pos = -1 would
-        # index page -1; clamp to 0 — the write lands in scratch page 0
-        write_pos = np.maximum(write_pos, 0).astype(np.int32)
-        rope_pos = np.maximum(rope_pos, 0).astype(np.int32)
-        # per-slot decode budget: last legal write position + 1. Inactive
-        # slots get 1, clamping their scratch writes to position 0
+    def _slot_decode_state(self):
+        """(write_pos, rope_pos, budget) for one decode/speculate
+        dispatch. Inactive slots: state arrays are zeroed, so write_pos
+        = -1 would index page -1 — clamp to 0 (the write lands in
+        scratch page 0) and give them budget 1, clamping every later
+        step there too. Budget is the last legal write position + 1
+        (bucket + the request's own max_new_tokens)."""
+        write_pos = np.maximum(self.prompt_pad + self.emitted - 1,
+                               0).astype(np.int32)
+        rope_pos = np.maximum(self.row_len + self.emitted - 1,
+                              0).astype(np.int32)
         budget = np.ones((self.slots,), np.int32)
         for slot in range(self.slots):
             req = self.slot_req[slot]
             if req is not None:
                 budget[slot] = req.bucket + req.max_new_tokens
+        return write_pos, rope_pos, budget
+
+    def _decode_step(self):
+        k = self.decode_chunk
+        write_pos, rope_pos, budget = self._slot_decode_state()
         toks, oks, self.pool = self._compiled_call(
             ("decode", k), lambda: self._build_decode(k),
             self.gen._params(), self.model.bn_state, self.pool,
@@ -458,6 +945,66 @@ class ServingEngine:
                 self._record_token(slot, int(toks[t, slot]),
                                    bool(oks[t, slot]))
 
+    def _spec_step(self):
+        """One speculative iteration: the draft proposes K greedy tokens
+        per slot (one K-step scan over its own paged pool), the target
+        scores all K+1 candidate positions in ONE verify dispatch, and
+        the host emits the longest proposal prefix matching the target's
+        argmax plus the target's own next token — between 1 and K+1
+        TARGET-greedy tokens per slot per iteration, token-identical to
+        the non-speculative stream. k/v written for rejected positions
+        sit past the slot's new write frontier and are overwritten by the
+        next dispatch before anything can attend them."""
+        k = self.speculate_k
+        write_pos, rope_pos, budget = self._slot_decode_state()
+        d_toks, _, self.draft_pool = self._compiled_call(
+            ("draft_decode", k),
+            lambda: self._build_decode(k, gen=self.draft_gen),
+            self.draft_gen._params(), self.draft_model.bn_state,
+            self.draft_pool, self.page_tables, self.last_tok, write_pos,
+            rope_pos, self.row_len, self.prompt_pad, budget,
+            np.zeros((self.slots,), np.float32), self._split_key())
+        d_toks = np.asarray(d_toks)                    # (k, B_slots)
+        slab = np.concatenate(
+            [self.last_tok[:, None].astype(np.int32), d_toks.T], axis=1)
+        # per-position write slots, clamped to each request's own budget
+        # (positions an emitted token can attend never reach the clamp —
+        # emission stops at max_new first, so clamp-duplicated writes are
+        # only ever visible to host-truncated tokens)
+        pos = np.minimum(
+            write_pos[:, None] + np.arange(k + 1, dtype=np.int32)[None, :],
+            (budget - 1)[:, None]).astype(np.int32)
+        t_toks, t_oks, self.pool = self._compiled_call(
+            ("verify", k), lambda: self._build_verify(k),
+            self.gen._params(), self.model.bn_state, self.pool,
+            self.page_tables, slab, pos, rope_pos, self.row_len,
+            self.prompt_pad, self.poison)
+        t_toks = np.asarray(t_toks)                    # (B_slots, k+1)
+        t_oks = np.asarray(t_oks)
+        self.decode_steps += k + 1
+        self._spec_dispatches += 1
+        for slot in range(self.slots):
+            if not self.active[slot]:
+                continue
+            self._spec_proposed += k
+            accepted = 0
+            while accepted < k \
+                    and d_toks[accepted, slot] == t_toks[slot, accepted]:
+                accepted += 1
+            self._spec_accepted += accepted
+            for m in range(accepted + 1):
+                if not self.active[slot]:
+                    break  # retired mid-window: the rest is truncated
+                self._occupancy_sum += 1
+                self._record_token(slot, int(t_toks[slot, m]),
+                                   bool(t_oks[slot, m]))
+
+    def _decode_tick(self):
+        if self.speculate_k > 0 and self.draft_gen is not None:
+            self._spec_step()
+        else:
+            self._decode_step()
+
     def step(self) -> bool:
         """One scheduler tick: admit what fits (unless draining), then one
         slot-decode step if any slot is live. Returns whether
@@ -467,7 +1014,7 @@ class ServingEngine:
         if not self._draining:
             self._admit()
         if self.active.any():
-            self._decode_step()
+            self._decode_tick()
         if self._draining:
             return bool(self.active.any())
         return self.pending()
@@ -500,7 +1047,7 @@ class ServingEngine:
         again."""
         self._draining = True
         while self.active.any():
-            self._decode_step()
+            self._decode_tick()
         snap = self.stats()
         snap["drained"] = True
         snap["queued"] = len(self._queue)
@@ -532,12 +1079,27 @@ class ServingEngine:
             "queued": len(self._queue),
             **{k: snap[k] for k in ("serve_slots", "free_pages",
                                     "completed", "failed", "occupancy",
-                                    "recompiles")},
+                                    "recompiles", "pages_in_use",
+                                    "kv_pages_shared", "prefix_hit_rate",
+                                    "spec_accept_rate")},
         }
 
     # ---- metrics ------------------------------------------------------------
 
+    def flush_prefix_cache(self) -> int:
+        """Evict EVERY refcount-0 cached page back to the free list;
+        returns the number reclaimed. For weight hot-swap (cached KV is
+        stale under new weights) and for page-leak accounting: after
+        drain() + flush, free_pages must equal kv_pages - 1. Pages still
+        mounted by live requests survive (and stay cached)."""
+        if self.prefix_cache is None:
+            return 0
+        freed = self.prefix_cache.evict(self.num_pages, pressure=False)
+        self._free_pages.extend(freed)
+        return len(freed)
+
     def stats(self) -> Dict:
+        pc = self.prefix_cache
         ttfts = sorted(self._ttfts)  # bounded window of completions
 
         def pct(p):
@@ -552,10 +1114,16 @@ class ServingEngine:
             "tokens_generated": self._tokens_emitted,
             "decode_steps": self.decode_steps,
             "recompiles": self.recompile_count,
-            # mean fraction of slots doing USEFUL work per decode step
-            # (mid-chunk retirements stop counting) — the engine's
-            # steady-state utilization headline. occupied_slot_steps is
-            # the raw numerator so callers can compute occupancy over a
+            # mean fraction of computed positions doing USEFUL work per
+            # decode step (mid-chunk retirements stop counting) — the
+            # engine's steady-state utilization headline. Under
+            # speculation the denominator counts all K+1 verify
+            # positions, so occupancy folds the accept rate in
+            # ((1 + aK)/(K+1) on a saturated engine): it measures wasted
+            # COMPUTE, not idle slots — a router balancing on busyness
+            # should use active_slots/queued (health()) and read
+            # spec_accept_rate separately. occupied_slot_steps is the
+            # raw numerator so callers can compute occupancy over a
             # WINDOW from two stats() snapshots
             "occupancy": (self._occupancy_sum
                           / max(1, self.decode_steps) / self.slots),
@@ -566,4 +1134,27 @@ class ServingEngine:
             "kv_pages": self.num_pages,
             "kv_page_size": self.page_size,
             "serve_slots": self.slots,
+            # KV-pool observability (ROADMAP item 1: the router balances
+            # on these): in-use counts every non-free page (live-private
+            # + cached), cached the pages the radix trie holds (warm,
+            # reclaimable at refcount 0), shared those mounted by >1
+            # live request right now
+            "pages_in_use": self.num_pages - 1 - len(self._free_pages),
+            "kv_pages_cached": pc.pages if pc else 0,
+            "kv_pages_shared": pc.shared_pages() if pc else 0,
+            "prefix_cache": pc is not None,
+            "prefix_lookups": pc.lookups if pc else 0,
+            "prefix_hits": pc.hits if pc else 0,
+            "prefix_hit_rate": (round(pc.hits / max(1, pc.lookups), 4)
+                                if pc else 0.0),
+            "prefill_tokens_saved": pc.tokens_saved if pc else 0,
+            "prefix_evictions": pc.evictions if pc else 0,
+            # live references into the trie: must be 0 after drain() —
+            # nonzero at idle means a refcount leak
+            "prefix_refs_live": pc.live_refs() if pc else 0,
+            "speculate_k": self.speculate_k,
+            "spec_proposed": self._spec_proposed,
+            "spec_accepted": self._spec_accepted,
+            "spec_accept_rate": round(
+                self._spec_accepted / max(1, self._spec_proposed), 4),
         }

@@ -9,6 +9,13 @@ ServingEngine.drain() — stop admitting, finish the in-flight slots, final
 snapshot — instead of a hard stop (the graceful-shutdown half of elastic
 recovery, docs/resilience.md).
 
+Phase 2 drives the radix prefix cache under SKEWED traffic — 80% of the
+requests share a 64-token system prompt (the millions-of-users shape from
+ROADMAP item 1): prefix hits must fire for nearly all of them, the warm
+window must stay at zero recompiles (cold prefill, hit prefill, draft-free
+decode all warmed up front), and after drain() + flush_prefix_cache() the
+pool must hold exactly kv_pages - 1 free pages — the page-leak check.
+
 Usage: [FF_FAULT=nan_loss@serve:37] python scripts/serve_smoke.py [N]
 """
 
@@ -110,7 +117,71 @@ def main():
               f"as failed without stalling the batch")
     else:
         assert not failed, f"unexpected failures: {[r.rid for r in failed]}"
+
+    prefix_smoke(ff, rs, vocab, n_requests)
     print("serve_smoke: PASSED")
+
+
+def prefix_smoke(ff, rs, vocab, n_requests):
+    """Skewed shared-prefix workload: 80% of requests share a 64-token
+    system prompt. Asserts prefix hits, warm-window recompile flatness,
+    and zero page leaks after drain + flush."""
+    system = rs.randint(1, vocab, (64,)).astype(np.int32)
+    n_skew = (n_requests * 8) // 10
+    prompts = []
+    for i in range(n_requests):
+        if i % 5 < 4:  # interleave 80/20 so slots mix both shapes
+            tail = rs.randint(1, vocab, (int(rs.randint(1, 8)),))
+            prompts.append(np.concatenate([system, tail.astype(np.int32)]))
+        else:
+            n = int(rs.randint(3, 25))
+            prompts.append(rs.randint(1, vocab, (n,)).astype(np.int32))
+
+    # pinned buckets: background traffic -> 32, system-prompt traffic
+    # (65..71 tokens) -> 96; 96 + max_new 8 fits max_seq_len 112
+    eng = ff.make_serving_engine(max_seq_len=112, decode_buckets=[32, 96])
+    # warm every program the workload can need: cold prefill per bucket,
+    # the (bucket 96, 8 matched pages) hit prefill, and the decode scan.
+    # The first skewed warm request PUBLISHES the system pages, so the
+    # second takes the hit path — the measured window then compiles
+    # nothing.
+    warm_tail = rs.randint(1, vocab, (3,)).astype(np.int32)
+    eng.run([rs.randint(1, vocab, (10,)).astype(np.int32),
+             np.concatenate([system, warm_tail]),
+             np.concatenate([system, warm_tail + 1])], max_new_tokens=4)
+    warm = eng.recompile_count
+    assert eng.stats()["prefix_hits"] >= 1, "warmup hit prefill never ran"
+
+    t0 = time.perf_counter()
+    reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    while eng.health()["queued"]:
+        eng.step()
+    st = eng.drain()
+    dt = time.perf_counter() - t0
+
+    done = [r for r in reqs if r.state == "done"]
+    hits = st["prefix_hits"]
+    print(f"serve_smoke[prefix]: {len(done)}/{n_requests} done in {dt:.1f}s "
+          f"({st['tokens_generated'] / dt:.0f} tok/s), "
+          f"prefix hits {hits}/{st['prefix_lookups']} "
+          f"(saved {st['prefill_tokens_saved']} prefill tokens), "
+          f"shared-peak cached {st['kv_pages_cached']} pages, "
+          f"recompiles after warmup {eng.recompile_count - warm}")
+    assert len(done) == n_requests, "requests lost in the prefix phase"
+    assert hits >= n_skew - 1, (
+        f"only {hits} prefix hits; the {n_skew} shared-prefix requests "
+        f"(minus the publisher, warmed) must all hit")
+    assert eng.recompile_count == warm, (
+        f"recompile leak in the prefix-cache warm window: "
+        f"{eng.recompile_count - warm} programs built")
+    # page-leak check: every page is free or cached; flushing the cache
+    # returns the pool to exactly kv_pages - 1 free
+    assert st["prefix_refs_live"] == 0, "trie refcount leak after drain"
+    assert st["free_pages"] + st["kv_pages_cached"] == st["kv_pages"] - 1, (
+        f"page leak: {st['free_pages']} free + {st['kv_pages_cached']} "
+        f"cached != {st['kv_pages'] - 1}")
+    eng.flush_prefix_cache()
+    assert eng.stats()["free_pages"] == st["kv_pages"] - 1, "flush leaked"
 
 
 if __name__ == "__main__":

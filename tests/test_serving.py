@@ -8,7 +8,14 @@ Correctness anchors:
   * decode early-exit returns exactly the full-length scan's tokens;
   * warm buckets never recompile (the counter proves it);
   * a poisoned request (FF_FAULT nan_loss@serve) retires as failed
-    without stalling the rest of the batch.
+    without stalling the rest of the batch;
+  * the radix prefix cache is invisible to tokens: shared-prefix
+    admissions emit exactly the cold-cache stream, copy-on-write keeps
+    divergent continuations from ever touching each other's pages, and
+    drain() leaves zero live refcounts;
+  * speculative decoding is invisible to tokens: every emitted token is
+    the TARGET's greedy argmax, at any K — the draft only changes how
+    many dispatches that stream costs.
 """
 
 import jax.numpy as jnp
@@ -56,7 +63,12 @@ def test_continuous_batching_token_identical_to_sequential(ff):
                     f"from its solo run")
     st = eng.stats()
     assert st["completed"] == len(prompts)
-    assert st["free_pages"] == st["kv_pages"] - 1  # all pages returned
+    # every page is either free or cached (warm prefix KV, refcount 0);
+    # flushing the cache returns the remainder — no page leaks
+    assert st["free_pages"] + st["kv_pages_cached"] == st["kv_pages"] - 1
+    assert st["prefix_refs_live"] == 0
+    eng.flush_prefix_cache()
+    assert eng.stats()["free_pages"] == st["kv_pages"] - 1
     assert 0.0 < st["occupancy"] <= 1.0
 
 
@@ -200,9 +212,15 @@ def test_poisoned_request_retired_without_stalling(ff, monkeypatch):
         solo = ff.generate(r.prompt[None, :], max_new_tokens=5)
         np.testing.assert_array_equal(np.asarray(r.tokens, np.int32),
                                       solo[0, r.prompt.size:])
-    # the poisoned slot's pages were freed for reuse
+    # the poisoned slot's pages were freed for reuse (its prefill is
+    # never published to the prefix cache); the healthy requests' full
+    # pages stay cached at refcount 0 until flushed
     st = eng.stats()
-    assert st["failed"] == 1 and st["free_pages"] == st["kv_pages"] - 1
+    assert st["failed"] == 1
+    assert st["free_pages"] + st["kv_pages_cached"] == st["kv_pages"] - 1
+    assert st["prefix_refs_live"] == 0
+    eng.flush_prefix_cache()
+    assert eng.stats()["free_pages"] == st["kv_pages"] - 1
 
 
 @pytest.mark.slow  # 7 s; serving CI tier runs the full file
@@ -274,6 +292,384 @@ def test_decode_chunk_invariance(ff):
         hits = np.where(new == eos)[0]
         want = new[:hits[0] + 1] if hits.size else new
         np.testing.assert_array_equal(got, want)
+
+
+# ---- radix prefix cache: pure-host trie semantics (sub-second) ----------
+
+
+def _trie(ps=4):
+    from flexflow_tpu.runtime.serving import RadixPrefixCache
+
+    return RadixPrefixCache(ps)
+
+
+def test_radix_trie_match_insert_roundtrip():
+    """A published prefix is found page-aligned: full pages only, longest
+    path wins, the partial last page never enters the trie."""
+    pc = _trie(4)
+    prompt = np.arange(1, 14, dtype=np.int32)         # 13 tokens: 3 full
+    created = pc.insert(prompt, [], 0, [7, 8, 9])
+    assert [n.page for n in created] == [7, 8, 9] and pc.pages == 3
+    # identical prompt: all 3 pages match (cap at the last FULL page)
+    assert [n.page for n in pc.match(prompt, 3)] == [7, 8, 9]
+    # shares only the first 8 tokens: 2 pages
+    other = prompt.copy()
+    other[9] = 77
+    assert [n.page for n in pc.match(other, 3)] == [7, 8]
+    # a max_pages cap truncates the walk
+    assert [n.page for n in pc.match(prompt, 1)] == [7]
+    # nothing in common: no match
+    assert pc.match(np.full((8,), 60, np.int32), 2) == []
+
+
+def test_radix_trie_insert_stops_at_existing_chunk():
+    """Publishing under a capped match stops at the first chunk that
+    already exists — the duplicate page stays the caller's."""
+    pc = _trie(4)
+    prompt = np.arange(1, 13, dtype=np.int32)
+    pc.insert(prompt, [], 0, [5, 6])
+    # same prompt published again with different pages: nothing created
+    assert pc.insert(prompt, [], 0, [11, 12]) == []
+    assert pc.pages == 2
+    # extend past the existing path
+    m = pc.match(prompt, 3)
+    created = pc.insert(prompt, m, 2, [13])
+    assert [n.page for n in created] == [13] and pc.pages == 3
+
+
+def test_radix_trie_refcounts_and_eviction():
+    """Refcounted pages never evict; refcount-0 leaves evict LRU-first
+    and cascade to exposed parents; a protected path survives."""
+    pc = _trie(4)
+    a = np.arange(1, 9, dtype=np.int32)
+    b = np.full((4,), 50, np.int32)
+    na = pc.insert(a, [], 0, [1, 2])      # chain 1 -> 2
+    nb = pc.insert(b, [], 0, [3])         # leaf 3
+    pc.release(na)
+    pc.release(nb)
+    assert pc.live_refs() == 0 and pc.pages == 3
+    pc.match(a, 2)                        # touch chain a (newer last_use)
+    assert pc.evict(1) == [3]             # LRU leaf goes first
+    # cascade: evicting leaf 2 exposes 1
+    assert sorted(pc.evict(2)) == [1, 2] and pc.pages == 0
+    # refcount protection: a mounted path never evicts
+    nc = pc.insert(a, [], 0, [4, 5])
+    assert pc.evict(5) == [] and pc.pages == 2
+    pc.release(nc)
+    # protect= excludes a just-matched path about to be acquired
+    assert pc.evict(5, protect=nc) == [] and pc.pages == 2
+    assert sorted(pc.evict(5)) == [4, 5]
+    with pytest.raises(AssertionError, match="underflow"):
+        pc.release(nc)
+
+
+# ---- radix prefix cache: engine semantics --------------------------------
+
+
+@pytest.mark.slow  # 20 s; serving CI tier runs the full file
+def test_prefix_cache_token_identical_to_cold(ff):
+    """Skewed shared-prefix traffic: requests sharing a system prompt hit
+    the cache (prefill only the tail) yet emit exactly the tokens a
+    cold-cache engine — and a solo generate run — produces. The cache is
+    a perf mechanism, never semantics."""
+    rs = np.random.RandomState(23)
+    system = rs.randint(1, VOCAB, (12,)).astype(np.int32)  # 3 full pages
+    tails = [rs.randint(1, VOCAB, (L,)).astype(np.int32)
+             for L in (3, 7, 1, 5, 9)]
+    prompts = [np.concatenate([system, t]) for t in tails]
+
+    warm = ff.make_serving_engine(serve_slots=2, kv_page_size=4,
+                                  max_seq_len=64)
+    cold = ff.make_serving_engine(serve_slots=2, kv_page_size=4,
+                                  max_seq_len=64, prefix_cache=False)
+    w_reqs = warm.run(prompts, max_new_tokens=6)
+    c_reqs = cold.run(prompts, max_new_tokens=6)
+    assert [r.state for r in w_reqs] == ["done"] * len(prompts)
+    for w, c in zip(w_reqs, c_reqs):
+        np.testing.assert_array_equal(
+            np.asarray(w.tokens, np.int32), np.asarray(c.tokens, np.int32),
+            err_msg=f"prefix cache changed request {w.rid}'s tokens")
+        solo = ff.generate(w.prompt[None, :], max_new_tokens=6)
+        np.testing.assert_array_equal(np.asarray(w.tokens, np.int32),
+                                      solo[0, w.prompt.size:])
+    ws, cs = warm.stats(), cold.stats()
+    # every request after the first matched the shared 12-token prefix
+    assert ws["prefix_hits"] == len(prompts) - 1
+    assert ws["prefill_tokens_saved"] == (len(prompts) - 1) * 12
+    assert cs["prefix_lookups"] == 0 and not cs["prefix_cache"]
+    # the cold engine holds nothing back; the warm one caches pages
+    assert cs["free_pages"] == cs["kv_pages"] - 1
+    assert ws["free_pages"] + ws["kv_pages_cached"] == ws["kv_pages"] - 1
+
+
+@pytest.mark.slow  # 15 s; serving CI tier runs the full file
+def test_prefix_cow_isolation(ff):
+    """Copy-on-write: concurrent requests mounting the same cached prefix
+    write their divergent tails and decode tokens into their OWN pages —
+    the donor's published pages are bitwise untouched, and every stream
+    matches its solo run."""
+    rs = np.random.RandomState(29)
+    system = rs.randint(1, VOCAB, (8,)).astype(np.int32)   # 2 full pages
+    prompts = [np.concatenate([system,
+                               rs.randint(1, VOCAB, (L,)).astype(np.int32)])
+               for L in (2, 6, 4, 3)]
+    eng = ff.make_serving_engine(serve_slots=2, kv_page_size=4,
+                                 max_seq_len=64)
+    eng.run([prompts[0]], max_new_tokens=4)      # publish the prefix
+    pc = eng.prefix_cache
+    shared = []
+    node = pc.root
+    while node.children:
+        node = next(iter(node.children.values()))
+        shared.append(node.page)
+    assert len(shared) >= 2                      # the 2 system pages
+    shared = np.asarray(shared, np.int32)
+    before = {op.name: {n: np.asarray(eng.pool[op.name][n][shared])
+                        for n in ("k", "v")}
+              for op in eng.gen.attn_ops}
+
+    reqs = eng.run(prompts[1:], max_new_tokens=4)
+    for r in reqs:
+        assert r.prefix_tokens >= 8              # mounted the shared pages
+        solo = ff.generate(r.prompt[None, :], max_new_tokens=4)
+        np.testing.assert_array_equal(np.asarray(r.tokens, np.int32),
+                                      solo[0, r.prompt.size:])
+    after = {op.name: {n: np.asarray(eng.pool[op.name][n][shared])
+                       for n in ("k", "v")}
+             for op in eng.gen.attn_ops}
+    for name, kv in before.items():
+        for n in ("k", "v"):
+            np.testing.assert_array_equal(
+                kv[n], after[name][n],
+                err_msg=f"shared page of {name}/{n} was written in place "
+                        f"(copy-on-write violated)")
+
+
+@pytest.mark.slow  # 12 s; serving CI tier runs the full file
+def test_prefix_evict_under_pressure(ff):
+    """A pool sized for exactly one max request: cached pages from
+    retired traffic are reclaimed (LRU) when admission needs them, and
+    everything still completes with solo-identical tokens."""
+    eng = ff.make_serving_engine(serve_slots=1, kv_page_size=4,
+                                 max_seq_len=32, kv_pages=9)
+    rs = np.random.RandomState(31)
+    prompts = [rs.randint(1, VOCAB, (14,)).astype(np.int32)
+               for _ in range(4)]
+    reqs = eng.run(prompts, max_new_tokens=4)
+    assert [r.state for r in reqs] == ["done"] * 4
+    for r in reqs:
+        solo = ff.generate(r.prompt[None, :], max_new_tokens=4)
+        np.testing.assert_array_equal(np.asarray(r.tokens, np.int32),
+                                      solo[0, r.prompt.size:])
+    st = eng.stats()
+    assert st["prefix_evictions"] > 0, \
+        "distinct 14-token prompts must force cache eviction in 9 pages"
+    assert st["free_pages"] + st["kv_pages_cached"] == st["kv_pages"] - 1
+    assert st["prefix_refs_live"] == 0
+
+
+@pytest.mark.slow  # 10 s; serving CI tier runs the full file
+def test_prefix_refcounts_clean_after_drain(ff):
+    """drain() with slots mid-flight: every trie refcount drops to zero,
+    pages are either free or cached, and flush_prefix_cache() returns the
+    pool to exactly kv_pages - 1 free (the leak check)."""
+    rs = np.random.RandomState(37)
+    system = rs.randint(1, VOCAB, (8,)).astype(np.int32)
+    prompts = [np.concatenate([system,
+                               rs.randint(1, VOCAB, (3,)).astype(np.int32)])
+               for _ in range(5)]
+    eng = ff.make_serving_engine(serve_slots=2, kv_page_size=4,
+                                 max_seq_len=64, decode_chunk=2)
+    for p in prompts:
+        eng.submit(p, max_new_tokens=12)
+    eng.step()                    # slots mid-flight, queue non-empty
+    snap = eng.drain()
+    assert snap["drained"] and snap["prefix_refs_live"] == 0
+    assert snap["queued"] == len(prompts) - eng.slots
+    st = eng.stats()
+    assert st["free_pages"] + st["kv_pages_cached"] == st["kv_pages"] - 1
+    freed = eng.flush_prefix_cache()
+    assert freed == st["kv_pages_cached"]
+    assert eng.stats()["free_pages"] == st["kv_pages"] - 1
+
+
+@pytest.mark.slow  # 14 s; serving CI tier runs the full file
+def test_pool_exhaustion_flood_tiny_pool(ff):
+    """Regression (satellite): flooding a tiny pool must never fail a
+    request — admission leaves what doesn't fit in the queue and run()
+    keeps making progress via retirements until the flood drains."""
+    eng = ff.make_serving_engine(serve_slots=4, kv_page_size=4,
+                                 max_seq_len=32, kv_pages=9)
+    rs = np.random.RandomState(41)
+    prompts = [rs.randint(1, VOCAB, (rs.randint(2, 15),)).astype(np.int32)
+               for _ in range(12)]
+    reqs = eng.run(prompts, max_new_tokens=3)
+    assert [r.state for r in reqs] == ["done"] * len(prompts)
+    st = eng.stats()
+    assert st["failed"] == 0 and st["completed"] == len(prompts)
+    assert st["free_pages"] + st["kv_pages_cached"] == st["kv_pages"] - 1
+
+
+# ---- speculative decoding ------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def draft(ff):
+    """A smaller draft LM over the SAME vocabulary (random weights — its
+    proposals rarely match, which exercises the reject path hard)."""
+    cfg = FFConfig(batch_size=2, mesh_shape={"data": 1})
+    model = FFModel(cfg)
+    _, logits = llama_lm(model, 2, seq_len=16, hidden=32, layers=1,
+                         heads=2, kv_heads=2, vocab_size=VOCAB)
+    model.compile(final_tensor=logits)
+    return model
+
+
+@pytest.mark.slow  # 35 s; serving CI tier runs the full file
+def test_speculative_greedy_token_identity(ff, draft):
+    """Speculative decoding at several K — including K larger than
+    max_new_tokens — emits exactly the non-speculative greedy stream.
+    Two drafts: a random small model (near-0 accept rate, the all-reject
+    path) and the target itself (near-1 accept rate, the long-accept
+    path); the tokens must not depend on either."""
+    prompts = _prompts(43, [5, 9, 3, 12])
+    base = ff.make_serving_engine(serve_slots=2, kv_page_size=4,
+                                  max_seq_len=64)
+    want = [np.asarray(r.tokens, np.int32)
+            for r in base.run(prompts, max_new_tokens=5)]
+    for dm in (draft, ff):
+        for k in (1, 3, 8):      # 8 > max_new_tokens=5
+            eng = ff.make_serving_engine(serve_slots=2, kv_page_size=4,
+                                         max_seq_len=64, draft_model=dm,
+                                         speculate_k=k)
+            reqs = eng.run(prompts, max_new_tokens=5)
+            assert [r.state for r in reqs] == ["done"] * len(prompts)
+            for w, r in zip(want, reqs):
+                np.testing.assert_array_equal(
+                    w, np.asarray(r.tokens, np.int32),
+                    err_msg=f"speculate_k={k} draft={'self' if dm is ff else 'small'} "
+                            f"changed request {r.rid}'s tokens")
+            st = eng.stats()
+            assert st["spec_proposed"] > 0
+            if dm is ff:
+                # self-draft: proposals are the target's own argmax —
+                # the accept path must actually run
+                assert st["spec_accepted"] > 0
+            assert st["free_pages"] + st["kv_pages_cached"] \
+                == st["kv_pages"] - 1
+
+
+@pytest.mark.slow  # 12 s; serving CI tier runs the full file
+def test_speculative_with_eos_and_prefix_cache(ff, draft):
+    """eos retirement mid-verify-window truncates cleanly, and the prefix
+    cache + speculation compose: identical tokens to the plain engine
+    under the same eos."""
+    rs = np.random.RandomState(47)
+    system = rs.randint(1, VOCAB, (8,)).astype(np.int32)
+    prompts = [np.concatenate([system,
+                               rs.randint(1, VOCAB, (L,)).astype(np.int32)])
+               for L in (2, 5, 3)]
+    probe = ff.generate(prompts[0][None, :], max_new_tokens=8)
+    eos = int(probe[0, prompts[0].size + 2])
+    base = ff.make_serving_engine(serve_slots=2, kv_page_size=4,
+                                  max_seq_len=64, eos_id=eos)
+    want = [np.asarray(r.tokens, np.int32)
+            for r in base.run(prompts, max_new_tokens=8)]
+    eng = ff.make_serving_engine(serve_slots=2, kv_page_size=4,
+                                 max_seq_len=64, eos_id=eos,
+                                 draft_model=draft, speculate_k=2)
+    reqs = eng.run(prompts, max_new_tokens=8)
+    for w, r in zip(want, reqs):
+        np.testing.assert_array_equal(w, np.asarray(r.tokens, np.int32))
+    assert eng.stats()["prefix_hits"] >= len(prompts) - 1
+
+
+@pytest.mark.slow  # 25 s; serving CI tier runs the full file
+def test_recompile_flat_with_prefix_and_speculation(ff, draft):
+    """Warm-window flatness with BOTH features on: after one pass has
+    warmed the buckets (cold + hit prefills, draft mirrors, draft decode
+    and verify), further same-bucket traffic compiles nothing."""
+    rs = np.random.RandomState(53)
+    system = rs.randint(1, VOCAB, (8,)).astype(np.int32)
+
+    def mk(n, lo, hi):
+        return [np.concatenate([system, rs.randint(
+            1, VOCAB, (rs.randint(lo, hi),)).astype(np.int32)])
+            for _ in range(n)]
+
+    eng = ff.make_serving_engine(serve_slots=2, kv_page_size=4,
+                                 max_seq_len=64, draft_model=draft,
+                                 speculate_k=2)
+    eng.run(mk(6, 1, 8), max_new_tokens=4)      # warm bucket 16 paths
+    warm = eng.recompile_count
+    eng.run(mk(10, 1, 8), max_new_tokens=6)
+    assert eng.recompile_count == warm, \
+        "warm shared-prefix + speculative traffic must not recompile"
+    st = eng.stats()
+    assert st["prefix_hits"] > 0 and st["spec_proposed"] > 0
+
+
+def test_speculative_validation(ff, draft):
+    """The accept rule's preconditions are enforced at construction."""
+    with pytest.raises(ValueError, match="draft model"):
+        ff.make_serving_engine(speculate_k=2)
+    with pytest.raises(ValueError, match="greedy-only"):
+        ff.make_serving_engine(speculate_k=2, draft_model=draft,
+                               temperature=0.7)
+    with pytest.raises(ValueError, match="must be >= 0"):
+        ff.make_serving_engine(speculate_k=-1, draft_model=draft)
+
+
+@pytest.mark.slow  # 8 s; one extra model compile
+def test_speculative_vocab_mismatch_rejected(ff):
+    cfg = FFConfig(batch_size=2, mesh_shape={"data": 1})
+    model = FFModel(cfg)
+    _, logits = llama_lm(model, 2, seq_len=16, hidden=32, layers=1,
+                         heads=2, kv_heads=2, vocab_size=VOCAB + 7)
+    model.compile(final_tensor=logits)
+    with pytest.raises(ValueError, match="vocab mismatch"):
+        ff.make_serving_engine(speculate_k=2, draft_model=model)
+
+
+def test_serving_config_knob_validation():
+    """FFConfig __post_init__ guards + parse_args flags (satellite)."""
+    with pytest.raises(ValueError, match="power of two"):
+        FFConfig(batch_size=2, mesh_shape={"data": 1}, kv_page_size=12)
+    with pytest.raises(ValueError, match="serve_speculate_k"):
+        FFConfig(batch_size=2, mesh_shape={"data": 1},
+                 serve_speculate_k=-2)
+    cfg = FFConfig.parse_args([
+        "--batch-size", "2", "--serve-slots", "6", "--kv-page-size", "64",
+        "--kv-pages", "40", "--no-prefix-cache",
+        "--serve-speculate-k", "3"])
+    assert cfg.serve_slots == 6 and cfg.kv_page_size == 64
+    assert cfg.kv_pages == 40 and cfg.serve_prefix_cache is False
+    assert cfg.serve_speculate_k == 3
+    dflt = FFConfig.parse_args(["--batch-size", "2"])
+    assert dflt.serve_prefix_cache is True and dflt.serve_speculate_k == 0
+
+
+def test_stats_and_health_expose_pool_observability(ff):
+    """The router-facing observability keys (satellite): pool occupancy,
+    prefix-cache and speculation signals present in stats() AND mirrored
+    in health() without compiling anything."""
+    eng = ff.make_serving_engine(serve_slots=2, kv_page_size=4,
+                                 max_seq_len=32)
+    st = eng.stats()
+    for key in ("pages_in_use", "free_pages", "kv_pages_cached",
+                "kv_pages_shared", "prefix_hit_rate", "prefix_hits",
+                "prefill_tokens_saved", "prefix_evictions",
+                "prefix_refs_live", "spec_accept_rate", "spec_proposed",
+                "spec_accepted", "speculate_k"):
+        assert key in st, f"stats() missing {key}"
+    assert st["pages_in_use"] == 0 and st["prefix_hit_rate"] == 0.0
+    before = eng.recompile_count
+    h = eng.health()
+    assert eng.recompile_count == before     # health never compiles
+    for key in ("pages_in_use", "kv_pages_shared", "prefix_hit_rate",
+                "spec_accept_rate"):
+        assert key in h, f"health() missing {key}"
+    assert h["status"] == "idle"
 
 
 @pytest.mark.slow  # 7 s; serving CI tier runs the full file
