@@ -24,8 +24,14 @@
 #               resume smoke + a 2-process run killed mid-epoch and
 #               resumed SINGLE-process with on_topology_change=
 #               resume_resharded (gloo-gated)
+#   kernels   — Pallas kernel tier: paged-attention kernel parity vs the
+#               einsum oracle + serving token-identity with the kernel
+#               path forced (interpret mode on CPU = the REAL kernel
+#               code), the block autotuner suite, and a tune-then-
+#               consume smoke that writes and re-reads a real on-disk
+#               autotune table
 #
-# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|all]
+# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|kernels|all]
 set -e
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -150,6 +156,14 @@ run_elastic() {
   fi
 }
 
+# kernels tier: the paged-attention kernel + autotuner suites (slow-marked
+# serving token-identity variants included — pytest -q runs the whole
+# files), then the tune->persist->consume smoke against a real table file.
+run_kernels() {
+  python -m pytest tests/test_pallas_paged.py tests/test_kernel_tune.py -q
+  python scripts/kernel_tune_smoke.py
+}
+
 case "$TIER" in
   unit)     run_unit ;;
   sweep)    run_sweep ;;
@@ -161,7 +175,8 @@ case "$TIER" in
   serving)  run_serving ;;
   overlap)  run_overlap ;;
   elastic)  run_elastic ;;
-  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_native; run_docs; run_sweep ;;
+  kernels)  run_kernels ;;
+  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_kernels; run_native; run_docs; run_sweep ;;
   *) echo "unknown tier $TIER"; exit 2 ;;
 esac
 echo "ci($TIER): PASSED"

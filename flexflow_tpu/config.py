@@ -204,6 +204,18 @@ class FFConfig:
     # engine construction). A runtime object, not a flag: pass it
     # programmatically or via make_serving_engine(draft_model=...)
     draft_model: Optional[object] = None
+    # decode/verify attention over the paged KV pool:
+    #   "auto"   — Pallas paged-attention kernel on a TPU backend (page-
+    #              table lookup inside the kernel, only a slot's live
+    #              pages stream through VMEM), einsum page-gather
+    #              elsewhere
+    #   "pallas" — force the kernel everywhere (interpret mode off-TPU,
+    #              so CPU CI executes the real kernel code path)
+    #   "einsum" — force the page-gather oracle (bitwise the dense-cache
+    #              attention) — the parity baseline
+    # Greedy serving streams are token-identical under either impl
+    # (tests/test_pallas_paged.py pins it).
+    paged_attention_impl: str = "auto"
     # jax persistent compilation cache directory ("" = off): set before
     # the first trace (FFModel.compile / launcher) so repeated runs skip
     # recompiles; serving logs hit/miss per program build
@@ -272,6 +284,10 @@ class FFConfig:
             raise ValueError(
                 f"serve_speculate_k={self.serve_speculate_k}: must be "
                 f">= 0 (0 = speculative decoding off)")
+        if self.paged_attention_impl not in ("auto", "pallas", "einsum"):
+            raise ValueError(
+                f"paged_attention_impl={self.paged_attention_impl!r}: "
+                f"must be 'auto', 'pallas' or 'einsum'")
         if self.decode_buckets is not None:
             bs = list(self.decode_buckets)
             if not bs or any(int(b) < 1 for b in bs) \
@@ -362,6 +378,11 @@ class FFConfig:
                        help="draft tokens proposed per speculative "
                             "decode iteration (0 = off; needs a "
                             "draft model)")
+        p.add_argument("--paged-attention-impl", type=str, default="auto",
+                       choices=("auto", "pallas", "einsum"),
+                       help="decode attention over the paged pool: "
+                            "Pallas kernel vs einsum page-gather "
+                            "(auto = pallas on TPU)")
         # e.g. --mesh data=4,model=2 (replaces -ll:gpu device-count knobs)
         p.add_argument("--mesh", type=str, default="")
         args, _ = p.parse_known_args(argv)
@@ -404,4 +425,5 @@ class FFConfig:
             kv_pages=args.kv_pages,
             serve_prefix_cache=not args.no_prefix_cache,
             serve_speculate_k=args.serve_speculate_k,
+            paged_attention_impl=args.paged_attention_impl,
         )

@@ -38,6 +38,32 @@ from flexflow_tpu.ops.base import Op, WeightSpec
 BLOCKWISE_SEQ_THRESHOLD = 4096
 
 
+def resolve_paged_attention_impl(impl=None, config=None) -> str:
+    """Resolve an ``auto|pallas|einsum`` request (per-engine override
+    first, then FFConfig.paged_attention_impl) to the concrete decode
+    attention path:
+
+      * ``pallas`` — the paged-attention kernel (ops/pallas_kernels.py
+        paged_attention_fwd_pallas): page-table lookup inside the grid,
+        only a slot's live pages stream through VMEM. Off-TPU it runs in
+        interpret mode, so forcing it executes the REAL kernel code path
+        in every CPU CI tier.
+      * ``einsum`` — the page-gather + grouped einsum path, bitwise the
+        dense-cache attention: the parity oracle, and the default where
+        no native Mosaic backend exists.
+      * ``auto`` — pallas on a TPU backend, einsum elsewhere.
+    """
+    if impl in (None, "", "auto"):
+        impl = getattr(config, "paged_attention_impl", "auto") or "auto"
+    if impl == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "einsum"
+    if impl not in ("pallas", "einsum"):
+        raise ValueError(
+            f"paged_attention_impl={impl!r}: must be 'auto', 'pallas' "
+            f"or 'einsum'")
+    return impl
+
+
 def flash_seq_cap() -> int:
     """FF_FLASH_MAX_SEQ: deployment escape hatch capping flash-kernel
     sequence length (0/unset/garbage = unlimited). Consulted by the dense
@@ -377,8 +403,48 @@ class MultiHeadAttention(Op):
 
         return {"k": put(cache["k"], kh), "v": put(cache["v"], vh)}
 
+    def _paged_attention_ctx(self, qh, ck, cv, page_table, write_pos,
+                             row_len, prompt_pad, impl):
+        """Shared attention body of the paged decode/verify paths: q
+        (B, S, H, Dh) against the updated pool through the per-slot page
+        tables, write_pos (B, S) per-position frontiers. Two impls behind
+        FFConfig.paged_attention_impl (resolve_paged_attention_impl):
+
+          * ``einsum`` — gather the slot's pages into a logical
+            (B, L_max, KVH, Dh) cache and run _grouped_cache_attention:
+            bitwise the dense-cache computation (tests/test_serving.py),
+            the parity oracle. The gather re-materializes the ENTIRE
+            pool view in HBM every step.
+          * ``pallas`` — paged_attention_fwd_pallas: the page-table
+            lookup happens INSIDE the kernel grid, so only the slot's
+            live pages stream through VMEM; online softmax replaces the
+            materialized (B, L_max) score row. Numerics match the
+            einsum path to kernel tolerance (accumulation order
+            differs); greedy token streams are pinned identical by
+            tests/test_pallas_paged.py."""
+        resolved = resolve_paged_attention_impl(
+            impl, getattr(self.model, "config", None))
+        if resolved == "pallas":
+            from flexflow_tpu.ops.pallas_kernels import \
+                paged_attention_fwd_pallas
+
+            scale = 1.0 / math.sqrt(self.qk_head_dim)
+            return paged_attention_fwd_pallas(
+                qh, ck, cv, page_table, write_pos, row_len, prompt_pad,
+                scale)
+        b = qh.shape[0]
+        max_len = page_table.shape[1] * ck.shape[1]
+        gk = ck[page_table].reshape(b, max_len, *ck.shape[2:])
+        gv = cv[page_table].reshape(b, max_len, *cv.shape[2:])
+        idx = jnp.arange(max_len)
+        live = (idx[None, None, :] < row_len[:, None, None]) \
+            | ((idx[None, None, :] >= prompt_pad[:, None, None])
+               & (idx[None, None, :] <= write_pos[:, :, None]))
+        return self._grouped_cache_attention(
+            qh, gk, gv, live[:, None, None, :, :])
+
     def paged_decode_forward(self, params, xs, cache, page_table, write_pos,
-                             rope_pos, row_len, prompt_pad):
+                             rope_pos, row_len, prompt_pad, impl=None):
         """One continuous-batching decode step over the paged pool.
 
         xs[0]: (B_slots, 1, D) — each slot's last sampled token embedding
@@ -391,10 +457,9 @@ class MultiHeadAttention(Op):
         shared prompt_len): j < row_len  OR  prompt_pad <= j <= write_pos.
 
         The new token's k/v scatters into the pool at (page_table[b,
-        write_pos // page_size], write_pos % page_size); attention gathers
-        the slot's pages back into logical order — on the einsum path this
-        is bitwise the dense-cache computation (tests/test_serving.py)."""
-        b = xs[0].shape[0]
+        write_pos // page_size], write_pos % page_size); attention then
+        runs through _paged_attention_ctx — `impl` picks the page-gather
+        einsum oracle or the Pallas paged kernel."""
         page_size = cache["k"].shape[1]
         qh, kh, vh = self._project_qkv(params, xs[0], xs[1], xs[2],
                                        rope_offset=rope_pos)
@@ -405,20 +470,13 @@ class MultiHeadAttention(Op):
             kh[:, 0].astype(cache["k"].dtype))
         cv = cache["v"].at[page_ids, offs].set(
             vh[:, 0].astype(cache["v"].dtype))
-        # gather the slot's pages into logical layout (B, L_max, KVH, Dh)
-        max_len = page_table.shape[1] * page_size
-        gk = ck[page_table].reshape(b, max_len, *ck.shape[2:])
-        gv = cv[page_table].reshape(b, max_len, *cv.shape[2:])
-        idx = jnp.arange(max_len)
-        live = (idx[None, :] < row_len[:, None]) \
-            | ((idx[None, :] >= prompt_pad[:, None])
-               & (idx[None, :] <= write_pos[:, None]))
-        ctx = self._grouped_cache_attention(
-            qh, gk, gv, live[:, None, None, None, :])
+        ctx = self._paged_attention_ctx(qh, ck, cv, page_table,
+                                        write_pos[:, None], row_len,
+                                        prompt_pad, impl)
         return self._out_proj(params, ctx), {"k": ck, "v": cv}
 
     def paged_verify_forward(self, params, xs, cache, page_table, write_pos,
-                             rope_pos0, row_len, prompt_pad):
+                             rope_pos0, row_len, prompt_pad, impl=None):
         """Speculative-decode verify: a (B, S) slab of candidate tokens
         (S = K draft proposals + 1) scored against the paged pool in ONE
         dispatch (runtime/serving.py).
@@ -434,10 +492,9 @@ class MultiHeadAttention(Op):
         the next dispatch (verify or decode) overwrites them before any
         accepted position can attend them, so rejected-draft garbage is
         never observable. ``rope_pos0`` (B,) is the slab's first LOGICAL
-        position; position i rotates at rope_pos0 + i. The page gather is
-        the same reassembly as paged_decode_forward — bitwise the dense
-        cache operand (tests/test_serving.py)."""
-        b, s = xs[0].shape[0], xs[0].shape[1]
+        position; position i rotates at rope_pos0 + i. Attention runs
+        through _paged_attention_ctx (same einsum-oracle/Pallas-kernel
+        split as decode — the ONE kernel serves both shapes)."""
         page_size = cache["k"].shape[1]
         qh, kh, vh = self._project_qkv(params, xs[0], xs[1], xs[2],
                                        rope_offset=rope_pos0)
@@ -448,15 +505,8 @@ class MultiHeadAttention(Op):
             kh.astype(cache["k"].dtype))
         cv = cache["v"].at[page_ids, offs].set(
             vh.astype(cache["v"].dtype))
-        max_len = page_table.shape[1] * page_size
-        gk = ck[page_table].reshape(b, max_len, *ck.shape[2:])
-        gv = cv[page_table].reshape(b, max_len, *cv.shape[2:])
-        idx = jnp.arange(max_len)
-        live = (idx[None, None, :] < row_len[:, None, None]) \
-            | ((idx[None, None, :] >= prompt_pad[:, None, None])
-               & (idx[None, None, :] <= write_pos[:, :, None]))
-        ctx = self._grouped_cache_attention(
-            qh, gk, gv, live[:, None, None, :, :])
+        ctx = self._paged_attention_ctx(qh, ck, cv, page_table, write_pos,
+                                        row_len, prompt_pad, impl)
         return self._out_proj(params, ctx), {"k": ck, "v": cv}
 
     def _flash_ok(self, qh, kh) -> bool:

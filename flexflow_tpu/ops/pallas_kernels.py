@@ -39,24 +39,62 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _compiler_params():
+# jax renamed TPUCompilerParams -> CompilerParams across 0.4/0.5; resolve
+# whichever this build ships (interpret mode never constructs one, which is
+# why the old hard reference compiled everywhere CI runs but would have
+# broken on a real-TPU 0.4.37 build)
+_COMPILER_PARAMS_CLS = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams", None)
+
+
+def _compiler_params(semantics=("parallel", "parallel", "arbitrary")):
     """Outer grid axes are parallel (independent (bh, own-block) tiles); the
     innermost axis streams opposing-side tiles and must run sequentially —
     the scratch accumulators carry state across it."""
-    if _interpret():
+    if _interpret() or _COMPILER_PARAMS_CLS is None:
         return None
-    return pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel", "arbitrary"))
+    return _COMPILER_PARAMS_CLS(dimension_semantics=semantics)
 
 
 def _pick_block(seq: int, want: int) -> int:
     """Largest tile size <= want that divides seq (the guard in
     attention._flash_ok only promises 128-divisibility, so a 512 default
-    must degrade for e.g. seq 640)."""
+    must degrade for e.g. seq 640). This is the STATIC heuristic — the
+    cold fallback when the measured-cost autotune table
+    (search/kernel_tune.py) has no entry for the shape."""
     for b in (want, 256, 128, 64, 32, 16, 8):
         if b <= seq and seq % b == 0:
             return b
     return seq
+
+
+def _resolve_blocks(kernel: str, sq: int, sk: int, d: int, dtype,
+                    want_q, want_k, *, batch: int = 1, heads: int = 1,
+                    causal: bool = True):
+    """(block_q, block_k) for a flash kernel call. want_q/want_k = None
+    (the public API's default) means AUTO: the measured-cost autotune
+    table (search/kernel_tune.py, keyed by kernel/shape incl. dtype,
+    batch, heads, causality/device kind/jax version) wins when it has a
+    legal entry for this exact configuration, else the static
+    _pick_block heuristic from the 512 default (legality and hit/miss
+    accounting live in lookup_blocks). Explicit wants (the tuner's own
+    candidate sweep, callers pinning a block) bypass the table
+    entirely. Round-5 context: the static 512 default lost to XLA at
+    h4096 — a tuned table turns that into a re-measurable decision
+    instead of a hardcoded loss. Resolution happens at TRACE time
+    (shapes are static), so a warm program pays nothing."""
+    if want_q is None and want_k is None:
+        from flexflow_tpu.search import kernel_tune
+
+        hit = kernel_tune.lookup_blocks(kernel, seq_q=sq, seq_k=sk,
+                                        head_dim=d, dtype=dtype,
+                                        batch=batch, heads=heads,
+                                        causal=causal)
+        if hit is not None:
+            return hit
+        want_q = want_k = 512
+    return (_pick_block(sq, want_q if want_q is not None else 512),
+            _pick_block(sk, want_k if want_k is not None else 512))
 
 
 def _maybe_when(cond, fn):
@@ -140,17 +178,22 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q: int,
 
 
 def flash_attention_fwd_pallas(q, k, v, causal: bool, scale: float,
-                               block_q: int = 512, block_k: int = 512,
+                               block_q: Optional[int] = None,
+                               block_k: Optional[int] = None,
                                need_lse: bool = True):
     """q,k,v: (B, S, H, D) -> (out, lse|None).
     Grid: (B*H, S_q/block_q, S_k/block_k) — K/V tiles stream through the
-    innermost axis. need_lse=False (inference) skips materializing the
-    logsumexp residual — it exists only for the VJP and costs more HBM
-    writes than the output itself at small head dims."""
+    innermost axis. block_q/block_k default to AUTO (the kernel_tune
+    table, static 512-down heuristic cold); explicit values pin the tile
+    (degraded to a divisor of seq) and skip the table. need_lse=False
+    (inference) skips materializing the logsumexp residual — it exists
+    only for the VJP and costs more HBM writes than the output itself at
+    small head dims."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    block_q = _pick_block(sq, block_q)
-    block_k = _pick_block(sk, block_k)
+    block_q, block_k = _resolve_blocks("flash_fwd", sq, sk, d, q.dtype,
+                                       block_q, block_k, batch=b,
+                                       heads=h, causal=causal)
     assert sq % block_q == 0 and sk % block_k == 0
     # cross-attention diagonal offset (bottom-right aligned causality);
     # sq > sk with causal would leave the first rows keyless (0/0 in the
@@ -290,13 +333,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def flash_attention_bwd_pallas(q, k, v, o, lse, do, causal: bool,
-                               scale: float, block_q: int = 512,
-                               block_k: int = 512, dlse=None,
+                               scale: float, block_q: Optional[int] = None,
+                               block_k: Optional[int] = None, dlse=None,
                                delta_precomputed=None):
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    block_q = _pick_block(sq, block_q)
-    block_k = _pick_block(sk, block_k)
+    block_q, block_k = _resolve_blocks("flash_bwd", sq, sk, d, q.dtype,
+                                       block_q, block_k, batch=b,
+                                       heads=h, causal=causal)
     assert sq % block_q == 0 and sk % block_k == 0
     offset = sk - sq
     assert not (causal and offset < 0), "causal flash needs sq <= sk"
@@ -519,3 +563,169 @@ def _flash_bwd_rule(causal, scale, res, g):
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# ----------------------------------------------------- paged attention
+#
+# Serving-side decode/verify attention over the paged KV pool
+# (runtime/serving.py). The einsum path reassembles the ENTIRE pool into a
+# dense (B, max_len, KVH, Dh) logical cache with ck[page_table].reshape(...)
+# on every step — an HBM round-trip that grows with POOL size, not with the
+# tokens a slot actually holds. This kernel does the page-table lookup
+# inside the grid instead (scalar prefetch: the table is in SMEM before the
+# body runs, and each inner step's BlockSpec index_map picks the slot's
+# t-th pool page directly), so only the slot's LIVE pages —
+# ceil((max(write_pos)+1)/page_size) of them — ever stream through VMEM,
+# with an online-softmax accumulator carrying state across the page axis.
+# The Flex-TPU analogue (PAPERS.md 2407.08700): keep the data resident in
+# the compute unit; don't materialize the logical view in HBM.
+#
+# One kernel serves both serving shapes: S=1 is the continuous-batching
+# decode step, S=K+1 the speculative-verify slab (per-position write
+# frontiers). The live rule is exactly the einsum path's:
+#   j < row_len  OR  prompt_pad <= j <= write_pos[b, i]
+# and GQA grouping matches _grouped_cache_attention (query head h reads kv
+# head h // group). The einsum page-gather stays as the parity oracle
+# (tests/test_pallas_paged.py).
+
+
+def _paged_attn_kernel(pt_ref, lp_ref, wp_ref, rl_ref, pp_ref, q_ref,
+                       k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                       s: int, kvh: int, grp: int, ps: int, scale: float):
+    """One (slot, page) grid step: score the slot's (S, H, Dh) query slab
+    against this page's (ps, KVH, Dh) k/v and fold into the running
+    online softmax. Scalar-prefetch refs: page table (B, P), last live
+    page (B,), per-position write frontier (B, S), row_len (B,),
+    prompt_pad (B,). Scratch rows are kv-head-major: row
+    kh*(S*G) + i*G + g accumulates query head kh*G+g at slab position i."""
+    b = pl.program_id(0)
+    t = pl.program_id(1)
+    nt = pl.num_programs(1)
+
+    @pl.when(t == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # pages past the slot's write frontier are dead: skip their compute
+    # (the index_map already clamps their DMA to the resident last live
+    # page, so a dead step costs nothing — same trick as the causal
+    # clamp in the flash kernels)
+    @pl.when(t <= lp_ref[b])
+    def _step():
+        q = q_ref[0]                                # (S, H, Dqk)
+        k = k_ref[0]                                # (ps, KVH, Dqk)
+        v = v_ref[0]                                # (ps, KVH, Dv)
+        rl = rl_ref[b]
+        pp = pp_ref[b]
+        # live mask rows in (slab position, group) order — each slab
+        # position i attends at its OWN frontier wp[b, i], which gives
+        # in-slab causality for the verify slab (position i's window
+        # holds exactly the slab writes <= i plus committed history)
+        rows = []
+        for i in range(s):
+            j = t * ps + jax.lax.broadcasted_iota(jnp.int32, (1, ps), 1)
+            live = (j < rl) | ((j >= pp) & (j <= wp_ref[b, i]))
+            rows.append(jnp.broadcast_to(live, (grp, ps)))
+        live = jnp.concatenate(rows, axis=0)        # (S*G, ps)
+        for kh in range(kvh):
+            sl = slice(kh * s * grp, (kh + 1) * s * grp)
+            qk = q[:, kh * grp:(kh + 1) * grp, :].reshape(s * grp, -1)
+            sc = jnp.dot(qk, k[:, kh, :].T,
+                         preferred_element_type=jnp.float32) * scale
+            sc = jnp.where(live, sc, NEG_INF)
+            m_prev = m_scr[sl, 0:1]
+            l_prev = l_scr[sl, 0:1]
+            m_new = jnp.maximum(m_prev,
+                                jnp.max(sc, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(sc - m_new)
+            l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_scr[sl, :] = acc_scr[sl, :] * alpha + jnp.dot(
+                p.astype(v.dtype), v[:, kh, :],
+                preferred_element_type=jnp.float32)
+            m_scr[sl, :] = jnp.broadcast_to(m_new, (s * grp, LANES))
+            l_scr[sl, :] = jnp.broadcast_to(l_new, (s * grp, LANES))
+
+    @pl.when(t == nt - 1)
+    def _finish():
+        # every slab row has >= 1 live position (its own write frontier:
+        # prompt_pad <= write_pos always holds, and the inactive-slot
+        # zeros satisfy j == 0 <= write_pos == 0), so l > 0 — no guard
+        for kh in range(kvh):
+            sl = slice(kh * s * grp, (kh + 1) * s * grp)
+            o = acc_scr[sl, :] / l_scr[sl, 0:1]
+            o_ref[0, :, kh * grp:(kh + 1) * grp, :] = \
+                o.reshape(s, grp, -1).astype(o_ref.dtype)
+
+
+def paged_attention_fwd_pallas(q, k_pages, v_pages, page_table, write_pos,
+                               row_len, prompt_pad, scale: float,
+                               interpret: Optional[bool] = None):
+    """Paged-pool attention: q (B, S, H, Dqk) against k_pages/v_pages
+    ((P_pool, page_size, KVH, D)) through per-slot page tables
+    ((B, pages_per_slot) int32) -> (B, S, H, Dv) context.
+
+    write_pos (B, S) int32 is each slab position's logical write
+    frontier (host-clamped, nondecreasing over S); row_len / prompt_pad
+    (B,) the ragged-prompt live-rule bounds. Grid is (slots, pages_per_
+    slot) with the page axis sequential; pages past a slot's frontier
+    are skipped (clamped DMA + pl.when), so the per-step HBM traffic is
+    the slot's LIVE pages, not the pool. Inference-only: no VJP (the
+    serving engine never differentiates through decode).
+
+    `interpret` defaults to the module rule (interpret off-TPU), which
+    is how FFConfig.paged_attention_impl='pallas' executes the REAL
+    kernel code path in every CPU CI tier."""
+    b, s, h, dqk = q.shape
+    ps, kvh = k_pages.shape[1], k_pages.shape[2]
+    dv = v_pages.shape[3]
+    assert h % kvh == 0, f"heads {h} not a multiple of kv heads {kvh}"
+    grp = h // kvh
+    pps = page_table.shape[1]
+    # last live page per slot: the live rule's bound is max(write
+    # frontier, prompt tail) — a serving dispatch always has write_pos
+    # >= prompt_pad >= row_len, but the kernel honors the FULL rule so
+    # a direct caller querying inside the prompt (write_pos < row_len)
+    # still streams the prompt's pages. The slab's max frontier is its
+    # final position's (host-built nondecreasing; jnp.max guards the
+    # clamp-equal tail anyway).
+    last_idx = jnp.maximum(jnp.max(write_pos, axis=1), row_len - 1)
+    last_page = (last_idx // ps).astype(jnp.int32)
+
+    def q_map(bi, t, pt, lp, wp, rl, pp):
+        return (bi, 0, 0, 0)
+
+    def kv_map(bi, t, pt, lp, wp, rl, pp):
+        # the paged lookup: this grid step's k/v block IS pool page
+        # page_table[slot, t], fetched straight from HBM — dead steps
+        # (t past the frontier) clamp to the already-resident last live
+        # page so they trigger no DMA
+        return (pt[bi, jnp.minimum(t, lp[bi])], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(b, pps),
+        in_specs=[
+            pl.BlockSpec((1, s, h, dqk), q_map),
+            pl.BlockSpec((1, ps, kvh, dqk), kv_map),
+            pl.BlockSpec((1, ps, kvh, dv), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, s, h, dv), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((s * h, LANES), jnp.float32),   # running max
+            pltpu.VMEM((s * h, LANES), jnp.float32),   # running sum
+            pltpu.VMEM((s * h, dv), jnp.float32),      # ctx accumulator
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_attn_kernel, s=s, kvh=kvh, grp=grp,
+                          ps=ps, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, s, h, dv), q.dtype),
+        compiler_params=_compiler_params(("parallel", "arbitrary")),
+        interpret=_interpret() if interpret is None else interpret,
+    )(page_table.astype(jnp.int32), last_page,
+      write_pos.astype(jnp.int32), row_len.astype(jnp.int32),
+      prompt_pad.astype(jnp.int32), q, k_pages, v_pages)

@@ -285,17 +285,21 @@ class Generator:
                         # pool (runtime/serving.py): per-slot positions,
                         # page-table gather instead of a contiguous cache.
                         # A (B, S>1) slab is the speculative-decode verify
-                        # pass: write_pos is (B, S) per-position.
+                        # pass: write_pos is (B, S) per-position. "impl"
+                        # routes the attention body (einsum page-gather
+                        # oracle vs the Pallas paged kernel) per engine.
                         if tokens.shape[1] > 1:
                             out, nc = op.paged_verify_forward(
                                 p, xs, cache, paged["page_table"],
                                 paged["write_pos"], paged["rope_pos"],
-                                paged["row_len"], paged["prompt_pad"])
+                                paged["row_len"], paged["prompt_pad"],
+                                impl=paged.get("impl"))
                         else:
                             out, nc = op.paged_decode_forward(
                                 p, xs, cache, paged["page_table"],
                                 paged["write_pos"], paged["rope_pos"],
-                                paged["row_len"], paged["prompt_pad"])
+                                paged["row_len"], paged["prompt_pad"],
+                                impl=paged.get("impl"))
                     elif pos is None:
                         if gather_last:
                             # ragged chunked prefill: read-only query of

@@ -86,6 +86,12 @@ from flexflow_tpu.runtime import faultinject
 from flexflow_tpu.runtime.generation import Generator
 
 
+def _ktune_stats():
+    from flexflow_tpu.search import kernel_tune
+
+    return kernel_tune.stats()
+
+
 @dataclass
 class Request:
     """One serving request and its full lifecycle record."""
@@ -318,7 +324,8 @@ class ServingEngine:
                  decode_chunk: int = 8,
                  quantize: Optional[str] = None, seed: int = 0,
                  prefix_cache: Optional[bool] = None,
-                 draft_model=None, speculate_k: Optional[int] = None):
+                 draft_model=None, speculate_k: Optional[int] = None,
+                 paged_attention_impl: Optional[str] = None):
         cfg = model.config
         self.model = model
         self.slots = int(serve_slots or getattr(cfg, "serve_slots", 4))
@@ -353,6 +360,19 @@ class ServingEngine:
                 f"kv_pages={self.num_pages} cannot hold even one "
                 f"max_seq_len={self.max_seq_len} request "
                 f"(needs {1 + self.pages_per_slot} incl. scratch page 0)")
+
+        # decode attention impl over the paged pool: the per-engine
+        # override wins, else FFConfig.paged_attention_impl; resolved
+        # ONCE here ("auto" -> the backend's concrete choice) so every
+        # program this engine builds, and stats(), agree on it. The
+        # einsum page-gather stays the parity oracle — greedy streams
+        # are token-identical either way (tests/test_pallas_paged.py).
+        from flexflow_tpu.ops.attention import resolve_paged_attention_impl
+
+        self.paged_attention_impl = resolve_paged_attention_impl(
+            paged_attention_impl, cfg)
+        fflogger.info("serving: paged decode attention impl=%s",
+                      self.paged_attention_impl)
 
         # Generator supplies graph validation, the graph walk, prefill and
         # sampling — serving adds scheduling + the paged pool around them
@@ -469,6 +489,21 @@ class ServingEngine:
         self._spec_proposed = 0
         self._spec_accepted = 0
         self._spec_dispatches = 0
+        # decode-attention observability (ISSUE 7 satellite): pool pages
+        # the attention body READS per dispatch (sum over active slots
+        # of the final-step frontier's page count — what the pallas
+        # kernel streams / the einsum path gathers), plus a snapshot
+        # baseline for the kernel-tune table counters. The counters are
+        # PROCESS-GLOBAL (lookups fire inside kernel traces, which have
+        # no engine identity), so stats() reports the process's
+        # consultations since THIS engine was constructed — exact when
+        # the engine is the only tracer (the usual serving process),
+        # approximate when training or a second engine traces alongside
+        self._pages_touched = 0
+        self._last_pages_touched = 0
+        from flexflow_tpu.search import kernel_tune
+
+        self._ktune_base = kernel_tune.stats()
         import collections
 
         self._ttfts = collections.deque(maxlen=4096)
@@ -714,7 +749,8 @@ class ServingEngine:
                    rope_pos0, row_len, prompt_pad, poison):
             paged = {"page_table": page_table, "write_pos": write_pos,
                      "rope_pos": rope_pos0, "row_len": row_len,
-                     "prompt_pad": prompt_pad}
+                     "prompt_pad": prompt_pad,
+                     "impl": self.paged_attention_impl}
             logits, pool = gen._walk(params, state, slab, pool, None,
                                      paged=paged)
             logits = logits.astype(jnp.float32) \
@@ -743,7 +779,8 @@ class ServingEngine:
                     "page_table": page_table,
                     "write_pos": jnp.minimum(write_pos0 + i, budget - 1),
                     "rope_pos": jnp.minimum(rope_pos0 + i, rope_cap),
-                    "row_len": row_len, "prompt_pad": prompt_pad}
+                    "row_len": row_len, "prompt_pad": prompt_pad,
+                    "impl": self.paged_attention_impl}
                 logits, pool = gen._walk(params, state, tok[:, None],
                                          pool, None, paged=paged)
                 logits = logits[:, 0] + poison[:, None]  # (B_slots, V)
@@ -922,9 +959,22 @@ class ServingEngine:
                 budget[slot] = req.bucket + req.max_new_tokens
         return write_pos, rope_pos, budget
 
+    def _note_pages_touched(self, frontier, budget):
+        """Record the pool pages this dispatch's attention READS: per
+        active slot, pages up to its final-step write frontier (what the
+        pallas kernel streams through VMEM — the einsum path gathers the
+        whole table width regardless, which is exactly the delta the
+        kernel exists to remove)."""
+        fr = np.minimum(frontier, budget - 1)
+        touched = int(np.sum((fr // self.page_size + 1)[self.active])) \
+            if self.active.any() else 0
+        self._last_pages_touched = touched
+        self._pages_touched += touched
+
     def _decode_step(self):
         k = self.decode_chunk
         write_pos, rope_pos, budget = self._slot_decode_state()
+        self._note_pages_touched(write_pos + k - 1, budget)
         toks, oks, self.pool = self._compiled_call(
             ("decode", k), lambda: self._build_decode(k),
             self.gen._params(), self.model.bn_state, self.pool,
@@ -957,6 +1007,8 @@ class ServingEngine:
         next dispatch before anything can attend them."""
         k = self.speculate_k
         write_pos, rope_pos, budget = self._slot_decode_state()
+        # verify-slab frontier (the draft's decode mirrors the same pages)
+        self._note_pages_touched(write_pos + k, budget)
         d_toks, _, self.draft_pool = self._compiled_call(
             ("draft_decode", k),
             lambda: self._build_decode(k, gen=self.draft_gen),
@@ -1157,4 +1209,16 @@ class ServingEngine:
             "spec_accepted": self._spec_accepted,
             "spec_accept_rate": round(
                 self._spec_accepted / max(1, self._spec_proposed), 4),
+            # decode-attention hot-path observability (ISSUE 7): which
+            # impl this engine's programs trace, how many pool pages the
+            # last dispatch's attention read (vs the table-width gather
+            # the einsum path always re-materializes), and the kernel
+            # autotune table's process-wide hit/miss deltas since engine
+            # construction (see the baseline note in __init__)
+            "paged_attention_impl": self.paged_attention_impl,
+            "pages_touched": self._pages_touched,
+            "last_pages_touched": self._last_pages_touched,
+            **{f"kernel_tune_{k}": v - self._ktune_base.get(k, 0)
+               for k, v in _ktune_stats().items()
+               if k in ("hits", "misses")},
         }

@@ -1,0 +1,333 @@
+"""Measured-cost block-size autotuner for the Pallas kernel tier.
+
+The original FlexFlow thesis (PAPERS.md, "Beyond Data and Model
+Parallelism") drives every placement decision from MEASURED on-device
+costs; the same discipline applies one level down, to kernel tile sizes.
+Round 5 showed why: the flash kernels' static 512-block default lost to
+XLA's fused einsum at hidden 4096 — a hardcoded heuristic cannot know
+where a given chip generation's MXU/VMEM balance tips. This module makes
+block choice a measurement:
+
+  * ``tune_flash_attention`` sweeps ``(block_q, block_k)`` candidates for
+    one (seq, head_dim, dtype) shape through the dispatch-floor timing
+    harness ``search/measure.py`` already uses for op costs (per-call
+    min, null-dispatch floor subtracted, scalar-fetch forcing);
+  * winners persist to an on-disk JSON table keyed by **(kernel,
+    shape-sig incl. dtype, device kind, jax version)** — a bf16-measured
+    entry can never be served for an fp32 query, and a jax/libtpu
+    version bump invalidates every old row by key mismatch instead of
+    silently serving stale tiles;
+  * ``ops/pallas_kernels._resolve_blocks`` consults ``lookup_blocks`` at
+    trace time, falling back to the static ``_pick_block`` heuristic on
+    a miss (cold behavior is byte-identical to the pre-tuner code).
+
+Re-run the tuner after a hardware/jax change::
+
+    python -m flexflow_tpu.search.kernel_tune --seq 4096 --head-dim 128 \
+        --dtype bfloat16
+
+Table location: ``FF_KERNEL_TUNE_TABLE`` if set, else
+``~/.cache/flexflow_tpu/kernel_tune.json``. ``hits``/``misses`` counters
+(``stats()``) ride ServingEngine.stats() for observability.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+# (block_q, block_k) sweep grid; illegal candidates (not dividing the
+# sequence) are skipped per shape
+DEFAULT_CANDIDATES: Tuple[Tuple[int, int], ...] = (
+    (128, 128), (128, 256), (256, 128), (256, 256),
+    (256, 512), (512, 256), (512, 512))
+
+# in-memory table cache: {path: (file_stat_sig, {key: entry})} — keyed by
+# the file's (mtime_ns, size) so an out-of-process re-tune (the documented
+# `python -m flexflow_tpu.search.kernel_tune` flow) is picked up by the
+# NEXT trace in a long-lived consumer without a restart. Lookups happen at
+# trace time only, so the stat() is off every warm path.
+_TABLES: Dict[str, Tuple] = {}
+
+
+def _stat_sig(path: str):
+    try:
+        st = os.stat(path)
+        return (st.st_mtime_ns, st.st_size)
+    except OSError:
+        return None
+_STATS = {"hits": 0, "misses": 0, "illegal": 0}
+_WARNED_ILLEGAL = set()
+
+
+def default_table_path() -> str:
+    env = os.environ.get("FF_KERNEL_TUNE_TABLE", "")
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache", "flexflow_tpu",
+                        "kernel_tune.json")
+
+
+def device_key() -> str:
+    """Device-identity half of the table key: backend, chip kind, jax
+    version — measure._env_signature, the ONE environment probe every
+    persisted cost key shares. A version bump (jax or the libtpu it
+    pins) changes Mosaic codegen, so old timings stop matching new
+    executables — they must miss, not mislead."""
+    from flexflow_tpu.search.measure import _env_signature
+
+    backend, kind, version = _env_signature()
+    return f"{backend}|{kind}|jax-{version}"
+
+
+def shape_sig(*, seq_q: int, seq_k: int, head_dim: int, dtype,
+              batch: int, heads: int, causal: bool) -> str:
+    """Shape half of the key. EVERYTHING the sweep's timing depends on
+    is in the signature — dtype (bf16/f32 tiles have different VMEM
+    footprints and MXU throughput), batch*heads (the grid's parallel
+    extent), and causality (dead-tile clamps change the work per
+    block): a winner for one configuration is noise for another, so a
+    mismatch must MISS to the static heuristic, never approximate."""
+    import numpy as np
+
+    return (f"sq{int(seq_q)}|sk{int(seq_k)}|d{int(head_dim)}"
+            f"|b{int(batch)}|h{int(heads)}"
+            f"|{'causal' if causal else 'full'}|{np.dtype(dtype).name}")
+
+
+def _entry_key(kernel: str, sig: str, dev: Optional[str] = None) -> str:
+    return f"{kernel}|{dev or device_key()}|{sig}"
+
+
+def load_table(path: Optional[str] = None, reload: bool = False) -> Dict:
+    """Entries dict for `path` (default table), cached in-process and
+    invalidated by the file's (mtime, size) — a table written after the
+    process's first lookup (another process's re-tune, a test fixture)
+    is served on the next call, never silently shadowed by a cached
+    empty read. ``reload=True`` forces the re-read regardless."""
+    path = path or default_table_path()
+    sig = _stat_sig(path)
+    if not reload and path in _TABLES and _TABLES[path][0] == sig:
+        return _TABLES[path][1]
+    entries: Dict = {}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if isinstance(data, dict):
+            entries = data.get("entries", {})
+    except (OSError, ValueError):
+        entries = {}
+    _TABLES[path] = (sig, entries)
+    return entries
+
+
+def reload(path: Optional[str] = None) -> Dict:
+    return load_table(path, reload=True)
+
+
+def lookup_blocks(kernel: str, *, seq_q: int, seq_k: int, head_dim: int,
+                  dtype, batch: int, heads: int, causal: bool,
+                  path: Optional[str] = None) \
+        -> Optional[Tuple[int, int]]:
+    """Tuned (block_q, block_k) for this exact (kernel, shape, dtype,
+    batch, heads, causal) on THIS device/jax version, or None (cold
+    fallback — the caller's static heuristic applies). Legality is
+    checked HERE: an entry whose blocks no longer divide the sequence
+    (corrupt/hand-edited row) counts as a MISS + illegal, never a hit —
+    the hit counter means 'a tuned pick actually governed this trace'."""
+    entries = load_table(path)
+    e = entries.get(_entry_key(
+        kernel, shape_sig(seq_q=seq_q, seq_k=seq_k, head_dim=head_dim,
+                          dtype=dtype, batch=batch, heads=heads,
+                          causal=causal)))
+    if e and isinstance(e.get("blocks"), (list, tuple)) \
+            and len(e["blocks"]) == 2:
+        bq, bk = int(e["blocks"][0]), int(e["blocks"][1])
+        if 0 < bq <= seq_q and seq_q % bq == 0 \
+                and 0 < bk <= seq_k and seq_k % bk == 0:
+            _STATS["hits"] += 1
+            return bq, bk
+        note_illegal(kernel, (bq, bk), (seq_q, seq_k))
+    _STATS["misses"] += 1
+    return None
+
+
+def note_illegal(kernel: str, blocks, shape):
+    """A persisted entry that no longer divides the query shape (e.g. a
+    table tuned at seq 4096 consulted at 4097 would never key-match, but
+    a corrupt/hand-edited row can): log once, count, fall back."""
+    _STATS["illegal"] += 1
+    tag = (kernel, tuple(blocks), tuple(shape))
+    if tag in _WARNED_ILLEGAL:
+        return
+    _WARNED_ILLEGAL.add(tag)
+    from flexflow_tpu.logger import fflogger
+
+    fflogger.warning(
+        "kernel_tune: table entry %s blocks=%s does not divide shape %s "
+        "— using the static heuristic", kernel, blocks, shape)
+
+
+def stats() -> Dict[str, int]:
+    return dict(_STATS)
+
+
+def reset_stats():
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+def record(kernel: str, sig: str, blocks: Tuple[int, int],
+           seconds: float, candidates: Optional[Dict] = None,
+           path: Optional[str] = None) -> str:
+    """Persist one winner (atomic tmp+rename write, the checkpoint.py
+    discipline) and refresh the in-memory cache. Returns the key."""
+    path = path or default_table_path()
+    entries = load_table(path, reload=True)
+    key = _entry_key(kernel, sig)
+    entries[key] = {
+        "blocks": [int(blocks[0]), int(blocks[1])],
+        "seconds": float(seconds),
+        "when": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    if candidates:
+        entries[key]["candidates"] = {
+            f"{bq}x{bk}": float(s) for (bq, bk), s in candidates.items()}
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"version": 1, "entries": entries}, f, indent=1,
+                  sort_keys=True)
+    os.replace(tmp, path)
+    _TABLES[path] = (_stat_sig(path), entries)
+    return key
+
+
+def static_blocks(seq_q: int, seq_k: int) -> Tuple[int, int]:
+    """What the cold fallback would pick — recorded next to tuned picks
+    so benches/tests can state whether tuning CHANGED the decision."""
+    from flexflow_tpu.ops.pallas_kernels import _pick_block
+
+    return _pick_block(seq_q, 512), _pick_block(seq_k, 512)
+
+
+def tune_flash_attention(seq_q: int, seq_k: Optional[int] = None, *,
+                         head_dim: int = 64, dtype="float32",
+                         batch: int = 1, heads: int = 4,
+                         causal: bool = True,
+                         candidates: Optional[Sequence] = None,
+                         warmup: int = 1, iters: int = 3,
+                         path: Optional[str] = None,
+                         verbose: bool = False) -> Dict:
+    """Sweep (block_q, block_k) for the flash FORWARD kernel at one
+    shape, persist the winner, return the decision record::
+
+        {"kernel", "sig", "blocks", "static", "changed", "seconds",
+         "candidates": {(bq, bk): seconds}}
+
+    Timing goes through measure.time_scalar_program — the same
+    dispatch-floor harness the strategy search trusts for op costs (the
+    kernel call is wrapped in a scalar-reducing jit so each timed call
+    fetches 4 bytes). Off-TPU the kernels run in interpret mode: the
+    sweep still exercises the full tune->persist->consume path (the CI
+    smoke), it just measures the interpreter."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from flexflow_tpu.ops.pallas_kernels import flash_attention_fwd_pallas
+    from flexflow_tpu.search import measure
+
+    seq_k = seq_k or seq_q
+    scale = 1.0 / math.sqrt(head_dim)
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(batch, seq_q, heads, head_dim), dtype)
+    k = jnp.asarray(rs.randn(batch, seq_k, heads, head_dim), dtype)
+    v = jnp.asarray(rs.randn(batch, seq_k, heads, head_dim), dtype)
+
+    cand = [tuple(c) for c in (candidates or DEFAULT_CANDIDATES)]
+    legal = [(bq, bk) for bq, bk in cand
+             if bq <= seq_q and seq_q % bq == 0
+             and bk <= seq_k and seq_k % bk == 0]
+    if not legal:
+        raise ValueError(
+            f"no legal (block_q, block_k) candidate for seq_q={seq_q}, "
+            f"seq_k={seq_k} in {cand}")
+
+    timed: Dict[Tuple[int, int], float] = {}
+    for bq, bk in legal:
+        def step(q_, k_, v_, bq=bq, bk=bk):
+            out, _ = flash_attention_fwd_pallas(
+                q_, k_, v_, causal, scale, block_q=bq, block_k=bk,
+                need_lse=False)
+            return jnp.sum(out.astype(jnp.float32))
+
+        dt = measure.time_scalar_program(jax.jit(step), q, k, v,
+                                         warmup=warmup, iters=iters)
+        timed[(bq, bk)] = dt
+        if verbose:
+            print(f"[kernel_tune] flash_fwd sq{seq_q} sk{seq_k} "
+                  f"d{head_dim} {jnp.dtype(dtype).name} "
+                  f"block ({bq}, {bk}): {dt * 1e3:.3f} ms")
+    best = min(timed, key=timed.get)
+    sig = shape_sig(seq_q=seq_q, seq_k=seq_k, head_dim=head_dim,
+                    dtype=dtype, batch=batch, heads=heads, causal=causal)
+    record("flash_fwd", sig, best, timed[best], candidates=timed,
+           path=path)
+    static = static_blocks(seq_q, seq_k)
+    rec = {
+        "kernel": "flash_fwd", "sig": sig, "device": device_key(),
+        "blocks": list(best), "static": list(static),
+        "changed": tuple(best) != tuple(static),
+        "seconds": timed[best],
+        "candidates": {f"{bq}x{bk}": s for (bq, bk), s in timed.items()},
+    }
+    if verbose:
+        print(f"[kernel_tune] winner {best} (static {static}, "
+              f"changed={rec['changed']}) -> {path or default_table_path()}")
+    return rec
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Tune flash-attention block sizes on this device and "
+                    "persist the winners (consulted automatically by "
+                    "ops/pallas_kernels at trace time).")
+    p.add_argument("--seq", "--seq-q", dest="seq_q", type=int,
+                   required=True)
+    p.add_argument("--seq-k", type=int, default=None)
+    p.add_argument("--head-dim", type=int, default=64)
+    p.add_argument("--dtype", type=str, default="float32",
+                   choices=("float32", "bfloat16"))
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--no-causal", action="store_true")
+    p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--candidates", type=str, default="",
+                   help="e.g. '128x128,256x256' (default: built-in grid)")
+    p.add_argument("--table", type=str, default="",
+                   help="table path (default FF_KERNEL_TUNE_TABLE or "
+                        "~/.cache/flexflow_tpu/kernel_tune.json)")
+    args = p.parse_args(argv)
+    cand = None
+    if args.candidates:
+        cand = []
+        for part in args.candidates.split(","):
+            bq, _, bk = part.partition("x")
+            cand.append((int(bq), int(bk)))
+    rec = tune_flash_attention(
+        args.seq_q, args.seq_k, head_dim=args.head_dim, dtype=args.dtype,
+        batch=args.batch, heads=args.heads, causal=not args.no_causal,
+        candidates=cand, iters=args.iters, path=args.table or None,
+        verbose=True)
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -76,10 +76,38 @@ def choice_key(op_name: str, out_dims, axis_map,
     return key
 
 
+_ENV_SIG: Optional[Tuple] = None
+
+
+def _env_signature() -> Tuple:
+    """(backend, device kind, jax version) stamped into every cost
+    signature. Within one process it is constant — but these signatures
+    are the keys the persistent cost tables (kernel_tune today, the
+    ROADMAP-3 cross-session cost DB next) are built from, and a timing
+    taken on one backend/jax build must never be served on another."""
+    global _ENV_SIG
+    if _ENV_SIG is None:
+        import jax
+
+        try:
+            kind = getattr(jax.devices()[0], "device_kind", "?")
+        except Exception:
+            kind = "?"
+        _ENV_SIG = (jax.default_backend(), kind, jax.__version__)
+    return _ENV_SIG
+
+
 def _op_signature(op: Op, in_shapes, w_shapes) -> Tuple:
+    # BUGFIX (ISSUE 7 satellite): shapes alone under-keyed the cache —
+    # the same (op, shard shape) measured in bf16 was served for an fp32
+    # query (2x the HBM bytes), and nothing invalidated entries across a
+    # jax/libtpu bump. Input dtypes + the environment signature are now
+    # part of every key.
+    in_dtypes = tuple(t.dtype.name if hasattr(t.dtype, "name")
+                      else repr(t.dtype) for t in op.inputs)
     return (type(op).__name__, tuple(sorted(
         (k, repr(v)) for k, v in op.attrs.items())),
-        tuple(in_shapes), tuple(w_shapes))
+        tuple(in_shapes), tuple(w_shapes), in_dtypes, _env_signature())
 
 
 def _rand_for(shape, dtype: DataType, rs):
@@ -199,6 +227,31 @@ def _dispatch_floor(calls: int = 3) -> float:
     return best
 
 
+def time_scalar_program(step, *args, warmup: int = 1, iters: int = 5,
+                        loop: int = 1) -> float:
+    """THE timing primitive (exposed for the kernel autotuner,
+    search/kernel_tune.py, and any future microbench): time a jitted
+    callable that returns ONE scalar, with every tunnel defense
+    measure_one documents — compile excluded, each call forced by a
+    4-byte float() fetch, the null-dispatch floor sampled inside the
+    same drift window and subtracted, best-of-iters so one transport
+    stall cannot inflate the result. ``loop`` divides the result when
+    the program repeats its body in-graph (lax.scan amortization).
+    Returns seconds, clamped positive."""
+    import time as _time
+
+    float(step(*args))  # compile + first warmup
+    for _ in range(warmup):
+        float(step(*args))
+    floor = _dispatch_floor()
+    best = float("inf")
+    for _ in range(iters):
+        t0 = _time.perf_counter()
+        float(step(*args))
+        best = min(best, _time.perf_counter() - t0)
+    return max((best - floor) / max(loop, 1), 1e-9)
+
+
 def measure_one(op: Op, in_shapes, w_shapes, *, warmup=1, iters=5,
                 timeout_compile=None) -> Optional[float]:
     """Time one jitted fwd+bwd of `op` at the given per-shard shapes on the
@@ -280,18 +333,10 @@ def measure_one(op: Op, in_shapes, w_shapes, *, warmup=1, iters=5,
                                        + jax.tree_util.tree_leaves(fxsN)))
 
         step = jax.jit(scalar_loop)
-        float(step(params, fxs))  # compile + warmup
-        for _ in range(warmup):
-            float(step(params, fxs))
-        # sample the floor NOW, inside the same drift window as the timed
-        # calls below (see _dispatch_floor)
-        floor = _dispatch_floor()
-        best = float("inf")
-        for _ in range(iters):
-            t0 = time.perf_counter()
-            float(step(params, fxs))
-            best = min(best, time.perf_counter() - t0)
-        dt = max((best - floor) / loop, 1e-7)
+        # shared primitive: compile+warmup, floor sampled inside the
+        # same drift window, per-call min, scan-loop amortization
+        dt = max(time_scalar_program(step, params, fxs, warmup=warmup,
+                                     iters=iters, loop=loop), 1e-7)
     except Exception as e:
         _log_skip(op, e)
         return None

@@ -1,0 +1,298 @@
+"""Pallas paged-attention decode kernel (ops/pallas_kernels.py
+paged_attention_fwd_pallas) and its routing knob
+(FFConfig.paged_attention_impl).
+
+Correctness anchors:
+  * kernel vs the einsum page-gather oracle (bitwise the dense-cache
+    attention) within kernel tolerance — decode (S=1), verify slab
+    (S=K+1 with per-position frontiers), GQA head grouping, ragged
+    row_len/prompt_pad, scrambled page tables, the inactive-slot
+    scratch-page-0 state;
+  * a full greedy serving run (prefix cache + speculation ON) is
+    TOKEN-IDENTICAL between impl='pallas' and impl='einsum' — the kernel
+    is a perf mechanism, never semantics;
+  * the recompile counter stays flat under warm traffic with the kernel
+    path enabled (the kernel does not break the one-program contract).
+
+On CPU the kernel runs in interpret mode — the REAL kernel code path,
+executed by every CI tier (the ISSUE-7 routing requirement).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel
+from flexflow_tpu.models.llama import llama_lm
+from flexflow_tpu.ops.attention import resolve_paged_attention_impl
+from flexflow_tpu.ops.pallas_kernels import paged_attention_fwd_pallas
+
+VOCAB = 89
+TOL = dict(rtol=2e-5, atol=2e-5)
+
+
+@pytest.fixture(scope="module")
+def ff():
+    cfg = FFConfig(batch_size=2, mesh_shape={"data": 1})
+    model = FFModel(cfg)
+    # kv_heads=2 < heads=4: the GQA grouping is always exercised
+    _, logits = llama_lm(model, 2, seq_len=16, hidden=64, layers=2,
+                         heads=4, kv_heads=2, vocab_size=VOCAB)
+    model.compile(final_tensor=logits)
+    return model
+
+
+@pytest.fixture(scope="module")
+def attn(ff):
+    return next(op for op in ff.ops
+                if type(op).__name__ == "MultiHeadAttention")
+
+
+def _pool(rs, attn, n_pages=10, page=4):
+    return {
+        "k": jnp.asarray(rs.randn(n_pages, page, attn.num_kv_heads,
+                                  attn.qk_head_dim), jnp.float32),
+        "v": jnp.asarray(rs.randn(n_pages, page, attn.num_kv_heads,
+                                  attn.v_head_dim), jnp.float32),
+    }
+
+
+def _params(ff, attn):
+    return {k: jnp.asarray(v) for k, v in ff.params[attn.name].items()}
+
+
+def test_kernel_matches_einsum_decode_ragged_scrambled(ff, attn):
+    """S=1 decode step over a deliberately non-identity page table with
+    ragged row_len/prompt_pad: the kernel's online softmax must match
+    the page-gather einsum (itself bitwise the dense-cache attention,
+    tests/test_serving.py) to kernel tolerance."""
+    rs = np.random.RandomState(3)
+    pool = _pool(rs, attn)
+    params = _params(ff, attn)
+    table = jnp.asarray([[5, 2, 7, 1], [3, 6, 4, 8]], jnp.int32)
+    x = jnp.asarray(rs.randn(2, 1, attn.q_in), jnp.float32)
+    wp = jnp.asarray([9, 13], jnp.int32)
+    rope = jnp.asarray([4, 7], jnp.int32)
+    row_len = jnp.asarray([3, 7], jnp.int32)       # ragged true prompts
+    pad = jnp.asarray([8, 8], jnp.int32)           # bucket-padded width
+    out_e, cache_e = attn.paged_decode_forward(
+        params, [x, x, x], pool, table, wp, rope, row_len, pad,
+        impl="einsum")
+    out_p, cache_p = attn.paged_decode_forward(
+        params, [x, x, x], pool, table, wp, rope, row_len, pad,
+        impl="pallas")
+    np.testing.assert_allclose(np.asarray(out_e), np.asarray(out_p), **TOL)
+    # the scatter half is shared code — the pools must be BITWISE equal
+    for n in ("k", "v"):
+        np.testing.assert_array_equal(np.asarray(cache_e[n]),
+                                      np.asarray(cache_p[n]))
+
+
+def test_kernel_matches_einsum_verify_slab(ff, attn):
+    """S=4 speculative-verify slab: per-position write frontiers give
+    in-slab causality; every position's context must match the oracle."""
+    rs = np.random.RandomState(5)
+    pool = _pool(rs, attn)
+    params = _params(ff, attn)
+    table = jnp.asarray([[5, 2, 7, 1], [3, 6, 4, 8]], jnp.int32)
+    s = 4
+    x = jnp.asarray(rs.randn(2, s, attn.q_in), jnp.float32)
+    wp0 = jnp.asarray([9, 11], jnp.int32)
+    # nondecreasing frontiers incl. the budget clamp (equal tail)
+    wp = jnp.minimum(wp0[:, None] + jnp.arange(s)[None, :], 13)
+    rope = jnp.asarray([4, 7], jnp.int32)
+    row_len = jnp.asarray([3, 7], jnp.int32)
+    pad = jnp.asarray([8, 8], jnp.int32)
+    out_e, _ = attn.paged_verify_forward(
+        params, [x, x, x], pool, table, wp, rope, row_len, pad,
+        impl="einsum")
+    out_p, _ = attn.paged_verify_forward(
+        params, [x, x, x], pool, table, wp, rope, row_len, pad,
+        impl="pallas")
+    np.testing.assert_allclose(np.asarray(out_e), np.asarray(out_p), **TOL)
+
+
+def test_kernel_inactive_slot_scratch_page(ff, attn):
+    """The serving engine's inactive-slot state (zeroed table -> every
+    write lands in scratch page 0, write_pos=row_len=prompt_pad=0): the
+    kernel must produce the same finite output as the oracle — its live
+    rule admits j=0, so the online softmax never divides by zero."""
+    rs = np.random.RandomState(7)
+    pool = _pool(rs, attn)
+    params = _params(ff, attn)
+    table = jnp.asarray([[5, 2, 7, 1], [0, 0, 0, 0]], jnp.int32)
+    x = jnp.asarray(rs.randn(2, 1, attn.q_in), jnp.float32)
+    wp = jnp.asarray([9, 0], jnp.int32)
+    rope = jnp.asarray([4, 0], jnp.int32)
+    row_len = jnp.asarray([3, 0], jnp.int32)
+    pad = jnp.asarray([8, 0], jnp.int32)
+    out_e, _ = attn.paged_decode_forward(
+        params, [x, x, x], pool, table, wp, rope, row_len, pad,
+        impl="einsum")
+    out_p, _ = attn.paged_decode_forward(
+        params, [x, x, x], pool, table, wp, rope, row_len, pad,
+        impl="pallas")
+    assert bool(jnp.isfinite(out_p).all())
+    np.testing.assert_allclose(np.asarray(out_e), np.asarray(out_p), **TOL)
+
+
+def test_kernel_live_pages_cover_prompt_past_frontier(ff, attn):
+    """The live-page bound must honor BOTH halves of the live rule: a
+    caller querying with write_pos INSIDE the prompt (write_pos <
+    row_len — never produced by the serving engine, but legal at the op
+    boundary) still attends the whole live prompt, j < row_len. A
+    frontier-only bound would silently skip the prompt's tail pages."""
+    rs = np.random.RandomState(23)
+    pool = _pool(rs, attn)
+    params = _params(ff, attn)
+    table = jnp.asarray([[5, 2, 7, 1], [3, 6, 4, 8]], jnp.int32)
+    x = jnp.asarray(rs.randn(2, 1, attn.q_in), jnp.float32)
+    wp = jnp.asarray([5, 2], jnp.int32)            # frontier in page 1/0
+    rope = jnp.asarray([5, 2], jnp.int32)
+    row_len = jnp.asarray([14, 11], jnp.int32)     # prompt spans 4/3 pages
+    pad = jnp.asarray([16, 16], jnp.int32)
+    out_e, _ = attn.paged_decode_forward(
+        params, [x, x, x], pool, table, wp, rope, row_len, pad,
+        impl="einsum")
+    out_p, _ = attn.paged_decode_forward(
+        params, [x, x, x], pool, table, wp, rope, row_len, pad,
+        impl="pallas")
+    np.testing.assert_allclose(np.asarray(out_e), np.asarray(out_p), **TOL)
+
+
+def test_kernel_vs_dense_cache_tolerance(ff, attn):
+    """The ISSUE-7 pin: the kernel against decode_forward on the
+    EQUIVALENT contiguous dense cache (the pre-paged ground truth) —
+    one tolerance bound covering kernel + page-table lookup together."""
+    rs = np.random.RandomState(11)
+    params = _params(ff, attn)
+    b, page, n_pages = 2, 4, 4
+    max_len = page * n_pages
+    kvh, dqk, dv = attn.num_kv_heads, attn.qk_head_dim, attn.v_head_dim
+    dense = {"k": jnp.asarray(rs.randn(b, max_len, kvh, dqk), jnp.float32),
+             "v": jnp.asarray(rs.randn(b, max_len, kvh, dv), jnp.float32)}
+    x = jnp.asarray(rs.randn(b, 1, attn.q_in), jnp.float32)
+    pos, prompt_pad = 9, 8
+    rope = jnp.asarray([4, 7], jnp.int32)
+    row_len = jnp.asarray([3, 7], jnp.int32)
+    table = np.array([[5, 2, 7, 1], [3, 6, 4, 8]], np.int32)
+    pool = {"k": jnp.zeros((10, page, kvh, dqk), jnp.float32),
+            "v": jnp.zeros((10, page, kvh, dv), jnp.float32)}
+    for row in range(b):
+        for p in range(n_pages):
+            for name in ("k", "v"):
+                pool[name] = pool[name].at[table[row, p]].set(
+                    dense[name][row, p * page:(p + 1) * page])
+    out_d, _ = attn.decode_forward(
+        params, [x, x, x], dense, pos, rope_pos=rope,
+        row_lengths=row_len, prompt_len=prompt_pad)
+    out_k, _ = attn.paged_decode_forward(
+        params, [x, x, x], pool, jnp.asarray(table),
+        jnp.full((b,), pos, jnp.int32), rope, row_len,
+        jnp.full((b,), prompt_pad, jnp.int32), impl="pallas")
+    np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_k), **TOL)
+
+
+def test_kernel_raw_entrypoint_gqa_rows(ff, attn):
+    """Direct kernel call: the GQA row layout (query head h reads kv
+    head h // group) must match _grouped_cache_attention's reshape —
+    checked by feeding DISTINCT per-head queries through both paths."""
+    rs = np.random.RandomState(13)
+    b, s, h, kvh, d, page = 2, 2, 4, 2, attn.qk_head_dim, 4
+    q = jnp.asarray(rs.randn(b, s, h, d), jnp.float32)
+    pool = _pool(rs, attn, n_pages=9, page=page)
+    table = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+    wp = jnp.asarray([[6, 7], [9, 10]], jnp.int32)
+    row_len = jnp.asarray([2, 5], jnp.int32)
+    pad = jnp.asarray([4, 6], jnp.int32)
+    scale = 0.37
+    out = paged_attention_fwd_pallas(q, pool["k"], pool["v"], table, wp,
+                                     row_len, pad, scale)
+    # oracle: gather + grouped einsum (the _grouped_cache_attention math
+    # with an explicit scale)
+    max_len = table.shape[1] * page
+    gk = pool["k"][table].reshape(b, max_len, kvh, d)
+    gv = pool["v"][table].reshape(b, max_len, kvh, d)
+    idx = jnp.arange(max_len)
+    live = (idx[None, None, :] < row_len[:, None, None]) \
+        | ((idx[None, None, :] >= pad[:, None, None])
+           & (idx[None, None, :] <= wp[:, :, None]))
+    qg = q.reshape(b, s, kvh, h // kvh, d)
+    logits = jnp.einsum("bqkgd,bskd->bkgqs", qg, gk,
+                        preferred_element_type=jnp.float32) * scale
+    logits = jnp.where(live[:, None, None, :, :], logits,
+                       jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    want = jnp.einsum("bkgqs,bskd->bqkgd", probs, gv).reshape(b, s, h, d)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), **TOL)
+
+
+def test_resolve_impl_knob(ff):
+    """auto resolves per backend; bad values are rejected; the FFConfig
+    knob validates."""
+    want_auto = "pallas" if jax.default_backend() == "tpu" else "einsum"
+    assert resolve_paged_attention_impl(None, ff.config) == want_auto
+    assert resolve_paged_attention_impl("auto", None) == want_auto
+    assert resolve_paged_attention_impl("pallas", ff.config) == "pallas"
+    assert resolve_paged_attention_impl("einsum", None) == "einsum"
+    with pytest.raises(ValueError, match="paged_attention_impl"):
+        resolve_paged_attention_impl("cuda", None)
+    with pytest.raises(ValueError, match="paged_attention_impl"):
+        FFConfig(batch_size=2, mesh_shape={"data": 1},
+                 paged_attention_impl="einsums")
+    cfg = FFConfig.parse_args(["--batch-size", "2",
+                               "--paged-attention-impl", "pallas"])
+    assert cfg.paged_attention_impl == "pallas"
+
+
+@pytest.mark.slow  # ~40 s: two engines, interpret-mode kernel; kernels CI tier
+def test_serving_token_identity_pallas_vs_einsum(ff):
+    """THE acceptance pin: a full greedy serving run — prefix cache ON,
+    speculative decoding ON (self-draft: the accept path genuinely
+    runs) — emits exactly the same token streams under impl='pallas'
+    (interpret-mode kernel on CPU) and impl='einsum'."""
+    rs = np.random.RandomState(17)
+    system = rs.randint(1, VOCAB, (8,)).astype(np.int32)  # 2 shared pages
+    prompts = [np.concatenate([system,
+                               rs.randint(1, VOCAB, (L,)).astype(np.int32)])
+               for L in (2, 5, 1, 4)] \
+        + [rs.randint(1, VOCAB, (6,)).astype(np.int32)]
+    outs = {}
+    for impl in ("einsum", "pallas"):
+        eng = ff.make_serving_engine(
+            serve_slots=2, kv_page_size=4, max_seq_len=64,
+            draft_model=ff, speculate_k=2, paged_attention_impl=impl)
+        reqs = eng.run(prompts, max_new_tokens=5)
+        assert [r.state for r in reqs] == ["done"] * len(prompts)
+        outs[impl] = [np.asarray(r.tokens, np.int32) for r in reqs]
+        st = eng.stats()
+        assert st["paged_attention_impl"] == impl
+        assert st["prefix_hits"] > 0 and st["spec_accepted"] > 0
+        assert st["pages_touched"] > 0 and st["last_pages_touched"] >= 0
+    for a, b in zip(outs["einsum"], outs["pallas"]):
+        np.testing.assert_array_equal(
+            a, b, err_msg="pallas paged-attention changed the greedy "
+                          "token stream (must be a pure perf mechanism)")
+
+
+@pytest.mark.slow  # ~20 s; kernels CI tier
+def test_recompile_flat_with_pallas_impl(ff):
+    """The one-program serving contract survives the kernel path: after
+    bucket warmup, mixed same-bucket traffic through the pallas impl
+    compiles nothing new."""
+    eng = ff.make_serving_engine(serve_slots=2, kv_page_size=4,
+                                 max_seq_len=64,
+                                 paged_attention_impl="pallas")
+    rs = np.random.RandomState(19)
+    eng.run([rs.randint(1, VOCAB, (5,)).astype(np.int32),
+             rs.randint(1, VOCAB, (12,)).astype(np.int32)],
+            max_new_tokens=4)                     # warm buckets 8 + 16
+    warm = eng.recompile_count
+    eng.run([rs.randint(1, VOCAB, (n,)).astype(np.int32)
+             for n in (6, 3, 9, 14, 2)], max_new_tokens=6)
+    assert eng.recompile_count == warm, \
+        "warm traffic with the pallas kernel path must not recompile"
+    st = eng.stats()
+    assert st["paged_attention_impl"] == "pallas"
+    assert st["pages_touched"] > 0

@@ -660,9 +660,17 @@ def test_stats_and_health_expose_pool_observability(ff):
                 "kv_pages_shared", "prefix_hit_rate", "prefix_hits",
                 "prefill_tokens_saved", "prefix_evictions",
                 "prefix_refs_live", "spec_accept_rate", "spec_proposed",
-                "spec_accepted", "speculate_k"):
+                "spec_accepted", "speculate_k",
+                # decode-attention hot path (ISSUE 7): impl routing,
+                # pages the last dispatch's attention read, autotune
+                # table consultations
+                "paged_attention_impl", "pages_touched",
+                "last_pages_touched", "kernel_tune_hits",
+                "kernel_tune_misses"):
         assert key in st, f"stats() missing {key}"
     assert st["pages_in_use"] == 0 and st["prefix_hit_rate"] == 0.0
+    assert st["paged_attention_impl"] in ("pallas", "einsum")
+    assert st["pages_touched"] == 0 and st["last_pages_touched"] == 0
     before = eng.recompile_count
     h = eng.health()
     assert eng.recompile_count == before     # health never compiles
