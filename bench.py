@@ -97,6 +97,7 @@ TIER_COST_S = {"tiny": 90, "mid": 150, "full": 240, "full_scan": 180,
                "cpu_smoke_scan": 30,
                "decode_throughput": 180,
                "prefix_serving": 150,
+               "router_serving": 240,
                "paged_attention": 120,
                "input_overlap": 90}
 
@@ -110,6 +111,30 @@ SERVE_MAX_NEW = 32
 # (the static-shape decode attends the full gathered length — slack there
 # is wasted FLOPs on every step of every slot)
 SERVE_PROMPT_LENS = (6, 10, 14, 20, 24, 28)
+
+# router_serving tier (ISSUE 8): the multi-replica ServingRouter. Two
+# questions, answered in one row: (1) aggregate tokens/s at 2 replicas
+# vs 1 (the fleet-scaling number — on the CPU smoke box both replicas
+# share two cores, so the honest expectation is ~1x; on real hardware
+# each replica owns its chips); (2) accepted-request p99 TTFT during a
+# mid-flight replica kill under sustained overload, with shedding
+# (serve_max_queue bounded) vs without — shedding must keep the
+# accepted p99 bounded (no worse than ~2x the no-overload run) while
+# the unshedded queue's p99 degrades with the backlog. Router counters
+# (fenced, resubmitted, timeouts, rejected) ride the config block.
+ROUTER_REQUESTS = 64
+ROUTER_MAX_NEW = 16
+# kill-drill shape: longer generations + more requests make the overload
+# SUSTAINED (a burst that drains in one service interval measures
+# nothing), and the shed window runs with dispatch_backlog=0 so accepted
+# work waits in no deep engine queue — the bound shedding promises
+ROUTER_KILL_MAX_NEW = 32
+ROUTER_OVERLOAD_REQUESTS = 240
+ROUTER_SHED_QUEUE = 1
+# early kill: failover victims have accrued little pre-crash wait, so
+# the shed window's p99 measures the SHEDDING bound, not the (separately
+# counted) failover cost
+ROUTER_KILL_TICK = 12
 
 # prefix_serving tier (ISSUE 6): skewed shared-prefix traffic — 80% of
 # requests share a long system prompt (the millions-of-users shape from
@@ -546,6 +571,219 @@ def _run_prefix_serving_tier(n_dev, backend, dev_kind):
     }
 
 
+def _run_router_serving_tier(n_dev, backend, dev_kind):
+    """router_serving row: the fleet router (runtime/router.py) measured
+    three ways — replica-scaling throughput (2 vs 1 replicas, same total
+    load), a no-overload paced baseline, and a kill-under-overload drill
+    (FF_FAULT crashes replica 0 mid-run while paced submission exceeds
+    the measured service rate) run twice: shedding on (bounded router
+    queue) vs off. Every router uses prefix_cache=False so warm rounds
+    stay warm (repeated prompts would otherwise reach hit-prefill
+    variants the timed window never warmed)."""
+    import numpy as np
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.llama import llama_lm
+    from flexflow_tpu.runtime import faultinject
+
+    _phase("build_router_serving")
+    vocab = 256
+    cfg = FFConfig(batch_size=2, mesh_shape={"data": 1}, serve_slots=4,
+                   kv_page_size=16)
+    ff = FFModel(cfg)
+    _, logits = llama_lm(ff, 2, seq_len=16, hidden=128, layers=2, heads=4,
+                         kv_heads=2, vocab_size=vocab)
+    ff.compile(final_tensor=logits)
+
+    rs = np.random.RandomState(0)
+    lens = [SERVE_PROMPT_LENS[i % len(SERVE_PROMPT_LENS)]
+            for i in range(ROUTER_REQUESTS)]
+    prompts = [rs.randint(1, vocab, (n,)).astype(np.int32) for n in lens]
+    warm = [rs.randint(1, vocab, (n,)).astype(np.int32)
+            for n in SERVE_PROMPT_LENS]
+
+    def mk_router(replicas, max_queue=0, backlog=None):
+        # 8 slots x chunk 2: a queued request is admitted at a driver
+        # TICK boundary, so the tick is the latency quantum of every
+        # shed-queue wait — keep it small (chunk 8 ticks are ~4x longer
+        # and the shed-p99 bound drowns in a single tick's wait). The
+        # fleet-throughput cost of the shorter scan is the same for
+        # every window, so the comparisons stay apples-to-apples.
+        r = ff.make_serving_router(
+            replicas=replicas, max_queue=max_queue,
+            dispatch_backlog=backlog, max_seq_len=96, serve_slots=8,
+            decode_chunk=2, prefix_cache=False, start=False)
+        r.warmup(warm, max_new_tokens=4)
+        return r
+
+    # ---- replica scaling: the same 64 requests through 1 then 2 replicas
+    tps = {}
+    for n_rep in (1, 2):
+        _phase(f"time_router_{n_rep}_replicas")
+        router = mk_router(n_rep)
+        try:
+            warm_compiles = [e.recompile_count for e in router.engines]
+            best = None
+            for _ in range(2):      # best-of-2: bursty-host guard
+                t0 = time.perf_counter()
+                reqs = router.run(prompts, max_new_tokens=ROUTER_MAX_NEW,
+                                  timeout=1200)
+                dt = time.perf_counter() - t0
+                assert all(r.state == "done" for r in reqs)
+                best = dt if best is None else min(best, dt)
+            tps[n_rep] = ROUTER_REQUESTS * ROUTER_MAX_NEW / best
+            recompiled = any(
+                e.recompile_count != c
+                for e, c in zip(router.engines, warm_compiles))
+        finally:
+            router.close()
+
+    # the drill windows are CLOSED-LOOP floods, not paced arrivals: an
+    # instantaneous flood is genuine overload whatever this epoch's
+    # service rate is, so the drill needs no rate calibration that a
+    # co-tenant load swing between windows would invalidate
+    def flood_run(router, n, max_new):
+        router.start()
+        time.sleep(0.05)    # drivers up before the first arrival — the
+        #                     first TTFT must not measure thread spin-up
+        reqs = [router.submit(prompts[i % len(prompts)], max_new)
+                for i in range(n)]
+        router.wait([r for r in reqs if r.state != "rejected"],
+                    timeout=1200)
+        done = sorted(r.ttft for r in reqs if r.state == "done")
+
+        def pct(p):
+            return round(done[min(len(done) - 1,
+                                  int(p * len(done)))] * 1e3, 3) \
+                if done else 0.0
+
+        return reqs, pct
+
+    # ---- no-overload baseline: paced WELL under the service rate, same
+    # shallow-dispatch config as the shed window (isolate the queue
+    # bound, not the backlog depth). 0.4x, not 0.7x: the estimate comes
+    # from a fully SATURATED window, and per-request service at light
+    # occupancy is slower (the fixed-shape dispatch amortizes over fewer
+    # busy slots), so "well under" needs real headroom
+    # every percentile window runs best-of-2 with a FRESH router per
+    # round (the file-wide bursty-host guard: a co-tenant burst inflates
+    # one round, the min survives; both sides of every ratio get the
+    # same treatment)
+    def best_of(fn, rounds=2):
+        best = None
+        for _ in range(rounds):
+            w = fn()
+            if best is None or w["p99_ttft_ms"] < best["p99_ttft_ms"]:
+                best = w
+        return best
+
+    def light_window():
+        # "no overload" = a momentarily FULL fleet, not an idle one: one
+        # request per fleet slot plus one — the load level shedding
+        # promises to preserve for accepted work
+        _phase("time_router_light")
+        router = mk_router(2, backlog=0)
+        try:
+            _, pct = flood_run(router, 2 * 8 + 1, ROUTER_KILL_MAX_NEW)
+            return {"p99_ttft_ms": pct(0.99),
+                    "p50_ttft_ms": pct(0.50)}
+        finally:
+            router.close()
+
+    p99_light = best_of(light_window)["p99_ttft_ms"]
+
+    def drill_window(name, max_queue, fault=None):
+        _phase(f"time_router_{name}")
+        if fault:
+            os.environ["FF_FAULT"] = fault
+            faultinject.reset()
+        router = mk_router(2, max_queue=max_queue, backlog=0)
+        try:
+            reqs, pct = flood_run(router, ROUTER_OVERLOAD_REQUESTS,
+                                  ROUTER_KILL_MAX_NEW)
+            st = router.stats()
+            return {
+                "p99_ttft_ms": pct(0.99), "p50_ttft_ms": pct(0.50),
+                "accepted": sum(1 for r in reqs
+                                if r.state != "rejected"),
+                "rejected": st["rejected"], "fenced": st["fenced"],
+                "resubmitted": st["resubmitted"],
+                "timeouts": st["timeouts"],
+                "completed": st["completed"],
+            }
+        finally:
+            router.close()
+
+    # ---- sustained overload WITHOUT a kill, shedding on vs off: the
+    # pure shedding bound (no failover victims in the percentile), then
+    # the same pair DURING a replica kill (FF_FAULT crashes replica 0
+    # mid-run; fresh plan per window — the crash is one-shot per parse)
+    old_fault = os.environ.get("FF_FAULT")
+    kill_fault = f"crash({ROUTER_KILL_TICK})@replica:0"
+    try:
+        overload = {
+            "shed": best_of(lambda: drill_window(
+                "overload_shed", ROUTER_SHED_QUEUE)),
+            "noshed": best_of(lambda: drill_window(
+                "overload_noshed", 0)),
+        }
+        kill = {
+            "shed": best_of(lambda: drill_window(
+                "kill_shed", ROUTER_SHED_QUEUE, fault=kill_fault)),
+            "noshed": best_of(lambda: drill_window(
+                "kill_noshed", 0, fault=kill_fault)),
+        }
+    finally:
+        if old_fault is None:
+            os.environ.pop("FF_FAULT", None)
+        else:
+            os.environ["FF_FAULT"] = old_fault
+        faultinject.reset()
+
+    p99_shed = overload["shed"]["p99_ttft_ms"]
+    p99_noshed = overload["noshed"]["p99_ttft_ms"]
+    return {
+        "metric": "router_serving_throughput", "tier": "router_serving",
+        "value": round(tps[2], 2), "unit": "tokens/s",
+        "vs_baseline": round(tps[2] / tps[1], 3),
+        "replicas_2_tokens_per_s": round(tps[2], 2),
+        "replicas_1_tokens_per_s": round(tps[1], 2),
+        "p99_ttft_ms_light": p99_light,
+        "p99_ttft_ms_overload_shed": p99_shed,
+        "p99_ttft_ms_overload_noshed": p99_noshed,
+        "p99_ttft_ms_kill_shed": kill["shed"]["p99_ttft_ms"],
+        "p99_ttft_ms_kill_noshed": kill["noshed"]["p99_ttft_ms"],
+        # the ISSUE-8 acceptance shape: under sustained overload,
+        # shedding keeps accepted p99 bounded vs the no-overload run
+        # while the unshedded queue's p99 degrades with the backlog
+        "shed_p99_bounded_2x_light": bool(p99_shed <= 2 * p99_light),
+        "noshed_p99_vs_shed": round(p99_noshed / max(p99_shed, 1e-9), 2),
+        "overload_shed": overload["shed"],
+        "overload_noshed": overload["noshed"],
+        "kill_shed": kill["shed"], "kill_noshed": kill["noshed"],
+        "recompiles_after_warmup": bool(recompiled),
+        "backend": backend, "device_kind": dev_kind, "n_devices": n_dev,
+        "config": {"requests": ROUTER_REQUESTS,
+                   "max_new_tokens": ROUTER_MAX_NEW,
+                   "kill_max_new_tokens": ROUTER_KILL_MAX_NEW,
+                   "overload_requests": ROUTER_OVERLOAD_REQUESTS,
+                   "load_shape": "closed_loop_flood",
+                   "kill_busy_tick": ROUTER_KILL_TICK,
+                   "serve_max_queue_shed": ROUTER_SHED_QUEUE,
+                   "serve_slots": 8, "kv_page_size": 16,
+                   "decode_chunk": 2, "max_seq_len": 96,
+                   "hidden": 128, "layers": 2,
+                   "prefix_cache": False,
+                   # the router-counter stamp (ISSUE 8 satellite):
+                   # failure-drill ledger of the shedded kill window
+                   "router_fenced": kill["shed"]["fenced"],
+                   "router_resubmitted": kill["shed"]["resubmitted"],
+                   "router_timeouts": kill["shed"]["timeouts"],
+                   "router_rejected": kill["shed"]["rejected"],
+                   "dispatch_ahead": 0, "host_wait_fraction": 0.0},
+    }
+
+
 def _run_paged_attention_tier(n_dev, backend, dev_kind):
     """paged_attention microbench (ISSUE 7): the Pallas paged-decode
     kernel vs the einsum page-gather oracle on the SAME pool, timed
@@ -827,6 +1065,14 @@ def child():
             or deadline - time.time() >= TIER_COST_S["prefix_serving"]):
         for row in _run_prefix_serving_tier(n_dev, backend, dev_kind):
             print(json.dumps(row), flush=True)
+    # router_serving tier: fleet throughput at 2 replicas vs 1 + the
+    # kill-under-overload p99 drill with shedding on vs off
+    if "router_serving" not in skip and (
+            deadline is None
+            or deadline - time.time() >= TIER_COST_S["router_serving"]):
+        print(json.dumps(
+            _run_router_serving_tier(n_dev, backend, dev_kind)),
+            flush=True)
     # paged_attention microbench: Pallas paged-decode kernel vs the
     # einsum page-gather oracle + the flash block autotune record
     if "paged_attention" not in skip and (
@@ -902,6 +1148,7 @@ def _serving_rows(results):
     return [r for r in results
             if r.get("metric") in ("decode_throughput", "serve_latency",
                                    "prefix_serving_throughput",
+                                   "router_serving_throughput",
                                    "paged_attention_microbench")]
 
 

@@ -30,8 +30,16 @@
 #               code), the block autotuner suite, and a tune-then-
 #               consume smoke that writes and re-reads a real on-disk
 #               autotune table
+#   router    — fleet-router tier: the multi-replica ServingRouter suite
+#               (failover exactly-once + token identity incl. prefix
+#               cache + speculation, deadline/shedding/affinity
+#               semantics, hang detection, engine thread-safety) + a
+#               2-replica 200-request smoke with FF_FAULT crashing
+#               replica 0 mid-flight — all non-expired requests complete
+#               exactly once, zero lost/duplicated, zero warm recompiles
+#               on the survivor
 #
-# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|kernels|all]
+# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|kernels|router|all]
 set -e
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -164,6 +172,19 @@ run_kernels() {
   python scripts/kernel_tune_smoke.py
 }
 
+# router tier: the fleet suite (failover/deadline/shedding/affinity +
+# the concurrent-submit engine stress in test_serving), then the
+# 2-replica smoke under a deterministic mid-flight crash of replica 0
+# (crash@replica is identity-indexed, so the smoke's warmup consumes
+# nothing from the plan; tick 10 guarantees work is genuinely
+# mid-stream when the replica dies).
+run_router() {
+  python -m pytest tests/test_router.py -q
+  python -m pytest tests/test_serving.py -q \
+    -k "thread_safe or deadline_expires"
+  FF_FAULT="crash(10)@replica:0" python scripts/router_smoke.py 200
+}
+
 case "$TIER" in
   unit)     run_unit ;;
   sweep)    run_sweep ;;
@@ -176,7 +197,8 @@ case "$TIER" in
   overlap)  run_overlap ;;
   elastic)  run_elastic ;;
   kernels)  run_kernels ;;
-  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_kernels; run_native; run_docs; run_sweep ;;
+  router)   run_router ;;
+  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_kernels; run_router; run_native; run_docs; run_sweep ;;
   *) echo "unknown tier $TIER"; exit 2 ;;
 esac
 echo "ci($TIER): PASSED"

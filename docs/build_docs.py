@@ -30,7 +30,7 @@ PAGES = [("index", os.path.join(ROOT, "README.md"), "Overview"),
          ("resilience", os.path.join(DOCS, "resilience.md"),
           "Fault tolerance & elastic recovery"),
          ("serving", os.path.join(DOCS, "serving.md"),
-          "Serving (continuous batching, prefix cache, speculation)"),
+          "Serving (continuous batching, prefix cache, fleet router)"),
          ("performance", os.path.join(DOCS, "performance.md"),
           "Performance (host overlap, Pallas kernel tier)"),
          ("analysis", os.path.join(DOCS, "analysis.md"),

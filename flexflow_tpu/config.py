@@ -204,6 +204,13 @@ class FFConfig:
     # engine construction). A runtime object, not a flag: pass it
     # programmatically or via make_serving_engine(draft_model=...)
     draft_model: Optional[object] = None
+    # fleet router (runtime/router.py ServingRouter): bound on the router
+    # queue — submissions past it are REJECTED immediately (state
+    # "rejected") instead of queueing, so accepted-request p99 TTFT stays
+    # bounded under overload while excess load fails fast at the front
+    # door. 0 = unbounded (the pre-router behavior: the queue grows with
+    # the backlog and every request's tail latency grows with it).
+    serve_max_queue: int = 0
     # decode/verify attention over the paged KV pool:
     #   "auto"   — Pallas paged-attention kernel on a TPU backend (page-
     #              table lookup inside the kernel, only a slot's live
@@ -284,6 +291,10 @@ class FFConfig:
             raise ValueError(
                 f"serve_speculate_k={self.serve_speculate_k}: must be "
                 f">= 0 (0 = speculative decoding off)")
+        if self.serve_max_queue < 0:
+            raise ValueError(
+                f"serve_max_queue={self.serve_max_queue}: must be >= 0 "
+                f"(0 = unbounded router queue)")
         if self.paged_attention_impl not in ("auto", "pallas", "einsum"):
             raise ValueError(
                 f"paged_attention_impl={self.paged_attention_impl!r}: "
@@ -378,6 +389,9 @@ class FFConfig:
                        help="draft tokens proposed per speculative "
                             "decode iteration (0 = off; needs a "
                             "draft model)")
+        p.add_argument("--serve-max-queue", type=int, default=0,
+                       help="fleet-router queue bound: submissions past "
+                            "it are rejected fast (0 = unbounded)")
         p.add_argument("--paged-attention-impl", type=str, default="auto",
                        choices=("auto", "pallas", "einsum"),
                        help="decode attention over the paged pool: "
@@ -425,5 +439,6 @@ class FFConfig:
             kv_pages=args.kv_pages,
             serve_prefix_cache=not args.no_prefix_cache,
             serve_speculate_k=args.serve_speculate_k,
+            serve_max_queue=args.serve_max_queue,
             paged_attention_impl=args.paged_attention_impl,
         )

@@ -1249,6 +1249,41 @@ class FFModel:
         outs = [r.output if r.state == "done" else None for r in reqs]
         return outs, eng.stats()
 
+    def make_serving_router(self, replicas: int = 2, **kwargs):
+        """Fleet serving router (runtime/router.py ServingRouter): N
+        continuous-batching replicas of this model, each driven on its
+        own thread, with failover (a crashed/hung replica is fenced and
+        its work resubmitted to survivors exactly once), per-request
+        deadlines, overload shedding (``max_queue`` /
+        FFConfig.serve_max_queue) and least-loaded + prefix-affinity
+        placement on the replicas' live health counters. Router kwargs
+        (``max_queue``, ``health_timeout_s``, ``dispatch_backlog``,
+        ``start``) are split out; everything else is forwarded to every
+        replica's ServingEngine."""
+        from flexflow_tpu.runtime.router import ServingRouter
+
+        return ServingRouter(self, replicas=replicas, **kwargs)
+
+    def serve_fleet(self, prompts, max_new_tokens: int = 32,
+                    replicas: int = 2,
+                    deadline_s: Optional[float] = None, **kwargs):
+        """One-shot fleet serve: run `prompts` through a fresh N-replica
+        ServingRouter and return (outputs, stats) — outputs[i] is prompt
+        + generated tokens for prompts[i], or None for a request that
+        failed, expired (``deadline_s``) or was shed; stats is the
+        router's fleet ledger (per-replica engine rows included). Greedy
+        fleet output is token-identical to single-replica serve() — the
+        router moves work, never changes it."""
+        router = self.make_serving_router(replicas=replicas, **kwargs)
+        try:
+            reqs = router.run(prompts, max_new_tokens=max_new_tokens,
+                              deadline_s=deadline_s)
+            outs = [r.output if r.state == "done" else None for r in reqs]
+            stats = router.stats()
+        finally:
+            router.close()
+        return outs, stats
+
     def generate_seq2seq(self, src_tokens, tgt_prompt=None,
                          max_new_tokens: int = 32, bos_token_id: int = 1,
                          temperature: float = 0.0, top_k: int = 0,

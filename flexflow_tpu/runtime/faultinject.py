@@ -16,11 +16,15 @@ Grammar: comma-separated ``kind[(value)]@site:index`` events.
          parameter in parentheses (``shrink(2)`` = shrink to 2 devices) —
          read back via ``FaultPlan.last_value`` after a match
   site   where the event fires. ``step`` is special: *index* is the 1-based
-         global training step (compared against the step counter). Every
-         other site (``save``, ``load``, ``data``, ``resume`` …) is
-         occurrence-counted: *index* is the 1-based call count at that
-         site, so ``io_fail@save:1`` fails exactly the first checkpoint
-         save.
+         global training step (compared against the step counter).
+         ``replica`` is identity-indexed: *index* names the serving
+         replica (0-based, the router's replica id), so
+         ``crash@replica:0`` fells exactly replica 0 — checked with
+         ``pending()``/``at_site()``, never occurrence-counted. Every
+         other site (``save``, ``load``, ``data``, ``resume``,
+         ``serve`` …) is occurrence-counted: *index* is the 1-based call
+         count at that site, so ``io_fail@save:1`` fails exactly the
+         first checkpoint save.
 
 Duplicate kinds are allowed (``nan_loss@step:3,nan_loss@step:4`` injects
 two consecutive NaNs); a range ``nan_loss@step:3-5`` expands to one event
@@ -37,7 +41,17 @@ Consumers:
   * the launcher and ``runtime/elastic.py`` check ``shrink(<k>)@resume:<n>``
     on the n-th resume and present only ``k`` visible devices
     (``_env.force_cpu_devices`` in a fresh process; a capped count when
-    the backend is already up) — the changed-topology drill.
+    the backend is already up) — the changed-topology drill;
+  * ``runtime/router.py`` drives the fleet-failover drills:
+    ``crash@replica:<r>`` kills replica *r*'s driver thread and
+    ``hang@replica:<r>`` wedges it past the health timeout — both fire at
+    the replica's first scheduler tick with live work, or at its
+    *value*-th such tick with ``crash(<tick>)@replica:<r>`` (the router
+    peeks with ``pending()`` and consumes with ``at_site()`` when its own
+    tick counter reaches the trigger);
+  * ``ServingEngine._admit`` checks ``slow(<ms>)@serve:<n>`` and stalls
+    the n-th admission host-side by ``<ms>`` — the slow-replica drill
+    that expires an in-flight deadline deterministically.
 
 The active plan is parsed lazily from ``FF_FAULT`` and re-parsed (with
 occurrence counters reset) whenever the env value changes; tests that
@@ -113,16 +127,36 @@ class FaultPlan:
                     values[(kind, site, i)] = value
         return cls(events, values)
 
-    def at_step(self, kind: str, step: int) -> bool:
-        """True when the plan holds ``kind@step:<step>``. One-shot: a
-        fired event is consumed, so a supervisor rewind that re-executes
-        the step does not re-inject (the fault "happened" once)."""
-        ev = (kind, "step", int(step))
+    def at_site(self, kind: str, site: str, index: int) -> bool:
+        """Identity-indexed one-shot check: True when the plan holds
+        ``kind@site:<index>`` where *index* names a thing (a step number,
+        a replica id) rather than a call count. A fired event is
+        consumed, so it happens exactly once; ``last_value`` carries its
+        parameter."""
+        ev = (kind, site, int(index))
         if ev in self.events and ev not in self._consumed:
             self._consumed.add(ev)
             self.last_value = self.values.get(ev)
             return True
         return False
+
+    def pending(self, kind: str, site: str,
+                index: int) -> Tuple[bool, Optional[int]]:
+        """(scheduled, value) for an identity-indexed event WITHOUT
+        consuming it. Callers that trigger on their own clock — the
+        router fires ``crash@replica:<r>`` at the replica's value-th
+        busy tick — peek here each tick and consume with ``at_site()``
+        only when their trigger condition is met."""
+        ev = (kind, site, int(index))
+        if ev in self.events and ev not in self._consumed:
+            return True, self.values.get(ev)
+        return False, None
+
+    def at_step(self, kind: str, step: int) -> bool:
+        """True when the plan holds ``kind@step:<step>``. One-shot: a
+        fired event is consumed, so a supervisor rewind that re-executes
+        the step does not re-inject (the fault "happened" once)."""
+        return self.at_site(kind, "step", step)
 
     def has_step_events(self, *kinds: str) -> bool:
         """Does the plan schedule any step-site event of these kinds?

@@ -1,0 +1,649 @@
+"""Fleet serving: a router over N ServingEngine replicas.
+
+One ServingEngine is a replica, not a service: nothing survives the loss
+of an engine, nothing bounds how long a request can wait, and an
+overloaded queue grows without limit. The paper's discipline — drive
+placement from MEASURED behavior of the real machine, not static
+assignment (PAPERS.md "Beyond Data and Model Parallelism") — applies one
+level up: this router routes, sheds and fails over on the live
+``health()``/``load()`` signals each replica already exports.
+
+``ServingRouter`` fronts N replicas, each driven by its own thread:
+
+  * FAILOVER — a replica whose driver thread raises (a crashed engine),
+    that stops heartbeating past ``health_timeout_s`` (a hung dispatch),
+    or whose health probe itself dies is FENCED: its in-flight and
+    engine-queued requests are resubmitted to survivors exactly once.
+    Greedy decode is deterministic and an un-admitted request keeps no
+    cache state (the PR-5 drain/requeue contract), so a resubmitted
+    request re-decodes from scratch on the survivor and its final stream
+    is token-identical to an uninterrupted single-replica run — the dead
+    replica's partial tokens are discarded, never spliced. A request
+    whose SECOND replica also dies fails loudly ("replica lost twice")
+    instead of ping-ponging.
+  * PER-REQUEST DEADLINES — ``submit(..., deadline_s=)``. A request that
+    expires while queued (in the router queue OR a replica's engine
+    queue) retires as ``"timeout"`` without ever prefilling; an expired
+    request found in-flight on a FENCED replica is not resubmitted (the
+    work is already worthless); an admitted request on a healthy replica
+    is never cancelled mid-batch (cancellation would disturb the
+    fixed-shape slot program) — its late completion is delivered and the
+    caller may discard it.
+  * OVERLOAD SHEDDING — the router queue is bounded by ``max_queue``
+    (FFConfig.serve_max_queue; 0 = unbounded). A submit over the bound
+    returns immediately with state ``"rejected"``: excess load fails in
+    microseconds at the front door, so ACCEPTED requests keep a bounded
+    queue wait and the fleet's p99 TTFT stays flat instead of every
+    request sharing an ever-growing backlog (bench `router_serving`
+    measures exactly this).
+  * HEALTH-DRIVEN PLACEMENT — dispatch picks the least-loaded live
+    replica by the same counters ``health()`` exports (active slots +
+    queued work, read via the router's own outstanding ledger plus the
+    engine's lock-free ``load()``), with PREFIX AFFINITY on top: the
+    first full KV page of the prompt (exactly the radix trie's first
+    edge, so equal keys <=> a guaranteed trie hit) is hashed to the
+    replica that last served it. Shared-prompt traffic therefore lands
+    where its prefix pages are already cached instead of re-prefilling
+    the same system prompt on every replica. Affinity is a preference,
+    never a constraint — a fenced or saturated home replica falls back
+    to least-loaded, so affinity can neither black-hole nor starve.
+
+Failure drills are deterministic in CI via FF_FAULT
+(runtime/faultinject.py): ``crash@replica:<r>`` kills replica r's driver
+at its first busy tick (``crash(<t>)@replica:<r>`` at its t-th),
+``hang@replica:<r>`` wedges it until the heartbeat sweep fences it, and
+``slow(<ms>)@serve:<n>`` stalls an engine admission so an in-flight
+deadline expires on cue.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flexflow_tpu.logger import fflogger
+from flexflow_tpu.runtime import faultinject
+
+
+class ReplicaCrash(RuntimeError):
+    """Injected replica loss (FF_FAULT ``crash@replica:<r>``): raised on
+    the replica's driver thread to simulate the whole engine dying
+    mid-dispatch."""
+
+
+@dataclass
+class FleetRequest:
+    """One router-level request and its lifecycle record. The underlying
+    engine Request is replaced wholesale on failover — ``tokens`` always
+    holds ONE replica's complete stream, never a splice."""
+
+    rid: int
+    prompt: np.ndarray              # (S,) int32
+    max_new_tokens: int
+    # absolute time.perf_counter() deadline (None = none)
+    deadline: Optional[float] = None
+    # first full KV page of the prompt (the radix trie's first edge);
+    # None when the prompt is shorter than one page
+    affinity: Optional[Tuple[int, ...]] = None
+    # queued | dispatched | done | failed | timeout | rejected
+    state: str = "queued"
+    replica: int = -1               # current/last replica
+    attempts: int = 0               # dispatches (attempts-1 = failovers)
+    tokens: List[int] = field(default_factory=list)
+    error: str = ""
+    t_submit: float = 0.0
+    ttft: float = 0.0               # router submit -> first token (s)
+    t_done: float = 0.0
+
+    @property
+    def output(self) -> np.ndarray:
+        """prompt + emitted tokens (the generate() shape)."""
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)])
+
+    @property
+    def settled(self) -> bool:
+        return self.state not in ("queued", "dispatched")
+
+
+class ServingRouter:
+    """Route requests over N ServingEngine replicas of one model.
+
+    Each replica runs on its own daemon thread; the lock order is
+    router -> engine, and an engine's lock is only ever taken by its own
+    driver thread (plus warmup/drain when the fleet is quiet), so the
+    two layers can never deadlock. ``submit()``/``run()`` from any
+    thread; ``drain()`` for graceful shutdown, ``close()`` to abandon.
+
+    ``start=False`` builds the fleet without spawning drivers (requests
+    queue, shed and expire deterministically — the test hook);
+    ``start()``/``run()`` bring the drivers up."""
+
+    # the hang detector cannot distinguish a wedged dispatch from a
+    # legitimately long one by wall clock alone, and a COLD tick
+    # compiles its program (seconds, minutes on a real TPU pod) — so the
+    # default timeout is sized for cold compiles. Latency-sensitive
+    # fleets warmup() every replica first, after which a healthy tick is
+    # milliseconds and a tight timeout (the drill tests run 0.5 s) is
+    # meaningful.
+    DEFAULT_HEALTH_TIMEOUT_S = 60.0
+
+    def __init__(self, model, replicas: int = 2,
+                 max_queue: Optional[int] = None,
+                 health_timeout_s: Optional[float] = None,
+                 dispatch_backlog: Optional[int] = None,
+                 start: bool = True, **engine_kwargs):
+        if health_timeout_s is None:
+            health_timeout_s = self.DEFAULT_HEALTH_TIMEOUT_S
+        if replicas < 1:
+            raise ValueError(f"replicas={replicas}: must be >= 1")
+        if health_timeout_s <= 0:
+            raise ValueError(
+                f"health_timeout_s={health_timeout_s}: must be > 0")
+        cfg = model.config
+        self.model = model
+        self.n = int(replicas)
+        self.max_queue = int(max_queue if max_queue is not None
+                             else getattr(cfg, "serve_max_queue", 0))
+        if self.max_queue < 0:
+            raise ValueError(
+                f"max_queue={self.max_queue}: must be >= 0 (0 = unbounded)")
+        self.health_timeout_s = float(health_timeout_s)
+        self.engines = [model.make_serving_engine(**engine_kwargs)
+                        for _ in range(self.n)]
+        self.page_size = self.engines[0].page_size
+        slots = self.engines[0].slots
+        # outstanding-per-replica cap: slots in flight + a short engine
+        # queue so admission can pipeline, but deep backlogs stay in the
+        # ROUTER queue where deadlines expire before dispatch and a
+        # fence requeues cheaply
+        self.dispatch_backlog = int(dispatch_backlog
+                                    if dispatch_backlog is not None
+                                    else slots)
+        self._cap = slots + self.dispatch_backlog
+
+        self._lock = threading.RLock()
+        self._queue: collections.deque = collections.deque()  # FleetRequest
+        # rid -> (FleetRequest, engine Request | None): None until the
+        # replica's driver hands the request to its engine
+        self._outstanding: List[Dict] = [dict() for _ in range(self.n)]
+        self._to_submit: List[collections.deque] = [
+            collections.deque() for _ in range(self.n)]
+        # prefix chunk -> replica that last served it (bounded LRU: the
+        # map must not grow with total distinct-prompt traffic)
+        self._affinity: "collections.OrderedDict" = collections.OrderedDict()
+        self._affinity_cap = 4096
+        self._fenced = [False] * self.n
+        self._fence_reason = [""] * self.n
+        self._heartbeat = [time.monotonic()] * self.n
+        self._busy_ticks = [0] * self.n
+        self._stop = threading.Event()
+        self._draining = False
+        self._next_rid = 0
+        # router counters (stats()): the fleet-level ledger
+        self._submitted = 0
+        self._dispatched = 0
+        self._completed = 0
+        self._failed = 0
+        self._timeouts = 0
+        self._rejected = 0
+        self._fenced_count = 0
+        self._resubmitted = 0
+        self._ttfts = collections.deque(maxlen=4096)
+        self._threads: List[threading.Thread] = []
+        self._started = False
+        if start:
+            self.start()
+
+    # ---- lifecycle ----------------------------------------------------------
+
+    def start(self):
+        """Spawn one driver thread per replica (idempotent)."""
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
+        self._threads = [
+            threading.Thread(target=self._replica_main, args=(r,),
+                             daemon=True, name=f"ff-router-replica-{r}")
+            for r in range(self.n)]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, prompt, max_new_tokens: int,
+               deadline_s: Optional[float] = None) -> FleetRequest:
+        """Queue one request (validated synchronously against replica
+        0's admission rules, so a malformed request raises HERE, not on
+        a driver thread). Over ``max_queue``, returns immediately with
+        state ``"rejected"`` — shedding is a fast status, not an
+        exception, so a loaded front door costs one queue-length check."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens={max_new_tokens}: must be >= 1")
+        eng0 = self.engines[0]
+        bucket = eng0._bucket(prompt.size)
+        if bucket + max_new_tokens > eng0.max_seq_len:
+            raise ValueError(
+                f"bucketed prompt ({bucket}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_seq_len {eng0.max_seq_len}")
+        if deadline_s is not None and deadline_s < 0:
+            raise ValueError(f"deadline_s={deadline_s}: must be >= 0")
+        now = time.perf_counter()
+        affinity = (tuple(int(t) for t in prompt[:self.page_size])
+                    if prompt.size >= self.page_size else None)
+        with self._lock:
+            if self._draining:
+                raise RuntimeError(
+                    "ServingRouter is draining: new requests are not "
+                    "admitted")
+            req = FleetRequest(
+                rid=self._next_rid, prompt=prompt,
+                max_new_tokens=int(max_new_tokens),
+                deadline=(now + deadline_s if deadline_s is not None
+                          else None),
+                affinity=affinity, t_submit=now)
+            self._next_rid += 1
+            self._submitted += 1
+            if self.max_queue and len(self._queue) >= self.max_queue:
+                req.state = "rejected"
+                req.error = f"router queue full ({self.max_queue})"
+                req.t_done = time.perf_counter()
+                self._rejected += 1
+                return req
+            self._queue.append(req)
+        return req
+
+    def run(self, prompts, max_new_tokens: int = 32,
+            deadline_s: Optional[float] = None,
+            timeout: Optional[float] = None) -> List[FleetRequest]:
+        """Submit ``prompts`` and block until every one settles; returns
+        the requests in submission order (rejected/expired included)."""
+        self.start()
+        reqs = [self.submit(p, max_new_tokens, deadline_s=deadline_s)
+                for p in prompts]
+        self.wait(reqs, timeout=timeout)
+        return reqs
+
+    def wait(self, reqs: Optional[List[FleetRequest]] = None,
+             timeout: Optional[float] = None):
+        """Block until ``reqs`` (default: everything outstanding) settle.
+        This is also where fleet-level liveness runs when the caller's
+        thread is the only healthy one left: the hang sweep and the
+        no-survivors check. Brings the drivers up if nobody has yet —
+        only driver threads move queued work, so waiting on an
+        un-started fleet would otherwise spin forever."""
+        self.start()
+        t0 = time.monotonic()
+        while True:
+            with self._lock:
+                self._sweep_hangs_locked()
+                self._fail_if_no_survivors_locked()
+                if reqs is None:
+                    open_work = (bool(self._queue)
+                                 or any(self._outstanding)
+                                 or any(self._to_submit))
+                else:
+                    open_work = any(not r.settled for r in reqs)
+            if not open_work:
+                return
+            if self._stop.is_set():
+                raise RuntimeError(
+                    "router.wait: the router was closed with work still "
+                    "open — close() abandons un-settled requests")
+            if timeout is not None and time.monotonic() - t0 > timeout:
+                raise TimeoutError(
+                    f"router.wait: work still open after {timeout}s "
+                    f"(health: {self.health()})")
+            time.sleep(0.003)
+
+    def warmup(self, prompts, max_new_tokens: int = 4):
+        """Drive ``prompts`` through EVERY replica engine directly
+        (bypassing the router queue) so all replicas compile the same
+        program set before measured traffic: failover traffic onto a
+        survivor then hits only warm programs — the smoke asserts zero
+        survivor recompiles through a mid-flight crash. Call while the
+        fleet is quiet (before submitting routed traffic)."""
+        for eng in self.engines:
+            eng.run([np.asarray(p, np.int32) for p in prompts],
+                    max_new_tokens=max_new_tokens)
+
+    def drain(self) -> Dict:
+        """Graceful fleet shutdown: stop admitting, let the drivers
+        finish everything queued and in flight, stop the threads, drain
+        the surviving engines, return a final stats snapshot."""
+        with self._lock:
+            self._draining = True
+        self.start()    # a start=False fleet still owes its queued work
+        self.wait(None)
+        self.close()
+        for r, eng in enumerate(self.engines):
+            if not self._fenced[r]:
+                eng.drain()
+        snap = self.stats()
+        snap["drained"] = True
+        fflogger.info(
+            "router: drained — %d completed, %d failed, %d timeouts, "
+            "%d rejected; %d fenced, %d resubmitted",
+            snap["completed"], snap["failed"], snap["timeouts"],
+            snap["rejected"], snap["fenced"], snap["resubmitted"])
+        return snap
+
+    def close(self):
+        """Stop the driver threads without waiting for open work (the
+        work stays un-settled); idempotent."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=30)
+
+    # ---- dispatch (router lock held) ----------------------------------------
+
+    def _alive(self) -> List[int]:
+        return [r for r in range(self.n) if not self._fenced[r]]
+
+    def _load(self, r: int) -> int:
+        # the health() counters, via the router's exact outstanding
+        # ledger: dispatched minus settled == active + engine-queued +
+        # assigned-but-not-yet-handed-over (the hand-off deque is a
+        # SUBSET of outstanding — never add the two)
+        return len(self._outstanding[r])
+
+    def _pick_replica_locked(self, req: FleetRequest) -> Optional[int]:
+        alive = self._alive()
+        if not alive:
+            return None
+        if req.affinity is not None:
+            home = self._affinity.get(req.affinity)
+            if home is not None and not self._fenced[home] \
+                    and self._load(home) < self._cap:
+                return home
+        cands = [r for r in alive if self._load(r) < self._cap]
+        if not cands:
+            return None
+        return min(cands, key=lambda r: (self._load(r), r))
+
+    def _dispatch_locked(self):
+        """Assign queued work: expired requests retire as timeout
+        BEFORE placement (never dispatched), the rest go to the affinity
+        home when it is live and has room, else the least-loaded live
+        replica with room. Assignment only moves the request onto the
+        replica's hand-off deque — the driver thread performs the actual
+        engine.submit on its own lock, so dispatch never blocks behind a
+        replica mid-tick."""
+        now = time.perf_counter()
+        while self._queue:
+            req = self._queue[0]
+            if req.deadline is not None and now >= req.deadline:
+                self._queue.popleft()
+                self._finalize_locked(
+                    req, "timeout", "deadline expired in router queue")
+                continue
+            r = self._pick_replica_locked(req)
+            if r is None:
+                return
+            self._queue.popleft()
+            req.state = "dispatched"
+            req.replica = r
+            req.attempts += 1
+            self._dispatched += 1
+            if req.affinity is not None:
+                self._affinity[req.affinity] = r
+                self._affinity.move_to_end(req.affinity)
+                while len(self._affinity) > self._affinity_cap:
+                    self._affinity.popitem(last=False)
+            self._outstanding[r][req.rid] = (req, None)
+            self._to_submit[r].append(req)
+
+    def _finalize_locked(self, req: FleetRequest, state: str,
+                         error: str = ""):
+        req.state = state
+        req.error = error
+        req.t_done = time.perf_counter()
+        if state == "done":
+            self._completed += 1
+            if req.ttft:
+                self._ttfts.append(req.ttft)
+        elif state == "timeout":
+            self._timeouts += 1
+        else:
+            self._failed += 1
+
+    def _fence_locked(self, r: int, reason: str):
+        """Fence replica r: mark it dead, requeue its outstanding work.
+        Exactly-once resubmission: a request is resubmitted only from
+        state "dispatched" on THIS replica, at most once overall
+        (attempts caps at 2), and never after its deadline — an expired
+        in-flight request is already worthless, so it retires as timeout
+        instead of burning survivor capacity."""
+        if self._fenced[r]:
+            return
+        self._fenced[r] = True
+        self._fence_reason[r] = reason
+        self._fenced_count += 1
+        out = self._outstanding[r]
+        self._outstanding[r] = {}
+        self._to_submit[r].clear()
+        now = time.perf_counter()
+        requeued = []
+        for _, (req, _ereq) in sorted(out.items()):
+            if req.state != "dispatched" or req.replica != r:
+                continue
+            if req.deadline is not None and now >= req.deadline:
+                self._finalize_locked(
+                    req, "timeout",
+                    f"deadline expired in flight on fenced replica {r}")
+            elif req.attempts >= 2:
+                self._finalize_locked(
+                    req, "failed",
+                    f"replica lost twice (last: {reason})")
+            else:
+                req.state = "queued"
+                req.replica = -1
+                req.tokens = []   # discard the dead replica's partial
+                #                   stream: the survivor re-decodes the
+                #                   identical greedy tokens from scratch
+                requeued.append(req)
+                self._resubmitted += 1
+        # front of the queue, original order: failover work has waited
+        # longest
+        for req in reversed(requeued):
+            self._queue.appendleft(req)
+        # shared-prefix homes pointing at the corpse re-home on next use
+        for key in [k for k, v in self._affinity.items() if v == r]:
+            del self._affinity[key]
+        fflogger.warning(
+            "router: replica %d FENCED (%s) — %d requests resubmitted, "
+            "%d survivors", r, reason, len(requeued), len(self._alive()))
+        self._fail_if_no_survivors_locked()
+
+    def _sweep_hangs_locked(self):
+        """Fence any replica with outstanding work whose driver has not
+        heartbeaten within health_timeout_s — run by every healthy
+        driver's tick and by wait(), so one wedged replica cannot take
+        the detector down with it."""
+        if not self._started:
+            return
+        now = time.monotonic()
+        for r in range(self.n):
+            if self._fenced[r]:
+                continue
+            if not self._outstanding[r] and not self._to_submit[r]:
+                continue
+            if now - self._heartbeat[r] > self.health_timeout_s:
+                self._fence_locked(
+                    r, f"hang: no heartbeat for {self.health_timeout_s}s")
+
+    def _fail_if_no_survivors_locked(self):
+        if self._started and not self._alive():
+            while self._queue:
+                req = self._queue.popleft()
+                self._finalize_locked(
+                    req, "failed", "no live replicas")
+
+    # ---- the replica driver thread ------------------------------------------
+
+    def _maybe_injected_fault(self, r: int) -> bool:
+        """FF_FAULT fleet drills, checked each busy tick: crash raises
+        ReplicaCrash (the driver's except fences and requeues — the real
+        crash path end to end); hang stops heartbeating and spins until
+        the sweep fences this replica (returns True: exit the driver)."""
+        plan = faultinject.active_plan()
+        scheduled, value = plan.pending("crash", "replica", r)
+        if scheduled and self._busy_ticks[r] >= (value or 1):
+            plan.at_site("crash", "replica", r)
+            raise ReplicaCrash(f"injected crash@replica:{r} "
+                               f"(busy tick {self._busy_ticks[r]})")
+        scheduled, value = plan.pending("hang", "replica", r)
+        if scheduled and self._busy_ticks[r] >= (value or 1):
+            plan.at_site("hang", "replica", r)
+            fflogger.warning(
+                "router: replica %d injected hang — waiting for the "
+                "health sweep to fence it", r)
+            while not self._fenced[r] and not self._stop.is_set():
+                time.sleep(0.005)
+            return True
+        return False
+
+    def _replica_main(self, r: int):
+        eng = self.engines[r]
+        while not self._stop.is_set():
+            with self._lock:
+                if self._fenced[r]:
+                    return
+                self._sweep_hangs_locked()
+                self._dispatch_locked()
+                assigned = []
+                while self._to_submit[r]:
+                    assigned.append(self._to_submit[r].popleft())
+                busy = bool(self._outstanding[r])
+            # heartbeat BEFORE the tick too: the sweep then measures one
+            # tick's duration, not dispatch-wait + tick
+            self._heartbeat[r] = time.monotonic()
+            try:
+                if busy:
+                    self._busy_ticks[r] += 1
+                    if self._maybe_injected_fault(r):
+                        return
+                for req in assigned:
+                    ereq = eng.submit(req.prompt, req.max_new_tokens,
+                                      deadline=req.deadline)
+                    with self._lock:
+                        if self._fenced[r]:     # fenced mid-hand-off
+                            return
+                        self._outstanding[r][req.rid] = (req, ereq)
+                progressed = eng.step() if busy else False
+            except Exception as e:  # noqa: BLE001 — ANY driver/engine
+                #   death is a replica loss; classification happens in
+                #   the fence reason
+                with self._lock:
+                    self._fence_locked(r, f"{type(e).__name__}: {e}")
+                return
+            self._heartbeat[r] = time.monotonic()
+            self._collect(r)
+            if not progressed and not assigned:
+                time.sleep(0.002)   # idle: don't spin the host
+
+    def _collect(self, r: int):
+        """Finalize engine requests that settled on replica r. Runs on
+        r's own driver thread after its step(), so the engine states it
+        reads are final; the router lock makes finalize exactly-once
+        even against a concurrent fence (state must still be
+        "dispatched" and owned by r)."""
+        with self._lock:
+            out = self._outstanding[r]
+            for rid in list(out.keys()):
+                req, ereq = out[rid]
+                if ereq is None or ereq.state in ("queued", "running"):
+                    continue
+                del out[rid]
+                if req.state != "dispatched" or req.replica != r:
+                    continue    # fenced + resubmitted elsewhere: stale
+                if ereq.state == "done":
+                    req.tokens = list(ereq.tokens)
+                    # engine TTFT measures from ENGINE submit; the
+                    # router's adds the dispatch wait
+                    req.ttft = (ereq.t_submit - req.t_submit) + ereq.ttft
+                    self._finalize_locked(req, "done")
+                elif ereq.state == "timeout":
+                    self._finalize_locked(
+                        req, "timeout",
+                        ereq.error or "deadline expired in engine queue")
+                else:
+                    self._finalize_locked(
+                        req, "failed", ereq.error or "engine failure")
+
+    # ---- observability ------------------------------------------------------
+
+    def stats(self) -> Dict:
+        """Fleet ledger + per-replica engine stats. The router counters
+        (fenced, resubmitted, timeouts, rejected) are the failure-drill
+        acceptance surface; TTFT percentiles cover COMPLETED requests
+        and measure router-submit -> first token (queue wait included —
+        that is what shedding bounds)."""
+        with self._lock:
+            ttfts = sorted(self._ttfts)
+
+            def pct(p):
+                if not ttfts:
+                    return 0.0
+                return ttfts[min(len(ttfts) - 1, int(p * len(ttfts)))]
+
+            per_replica = []
+            for r, eng in enumerate(self.engines):
+                row = {"replica": r, "fenced": self._fenced[r],
+                       "fence_reason": self._fence_reason[r],
+                       "outstanding": self._load(r),
+                       **eng.load()}
+                per_replica.append(row)
+            return {
+                "replicas": self.n,
+                "alive": len(self._alive()),
+                "submitted": self._submitted,
+                "dispatched": self._dispatched,
+                "completed": self._completed,
+                "failed": self._failed,
+                "timeouts": self._timeouts,
+                "rejected": self._rejected,
+                "fenced": self._fenced_count,
+                "resubmitted": self._resubmitted,
+                "queued": len(self._queue),
+                "max_queue": self.max_queue,
+                "ttft_p50_ms": round(pct(0.50) * 1e3, 3),
+                "ttft_p99_ms": round(pct(0.99) * 1e3, 3),
+                "affinity_keys": len(self._affinity),
+                "per_replica": per_replica,
+            }
+
+    def health(self) -> Dict:
+        """Cheap fleet probe: never takes an engine lock (per-replica
+        load rides the lock-free ``load()``), so it answers even while
+        every replica is mid-dispatch."""
+        with self._lock:
+            alive = self._alive()
+            open_work = (bool(self._queue) or any(self._outstanding)
+                         or any(self._to_submit))
+            if self._draining:
+                status = "draining" if open_work else "drained"
+            elif not alive:
+                status = "dead"
+            elif open_work:
+                status = "busy"
+            else:
+                status = "idle"
+            return {
+                "status": status,
+                "admitting": not self._draining and bool(alive),
+                "alive": len(alive),
+                "replicas": self.n,
+                "queued": len(self._queue),
+                "outstanding": sum(self._load(r) for r in range(self.n)
+                                   if not self._fenced[r]),
+                "fenced": self._fenced_count,
+                "max_queue": self.max_queue,
+            }

@@ -38,6 +38,12 @@ of it:
     topology changes (docs/resilience.md) — stop admitting, finish the
     in-flight slots, final stats snapshot; queued-but-unadmitted requests
     stay queued for re-submission to the replacement engine.
+  * FLEET-READY: one engine lock serializes every queue/slot/counter
+    mutation so a router (runtime/router.py ServingRouter) can drive
+    each replica from its own thread while other threads submit and
+    probe; ``submit(..., deadline=)`` retires requests that expire while
+    queued as ``"timeout"`` without ever prefilling; ``load()`` is the
+    lock-free dispatch signal.
   * RADIX PREFIX CACHE (RadixPrefixCache): a trie over page-aligned
     prompt token chunks maps each full KV page a finished prefill
     produced to its pool page id, with a per-page refcount of the live
@@ -72,6 +78,7 @@ prompt_pad)`` hold masked bucket-pad garbage, decode tokens append from
 from __future__ import annotations
 
 import math
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -99,7 +106,13 @@ class Request:
     rid: int
     prompt: np.ndarray              # (S,) int32, true (unpadded) prompt
     max_new_tokens: int
-    state: str = "queued"           # queued | running | done | failed
+    state: str = "queued"       # queued | running | done | failed | timeout
+    # absolute time.perf_counter() deadline (None = none): a request that
+    # expires while QUEUED retires as "timeout" without ever prefilling
+    # (no pages, no dispatch); an already-admitted request is never
+    # cancelled mid-batch — cancellation would disturb the fixed-shape
+    # slot program — its late completion is the caller's to discard
+    deadline: Optional[float] = None
     tokens: List[int] = field(default_factory=list)  # emitted tokens
     slot: int = -1
     bucket: int = 0
@@ -474,6 +487,13 @@ class ServingEngine:
         self._programs: Dict = {}
         self._key = jax.random.PRNGKey(seed)
         self._next_rid = 0
+        # ONE engine lock around every queue/slot/counter mutation so a
+        # router can drive this replica from its own thread while other
+        # threads submit(), probe health() or snapshot stats(). Reentrant:
+        # step() holds it across the whole tick (including the device
+        # dispatch) and calls locked helpers underneath — cross-thread
+        # callers simply serialize behind the tick.
+        self._lock = threading.RLock()
         self.recompile_count = 0
         self.decode_steps = 0
         self._occupancy_sum = 0
@@ -485,6 +505,7 @@ class ServingEngine:
         self._submitted = 0
         self._completed = 0
         self._failed = 0
+        self._timeouts = 0      # expired while queued, never dispatched
         self._tokens_emitted = 0
         self._spec_proposed = 0
         self._spec_accepted = 0
@@ -520,14 +541,12 @@ class ServingEngine:
                 f"bucket {self.buckets[-1]}")
         return _pow2_bucket(prompt_len)
 
-    def submit(self, prompt, max_new_tokens: int) -> Request:
-        if self._draining:
-            # the serving-side preemption notice: a draining engine is on
-            # its way down (elastic restart / deploy) — callers must
-            # route new traffic elsewhere, not queue behind a shutdown
-            raise RuntimeError(
-                "ServingEngine is draining: new requests are not admitted "
-                "(health()['status'] exposes this to the router)")
+    def submit(self, prompt, max_new_tokens: int,
+               deadline: Optional[float] = None) -> Request:
+        """Queue one request. ``deadline`` is an absolute
+        ``time.perf_counter()`` instant: a request still queued past it
+        retires as ``"timeout"`` without ever prefilling (an admitted
+        request is never cancelled — see Request.deadline)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -538,16 +557,27 @@ class ServingEngine:
             raise ValueError(
                 f"bucketed prompt ({bucket}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_seq_len {self.max_seq_len}")
-        req = Request(rid=self._next_rid, prompt=prompt,
-                      max_new_tokens=int(max_new_tokens), bucket=bucket,
-                      t_submit=time.perf_counter())
-        self._next_rid += 1
-        self._submitted += 1
-        self._queue.append(req)
+        with self._lock:
+            if self._draining:
+                # the serving-side preemption notice: a draining engine is
+                # on its way down (elastic restart / deploy) — callers
+                # must route new traffic elsewhere, not queue behind a
+                # shutdown
+                raise RuntimeError(
+                    "ServingEngine is draining: new requests are not "
+                    "admitted (health()['status'] exposes this to the "
+                    "router)")
+            req = Request(rid=self._next_rid, prompt=prompt,
+                          max_new_tokens=int(max_new_tokens), bucket=bucket,
+                          deadline=deadline, t_submit=time.perf_counter())
+            self._next_rid += 1
+            self._submitted += 1
+            self._queue.append(req)
         return req
 
     def pending(self) -> bool:
-        return bool(self._queue) or bool(self.active.any())
+        with self._lock:
+            return bool(self._queue) or bool(self.active.any())
 
     def _retire(self, slot: int, state: str, error: str = ""):
         req = self.slot_req[slot]
@@ -802,11 +832,29 @@ class ServingEngine:
 
     # ---- the scheduler loop -------------------------------------------------
 
+    def _expire_queued(self):
+        """Retire queued requests whose deadline has passed as "timeout"
+        — they never prefill, hold no pages and cost no dispatch (the
+        per-request-deadline half of the fleet-router contract: expiring
+        work is dropped at the cheapest possible point)."""
+        now = time.perf_counter()
+        kept: List[Request] = []
+        for req in self._queue:
+            if req.deadline is not None and now >= req.deadline:
+                req.state = "timeout"
+                req.error = "deadline expired while queued"
+                req.t_done = now
+                self._timeouts += 1
+            else:
+                kept.append(req)
+        self._queue = kept
+
     def _admit(self):
         """Move queued requests into free slots: look up the longest
         cached prompt prefix, allocate fresh pages for everything past it
         (copy-on-write — shared pages are never written), prefill the
         tail (bucket-shaped program) and seed the slot."""
+        self._expire_queued()
         while self._queue:
             try:
                 slot = next(i for i in range(self.slots)
@@ -843,6 +891,13 @@ class ServingEngine:
                     # QUEUED with no refcounts or pages held.
                     return
             self._queue.pop(0)
+            # fault injection: FF_FAULT=slow(<ms>)@serve:<n> stalls the
+            # n-th admission host-side — the deterministic slow-replica
+            # drill (a deadline set tighter than <ms> expires while this
+            # request is in flight; the router must NOT resubmit it)
+            if faultinject.active_plan().fire("slow", "serve"):
+                time.sleep((faultinject.active_plan().last_value or 0)
+                           / 1000.0)
             fresh = [self._free_pages.pop() for _ in range(need)]
             if self.prefix_cache is not None:
                 self.prefix_cache.note_admitted(full)
@@ -1062,14 +1117,18 @@ class ServingEngine:
         slot-decode step if any slot is live. Returns whether
         PROGRESSABLE work remains — on a draining engine only live slots
         count (the frozen queue can never be admitted here), so a
-        while-step loop always terminates."""
-        if not self._draining:
-            self._admit()
-        if self.active.any():
-            self._decode_tick()
-        if self._draining:
-            return bool(self.active.any())
-        return self.pending()
+        while-step loop always terminates. Holds the engine lock for the
+        whole tick: concurrent submit()/stats() callers serialize behind
+        it (thread-per-replica routers drive step from one thread, so
+        the tick itself never contends)."""
+        with self._lock:
+            if not self._draining:
+                self._admit()
+            if self.active.any():
+                self._decode_tick()
+            if self._draining:
+                return bool(self.active.any())
+            return self.pending()
 
     def run(self, prompts=None, max_new_tokens: int = 32) -> List[Request]:
         """Submit `prompts` (list of 1-D int32 arrays) and drive the
@@ -1097,12 +1156,19 @@ class ServingEngine:
         the replacement engine; their count rides the snapshot. Idempotent
         — a second drain() finds no live slots and returns the snapshot
         again."""
-        self._draining = True
-        while self.active.any():
-            self._decode_tick()
-        snap = self.stats()
-        snap["drained"] = True
-        snap["queued"] = len(self._queue)
+        with self._lock:
+            self._draining = True
+        while True:
+            # lock per tick, not across the drain: submit() callers get a
+            # prompt RuntimeError instead of blocking on the whole drain
+            with self._lock:
+                if not self.active.any():
+                    break
+                self._decode_tick()
+        with self._lock:
+            snap = self.stats()
+            snap["drained"] = True
+            snap["queued"] = len(self._queue)
         fflogger.info(
             "serving: drained — %d completed, %d failed, %d still queued "
             "(re-submit to the replacement engine), occupancy %.2f, "
@@ -1114,27 +1180,41 @@ class ServingEngine:
         """Cheap liveness/readiness probe for a router: admission status
         plus the load counters a balancer steers by, sliced from the one
         ``stats()`` snapshot so the two probes share every formula and
-        key name. Never compiles or touches the device."""
-        active = int(self.active.sum())
-        if self._draining:
-            # the frozen queue does not hold "draining": those requests
-            # can never be admitted here (they belong to the replacement
-            # engine), so the drain is over when the live slots are
-            status = "draining" if active else "drained"
-        else:
-            status = "busy" if (active or self._queue) else "idle"
-        snap = self.stats()
-        return {
-            "status": status,
-            "admitting": not self._draining,
-            "active_slots": active,
-            "queued": len(self._queue),
-            **{k: snap[k] for k in ("serve_slots", "free_pages",
-                                    "completed", "failed", "occupancy",
-                                    "recompiles", "pages_in_use",
-                                    "kv_pages_shared", "prefix_hit_rate",
-                                    "spec_accept_rate")},
-        }
+        key name. Never compiles or touches the device. Serializes
+        behind a running tick — for a contention-free mid-tick load
+        estimate use ``load()``."""
+        with self._lock:
+            active = int(self.active.sum())
+            if self._draining:
+                # the frozen queue does not hold "draining": those
+                # requests can never be admitted here (they belong to the
+                # replacement engine), so the drain is over when the live
+                # slots are
+                status = "draining" if active else "drained"
+            else:
+                status = "busy" if (active or self._queue) else "idle"
+            snap = self.stats()
+            return {
+                "status": status,
+                "admitting": not self._draining,
+                "active_slots": active,
+                "queued": len(self._queue),
+                **{k: snap[k] for k in ("serve_slots", "free_pages",
+                                        "completed", "failed", "timeouts",
+                                        "occupancy", "recompiles",
+                                        "pages_in_use", "kv_pages_shared",
+                                        "prefix_hit_rate",
+                                        "spec_accept_rate")},
+            }
+
+    def load(self) -> Dict:
+        """Lock-free load snapshot for a router's dispatch loop: active
+        slots + queue depth, read WITHOUT the engine lock so a dispatcher
+        never blocks behind a replica mid-tick. The reads race the owning
+        thread by design — a balancer steering on slightly stale load is
+        correct; a balancer stalled behind every decode dispatch is not."""
+        return {"active_slots": int(self.active.sum()),
+                "queued": len(self._queue)}
 
     # ---- metrics ------------------------------------------------------------
 
@@ -1146,11 +1226,16 @@ class ServingEngine:
         mounted by live requests survive (and stay cached)."""
         if self.prefix_cache is None:
             return 0
-        freed = self.prefix_cache.evict(self.num_pages, pressure=False)
-        self._free_pages.extend(freed)
-        return len(freed)
+        with self._lock:
+            freed = self.prefix_cache.evict(self.num_pages, pressure=False)
+            self._free_pages.extend(freed)
+            return len(freed)
 
     def stats(self) -> Dict:
+        with self._lock:
+            return self._stats_locked()
+
+    def _stats_locked(self) -> Dict:
         pc = self.prefix_cache
         ttfts = sorted(self._ttfts)  # bounded window of completions
 
@@ -1163,6 +1248,7 @@ class ServingEngine:
             "requests": self._submitted,
             "completed": self._completed,
             "failed": self._failed,
+            "timeouts": self._timeouts,
             "tokens_generated": self._tokens_emitted,
             "decode_steps": self.decode_steps,
             "recompiles": self.recompile_count,

@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""CI fleet-router smoke (ci/run_ci.sh `router` tier): 2 ServingEngine
+replicas behind a ServingRouter, 200 requests with skewed shared
+prefixes (80% share a 64-token system prompt), and FF_FAULT
+``crash(<tick>)@replica:0`` felling replica 0 mid-flight. Proves the
+ISSUE-8 acceptance end to end on CPU:
+
+  * every non-expired request completes EXACTLY ONCE — none lost, none
+    duplicated (router ledger == sum of per-engine completions), each
+    resubmitted at most once;
+  * greedy outputs stay token-identical to a solo run through the
+    failover (every resubmitted request is checked, plus a sample);
+  * ZERO warm recompiles on the survivor: failover traffic lands only on
+    programs its warmup already built;
+  * requests that expire while queued retire as "timeout" with zero
+    dispatch (attempts == 0);
+  * a bounded router queue (serve_max_queue) rejects excess load fast
+    while accepted work completes untouched.
+
+Usage: [FF_FAULT=crash(10)@replica:0] python scripts/router_smoke.py [N]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flexflow_tpu._env import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(1)
+
+import numpy as np  # noqa: E402
+
+from flexflow_tpu import FFConfig, FFModel  # noqa: E402
+from flexflow_tpu.models.llama import llama_lm  # noqa: E402
+
+
+def build_model():
+    vocab = 128
+    cfg = FFConfig(batch_size=2, mesh_shape={"data": 1}, serve_slots=4,
+                   kv_page_size=8)
+    ff = FFModel(cfg)
+    _, logits = llama_lm(ff, 2, seq_len=16, hidden=64, layers=1, heads=4,
+                         kv_heads=2, vocab_size=vocab)
+    ff.compile(final_tensor=logits)
+    return ff, vocab
+
+
+def skewed_prompts(rs, vocab, n, system):
+    """80% share the system prompt (interleaved so slots mix shapes)."""
+    prompts = []
+    for i in range(n):
+        if i % 5 < 4:
+            tail = rs.randint(1, vocab, (int(rs.randint(1, 8)),))
+            prompts.append(np.concatenate([system, tail.astype(np.int32)]))
+        else:
+            prompts.append(rs.randint(
+                1, vocab, (int(rs.randint(3, 25)),)).astype(np.int32))
+    return prompts
+
+
+def main():
+    n_requests = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+    fault = os.environ.get("FF_FAULT", "")
+    ff, vocab = build_model()
+    rs = np.random.RandomState(0)
+    system = rs.randint(1, vocab, (64,)).astype(np.int32)  # 8 full pages
+    prompts = skewed_prompts(rs, vocab, n_requests, system)
+
+    # pinned buckets: background -> 32, system-prompt traffic (65..71
+    # tokens) -> 96; 96 + max_new 12 fits max_seq_len 112
+    router = ff.make_serving_router(
+        replicas=2, max_seq_len=112, decode_buckets=[32, 96], start=False)
+    # warm EVERY replica over every program the workload (and its
+    # failover resubmissions) can reach: cold prefill per bucket, the
+    # (96, 8-matched-pages) hit prefill (the first system prompt
+    # publishes, the second hits), and the decode scan. crash@replica is
+    # identity-indexed, so warmup consumes nothing from the fault plan.
+    warm_tail = rs.randint(1, vocab, (3,)).astype(np.int32)
+    router.warmup([rs.randint(1, vocab, (10,)).astype(np.int32),
+                   np.concatenate([system, warm_tail]),
+                   np.concatenate([system, warm_tail + 1])],
+                  max_new_tokens=4)
+    for r, eng in enumerate(router.engines):
+        assert eng.stats()["prefix_hits"] >= 1, \
+            f"replica {r} warmup never ran the hit prefill"
+    warm_compiles = [e.recompile_count for e in router.engines]
+    warm_done = [e.stats()["completed"] for e in router.engines]
+
+    t0 = time.perf_counter()
+    reqs = router.run(prompts, max_new_tokens=12, timeout=1200)
+    dt = time.perf_counter() - t0
+    st = router.stats()
+
+    done = [r for r in reqs if r.state == "done"]
+    resubmitted = [r for r in reqs if r.attempts == 2]
+    print(f"router_smoke: {len(done)}/{n_requests} done in {dt:.1f}s, "
+          f"fenced {st['fenced']}, resubmitted {st['resubmitted']}, "
+          f"survivor prefix hits "
+          f"{router.engines[1].stats()['prefix_hits']}")
+
+    # exactly once, nothing lost, nothing duplicated
+    assert all(r.settled for r in reqs), "requests lost"
+    assert len(done) == n_requests, \
+        f"{n_requests - len(done)} requests did not complete"
+    assert st["completed"] == n_requests
+    engine_done = sum(e.stats()["completed"] - w
+                      for e, w in zip(router.engines, warm_done))
+    assert engine_done == n_requests, (
+        f"engines completed {engine_done} != {n_requests}: a request ran "
+        f"to completion twice (duplicated) or vanished (lost)")
+    assert all(1 <= r.attempts <= 2 for r in reqs), \
+        "a request was resubmitted more than once"
+
+    if "crash" in fault and "@replica:0" in fault:
+        assert st["fenced"] == 1, f"crash fault armed but fenced == " \
+            f"{st['fenced']}"
+        assert st["resubmitted"] >= 1 and resubmitted, \
+            "the crash was supposed to catch work in flight"
+        # the survivor saw failover traffic yet compiled NOTHING new
+        assert router.engines[1].recompile_count == warm_compiles[1], (
+            f"survivor recompile leak: "
+            f"{router.engines[1].recompile_count - warm_compiles[1]} "
+            f"programs built after warmup")
+        print(f"router_smoke: replica 0 crashed mid-flight "
+              f"({st['per_replica'][0]['fence_reason']}); "
+              f"{len(resubmitted)} requests failed over, survivor built "
+              f"0 new programs")
+    else:
+        assert st["fenced"] == 0 and not resubmitted
+        for r, eng in enumerate(router.engines):
+            assert eng.recompile_count == warm_compiles[r], \
+                f"replica {r} recompile leak without any fault"
+
+    # token identity through the failover: every resubmitted request +
+    # a sample of the rest against solo generate
+    for r in resubmitted + done[:: max(1, len(done) // 8)]:
+        solo = ff.generate(r.prompt[None, :], max_new_tokens=12)
+        np.testing.assert_array_equal(
+            np.asarray(r.tokens, np.int32), solo[0, r.prompt.size:],
+            err_msg=f"request {r.rid} (attempts {r.attempts}) diverged "
+                    f"from its solo run")
+    print(f"router_smoke: token identity held for {len(resubmitted)} "
+          f"failed-over + sampled requests")
+
+    deadline_leg(router, rs, vocab, system)
+    shedding_leg(ff, rs, vocab)
+    print("router_smoke: PASSED")
+
+
+def deadline_leg(router, rs, vocab, system):
+    """Expired-while-queued requests retire as timeout with ZERO
+    dispatch; unexpired siblings complete normally on the survivors."""
+    st0 = router.stats()
+    expired = [router.submit(
+        np.concatenate([system, rs.randint(1, vocab, (2,)).astype(np.int32)]),
+        8, deadline_s=0.0) for _ in range(10)]
+    live = [router.submit(
+        np.concatenate([system, rs.randint(1, vocab, (3,)).astype(np.int32)]),
+        8, deadline_s=60.0) for _ in range(10)]
+    router.wait(expired + live, timeout=600)
+    assert [r.state for r in expired] == ["timeout"] * 10
+    assert all(r.attempts == 0 for r in expired), \
+        "an expired-in-queue request was dispatched"
+    assert [r.state for r in live] == ["done"] * 10
+    st = router.stats()
+    assert st["timeouts"] - st0["timeouts"] == 10
+    assert st["dispatched"] - st0["dispatched"] == 10, \
+        "only the live requests may dispatch"
+    print(f"router_smoke[deadline]: 10 expired retired undispatched, "
+          f"10 live completed (fleet p99 TTFT {st['ttft_p99_ms']:.0f} ms)")
+
+
+def shedding_leg(ff, rs, vocab):
+    """A bounded router queue rejects excess load fast; accepted work is
+    untouched and completes exactly once."""
+    router = ff.make_serving_router(replicas=1, serve_slots=2,
+                                    max_seq_len=32, max_queue=8,
+                                    start=False)
+    try:
+        t0 = time.perf_counter()
+        reqs = [router.submit(
+            rs.randint(1, vocab, (int(rs.randint(3, 10)),)).astype(np.int32),
+            4) for _ in range(40)]
+        t_submit = time.perf_counter() - t0
+        shed = [r for r in reqs if r.state == "rejected"]
+        accepted = [r for r in reqs if r.state == "queued"]
+        assert len(accepted) == 8 and len(shed) == 32, \
+            f"{len(shed)} shed of 40 over a queue of 8"
+        assert t_submit < 0.5, \
+            f"40 submits (32 rejections) took {t_submit:.2f}s — not fast"
+        snap = router.drain()
+        assert [r.state for r in accepted] == ["done"] * 8
+        assert snap["completed"] == 8 and snap["rejected"] == 32
+        for r in accepted[::3]:
+            solo = ff.generate(r.prompt[None, :], max_new_tokens=4)
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens, np.int32), solo[0, r.prompt.size:])
+        print(f"router_smoke[shed]: 32/40 rejected in "
+              f"{t_submit * 1e3:.1f} ms total, 8 accepted all completed")
+    finally:
+        router.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -96,6 +96,42 @@ def test_fault_parser_value_grammar():
             FaultPlan.parse(bad)
 
 
+def test_fault_parser_replica_kinds():
+    """The fleet-router drill grammar (ISSUE 8): crash@replica:<r> and
+    hang@replica:<r> are IDENTITY-indexed (index names the replica, 0
+    allowed), peeked with pending() and consumed one-shot with
+    at_site(); slow(<ms>)@serve:<n> rides the existing occurrence
+    counting with the stall milliseconds as its value."""
+    p = FaultPlan.parse(
+        "crash@replica:0,hang@replica:1,slow(250)@serve:3")
+    assert ("crash", "replica", 0) in p.events
+    assert ("hang", "replica", 1) in p.events
+    # pending() peeks without consuming — the router polls it every busy
+    # tick until its own tick counter reaches the trigger
+    assert p.pending("crash", "replica", 0) == (True, None)
+    assert p.pending("crash", "replica", 0) == (True, None)
+    assert p.pending("crash", "replica", 1) == (False, None)
+    assert p.at_site("crash", "replica", 0) and p.last_value is None
+    assert not p.at_site("crash", "replica", 0), "one-shot"
+    assert p.pending("crash", "replica", 0) == (False, None), \
+        "a consumed event is no longer pending"
+    assert p.at_site("hang", "replica", 1)
+    # slow is occurrence-counted on the serve site: fires on the 3rd
+    # admission with the stall parameter
+    assert not p.fire("slow", "serve")
+    assert not p.fire("slow", "serve")
+    assert p.fire("slow", "serve") and p.last_value == 250
+    # a crash trigger tick rides the value grammar: crash(5)@replica:2
+    # = crash replica 2 at its 5th busy tick
+    t = FaultPlan.parse("crash(5)@replica:2")
+    assert t.pending("crash", "replica", 2) == (True, 5)
+    assert t.at_site("crash", "replica", 2) and t.last_value == 5
+    # at_step is the step-site specialization of at_site
+    s = FaultPlan.parse("nan_loss@step:7")
+    assert s.pending("nan_loss", "step", 7) == (True, None)
+    assert s.at_step("nan_loss", 7) and not s.at_step("nan_loss", 7)
+
+
 # ------------------------------------------------- integrity manifest
 
 

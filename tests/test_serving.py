@@ -18,6 +18,8 @@ Correctness anchors:
     many dispatches that stream costs.
 """
 
+import time
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -678,6 +680,98 @@ def test_stats_and_health_expose_pool_observability(ff):
                 "spec_accept_rate"):
         assert key in h, f"health() missing {key}"
     assert h["status"] == "idle"
+
+
+def test_engine_deadline_expires_in_queue_without_dispatch(ff):
+    """submit(deadline=): a request that expires while queued retires as
+    "timeout" at the next tick — no prefill, no pages, no compile (the
+    engine half of the router's per-request-deadline contract). An
+    unexpired sibling is untouched."""
+    eng = ff.make_serving_engine(serve_slots=2, kv_page_size=4,
+                                 max_seq_len=32)
+    now = time.perf_counter()
+    dead = eng.submit(np.arange(1, 6, dtype=np.int32), 4, deadline=now)
+    live = eng.submit(np.arange(1, 7, dtype=np.int32), 4,
+                      deadline=now + 3600.0)
+    free0 = len(eng._free_pages)
+    eng._expire_queued()   # what _admit runs first, without the prefill
+    assert dead.state == "timeout" and "deadline" in dead.error
+    assert dead.tokens == [] and dead.t_done > 0
+    assert live.state == "queued"
+    st = eng.stats()
+    assert st["timeouts"] == 1 and st["requests"] == 2
+    assert eng.recompile_count == 0, "expired work must never compile"
+    assert len(eng._free_pages) == free0, "expired work must hold no pages"
+    assert "timeouts" in eng.health()
+    # load() is the router's lock-free dispatch signal
+    assert eng.load() == {"active_slots": 0, "queued": 1}
+
+
+@pytest.mark.slow  # 18 s; the router drives each replica from its own
+# thread — this pins the one-engine-lock contract under real contention
+def test_engine_thread_safe_under_concurrent_submit(ff):
+    """Concurrent-submit stress: four threads submit while the main
+    thread drives step() — every request completes exactly once, the
+    counters add up, and the page accounting survives (the invariants a
+    torn queue/slot mutation would break)."""
+    import threading
+
+    eng = ff.make_serving_engine(serve_slots=3, kv_page_size=4,
+                                 max_seq_len=64)
+    per_thread, n_threads = 6, 4
+    all_reqs, errs = [], []
+    lock = threading.Lock()
+    done_submitting = threading.Event()
+    barrier = threading.Barrier(n_threads + 1)
+
+    def submitter(seed):
+        rs = np.random.RandomState(seed)
+        barrier.wait()
+        try:
+            for _ in range(per_thread):
+                p = rs.randint(1, VOCAB,
+                               (int(rs.randint(2, 14)),)).astype(np.int32)
+                r = eng.submit(p, int(rs.randint(2, 6)))
+                with lock:
+                    all_reqs.append(r)
+                time.sleep(0.001 * rs.randint(0, 4))
+        except Exception as e:  # noqa: BLE001 — surfaced to the assert
+            with lock:
+                errs.append(e)
+
+    threads = [threading.Thread(target=submitter, args=(60 + i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+
+    def stepper():
+        while not done_submitting.is_set() or eng.pending():
+            if not eng.step():
+                time.sleep(0.001)
+
+    step_thread = threading.Thread(target=stepper)
+    step_thread.start()
+    for t in threads:
+        t.join()
+    done_submitting.set()
+    step_thread.join()
+
+    assert not errs, errs
+    total = per_thread * n_threads
+    assert len(all_reqs) == total
+    assert [r.state for r in all_reqs] == ["done"] * total
+    st = eng.stats()
+    assert st["requests"] == total and st["completed"] == total
+    assert st["failed"] == 0 and st["timeouts"] == 0
+    assert st["free_pages"] + st["kv_pages_cached"] == st["kv_pages"] - 1
+    assert st["prefix_refs_live"] == 0
+    # spot-check token identity through the contention
+    for r in all_reqs[::7]:
+        solo = ff.generate(r.prompt[None, :],
+                           max_new_tokens=r.max_new_tokens)
+        np.testing.assert_array_equal(np.asarray(r.tokens, np.int32),
+                                      solo[0, r.prompt.size:])
 
 
 @pytest.mark.slow  # 7 s; serving CI tier runs the full file
