@@ -99,7 +99,8 @@ TIER_COST_S = {"tiny": 90, "mid": 150, "full": 240, "full_scan": 180,
                "prefix_serving": 150,
                "router_serving": 240,
                "paged_attention": 120,
-               "input_overlap": 90}
+               "input_overlap": 90,
+               "collective_overlap": 120}
 
 # serving tier (runtime/serving.py): 32 mixed-length requests through the
 # continuous-batching engine vs the same requests decoded sequentially
@@ -996,6 +997,115 @@ def _run_overlap_tier(n_dev, backend, dev_kind):
     }
 
 
+def _run_collective_overlap_tier(n_dev, backend, dev_kind):
+    """collective_overlap tier (ISSUE 10): (a) step time + epilogue
+    fraction with overlap_grad_sync (bucketed in-scan grad reduce-scatter
+    + ZeRO-1 sharded update) ON vs OFF, and (b) per-step checkpoint stall
+    at checkpoint_every=1 with async vs sync publishing. On this CPU box
+    the collective numbers are smoke-grade (virtual devices share cores —
+    the overlap win needs real ICI); the checkpoint stall is a genuine
+    host-side measurement either way (the async save moves orbax
+    serialization + manifest hashing + fsync off the step path)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from flexflow_tpu import (ActiMode, FFConfig, FFModel, LossType,
+                              MetricsType, SGDOptimizer)
+    from flexflow_tpu.runtime.checkpoint import (save_checkpoint,
+                                                 wait_pending_saves)
+
+    _phase("build_collective_overlap")
+    batch, accum, steps = 16 * n_dev, 2, 6
+
+    def build(overlap):
+        cfg = FFConfig(batch_size=batch, mesh_shape={"data": n_dev},
+                       grad_accum_steps=accum, overlap_grad_sync=overlap)
+        ff = FFModel(cfg)
+        x = ff.create_tensor([batch, 256], name="x")
+        t = ff.dense(x, 1024, ActiMode.AC_MODE_RELU)
+        t = ff.dense(t, 1024, ActiMode.AC_MODE_RELU)
+        ff.dense(t, 16, name="out")
+        ff.compile(SGDOptimizer(lr=0.01),
+                   LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                   [MetricsType.METRICS_ACCURACY])
+        return ff
+
+    rs = np.random.RandomState(0)
+    bt = {"x": rs.randn(batch, 256).astype(np.float32),
+          "label": rs.randint(0, 16, (batch, 1)).astype(np.int32)}
+
+    def time_steps(ff):
+        ff._run_train_step(bt)  # compile + warm
+        import jax
+
+        jax.block_until_ready(ff._last_loss)
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                ff._run_train_step(bt)
+            jax.block_until_ready(ff._last_loss)
+            dt = (time.perf_counter() - t0) / steps
+            best = dt if best is None or dt < best else best
+        return best
+
+    _phase("time_collective_overlap_off")
+    ff_off = build(False)
+    t_off = time_steps(ff_off)
+    bd_off = ff_off.step_breakdown(batch=bt, iters=2)
+    _phase("time_collective_overlap_on")
+    ff_on = build(True)
+    t_on = time_steps(ff_on)
+    bd_on = ff_on.step_breakdown(batch=bt, iters=2)
+
+    # checkpoint stall: per-step saves at checkpoint_every=1 cadence
+    _phase("time_ckpt_stall")
+
+    def ckpt_wall(async_save):
+        d = tempfile.mkdtemp(prefix="ff_bench_ckpt_")
+        try:
+            t0 = time.perf_counter()
+            for i in range(steps):
+                ff_on._run_train_step(bt)
+                save_checkpoint(ff_on, d, step=i, keep=2,
+                                async_save=async_save)
+            import jax
+
+            jax.block_until_ready(ff_on._last_loss)
+            stepped = time.perf_counter() - t0  # saves still pending OK:
+            # the stall the TRAINING LOOP sees is the quantity measured
+            wait_pending_saves(d)
+            return stepped
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+    wall_sync = ckpt_wall(False)
+    wall_async = ckpt_wall(True)
+    stall_sync_ms = max(wall_sync / steps - t_on, 0.0) * 1e3
+    stall_async_ms = max(wall_async / steps - t_on, 0.0) * 1e3
+    return {
+        "metric": "collective_overlap_step", "tier": "collective_overlap",
+        "value": round(t_on * 1e3, 3), "unit": "ms/step",
+        "vs_baseline": round(t_off / max(t_on, 1e-12), 3),
+        "step_ms_sync_epilogue": round(t_off * 1e3, 3),
+        "epilogue_fraction_on": bd_on.get("epilogue_fraction"),
+        "epilogue_fraction_off": bd_off.get("epilogue_fraction"),
+        "collective_instructions_on": bd_on.get("collective_instructions"),
+        "collective_instructions_off": bd_off.get(
+            "collective_instructions"),
+        "ckpt_stall_ms_sync": round(stall_sync_ms, 3),
+        "ckpt_stall_ms_async": round(stall_async_ms, 3),
+        "backend": backend, "device_kind": dev_kind, "n_devices": n_dev,
+        "config": {"batch": batch, "hidden": 1024,
+                   "grad_accum_steps": accum, "steps": steps,
+                   "overlap_grad_sync": True, "async_checkpointing": True,
+                   "checkpoint_every": 1,
+                   "dispatch_ahead": 0, "host_wait_fraction": 0.0},
+    }
+
+
 def child():
     deadline = float(os.environ.get("FF_BENCH_DEADLINE", "0")) or None
 
@@ -1088,6 +1198,15 @@ def child():
             or deadline - time.time() >= TIER_COST_S["input_overlap"]):
         print(json.dumps(_run_overlap_tier(n_dev, backend, dev_kind)),
               flush=True)
+    # collective_overlap tier: in-graph grad-sync overlap + ZeRO-1 update
+    # step time vs the serial epilogue, and the checkpoint-stall pair
+    # (checkpoint_every=1, async vs sync publish)
+    if "collective_overlap" not in skip and (
+            deadline is None
+            or deadline - time.time() >= TIER_COST_S["collective_overlap"]):
+        print(json.dumps(
+            _run_collective_overlap_tier(n_dev, backend, dev_kind)),
+            flush=True)
     _phase("done")
 
 

@@ -18,7 +18,13 @@
 #               shared-prefix phase (hits, 0 recompiles, no page leaks)
 #   overlap   — host-overlap step engine tests (prefetch pipeline +
 #               dispatch-ahead fit) + a slow-loader smoke asserting
-#               throughput improves and host_wait drops
+#               throughput improves and host_wait drops; plus the
+#               IN-GRAPH overlap drill (ISSUE 10): bucketed grad sync +
+#               ZeRO-1 update pinned vs the serial epilogue, an
+#               async-written manifest-verified checkpoint resuming
+#               bitwise, and — gloo-gated — the same overlapped-sync
+#               training preempted and resumed bitwise across TWO
+#               controller processes
 #   elastic   — elastic-recovery tests (topology-change resume, integrity
 #               manifests, serving drain) + the corruption-injection
 #               resume smoke + a 2-process run killed mid-epoch and
@@ -140,9 +146,22 @@ run_serving() {
 # the sync loop, checkpoint-cursor exactness under prefetch, io_fail
 # retry inside the worker, retrace flatness), then the slow-loader smoke
 # asserting throughput improves and the host_wait fraction drops.
+# In-graph leg (ISSUE 10): the collective-overlap suite (bucketed grad
+# sync + ZeRO-1 pinned numerics, async checkpointing, machine-model
+# hierarchical pricing) and its smoke — local always; the 2-process
+# overlapped-sync preempt/resume-bitwise drill where gloo exists.
 run_overlap() {
   python -m pytest tests/test_overlap.py tests/test_pipeline_loader.py -q
+  python -m pytest tests/test_collective_overlap.py \
+    tests/test_machine_model.py -q
   python scripts/overlap_smoke.py
+  python scripts/collective_overlap_smoke.py
+  if has_gloo; then
+    python scripts/collective_overlap_smoke.py two_process
+  else
+    echo "overlap: no gloo CPU collectives in this jax build —" \
+         "skipping the 2-process overlapped-sync resume drill"
+  fi
 }
 
 # elastic tier: the recovery suite (resume onto fewer devices /

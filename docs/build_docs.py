@@ -32,7 +32,7 @@ PAGES = [("index", os.path.join(ROOT, "README.md"), "Overview"),
          ("serving", os.path.join(DOCS, "serving.md"),
           "Serving (continuous batching, prefix cache, fleet router)"),
          ("performance", os.path.join(DOCS, "performance.md"),
-          "Performance (host overlap, Pallas kernel tier)"),
+          "Performance (host + in-graph overlap, Pallas kernel tier)"),
          ("analysis", os.path.join(DOCS, "analysis.md"),
           "fflint static analysis"),
          ("install", os.path.join(ROOT, "INSTALL.md"), "Install")]

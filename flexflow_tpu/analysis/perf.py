@@ -25,6 +25,16 @@ about what is expensive. Four lints:
       per STAGE op: stage count, microbatches, bubble fraction
       ((n-1)/(m+n-1), GPipe) and per-stage FLOP imbalance when the layer
       count doesn't split evenly.
+  dcn-collective (warning; two-tier meshes only)
+      a PER-LAYER collective crosses a DCN-spanning axis
+      (FFConfig.dcn_mesh_shape / MachineModel.dcn_axes): CONTRACT
+      assigned to a DCN axis psums activations across hosts every layer
+      (fwd + bwd), and a reshard edge whose implied collective crosses a
+      DCN axis pays host bandwidth per layer. Data/STAGE across DCN is
+      the intended hierarchical placement (one grad sync / one boundary
+      hop per step) and is NOT flagged — the search's hierarchical
+      candidates (search/driver.hierarchical_strategy) produce exactly
+      that shape.
 """
 
 from __future__ import annotations
@@ -60,6 +70,43 @@ def check_perf(ctx: AnalysisContext, machine=None) -> List[Violation]:
     out.extend(_check_replicated_weights(ctx, cost))
     out.extend(_check_hbm(ctx, cost))
     out.extend(_check_pipeline(ctx))
+    out.extend(_check_dcn(ctx, cost))
+    return out
+
+
+def _dcn_axes(ctx: AnalysisContext, cost) -> set:
+    """Mesh axes the machine model prices at the DCN tier (host-spanning
+    and actually parallel on this mesh)."""
+    return {ax for ax, hosts in (cost.machine.dcn_axes or {}).items()
+            if int(hosts) > 1 and ctx.mesh_shape.get(ax, 1) > 1}
+
+
+# ---- DCN-crossing per-layer collectives ------------------------------------
+
+def _check_dcn(ctx: AnalysisContext, cost) -> List[Violation]:
+    from flexflow_tpu.parallel.pconfig import CONTRACT
+
+    dcn = _dcn_axes(ctx, cost)
+    out: List[Violation] = []
+    if not dcn:
+        return out
+    for op in ctx.ops:
+        am = ctx.resolutions[op.name].axis_map or {}
+        bad = [ax for ax, d in am.items() if d == CONTRACT and ax in dcn]
+        if not bad:
+            continue
+        out_bytes = op.output_bytes()
+        secs = sum(2.0 * cost.machine.all_reduce_time(
+            out_bytes, ctx.mesh_shape[ax], ax) for ax in bad)
+        out.append(Violation(
+            code="dcn-collective", pass_name="perf", severity="warning",
+            op_name=op.name, est_bytes=out_bytes, est_seconds=secs,
+            message=(f"CONTRACT on DCN-spanning axes {bad}: the output "
+                     f"psum ({_fmt_bytes(out_bytes)}, fwd + bwd mirror) "
+                     f"crosses hosts EVERY layer, est {secs * 1e3:.3f} ms "
+                     f"per step on this machine model — keep contract/TP "
+                     f"inside ICI and put data/STAGE parallelism on the "
+                     f"DCN axes (the hierarchical search candidate)")))
     return out
 
 
@@ -67,6 +114,7 @@ def check_perf(ctx: AnalysisContext, machine=None) -> List[Violation]:
 
 def _check_resharding(ctx: AnalysisContext, cost) -> List[Violation]:
     out: List[Violation] = []
+    dcn = _dcn_axes(ctx, cost)
     for op in ctx.ops:
         am = ctx.resolutions[op.name].axis_map
         for input_idx, t in enumerate(op.inputs):
@@ -88,15 +136,25 @@ def _check_resharding(ctx: AnalysisContext, cost) -> List[Violation]:
                        and ctx.mesh_shape[ax] > 1]
             nbytes = t.volume() * cost.dtype_bytes
             sev = "warning" if nbytes >= RESHARD_WARN_BYTES else "info"
+            crosses_dcn = sorted(set(changed) & dcn)
+            if crosses_dcn:
+                # a per-layer collective at DCN bandwidth is a strategy
+                # bug regardless of size — always worth a warning
+                sev = "warning"
             out.append(Violation(
-                code="reshard", pass_name="perf", severity=sev,
+                code="dcn-collective" if crosses_dcn else "reshard",
+                pass_name="perf", severity=sev,
                 op_name=op.name, est_bytes=nbytes, est_seconds=secs,
                 message=(f"input {input_idx} ({t.name}, "
                          f"{_fmt_bytes(nbytes)}) arrives from {src!r} "
                          f"sharded {_fmt_map(pam)} but this op constrains "
                          f"{_fmt_map(want)} — GSPMD inserts a collective "
                          f"over axes {changed}, est "
-                         f"{secs * 1e3:.3f} ms on this machine model")))
+                         f"{secs * 1e3:.3f} ms on this machine model"
+                         + (f"; axes {crosses_dcn} SPAN HOSTS, so this "
+                            f"per-layer collective runs at DCN bandwidth "
+                            f"— keep it inside ICI (hierarchical "
+                            f"candidate)" if crosses_dcn else ""))))
     # ranked: biggest implied collective first
     out.sort(key=lambda v: -(v.est_bytes or 0))
     return out

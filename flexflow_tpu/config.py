@@ -98,6 +98,24 @@ class FFConfig:
     # all-gathers at use and reduce-scatters the gradient. Param + opt
     # HBM divides by the axis size. "" = off.
     fsdp_axis: str = ""
+    # in-graph compute/communication overlap (runtime/executor.py +
+    # runtime/optimizer.py Zero1Update): reduce each microbatch's
+    # gradients into data-axis-scattered per-op buckets INSIDE the
+    # accumulation scan (the collective for microbatch k overlaps the
+    # backward of microbatch k+1) and run the optimizer update sharded
+    # ZeRO-1 style — each data shard updates its slice of params and
+    # optimizer state from the already-scattered grads, then params
+    # all-gather ONCE. Optimizer-state HBM divides by the data degree;
+    # composes with fsdp_axis (a weight the FSDP axis already shards
+    # keeps its ZeRO-3 layout). No-op on meshes without a data axis > 1.
+    overlap_grad_sync: bool = False
+    # async checkpointing (runtime/checkpoint.py): save_checkpoint
+    # snapshots params to host in-step and runs the atomic tmp-dir +
+    # manifest + publish-rename path on ONE background publisher thread,
+    # so checkpoint_every stops costing step time. The TrainSupervisor
+    # quiesces pending saves at SIGTERM/rewind/final; single-controller
+    # only (multihost saves are collective and stay synchronous).
+    async_checkpointing: bool = False
     # fflint (flexflow_tpu/analysis): static strategy validation inside
     # compile(), after the table is final but before params/programs are
     # built. "warn" logs violations through fflogger; "strict" raises
@@ -356,6 +374,14 @@ class FFConfig:
         p.add_argument("--profiling", action="store_true")
         p.add_argument("--fusion", action="store_true")
         p.add_argument("--num-devices", type=int, default=None)
+        p.add_argument("--overlap-grad-sync", action="store_true",
+                       help="bucketed grad reduce-scatter inside the "
+                            "accumulation scan + ZeRO-1 sharded optimizer "
+                            "update (opt-state HBM / data degree)")
+        p.add_argument("--async-checkpointing", action="store_true",
+                       help="publish checkpoints from a background thread "
+                            "(snapshot in-step, fsync/manifest/rename off "
+                            "the critical path)")
         p.add_argument("--fsdp", dest="fsdp_axis", nargs="?", const="data",
                        default="", metavar="AXIS",
                        help="shard params+optimizer state over AXIS "
@@ -428,6 +454,8 @@ class FFConfig:
             perform_fusion=args.fusion,
             num_devices=args.num_devices,
             mesh_shape=mesh_shape,
+            overlap_grad_sync=args.overlap_grad_sync,
+            async_checkpointing=args.async_checkpointing,
             fsdp_axis=args.fsdp_axis,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,

@@ -597,6 +597,8 @@ class FFModel:
         if self.optimizer is not None:
             if getattr(self.config, "fused_optimizer", False):
                 self.optimizer = self._maybe_fuse_optimizer(self.optimizer)
+            if getattr(cfg, "overlap_grad_sync", False):
+                self.optimizer = self._maybe_shard_optimizer(self.optimizer)
             self.opt_state = self.optimizer.init_state(self.params)
             self._train_step = self.executor.make_train_step(
                 self.optimizer, self.loss_type, self.metric_types,
@@ -678,6 +680,40 @@ class FFModel:
                     return opt
                 specs[op][w] = spec
         return ShardedFusedUpdate(opt, self.mesh, specs)
+
+    def _maybe_shard_optimizer(self, opt):
+        """FFConfig.overlap_grad_sync: pair the bucketed in-scan gradient
+        reduce-scatter with the ZeRO-1 sharded optimizer update
+        (runtime/optimizer.py Zero1Update) — each data shard updates its
+        slice of params/opt-state from the already-scattered grads, then
+        params all-gather once; opt-state HBM divides by the data degree.
+        Falls back (reason logged) under operator placement (no single
+        program sees every param), under a fused optimizer (its flat
+        state layout is already sharded its own way — the in-scan grad
+        buckets still apply), or on a mesh with no data axis > 1."""
+        from flexflow_tpu.logger import fflogger
+        from flexflow_tpu.runtime.optimizer import (FusedUpdate,
+                                                    ShardedFusedUpdate,
+                                                    Zero1Update)
+
+        if getattr(self.executor, "jits_per_group", False):
+            fflogger.warning(
+                "overlap_grad_sync: ZeRO-1 update unsupported under an "
+                "operator-placement strategy — using the unsharded update")
+            return opt
+        if isinstance(opt, (FusedUpdate, ShardedFusedUpdate)):
+            fflogger.warning(
+                "overlap_grad_sync: fused_optimizer already stores flat "
+                "state in its own layout — skipping the ZeRO-1 wrap (the "
+                "in-scan gradient buckets still apply)")
+            return opt
+        scatter = self.executor.grad_scatter_shardings()
+        if not scatter:
+            fflogger.info(
+                "overlap_grad_sync: no data axis > 1 on mesh %s — nothing "
+                "to scatter over", self.config.mesh_shape)
+            return opt
+        return Zero1Update(opt, scatter, self.executor.param_shardings())
 
     # ---------------------------------------------------------- train verbs
 
@@ -1131,6 +1167,23 @@ class FFModel:
         for cb in callbacks:
             cb.on_train_end()
         return self._perf
+
+    def step_breakdown(self, batch: Optional[Dict[str, np.ndarray]] = None,
+                       iters: int = 3) -> Dict[str, float]:
+        """Per-step compute/collective/epilogue breakdown of the compiled
+        train step (runtime/profiler.py step_phase_breakdown): measured
+        full-step and optimizer-epilogue wall time, plus the production
+        program's collective instruction count/bytes — the observability
+        for the in-graph overlap work (is the epilogue actually
+        shrinking?). Merges into ``last_step_breakdown`` alongside fit()'s
+        host-side numbers and returns the merged dict."""
+        from flexflow_tpu.runtime.profiler import step_phase_breakdown
+
+        rows = step_phase_breakdown(self, batch=batch, iters=iters)
+        merged = dict(self.last_step_breakdown or {})
+        merged.update(rows)
+        self.last_step_breakdown = merged
+        return merged
 
     def evaluate(self, batch: Dict[str, np.ndarray]):
         sharded = self.executor.shard_batch(batch)

@@ -38,10 +38,12 @@ policy and mesh-refit side).
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import json
 import re
 import os
+import threading
 from typing import List, Optional
 
 import jax
@@ -306,7 +308,8 @@ def _is_multihost() -> bool:
 
 def save_checkpoint(model, directory: str, step: Optional[int] = None,
                     extra_meta: Optional[dict] = None,
-                    keep: Optional[int] = None) -> str:
+                    keep: Optional[int] = None,
+                    async_save: bool = False) -> str:
     """Save model state. Returns the checkpoint path.
 
     Atomic: orbax writes into ``<directory>/.tmp-step_N``; meta + strategy
@@ -324,153 +327,364 @@ def save_checkpoint(model, directory: str, step: Optional[int] = None,
     checkpoints are topology-free — a restore re-shards onto whatever mesh
     the restoring model compiled with.
 
+    ``async_save`` (FFConfig.async_checkpointing): the host snapshot is
+    still taken on THIS thread before returning — the training loop
+    donates param buffers to the next step, so the D2H copy cannot be
+    deferred (leaf transfers are started asynchronously and collected
+    once) — but everything after it (orbax serialization, manifest
+    hashing, fsync, the publish rename, retention) runs on ONE background
+    publisher thread, so ``checkpoint_every`` stops costing step time.
+    Submissions publish strictly in order; ``wait_pending_saves``
+    quiesces and re-raises the first failure; a publisher slower than the
+    save cadence applies BACKPRESSURE (at most one snapshot queued behind
+    the in-flight publish — the submit blocks rather than growing host
+    memory without bound); the atomicity story is unchanged (a process
+    exit mid-publish leaves a stale tmp dir, never a torn step).
+    Single-controller only — multihost saves are collective and fall
+    back to synchronous with a warning.
+
     Multi-controller (jax.process_count() > 1): arrays are handed to orbax
     as sharded jax.Arrays and EVERY process participates in the save — each
     host writes only its addressable shards (no host gather; a vocab-sharded
     embedding never materializes on one host). All processes must call this
     collectively; process 0 does the rename/prune between the barriers.
     Saving the same step twice overwrites (idempotent)."""
+    directory = os.path.abspath(directory)
+    step = int(step if step is not None else model._step_count)
+    if _is_multihost():
+        if async_save:
+            from flexflow_tpu.logger import fflogger
+
+            fflogger.warning(
+                "async checkpointing is single-controller only (the "
+                "multihost orbax save is collective) — saving step %d "
+                "synchronously", step)
+        return _save_multihost(model, directory, step, extra_meta, keep)
+
+    state = _host_state(model)
+    meta = _build_meta(model, step, with_opt="opt_state" in state,
+                       multihost=False)
+    if extra_meta:
+        meta.update(extra_meta)
+    strategies = dict(model.config.strategies)
+    path = os.path.join(directory, f"step_{step}")
+    if async_save:
+        import functools
+
+        # backpressure: each queued save holds a FULL host snapshot, so a
+        # publisher slower than the save cadence must slow the caller
+        # down (degrading toward a synchronous save), not grow host
+        # memory without bound — at most one snapshot in flight plus the
+        # one being submitted
+        _SAVER.wait_below(directory, 1)
+        _SAVER.submit(directory, step, functools.partial(
+            _publish_step, directory, step, state, meta, strategies, keep))
+        return path
+    _publish_step(directory, step, state, meta, strategies, keep)
+    return path
+
+
+def _host_state(model) -> dict:
+    """Snapshot params / optimizer state / bn stats to host numpy. Every
+    leaf's D2H transfer is STARTED before the first blocking conversion,
+    so the copies overlap instead of serializing leaf by leaf."""
+    state = {"params": _strip_none(model.params)}
+    if model.opt_state is not None:
+        state["opt_state"] = _strip_none(model.opt_state)
+    if model.bn_state:
+        state["bn_state"] = _strip_none(model.bn_state)
+    for leaf in jax.tree_util.tree_leaves(state):
+        try:
+            leaf.copy_to_host_async()
+        except AttributeError:
+            pass  # already host numpy / older array type
+    return jax.tree_util.tree_map(lambda a: np.asarray(a), state)
+
+
+def _build_meta(model, step: int, *, with_opt: bool,
+                multihost: bool) -> dict:
+    """Per-step ff_meta.json: topology + batch math recorded for elastic
+    resume (runtime/elastic.py) — a restart on a different device count
+    reads these to refit the mesh and preserve the global batch via
+    grad-accum adjustment."""
+    meta = {"step": int(step),
+            "mesh_shape": model.config.mesh_shape,
+            "num_devices": int(model.config.num_devices or 0),
+            "process_count": jax.process_count(),
+            "batch_size": int(model.config.batch_size),
+            "grad_accum_steps": int(getattr(model.config,
+                                            "grad_accum_steps", 1)),
+            "multihost": multihost,
+            "loss_type": model.loss_type.name if model.loss_type else None}
+    if with_opt:  # layout only meaningful when state saved
+        meta["opt_layout"] = _opt_layout(model)
+        if meta["opt_layout"] == "sharded_fused":
+            meta["opt_state_shardings"] = _sharded_fused_shardings(model)
+    return meta
+
+
+def _publish_step(directory: str, step: int, state: dict, meta: dict,
+                  strategies: dict, keep: Optional[int]):
+    """The write-and-publish half of a single-controller save: orbax the
+    host state into the tmp dir (retried), then finalize. Runs on the
+    caller's thread for a synchronous save, on the publisher thread for an
+    async one — the inputs are already host-resident snapshots, so it
+    never touches the model or the device."""
     import shutil
 
-    directory = os.path.abspath(directory)
-    step = step if step is not None else model._step_count
-    path = os.path.join(directory, f"step_{step}")
     tmp = os.path.join(directory, f".tmp-step_{step}")
-    multihost = _is_multihost()
-    is_writer = not multihost or jax.process_index() == 0
-    if is_writer:
-        os.makedirs(directory, exist_ok=True)
-        # only the TMP dir is cleared up front (orbax refuses to
-        # overwrite); a pre-existing published step_N stays live until the
-        # new one is ready — clearing it here would lose the checkpoint if
-        # the process dies during the orbax write
-        if os.path.exists(tmp):
-            shutil.rmtree(tmp)
-    if multihost:
-        from jax.experimental import multihost_utils
-
-        multihost_utils.sync_global_devices("ff_ckpt_clean")
-
-    if multihost:
-        prep = _strip_none  # keep sharded jax.Arrays; orbax writes per host
-    else:
-        prep = lambda tree: jax.tree_util.tree_map(
-            lambda a: np.asarray(a), _strip_none(tree))
-    state = {"params": prep(model.params)}
-    if model.opt_state is not None:
-        state["opt_state"] = prep(model.opt_state)
-    if model.bn_state:
-        state["bn_state"] = prep(model.bn_state)
+    os.makedirs(directory, exist_ok=True)
+    # only the TMP dir is cleared up front (orbax refuses to overwrite); a
+    # pre-existing published step_N stays live until the new one is ready
+    # — clearing it here would lose the checkpoint if the process dies
+    # during the orbax write
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
 
     def _save():
         faultinject.maybe_fail("io_fail", "save")
-        if is_writer and os.path.exists(tmp):
+        if os.path.exists(tmp):
             shutil.rmtree(tmp)  # half-written tmp from a failed attempt
         _checkpointer().save(tmp, state)
 
-    if multihost:
-        # the orbax save is COLLECTIVE: a per-host retry would re-enter
-        # it on one process only (different op counts per host -> the
-        # job deadlocks at orbax's internal syncs, or the writer rmtrees
-        # shards peers just wrote). A failed collective save must be
-        # retried collectively by the caller on every host.
-        _save()
-    else:
-        retry(attempts=3, base_delay=0.05, retryable=(OSError,),
-              name="orbax save")(_save)()
+    retry(attempts=3, base_delay=0.05, retryable=(OSError,),
+          name="orbax save")(_save)()
+    _finalize_step_dir(directory, step, meta, strategies, keep)
 
+
+def _save_multihost(model, directory: str, step: int,
+                    extra_meta: Optional[dict], keep: Optional[int]) -> str:
+    """Collective multi-controller save: orbax writes sharded jax.Arrays
+    (each host only its addressable shards), process 0 finalizes between
+    the two global barriers."""
+    import shutil
+
+    path = os.path.join(directory, f"step_{step}")
+    tmp = os.path.join(directory, f".tmp-step_{step}")
+    is_writer = jax.process_index() == 0
     if is_writer:
-        # topology + batch math recorded for elastic resume
-        # (runtime/elastic.py): a restart on a different device count reads
-        # these to refit the mesh and preserve the global batch via
-        # grad-accum adjustment
-        meta = {"step": int(step),
-                "mesh_shape": model.config.mesh_shape,
-                "num_devices": int(model.config.num_devices or 0),
-                "process_count": jax.process_count(),
-                "batch_size": int(model.config.batch_size),
-                "grad_accum_steps": int(getattr(model.config,
-                                                "grad_accum_steps", 1)),
-                "multihost": multihost,
-                "loss_type": model.loss_type.name if model.loss_type else None}
-        if "opt_state" in state:  # layout only meaningful when state saved
-            meta["opt_layout"] = _opt_layout(model)
-            if meta["opt_layout"] == "sharded_fused":
-                meta["opt_state_shardings"] = _sharded_fused_shardings(model)
+        os.makedirs(directory, exist_ok=True)
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("ff_ckpt_clean")
+    state = {"params": _strip_none(model.params)}
+    if model.opt_state is not None:
+        state["opt_state"] = _strip_none(model.opt_state)
+    if model.bn_state:
+        state["bn_state"] = _strip_none(model.bn_state)
+    # the orbax save is COLLECTIVE: a per-host retry would re-enter it on
+    # one process only (different op counts per host -> the job deadlocks
+    # at orbax's internal syncs, or the writer rmtrees shards peers just
+    # wrote). A failed collective save must be retried collectively by the
+    # caller on every host.
+    faultinject.maybe_fail("io_fail", "save")
+    _checkpointer().save(tmp, state)
+    if is_writer:
+        meta = _build_meta(model, step, with_opt="opt_state" in state,
+                           multihost=True)
         if extra_meta:
             meta.update(extra_meta)
-        with open(os.path.join(tmp, "ff_meta.json"), "w") as f:
-            json.dump(meta, f)
-        save_strategies_to_file(os.path.join(tmp, "strategy.txt"),
-                                model.config.strategies)
-        # the manifest is the LAST write into tmp: it covers every other
-        # file (orbax payload, meta, strategy), so a published dir always
-        # carries a complete proof of its own contents
-        write_manifest(tmp)
-        if os.path.exists(path):
-            # same-step overwrite: the old dir must vanish for the rename
-            # (os.replace cannot clobber a non-empty dir). The unprotected
-            # window shrinks to this instant — the complete replacement is
-            # already on disk in tmp, so a kill here leaves tmp salvageable
-            # rather than nothing mid-write
-            shutil.rmtree(path)
-        os.replace(tmp, path)  # the publish point
-        # top-level mirrors (older readers + import_strategy_file): written
-        # atomically too, AFTER the step dir is live
-        mtmp = os.path.join(directory, f".meta.json.tmp-{os.getpid()}")
-        with open(mtmp, "w") as f:
-            json.dump(meta, f)
-        os.replace(mtmp, os.path.join(directory, "meta.json"))
-        stmp = os.path.join(directory, f".strategy.txt.tmp-{os.getpid()}")
-        save_strategies_to_file(stmp, model.config.strategies)
-        os.replace(stmp, os.path.join(directory, "strategy.txt"))
-        if faultinject.active_plan().fire("corrupt_ckpt", "save"):
-            # deterministic bitrot drill: damage the JUST-PUBLISHED payload
-            # (before retention runs, so the intact-preservation rule below
-            # is what keeps an older recoverable step alive)
-            _inject_corruption(path)
-        if keep is not None and keep > 0:
-            steps_sorted = sorted(_step_dirs(directory))
-            doomed = steps_sorted[:-keep]
-
-            # the step THIS call just wrote (and fully hashed in
-            # write_manifest) is intact by construction — don't pay a
-            # second hash pass on the save critical path. The exception is
-            # the corruption drill, whose whole point is that the fresh
-            # step may no longer match its manifest.
-            drill = any(k == "corrupt_ckpt"
-                        for k, _s, _i in faultinject.active_plan().events)
-
-            def _survivor_intact(s: int) -> bool:
-                if s == int(step) and not drill:
-                    return True
-                return verify_step(directory, s)
-
-            # newest-first so an intact newest survivor short-circuits
-            if doomed and not any(_survivor_intact(s)
-                                  for s in reversed(steps_sorted[-keep:])):
-                # every survivor is corrupt/unreadable: deleting the whole
-                # tail would leave NO restorable checkpoint — spare the
-                # newest intact one (retention resumes normally once an
-                # intact step re-enters the survivor window)
-                for s in reversed(doomed):
-                    if verify_step(directory, s):
-                        doomed.remove(s)
-                        from flexflow_tpu.logger import fflogger
-
-                        fflogger.warning(
-                            "checkpoint retention: every surviving step of "
-                            "keep=%d fails verification — keeping intact "
-                            "step %d beyond the retention window", keep, s)
-                        break
-            for old in doomed:
-                shutil.rmtree(os.path.join(directory, f"step_{old}"),
-                              ignore_errors=True)
-    if multihost:
-        from jax.experimental import multihost_utils
-
-        multihost_utils.sync_global_devices("ff_ckpt_done")
+        _finalize_step_dir(directory, step, meta,
+                           dict(model.config.strategies), keep)
+    multihost_utils.sync_global_devices("ff_ckpt_done")
     return path
+
+
+def _finalize_step_dir(directory: str, step: int, meta: dict,
+                       strategies: dict, keep: Optional[int]):
+    """Meta + strategy + manifest into the tmp dir, the publish rename,
+    the top-level mirrors, the corruption drill, and retention — shared by
+    the sync, async, and multihost writer paths."""
+    import shutil
+
+    path = os.path.join(directory, f"step_{step}")
+    tmp = os.path.join(directory, f".tmp-step_{step}")
+    with open(os.path.join(tmp, "ff_meta.json"), "w") as f:
+        json.dump(meta, f)
+    save_strategies_to_file(os.path.join(tmp, "strategy.txt"), strategies)
+    # the manifest is the LAST write into tmp: it covers every other
+    # file (orbax payload, meta, strategy), so a published dir always
+    # carries a complete proof of its own contents
+    write_manifest(tmp)
+    if os.path.exists(path):
+        # same-step overwrite: the old dir must vanish for the rename
+        # (os.replace cannot clobber a non-empty dir). The unprotected
+        # window shrinks to this instant — the complete replacement is
+        # already on disk in tmp, so a kill here leaves tmp salvageable
+        # rather than nothing mid-write
+        shutil.rmtree(path)
+    os.replace(tmp, path)  # the publish point
+    # top-level mirrors (older readers + import_strategy_file): written
+    # atomically too, AFTER the step dir is live
+    mtmp = os.path.join(directory, f".meta.json.tmp-{os.getpid()}")
+    with open(mtmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(mtmp, os.path.join(directory, "meta.json"))
+    stmp = os.path.join(directory, f".strategy.txt.tmp-{os.getpid()}")
+    save_strategies_to_file(stmp, strategies)
+    os.replace(stmp, os.path.join(directory, "strategy.txt"))
+    if faultinject.active_plan().fire("corrupt_ckpt", "save"):
+        # deterministic bitrot drill: damage the JUST-PUBLISHED payload
+        # (before retention runs, so the intact-preservation rule below
+        # is what keeps an older recoverable step alive)
+        _inject_corruption(path)
+    if keep is not None and keep > 0:
+        steps_sorted = sorted(_step_dirs(directory))
+        doomed = steps_sorted[:-keep]
+
+        # the step THIS call just wrote (and fully hashed in
+        # write_manifest) is intact by construction — don't pay a
+        # second hash pass on the save critical path. The exception is
+        # the corruption drill, whose whole point is that the fresh
+        # step may no longer match its manifest.
+        drill = any(k == "corrupt_ckpt"
+                    for k, _s, _i in faultinject.active_plan().events)
+
+        def _survivor_intact(s: int) -> bool:
+            if s == int(step) and not drill:
+                return True
+            return verify_step(directory, s)
+
+        # newest-first so an intact newest survivor short-circuits
+        if doomed and not any(_survivor_intact(s)
+                              for s in reversed(steps_sorted[-keep:])):
+            # every survivor is corrupt/unreadable: deleting the whole
+            # tail would leave NO restorable checkpoint — spare the
+            # newest intact one (retention resumes normally once an
+            # intact step re-enters the survivor window)
+            for s in reversed(doomed):
+                if verify_step(directory, s):
+                    doomed.remove(s)
+                    from flexflow_tpu.logger import fflogger
+
+                    fflogger.warning(
+                        "checkpoint retention: every surviving step of "
+                        "keep=%d fails verification — keeping intact "
+                        "step %d beyond the retention window", keep, s)
+                    break
+        for old in doomed:
+            shutil.rmtree(os.path.join(directory, f"step_{old}"),
+                          ignore_errors=True)
+
+
+# ------------------------------------------------------ async publisher
+
+
+class _AsyncSaver:
+    """ONE background publisher thread for async checkpointing: saves to
+    any directory publish strictly in submission order (step N can never
+    rename after step N+1), pending work is awaitable per directory, and
+    the first failure is re-raised at the next wait — callers treat it
+    exactly like a synchronous save failure. The thread is a daemon: a
+    process exit mid-publish leaves only a stale tmp dir (the publish
+    rename is atomic), never a torn checkpoint; callers that need the
+    save DURABLE (supervisor preempt/final, rewind's intact scan) call
+    ``wait_pending_saves`` first."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._queue: collections.deque = collections.deque()
+        self._active: Optional[str] = None  # directory being published
+        self._errors: List[tuple] = []
+        self._thread: Optional[threading.Thread] = None
+
+    def submit(self, directory: str, step: int, fn):
+        with self._cond:
+            self._queue.append((directory, step, fn))
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="ff-ckpt-publisher", daemon=True)
+                self._thread.start()
+            self._cond.notify_all()
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while not self._queue:
+                    self._cond.wait()
+                directory, step, fn = self._queue.popleft()
+                self._active = directory
+            try:
+                fn()
+            except BaseException as e:  # surfaced at the next wait()
+                from flexflow_tpu.logger import fflogger
+
+                fflogger.error(
+                    "async checkpoint: publishing step %d in %s failed: "
+                    "%s: %s", step, directory, type(e).__name__, e)
+                # drop the traceback chain BEFORE retaining: its frames
+                # reference the publish closure and with it the full
+                # model host snapshot — a retained error must not pin
+                # model-sized memory until someone waits on it
+                e.__traceback__ = None
+                with self._cond:
+                    self._errors.append((directory, step, e))
+            finally:
+                with self._cond:
+                    self._active = None
+                    self._cond.notify_all()
+
+    def _matches(self, d: Optional[str], directory: Optional[str]) -> bool:
+        return directory is None or d == directory
+
+    def pending(self, directory: Optional[str] = None) -> int:
+        with self._cond:
+            return self._pending_locked(directory)
+
+    def wait_below(self, directory: Optional[str], n: int):
+        """Block until fewer than ``n`` matching saves are queued or in
+        flight — the submit-side backpressure primitive. Never raises:
+        retained failures keep surfacing at wait()."""
+        with self._cond:
+            while self._pending_locked(directory) > n:
+                self._cond.wait()
+
+    def _pending_locked(self, directory: Optional[str]) -> int:
+        n = sum(1 for d, _s, _f in self._queue
+                if self._matches(d, directory))
+        if self._active is not None and self._matches(self._active,
+                                                      directory):
+            n += 1
+        return n
+
+    def wait(self, directory: Optional[str] = None):
+        with self._cond:
+            while self._pending_locked(directory) > 0:
+                self._cond.wait()
+            errs = [e for e in self._errors
+                    if self._matches(e[0], directory)]
+            if errs:
+                self._errors = [e for e in self._errors if e not in errs]
+                if len(errs) > 1:
+                    from flexflow_tpu.logger import fflogger
+
+                    fflogger.warning(
+                        "async checkpoint: %d further save failure(s) "
+                        "consumed alongside the one re-raised (each was "
+                        "logged at failure time)", len(errs) - 1)
+                d, s, exc = errs[0]
+                raise RuntimeError(
+                    f"async checkpoint save of step {s} in {d} "
+                    f"failed") from exc
+
+
+_SAVER = _AsyncSaver()
+
+
+def wait_pending_saves(directory: Optional[str] = None):
+    """Quiesce async checkpointing: block until every pending async save
+    (to ``directory``, or anywhere when None) has published, then
+    re-raise the first failure among them. A no-op when nothing is
+    pending — safe to call unconditionally before reading a checkpoint
+    directory the training loop writes asynchronously."""
+    _SAVER.wait(os.path.abspath(directory) if directory else None)
+
+
+def pending_saves(directory: Optional[str] = None) -> int:
+    """Number of async saves still queued or publishing."""
+    return _SAVER.pending(os.path.abspath(directory) if directory else None)
 
 
 def restore_checkpoint(model, directory: str, step: Optional[int] = None,

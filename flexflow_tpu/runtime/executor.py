@@ -188,6 +188,36 @@ class GraphExecutor:
                 for name, ps in wp.items()}
         return out
 
+    def grad_scatter_shardings(self) -> Dict[str, Dict[str, NamedSharding]]:
+        """ZeRO-1 / bucketed-grad-sync layout (FFConfig.overlap_grad_sync):
+        each weight's strategy(+FSDP) sharding with its largest
+        still-unsharded divisible dim ADDITIONALLY split over the data
+        axis — the per-op "bucket" the accumulation scan reduce-scatters
+        gradients into, and the layout the ZeRO-1 optimizer update runs
+        in. A weight the data axis cannot divide (or that FSDP already
+        shards over it, the ZeRO-3 case) keeps its param sharding and
+        rides the plain all-reduce path. Returns {} when the mesh has no
+        data axis > 1 — nothing to scatter over."""
+        n = self.mesh_shape.get("data", 0)
+        if n <= 1:
+            return {}
+        base = self.param_shardings()
+        out: Dict[str, Dict[str, NamedSharding]] = {}
+        for op in self.model.ops:
+            specs = op.weight_specs()
+            if not specs:
+                continue
+            per = {}
+            for spec in specs:
+                ns = base.get(op.name, {}).get(spec.name)
+                if ns is None:
+                    continue
+                per[spec.name] = NamedSharding(
+                    self.mesh, _with_fsdp(ns.spec, spec.shape, "data", n))
+            if per:
+                out[op.name] = per
+        return out
+
     # ---- parameter / state initialization -----------------------------------
 
     def init_params(self, rng_key) -> Dict[str, Dict[str, jnp.ndarray]]:
@@ -351,6 +381,18 @@ class GraphExecutor:
             new_params, new_opt_state = optimizer.update(params, grads, opt_state)
             return new_params, new_opt_state, new_state, loss, mets
 
+        # in-graph grad-sync overlap (FFConfig.overlap_grad_sync): carry
+        # the accumulated grads through the scan in the data-scattered
+        # ZeRO-1 bucket layout instead of the full (replicated /
+        # all-reduced) tree — GSPMD then lowers each microbatch's
+        # cross-data-shard grad reduction to a reduce-scatter whose
+        # collective overlaps the NEXT microbatch's backward, and the
+        # scan epilogue shrinks to the final bucket + the sharded update
+        overlap = (bool(getattr(self.model.config, "overlap_grad_sync",
+                                False))
+                   and self.mesh_shape.get("data", 1) > 1)
+        scatter = self.grad_scatter_shardings() if overlap else {}
+
         def accum_step(params, opt_state, state, batch, rng):
             # gradient accumulation: the global batch splits into `accum`
             # equal microbatches scanned through fwd+bwd with summed grads
@@ -366,16 +408,35 @@ class GraphExecutor:
             micro = {k: v.reshape(accum, v.shape[0] // accum, *v.shape[1:])
                      for k, v in batch.items()}
 
+            def constrain(tree):
+                if not scatter:
+                    return tree
+                from flexflow_tpu.runtime.optimizer import \
+                    apply_tree_shardings
+
+                return apply_tree_shardings(
+                    tree, scatter, jax.lax.with_sharding_constraint)
+
+            def accum_zero(p):
+                # low-precision grads accumulate in f32: summing `accum`
+                # bf16 microbatch grads in bf16 drops low bits on every
+                # add (the scan used to sum in the grad dtype); the f32
+                # carry only lives for the scan's duration
+                dt = jnp.float32 if p.dtype in (jnp.bfloat16,
+                                                jnp.float16) else p.dtype
+                return jnp.zeros(p.shape, dt)
+
             def body(carry, mb_i):
                 g_acc, st = carry
                 mb, i = mb_i
                 (loss, (st, mets)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(
                         params, st, mb, jax.random.fold_in(rng, i))
-                g_acc = jax.tree.map(jnp.add, g_acc, grads)
-                return (g_acc, st), (loss, mets)
+                g_acc = jax.tree.map(
+                    lambda a, g: a + g.astype(a.dtype), g_acc, grads)
+                return (constrain(g_acc), st), (loss, mets)
 
-            zeros = jax.tree.map(jnp.zeros_like, params)
+            zeros = constrain(jax.tree.map(accum_zero, params))
             (g_sum, new_state), (losses, mets) = jax.lax.scan(
                 body, (zeros, state),
                 (micro, jnp.arange(accum, dtype=jnp.int32)))

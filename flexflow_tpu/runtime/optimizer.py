@@ -241,6 +241,92 @@ class ShardedFusedUpdate(Optimizer):
                                 )(params, grads, state)
 
 
+def apply_tree_shardings(tree, shardings, fn, default=None):
+    """Walk a ``{op: {weight: leaf}}`` tree alongside a (possibly partial)
+    matching dict of NamedShardings and apply ``fn(leaf, sharding)`` where
+    a sharding entry exists; leaves without one (tied weights, scalars
+    like the optimizer's step counter) get ``fn(leaf, default)`` when a
+    ``default`` sharding is given, else pass through untouched. ``fn`` is
+    ``jax.device_put`` for eager placement or
+    ``jax.lax.with_sharding_constraint`` inside a traced program — the
+    shared walk behind the ZeRO-1 layout (executor.grad_scatter_shardings
+    consumers)."""
+    def walk(sub, sh):
+        if sub is None:
+            return None
+        if isinstance(sub, dict):
+            return {k: walk(v, sh.get(k) if isinstance(sh, dict) else None)
+                    for k, v in sub.items()}
+        if sh is None or isinstance(sh, dict):
+            return sub if default is None else fn(sub, default)
+        return fn(sub, sh)
+
+    return walk(tree, shardings)
+
+
+class Zero1Update(Optimizer):
+    """ZeRO-1 sharded optimizer update (FFConfig.overlap_grad_sync) — the
+    epilogue half of in-graph grad-sync overlap.
+
+    Wraps any per-leaf optimizer with two sharding layouts: ``scatter``
+    (executor.grad_scatter_shardings — each weight's strategy(+FSDP)
+    sharding with its largest still-unsharded divisible dim additionally
+    split over the DATA axis) and ``gather`` (the model's normal param
+    shardings). ``update`` constrains grads AND params to the scatter
+    layout, runs the inner elementwise update on the 1/N-sized shards,
+    and constrains the new params back: GSPMD lowers the grad constraint
+    to a reduce-scatter (or a no-op when the accumulation scan already
+    delivered scattered buckets) and the return constraint to ONE
+    all-gather per weight — instead of every data replica redundantly
+    updating the full parameter after a full all-reduce. Optimizer STATE
+    is initialized (and therefore persisted across steps) in the scatter
+    layout, so its HBM divides by the data degree.
+
+    Values are bit-for-bit the per-leaf update's: sharding constraints
+    change placement, never operands. The state PYTREE structure is
+    unchanged too, so checkpoints restore across overlap_grad_sync
+    on/off (restore re-initializes state and re-places the saved values
+    leaf by leaf)."""
+
+    def __init__(self, inner: Optimizer, scatter, gather):
+        self.inner = inner
+        self.scatter = scatter  # {op: {weight: NamedSharding}} — ZeRO-1
+        self.gather = gather    # {op: {weight: NamedSharding}} — params
+
+    def __getattr__(self, name):
+        if name == "inner":
+            raise AttributeError(name)
+        return getattr(self.inner, name)
+
+    def init_state(self, params):
+        import jax as _jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        mesh = next(ns.mesh for per in self.scatter.values()
+                    for ns in per.values())
+        # leaves without a scatter entry (the step counter, momentum=None)
+        # commit REPLICATED on the same mesh: a multihost jit refuses a
+        # mix of global-committed moments and a single-device scalar
+        rep = NamedSharding(mesh, P())
+        state = self.inner.init_state(params)
+        return {k: apply_tree_shardings(v, self.scatter, _jax.device_put,
+                                        default=rep)
+                for k, v in state.items()}
+
+    def update(self, params, grads, state):
+        wsc = jax.lax.with_sharding_constraint
+        p = apply_tree_shardings(params, self.scatter, wsc)
+        g = apply_tree_shardings(grads, self.scatter, wsc)
+        s = {k: apply_tree_shardings(v, self.scatter, wsc)
+             for k, v in state.items()}
+        new_p, new_s = self.inner.update(p, g, s)
+        new_p = apply_tree_shardings(new_p, self.gather, wsc)
+        new_s = {k: apply_tree_shardings(v, self.scatter, wsc)
+                 for k, v in new_s.items()}
+        return new_p, new_s
+
+
 class SGDOptimizer(Optimizer):
     def __init__(self, model=None, lr: float = 0.01, momentum: float = 0.0,
                  nesterov: bool = False, weight_decay: float = 0.0,

@@ -296,6 +296,11 @@ class TrainSupervisor:
             cfg, "keep_checkpoints", 3)
         self.rewind_after = (rewind_after if rewind_after is not None
                              else getattr(cfg, "nonfinite_rewind_after", 0))
+        # async checkpointing (runtime/checkpoint.py): periodic saves
+        # publish on the background thread; preempt/final/initial saves
+        # stay synchronous (the caller is about to stop or to read the
+        # directory), and rewind/finalize quiesce pending publishes first
+        self.async_saves = bool(getattr(cfg, "async_checkpointing", False))
         self.watchdog = Watchdog(step_timeout_s if step_timeout_s is not None
                                  else getattr(cfg, "step_timeout_s", 0.0))
         self.faults = faults  # None -> the FF_FAULT env plan, read lazily
@@ -391,16 +396,29 @@ class TrainSupervisor:
     def save(self, reason: str = "periodic") -> Optional[str]:
         """Atomic checkpoint of params/opt/bn + step + RNG + dataloader
         cursors. Skips when the current step is already saved (a preempt
-        right after a periodic save must not write twice)."""
-        from flexflow_tpu.runtime.checkpoint import save_checkpoint
+        right after a periodic save must not write twice). With
+        async_checkpointing, ONLY periodic saves publish asynchronously —
+        a preempt/final/initial save must be durable when this returns,
+        so it quiesces pending publishes and writes synchronously."""
+        from flexflow_tpu.runtime.checkpoint import (save_checkpoint,
+                                                     wait_pending_saves)
 
+        async_ok = self.async_saves and reason == "periodic"
+        if self.async_saves and not async_ok:
+            # a preempt/final/initial save must leave the directory
+            # DURABLE when this returns — quiesce pending publishes even
+            # when the step itself was already (asynchronously) saved,
+            # and never let the synchronous save below race an older
+            # step's pending publish into the same directory
+            wait_pending_saves(self.directory)
         step = self.model._step_count
         if self._last_saved_step == step:
             return None
         extra = self._extra_meta()
         extra["reason"] = reason
         path = save_checkpoint(self.model, self.directory, step=step,
-                               extra_meta=extra, keep=self.keep)
+                               extra_meta=extra, keep=self.keep,
+                               async_save=async_ok)
         self._last_saved_step = step
         COUNTERS["checkpoints_saved"] += 1
         if self.verbose:
@@ -512,8 +530,22 @@ class TrainSupervisor:
     def rewind(self):
         """Divergence recovery: back to the last checkpoint (params, opt
         state, step counter, RNG, dataloader cursors)."""
-        from flexflow_tpu.runtime.checkpoint import latest_intact_step
+        from flexflow_tpu.runtime.checkpoint import (latest_intact_step,
+                                                     wait_pending_saves)
 
+        if self.async_saves:
+            # the rewind target may still be mid-publish on the background
+            # thread — the intact scan must see it published. A STALE
+            # publish failure surfacing here must not abort the recovery
+            # (the failed step is simply absent; the scan below falls back
+            # to the newest step that actually published intact)
+            try:
+                wait_pending_saves(self.directory)
+            except RuntimeError as e:
+                fflogger.warning(
+                    "rewind: a pending async checkpoint save had failed "
+                    "(%s) — rewinding to the newest intact step instead",
+                    e)
         step = latest_intact_step(
             self.directory,
             verify=bool(getattr(self.model.config, "verify_checkpoints",
@@ -664,6 +696,15 @@ class TrainSupervisor:
             # the last periodic/preempt checkpoint stands instead
             if not self.watchdog.fired:
                 self.save(reason="final")
+            if self.async_saves:
+                # drain the publisher even when the final save was skipped
+                # (watchdog abort): pending publishes are pure host-side
+                # IO of already-snapshotted state, safe to wait on — and a
+                # failed one must surface here, not vanish with the thread
+                from flexflow_tpu.runtime.checkpoint import \
+                    wait_pending_saves
+
+                wait_pending_saves(self.directory)
         finally:
             self.close()
         gs = getattr(self.model, "_guard_state", None)

@@ -75,7 +75,17 @@ class CostModel:
                  fsdp_axis: str = ""):
         self.model = model
         self.mesh_shape = dict(mesh_shape)
-        self.machine = machine or MachineModel()
+        if machine is None:
+            # two-tier topology by default: when the model's config names
+            # DCN-spanning axes (FFConfig.dcn_mesh_shape), EVERY cost
+            # consumer — the search, csim's tables, the fflint perf pass —
+            # prices collectives over those axes at the DCN tier without
+            # each caller having to remember to build the machine itself
+            dcn = getattr(getattr(model, "config", None),
+                          "dcn_mesh_shape", None)
+            machine = MachineModel(dcn_axes=dict(dcn)) if dcn \
+                else MachineModel()
+        self.machine = machine
         self.measured = measured or {}  # (op_name, parts) -> seconds (fwd+bwd)
         self.dtype_bytes = dtype_bytes
         # FSDP (FFConfig.fsdp_axis): weights + opt state further shard over
@@ -221,8 +231,8 @@ class CostModel:
                 if d is not None and ax not in sharded_axes:
                     if fsdp and ax == self.fsdp_axis:
                         # FSDP: the gradient over this axis reduce-scatters
-                        # (~half an all-reduce) instead of all-reducing
-                        total += 0.5 * self.machine.all_reduce_time(
+                        # instead of all-reducing
+                        total += self.machine.reduce_scatter_time(
                             wbytes / shard_deg, self.mesh_shape[ax], ax)
                     else:
                         total += self.machine.all_reduce_time(

@@ -294,7 +294,8 @@ def native_optimize(model, cost, mesh_shape: Dict[str, int], budget: int,
                     alpha: float, seed: int,
                     verbose: bool = False,
                     restarts: int = 4) -> Dict[str, ParallelConfig]:
-    from flexflow_tpu.search.driver import data_parallel_strategy
+    from flexflow_tpu.search.driver import (data_parallel_strategy,
+                                            hierarchical_strategy)
 
     cfg = getattr(model, "config", None)
     epp = getattr(cfg, "enable_parameter_parallel", True)
@@ -302,6 +303,18 @@ def native_optimize(model, cost, mesh_shape: Dict[str, int], budget: int,
     prob = get_search_problem(model, cost, mesh_shape, epp, eap)
     init = prob.choices_for(data_parallel_strategy(model, mesh_shape))
     dp_cost = prob.simulate(init)
+    # two-tier machine: the hierarchical ICI/DCN candidate (data/STAGE on
+    # the DCN axes, CONTRACT/TP inside ICI) is a first-class move — it
+    # seeds the chains when it beats flat DP, and it competes with the
+    # annealed winner below either way (the C tables already price its
+    # grad syncs at the DCN tier through op_grad_sync_time)
+    hier_c = hier_cost = None
+    if getattr(cost.machine, "dcn_axes", None):
+        hier_c = prob.choices_for(hierarchical_strategy(
+            model, mesh_shape, cost.machine.dcn_axes, epp, eap))
+        hier_cost = prob.simulate(hier_c)
+        if hier_cost < dp_cost:
+            init = hier_c
     # FSDP shards every weight over the full fsdp mesh axis; a sub-mesh
     # placement cannot hold such a weight, so the annealer must not
     # propose device-block moves (compile would reject its own winner)
@@ -309,6 +322,10 @@ def native_optimize(model, cost, mesh_shape: Dict[str, int], budget: int,
     best_c, best_p, best_cost = prob.mcmc(init, budget, alpha, seed,
                                           restarts=restarts,
                                           allow_place=allow_place)
+    if hier_cost is not None and hier_cost < best_cost:
+        best_c, best_p, best_cost = (hier_c,
+                                     np.zeros(len(prob.ops), np.int32),
+                                     hier_cost)
     if verbose:
         print(f"[search/native] best {best_cost * 1e3:.3f} ms vs DP "
               f"{dp_cost * 1e3:.3f} ms "

@@ -91,6 +91,53 @@ def legal_axis_maps(op, mesh_shape: Dict[str, int],
 
 
 
+def hierarchical_strategy(model, mesh_shape: Dict[str, int],
+                          dcn_axes: Dict[str, int],
+                          enable_parameter_parallel: bool = True,
+                          enable_attribute_parallel: bool = True
+                          ) -> Dict[str, AxisMap]:
+    """First-class ICI/DCN candidate (ROADMAP item 4): place the
+    once-per-step parallelism (data, STAGE) on the DCN-spanning axes and
+    keep the per-layer-collective parallelism (CONTRACT/TP) inside ICI —
+    the hierarchy the two-tier machine model prices but a flat proposal
+    distribution only finds by luck. Per op the candidate is chosen from
+    the op's LEGAL axis maps by a placement score, so the result always
+    simulates, lints, and compiles. ``optimize_strategies`` seeds the
+    anneal with it (and keeps it as a competing ``best``) whenever the
+    machine model declares DCN axes."""
+    from flexflow_tpu.parallel.pconfig import CONTRACT, STAGE
+
+    dcn = {ax for ax, hosts in (dcn_axes or {}).items()
+           if int(hosts) > 1 and mesh_shape.get(ax, 1) > 1}
+    out: Dict[str, AxisMap] = {}
+    for op in model.ops:
+        if isinstance(op, InputOp):
+            continue
+        best, best_score = {}, float("-inf")
+        for am in legal_axis_maps(op, mesh_shape,
+                                  enable_parameter_parallel,
+                                  enable_attribute_parallel):
+            score = 0.0
+            for ax, d in am.items():
+                if d is None:
+                    continue
+                if ax in dcn:
+                    # batch/stage across hosts: one grad sync / boundary
+                    # hop per step. Anything else (CONTRACT psum, a
+                    # sharded non-batch dim's halo/reshard) pays a
+                    # per-layer collective at DCN bandwidth — the
+                    # anti-pattern this candidate exists to avoid.
+                    score += 2.0 if d in (0, STAGE) else -4.0
+                else:
+                    # spend ICI on the model dimensions first
+                    score += (1.5 if d == CONTRACT
+                              else 1.0 if d != 0 else 0.5)
+            if score > best_score:
+                best, best_score = am, score
+        out[op.name] = {ax: d for ax, d in best.items() if d is not None}
+    return out
+
+
 def data_parallel_strategy(model, mesh_shape: Dict[str, int]) -> Dict[str, AxisMap]:
     out = {}
     for op in model.ops:
@@ -181,8 +228,19 @@ def optimize_strategies(model, budget: int = 1000, alpha: float = 0.05,
     # proposal distributions, precomputed once per op
     op_maps = {op.name: legal_axis_maps(op, mesh_shape, epp, eap) for op in ops}
 
-    current = data_parallel_strategy(model, mesh_shape)
-    current_cost = cost.iteration_time(current)
+    # seed candidates: flat data-parallel always; on a two-tier machine
+    # also the hierarchical ICI/DCN candidate. The anneal starts from the
+    # CHEAPER seed, and `best` starts at that seed's cost — best-of-chain
+    # can only improve on it, so the hierarchical structure survives even
+    # a short or unlucky chain (the losing seed costs strictly more and
+    # can never win)
+    seeds = [data_parallel_strategy(model, mesh_shape)]
+    if cost.machine.dcn_axes:
+        seeds.append(hierarchical_strategy(model, mesh_shape,
+                                           cost.machine.dcn_axes, epp, eap))
+    scored = sorted(((cost.iteration_time(s), i, s)
+                     for i, s in enumerate(seeds)), key=lambda t: t[:2])
+    current, current_cost = dict(scored[0][2]), scored[0][0]
     best, best_cost = dict(current), current_cost
     reset_span = min(max(budget // 100, 1), 1000)  # reference model.cc:1673-1677
 

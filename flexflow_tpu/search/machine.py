@@ -88,6 +88,25 @@ class MachineModel:
                   / self.dcn_bw + hosts * self.dcn_latency)
         return t
 
+    def reduce_scatter_time(self, bytes_per_chip: float, axis_size: int,
+                            axis_name: Optional[str] = None) -> float:
+        """Hierarchical ring reduce-scatter — the bucketed grad-sync
+        primitive (FFConfig.overlap_grad_sync) and FSDP's gradient
+        collective: the ring's reduce phase without the all-gather return
+        trip, so each tier costs half an all-reduce's wire time plus the
+        full per-hop latency."""
+        if axis_size <= 1:
+            return 0.0
+        intra, hosts = self._tiers(axis_size, axis_name)
+        t = 0.0
+        if intra > 1:
+            t += ((intra - 1) / intra * bytes_per_chip / (2 * self.ici_bw)
+                  + intra * self.ici_latency)
+        if hosts > 1:
+            t += ((hosts - 1) / hosts * bytes_per_chip / (2 * self.dcn_bw)
+                  + hosts * self.dcn_latency)
+        return t
+
     def all_to_all_time(self, bytes_per_chip: float, axis_size: int,
                         axis_name: Optional[str] = None) -> float:
         if axis_size <= 1:

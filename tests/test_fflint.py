@@ -177,6 +177,56 @@ def test_reshard_ranked_by_bytes():
                for v in notes)
 
 
+def test_dcn_collective_contract_across_hosts():
+    """ISSUE 10: CONTRACT assigned to a DCN-spanning axis psums
+    activations across hosts every layer — the perf pass must name it
+    (and the same strategy on a flat single-host mesh must NOT fire)."""
+    ff = _transformer()
+    ff.config.dcn_mesh_shape = {"data": 2}
+    strategies = {"ffn1_0": ParallelConfig.from_axis_map(
+        3, MESH, {"data": CONTRACT})}
+    rep = analyze(ff, strategies=strategies, mesh_shape=MESH)
+    vs = _find(rep, "dcn-collective")
+    assert any(v.severity == "warning" and v.op_name == "ffn1_0"
+               and "EVERY layer" in v.message for v in vs), vs
+    # flat mesh: same strategy, no DCN axes declared -> no dcn finding
+    ff2 = _transformer()
+    rep2 = analyze(ff2, strategies=strategies, mesh_shape=MESH)
+    assert "dcn-collective" not in _codes(rep2)
+
+
+def test_dcn_collective_reshard_across_hosts():
+    """A per-layer reshard whose implied collective crosses a DCN axis is
+    escalated to a warning and renamed dcn-collective, however small."""
+    ff = _transformer()
+    ff.config.dcn_mesh_shape = {"data": 2}
+    strategies = {
+        "ffn1_0": ParallelConfig.from_axis_map(3, MESH, {"data": 2}),
+        "ffn2_0": ParallelConfig.from_axis_map(3, MESH, {"data": 0}),
+    }
+    rep = analyze(ff, strategies=strategies, mesh_shape=MESH)
+    vs = _find(rep, "dcn-collective")
+    assert any("SPAN HOSTS" in v.message and v.severity == "warning"
+               for v in vs), vs
+
+
+def test_hierarchical_candidate_lints_clean_of_dcn_findings():
+    """The search's own hierarchical candidate (data on DCN, TP inside
+    ICI) must produce ZERO dcn-collective findings — the lint and the
+    candidate generator agree on what a good two-tier strategy is."""
+    from flexflow_tpu.search.driver import hierarchical_strategy
+
+    ff = _transformer()
+    ff.config.dcn_mesh_shape = {"data": 2}
+    hier = hierarchical_strategy(ff, MESH, {"data": 2})
+    strategies = {
+        name: ParallelConfig.from_axis_map(
+            ff.get_op_by_name(name).outputs[0].num_dims, MESH, am)
+        for name, am in hier.items()}
+    rep = analyze(ff, strategies=strategies, mesh_shape=MESH)
+    assert "dcn-collective" not in _codes(rep), rep.codes()
+
+
 def test_replicated_weight_no_fsdp(monkeypatch):
     import flexflow_tpu.analysis.perf as perf
 

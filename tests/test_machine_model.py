@@ -1,0 +1,163 @@
+"""Hierarchical ICI/DCN pricing in search/machine.py (ISSUE 10 satellite):
+a collective over a DCN-spanning axis must decompose EXACTLY into the
+intra-host ICI leg plus the cross-host DCN leg — and the axis->tier map
+must round-trip from FFConfig.dcn_mesh_shape into every cost consumer
+(CostModel default machine, csim tables, the fflint perf pass) without
+each caller rebuilding it.
+
+Composition laws tested (b = bytes/chip, axis 8 = 4 chips/host x 2 hosts):
+  all-reduce:      AR(b, 8, dcn) == AR(b, 4, ici) + AR(b, 2, pure-dcn)
+  reduce-scatter:  RS(b, 8, dcn) == RS(b, 4, ici) + RS(b, 2, pure-dcn)
+  all-gather:      AG(b, 8, dcn) == AG(b, 4, ici) + AG(4b, 2, pure-dcn)
+                   (each host forwards its intra-GATHERED 4b part)
+"""
+
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel
+from flexflow_tpu.search.cost_model import CostModel
+from flexflow_tpu.search.machine import MachineModel
+
+B = 64 * 1024 * 1024  # bytes per chip
+
+
+def _machines():
+    """(hierarchical over 'data', pure-ICI, pure-DCN-only helper)."""
+    hier = MachineModel(dcn_axes={"data": 2})
+    ici = MachineModel()
+    dcn = MachineModel(dcn_axes={"x": 2})  # axis 'x' size 2 => hosts-only
+    return hier, ici, dcn
+
+
+def test_all_reduce_composes_ici_plus_dcn():
+    hier, ici, dcn = _machines()
+    composed = hier.all_reduce_time(B, 8, "data")
+    assert composed == pytest.approx(
+        ici.all_reduce_time(B, 4, None) + dcn.all_reduce_time(B, 2, "x"),
+        rel=1e-12)
+
+
+def test_reduce_scatter_composes_ici_plus_dcn():
+    hier, ici, dcn = _machines()
+    composed = hier.reduce_scatter_time(B, 8, "data")
+    assert composed == pytest.approx(
+        ici.reduce_scatter_time(B, 4, None)
+        + dcn.reduce_scatter_time(B, 2, "x"), rel=1e-12)
+    # the reduce-scatter is the ring's reduce phase: strictly cheaper than
+    # the full all-reduce over the same axis, and more than a third of it
+    ar = hier.all_reduce_time(B, 8, "data")
+    assert ar / 3 < composed < ar
+
+
+def test_all_gather_composes_ici_plus_dcn():
+    hier, ici, dcn = _machines()
+    composed = hier.all_gather_time(B, 8, "data")
+    # the DCN leg moves the intra-gathered 4b parts between hosts
+    assert composed == pytest.approx(
+        ici.all_gather_time(B, 4, None) + dcn.all_gather_time(4 * B, 2, "x"),
+        rel=1e-12)
+
+
+def test_dcn_axis_only_applies_to_named_axis():
+    hier, ici, _ = _machines()
+    for fn in ("all_reduce_time", "all_gather_time", "reduce_scatter_time",
+               "all_to_all_time"):
+        assert getattr(hier, fn)(B, 8, "model") == pytest.approx(
+            getattr(ici, fn)(B, 8, "model")), fn
+
+
+def test_degenerate_host_count_clamps_to_divisor():
+    """dcn_axes hosts that don't divide the axis clamp to the nearest
+    divisor instead of mis-pricing (the _tiers contract)."""
+    m = MachineModel(dcn_axes={"data": 3})
+    assert m._tiers(8, "data") == (4, 2)
+    m2 = MachineModel(dcn_axes={"data": 16})
+    assert m2._tiers(8, "data") == (1, 8)  # clamped to the axis size
+
+
+def test_size_one_axis_costs_zero():
+    m = MachineModel(dcn_axes={"data": 2})
+    assert m.reduce_scatter_time(B, 1, "data") == 0.0
+    assert m.all_reduce_time(B, 1, "data") == 0.0
+
+
+def _tiny_model(**cfg_kw):
+    cfg = FFConfig(batch_size=32, **cfg_kw)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([32, 64], name="x")
+    t = ff.dense(x, 256, name="fc1")
+    t = ff.relu(t, name="r")
+    ff.dense(t, 8, name="head")
+    return ff
+
+
+def test_dcn_mesh_shape_roundtrips_into_cost_model():
+    """FFConfig.dcn_mesh_shape -> CostModel's DEFAULT machine: every cost
+    consumer that builds a CostModel without an explicit machine (the
+    search, csim's tables, fflint's perf pass) prices the axis at the DCN
+    tier."""
+    ff = _tiny_model(mesh_shape={"data": 8}, dcn_mesh_shape={"data": 2})
+    cost = CostModel(ff, ff.config.mesh_shape)
+    assert cost.machine.dcn_axes == {"data": 2}
+    flat = CostModel(ff, ff.config.mesh_shape, machine=MachineModel())
+    op = ff.get_op_by_name("fc1")
+    dp = {"data": 0}
+    assert cost.op_grad_sync_time(op, dp) > flat.op_grad_sync_time(op, dp)
+    # an explicit machine always wins over the config default
+    assert flat.machine.dcn_axes == {}
+
+
+def test_dcn_mesh_shape_roundtrips_into_search_tables():
+    """csim's CompiledSearchProblem reads the grad-sync costs from the
+    same CostModel — a DCN-priced table row must exceed the flat one."""
+    from flexflow_tpu.search.csim import CompiledSearchProblem
+
+    ff = _tiny_model(mesh_shape={"data": 8}, dcn_mesh_shape={"data": 2})
+    mesh = ff.config.mesh_shape
+    hier = CompiledSearchProblem(ff, CostModel(ff, mesh), mesh)
+    flat = CompiledSearchProblem(
+        ff, CostModel(ff, mesh, machine=MachineModel()), mesh)
+    assert hier.op_sync_costs.max() > flat.op_sync_costs.max()
+
+
+def test_hierarchical_strategy_shape():
+    """driver.hierarchical_strategy: data parallelism lands on the DCN
+    axis, contract/TP stays inside ICI, and every per-op map is drawn
+    from the op's legal set (so it simulates and compiles)."""
+    from flexflow_tpu.parallel.pconfig import CONTRACT
+    from flexflow_tpu.search.driver import (hierarchical_strategy,
+                                            legal_axis_maps)
+
+    ff = _tiny_model(mesh_shape={"data": 4, "model": 2},
+                     dcn_mesh_shape={"data": 2})
+    mesh = ff.config.mesh_shape
+    hier = hierarchical_strategy(ff, mesh, {"data": 2})
+    for name, am in hier.items():
+        assert am.get("data") in (0, None), (name, am)
+        assert am.get("model") != 0 or am.get("data") is None, (name, am)
+    # the weighted ops spend ICI on the model dimension
+    assert hier["fc1"].get("model") in (CONTRACT, 1)
+    # membership in the legal set
+    for op in ff.ops:
+        if op.name in hier:
+            legal = [{ax: d for ax, d in m.items() if d is not None}
+                     for m in legal_axis_maps(op, mesh)]
+            assert hier[op.name] in legal, op.name
+
+
+def test_search_runs_with_dcn_machine():
+    """optimize_strategies on a two-tier machine returns a legal strategy
+    table whose simulated cost is no worse than flat data-parallel."""
+    from flexflow_tpu.search.driver import (data_parallel_strategy,
+                                            optimize_strategies)
+
+    ff = _tiny_model(mesh_shape={"data": 4, "model": 2},
+                     dcn_mesh_shape={"data": 2})
+    mesh = ff.config.mesh_shape
+    machine = MachineModel(dcn_axes={"data": 2})
+    best = optimize_strategies(ff, budget=150, mesh_shape=mesh,
+                               machine=machine, seed=0, use_native=False)
+    cost = CostModel(ff, mesh, machine=machine)
+    best_am = {k: v.axis_map or {} for k, v in best.items()}
+    assert cost.iteration_time(best_am) <= cost.iteration_time(
+        data_parallel_strategy(ff, mesh)) * (1 + 1e-9)
