@@ -36,6 +36,15 @@
 #               code), the block autotuner suite, and a tune-then-
 #               consume smoke that writes and re-reads a real on-disk
 #               autotune table
+#   quant     — quantized serving tier: int8/fp8 KV pages (per-page-per-
+#               head scales, in-kernel dequant) + weight-only int8/fp8
+#               suites (scale round-trip, per-channel regression vs
+#               per-tensor, pallas/einsum parity + token identity on
+#               quantized pools, COW with quantized pages, divergence
+#               budget vs full-width) + a serve-smoke leg running the
+#               skewed shared-prefix workload on a bf16/int8 engine
+#               pair — hit rate and zero warm-window recompiles must
+#               match across dtypes
 #   router    — fleet-router tier: the multi-replica ServingRouter suite
 #               (failover exactly-once + token identity incl. prefix
 #               cache + speculation, deadline/shedding/affinity
@@ -45,7 +54,7 @@
 #               exactly once, zero lost/duplicated, zero warm recompiles
 #               on the survivor
 #
-# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|kernels|router|all]
+# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|kernels|quant|router|all]
 set -e
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -191,6 +200,15 @@ run_kernels() {
   python scripts/kernel_tune_smoke.py
 }
 
+# quant tier: the quantized-serving suite (slow-marked engine pairs
+# included — pytest -q runs the whole file), then the bf16/int8
+# serve-smoke pair on the skewed shared-prefix workload: identical hit
+# counts, zero warm-window recompiles on both, ~2x tokens-per-pool-GB.
+run_quant() {
+  python -m pytest tests/test_quantized_serving.py -q
+  python scripts/serve_smoke.py 120 quant
+}
+
 # router tier: the fleet suite (failover/deadline/shedding/affinity +
 # the concurrent-submit engine stress in test_serving), then the
 # 2-replica smoke under a deterministic mid-flight crash of replica 0
@@ -216,8 +234,9 @@ case "$TIER" in
   overlap)  run_overlap ;;
   elastic)  run_elastic ;;
   kernels)  run_kernels ;;
+  quant)    run_quant ;;
   router)   run_router ;;
-  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_kernels; run_router; run_native; run_docs; run_sweep ;;
+  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_kernels; run_quant; run_router; run_native; run_docs; run_sweep ;;
   *) echo "unknown tier $TIER"; exit 2 ;;
 esac
 echo "ci($TIER): PASSED"

@@ -30,7 +30,8 @@ PAGES = [("index", os.path.join(ROOT, "README.md"), "Overview"),
          ("resilience", os.path.join(DOCS, "resilience.md"),
           "Fault tolerance & elastic recovery"),
          ("serving", os.path.join(DOCS, "serving.md"),
-          "Serving (continuous batching, prefix cache, fleet router)"),
+          "Serving (continuous batching, prefix cache, fleet router, "
+          "quantized tier)"),
          ("performance", os.path.join(DOCS, "performance.md"),
           "Performance (host + in-graph overlap, Pallas kernel tier)"),
          ("analysis", os.path.join(DOCS, "analysis.md"),

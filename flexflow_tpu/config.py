@@ -229,11 +229,44 @@ class FFConfig:
     # door. 0 = unbounded (the pre-router behavior: the queue grows with
     # the backlog and every request's tail latency grows with it).
     serve_max_queue: int = 0
+    # ---- quantized serving tier (ISSUE 11) ----
+    # storage dtype of the paged KV pool (runtime/serving.py):
+    #   "native" — the compute dtype (float32/bfloat16), the pre-quant
+    #              behavior
+    #   "bf16"   — store pages in bfloat16 regardless of compute dtype
+    #              (plain cast, no scales): halves an f32 pool
+    #   "int8"   — symmetric int8 pages with per-page-per-kv-head f32
+    #              scales stored alongside the pool; ~2x the tokens per
+    #              pool byte vs bf16. Dequantization happens in VMEM —
+    #              inside the Pallas paged-attention kernel, or fused
+    #              into the einsum gather — so wide KV is never
+    #              materialized in HBM.
+    #   "fp8"    — float8_e4m3fn pages, same scale layout (needs a jax
+    #              build with jnp.float8_e4m3fn; validated at engine
+    #              construction, not here, so config objects stay
+    #              backend-free)
+    # The page allocator, COW rule, radix trie, router affinity and
+    # speculation are page-granular and unchanged — a page simply holds
+    # more tokens per byte, multiplying prefix-cache capacity and
+    # slots-per-chip at fixed HBM. Quantized KV is lossy: greedy streams
+    # carry a per-dtype divergence budget vs the full-width path
+    # (docs/serving.md "Quantized tier").
+    kv_cache_dtype: str = "native"
+    # serving-weight storage for the fixed-shape decode/prefill programs
+    # (runtime/generation.py weight-only quantization, promoted to a
+    # first-class serving mode): "native" | "int8" | "fp8". Quantization
+    # happens ONCE at engine init (per-output-channel scales); dequant
+    # fuses into each consuming matmul, so the HBM weight read per decode
+    # step — the decode bottleneck — is the quantized bytes.
+    serve_weight_dtype: str = "native"
     # decode/verify attention over the paged KV pool:
     #   "auto"   — Pallas paged-attention kernel on a TPU backend (page-
     #              table lookup inside the kernel, only a slot's live
     #              pages stream through VMEM), einsum page-gather
-    #              elsewhere
+    #              elsewhere; a measured winner persisted by
+    #              search/kernel_tune.py tune_paged_attention for this
+    #              engine's exact shape+dtype overrides the backend
+    #              default (measured costs beat heuristics)
     #   "pallas" — force the kernel everywhere (interpret mode off-TPU,
     #              so CPU CI executes the real kernel code path)
     #   "einsum" — force the page-gather oracle (bitwise the dense-cache
@@ -317,6 +350,15 @@ class FFConfig:
             raise ValueError(
                 f"paged_attention_impl={self.paged_attention_impl!r}: "
                 f"must be 'auto', 'pallas' or 'einsum'")
+        if self.kv_cache_dtype not in ("native", "bf16", "int8", "fp8"):
+            raise ValueError(
+                f"kv_cache_dtype={self.kv_cache_dtype!r}: must be "
+                f"'native', 'bf16', 'int8' or 'fp8' (exact spelling — a "
+                f"typo here would silently serve the wrong KV precision)")
+        if self.serve_weight_dtype not in ("native", "int8", "fp8"):
+            raise ValueError(
+                f"serve_weight_dtype={self.serve_weight_dtype!r}: must "
+                f"be 'native', 'int8' or 'fp8'")
         if self.decode_buckets is not None:
             bs = list(self.decode_buckets)
             if not bs or any(int(b) < 1 for b in bs) \
@@ -423,6 +465,16 @@ class FFConfig:
                        help="decode attention over the paged pool: "
                             "Pallas kernel vs einsum page-gather "
                             "(auto = pallas on TPU)")
+        p.add_argument("--kv-cache-dtype", type=str, default="native",
+                       choices=("native", "bf16", "int8", "fp8"),
+                       help="paged KV pool storage dtype (int8/fp8: "
+                            "per-page-per-head scales, in-kernel "
+                            "dequant; 2-4x tokens per pool byte)")
+        p.add_argument("--serve-weight-dtype", type=str, default="native",
+                       choices=("native", "int8", "fp8"),
+                       help="serving weight storage (weight-only "
+                            "quantization with per-output-channel "
+                            "scales, quantized once at engine init)")
         # e.g. --mesh data=4,model=2 (replaces -ll:gpu device-count knobs)
         p.add_argument("--mesh", type=str, default="")
         args, _ = p.parse_known_args(argv)
@@ -469,4 +521,6 @@ class FFConfig:
             serve_speculate_k=args.serve_speculate_k,
             serve_max_queue=args.serve_max_queue,
             paged_attention_impl=args.paged_attention_impl,
+            kv_cache_dtype=args.kv_cache_dtype,
+            serve_weight_dtype=args.serve_weight_dtype,
         )

@@ -1282,10 +1282,16 @@ class FFModel:
         requests (copy-on-write; on by default), and a draft model
         (``draft_model=`` + ``speculate_k=``) enables speculative
         decoding — token-identical greedy output, several tokens per
-        verify dispatch. Knobs default to this model's FFConfig
+        verify dispatch. The quantized serving tier
+        (``kv_cache_dtype="int8"|"fp8"|"bf16"`` and
+        ``weight_dtype="int8"|"fp8"``) stores KV pages and/or weights
+        narrow with in-kernel dequant: 2-4x the tokens per pool byte at
+        a documented per-dtype divergence budget (docs/serving.md
+        "Quantized tier"). Knobs default to this model's FFConfig
         (serve_slots, kv_page_size, kv_pages, decode_buckets,
-        serve_prefix_cache, serve_speculate_k, draft_model); kwargs
-        override per engine (see ServingEngine)."""
+        serve_prefix_cache, serve_speculate_k, draft_model,
+        kv_cache_dtype, serve_weight_dtype); kwargs override per engine
+        (see ServingEngine)."""
         from flexflow_tpu.runtime.serving import ServingEngine
 
         return ServingEngine(self, **kwargs)

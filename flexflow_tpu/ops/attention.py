@@ -64,6 +64,76 @@ def resolve_paged_attention_impl(impl=None, config=None) -> str:
     return impl
 
 
+#: quantized KV-page storage (ISSUE 11): the paged pool stores int8 or
+#: fp8 payload with one f32 scale per (page, kv head), so each page holds
+#: 2-4x more tokens per HBM byte — the allocator, COW rule, radix trie
+#: and router affinity are page-granular and never look inside a page.
+#: Dequantization happens where the data is consumed (inside the Pallas
+#: kernel's VMEM tiles, or fused into the einsum gather); wide KV is
+#: never materialized in HBM.
+
+
+def kv_storage_dtype(kv_dtype):
+    """Resolve an FFConfig.kv_cache_dtype value to ``(storage_dtype,
+    qmax)``. ``(None, None)`` = native (the compute dtype); a non-None
+    dtype with ``qmax=None`` (bf16) is a plain cast — no scales; a qmax
+    means symmetric scale quantization with per-page-per-head scales.
+    Raises on unknown values and on 'fp8' under a jax build without
+    ``jnp.float8_e4m3fn`` (the no-new-deps gate: fail loudly at engine
+    construction, never on a silent fallback)."""
+    if kv_dtype in (None, "", "native"):
+        return None, None
+    if kv_dtype in ("bf16", "bfloat16"):
+        return jnp.bfloat16, None
+    if kv_dtype == "int8":
+        return jnp.int8, 127.0
+    if kv_dtype == "fp8":
+        fp8 = getattr(jnp, "float8_e4m3fn", None)
+        if fp8 is None:
+            raise ValueError(
+                "kv_cache_dtype='fp8' needs a jax build with "
+                "jnp.float8_e4m3fn; this build lacks it — use 'int8'")
+        return fp8, float(jnp.finfo(fp8).max)
+    raise ValueError(
+        f"kv_cache_dtype={kv_dtype!r}: must be 'native', 'bf16', "
+        f"'int8' or 'fp8'")
+
+
+def storage_qmax(dtype) -> float:
+    """The symmetric quantization ceiling of a storage dtype (127 for
+    int8, finfo.max for fp8)."""
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.integer):
+        return float(jnp.iinfo(dtype).max)
+    return float(jnp.finfo(dtype).max)
+
+
+def page_scale(pf, qmax: float):
+    """Per-(page, kv-head) scale for a (..., page_size, KVH, D) float
+    slab: amax over the page's positions and head dim."""
+    return jnp.max(jnp.abs(pf.astype(jnp.float32)), axis=(-3, -1)) / qmax
+
+
+def page_quantize(pf, scale, qmax: float, dtype):
+    """Quantize (..., page_size, KVH, D) float against per-(page, head)
+    ``scale`` (..., KVH). Values are clipped BEFORE the cast: an fp8
+    overflow cast produces nan, not saturation. int8 rounds to nearest;
+    fp8 rounding is the cast's. Requantization at an UNCHANGED scale is
+    exact (round((q*s)/s) == q for |q| <= qmax), which is what makes the
+    append path's unconditional page requant safe."""
+    s = jnp.maximum(scale, 1e-12)[..., None, :, None]
+    q = jnp.clip(pf.astype(jnp.float32) / s, -qmax, qmax)
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.integer):
+        q = jnp.round(q)
+    return q.astype(dtype)
+
+
+def page_dequantize(q, scale):
+    """(..., page_size, KVH, D) storage payload x (..., KVH) scales ->
+    f32. The inverse of page_quantize; the einsum gather fuses this into
+    the page lookup, the Pallas kernel applies it per VMEM tile."""
+    return q.astype(jnp.float32) * scale[..., None, :, None]
+
+
 def flash_seq_cap() -> int:
     """FF_FLASH_MAX_SEQ: deployment escape hatch capping flash-kernel
     sequence length (0/unset/garbage = unlimited). Consulted by the dense
@@ -374,36 +444,120 @@ class MultiHeadAttention(Op):
     # of every slot preallocating max_len — the serving-side analog of the
     # partition-don't-pad philosophy the training side applies to sharding.
 
-    def init_paged_cache(self, num_pages: int, page_size: int, dtype):
+    def init_paged_cache(self, num_pages: int, page_size: int, dtype,
+                         kv_dtype=None):
         """A pool of `num_pages` KV pages. Page 0 is reserved by the
         serving engine as a scratch page (inactive slots write there), so
-        callers size num_pages as 1 + worst-case live pages."""
-        return {
+        callers size num_pages as 1 + worst-case live pages.
+
+        ``kv_dtype`` (FFConfig.kv_cache_dtype) picks the storage:
+        None/'native' stores ``dtype`` (the pre-quant pool), 'bf16'
+        stores bfloat16 (plain cast), 'int8'/'fp8' store quantized
+        payload plus per-(page, kv-head) f32 scales alongside — the
+        ``k_scale``/``v_scale`` entries ride the same page ids as the
+        payload, so the allocator/trie/COW machinery is untouched."""
+        sdtype, qmax = kv_storage_dtype(kv_dtype)
+        store = sdtype if sdtype is not None else dtype
+        pool = {
             "k": jnp.zeros((num_pages, page_size, self.num_kv_heads,
-                            self.qk_head_dim), dtype),
+                            self.qk_head_dim), store),
             "v": jnp.zeros((num_pages, page_size, self.num_kv_heads,
-                            self.v_head_dim), dtype),
+                            self.v_head_dim), store),
         }
+        if qmax is not None:
+            pool["k_scale"] = jnp.zeros(
+                (num_pages, self.num_kv_heads), jnp.float32)
+            pool["v_scale"] = jnp.zeros(
+                (num_pages, self.num_kv_heads), jnp.float32)
+        return pool
 
     def paged_prefill_write(self, cache, kh, vh, pages):
         """Scatter a slot's contiguous prefill k/v (1, L, KVH, Dh) into
         pool pages `pages` ((n_pages,) int32, n_pages = ceil(L /
         page_size)). The tail of the last page beyond L holds junk; it is
-        either overwritten by decode tokens or masked by the live rule."""
+        either overwritten by decode tokens or masked by the live rule.
+        Quantized pools ('k_scale' present) compute each page's
+        per-(page, head) scale over the whole just-written page — the
+        zero pad tail never inflates an amax — and replace scale AND
+        payload (prefill only ever targets the request's own fresh
+        pages, so a wholesale replace can never touch shared state)."""
         page_size = cache["k"].shape[1]
         n_pages = pages.shape[0]
         pad = n_pages * page_size - kh.shape[1]
+        quantized = "k_scale" in cache
+        out = dict(cache)
 
-        def put(pool, x):
-            x = x[0].astype(pool.dtype)                     # (L, KVH, Dh)
+        def paged(x):
+            x = x[0]                                        # (L, KVH, Dh)
             if pad:
                 x = jnp.pad(x, ((0, pad), (0, 0), (0, 0)))
-            return pool.at[pages].set(
-                x.reshape(n_pages, page_size, *x.shape[1:]))
+            return x.reshape(n_pages, page_size, *x.shape[1:])
 
-        return {"k": put(cache["k"], kh), "v": put(cache["v"], vh)}
+        for name, x in (("k", kh), ("v", vh)):
+            pool = cache[name]
+            if not quantized:
+                out[name] = pool.at[pages].set(paged(x).astype(pool.dtype))
+                continue
+            qmax = storage_qmax(pool.dtype)
+            pf = paged(x).astype(jnp.float32)
+            scale = page_scale(pf, qmax)                    # (n_pages, KVH)
+            out[name] = pool.at[pages].set(
+                page_quantize(pf, scale, qmax, pool.dtype))
+            out[name + "_scale"] = cache[name + "_scale"].at[pages].set(
+                scale)
+        return out
 
-    def _paged_attention_ctx(self, qh, ck, cv, page_table, write_pos,
+    def _paged_append(self, cache, kh, vh, page_ids, offs):
+        """Write ONE token per slot at ``(page_ids[b], offs[b])`` —
+        the decode-append half of the pool-write protocol. Full-width
+        pools scatter the position in place. Quantized pools re-quantize
+        the TARGET page against a running-max per-(page, head) scale:
+        gather the page, dequantize at the current scale, insert the new
+        token, grow the scale to cover it, requantize, scatter back.
+        Requantization at an unchanged scale is exact (page_quantize),
+        so older tokens only re-round when a genuinely larger token
+        arrives — part of the documented per-dtype divergence budget
+        (docs/serving.md). Appends only ever land in a request's own
+        private pages (write_pos >= prompt_pad > the shared prefix), so
+        the copy-on-write rule is preserved: published pages are never
+        gathered OR scattered here."""
+        quantized = "k_scale" in cache
+        out = dict(cache)
+        rows = jnp.arange(page_ids.shape[0])
+        for name, x in (("k", kh), ("v", vh)):
+            pool = cache[name]
+            if not quantized:
+                out[name] = pool.at[page_ids, offs].set(
+                    x.astype(pool.dtype))
+                continue
+            qmax = storage_qmax(pool.dtype)
+            sc = cache[name + "_scale"]
+            cur = sc[page_ids]                              # (B, KVH)
+            pf = page_dequantize(pool[page_ids], cur)       # (B,ps,KVH,D)
+            pf = pf.at[rows, offs].set(x.astype(jnp.float32))
+            amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+            new = jnp.maximum(cur, amax / qmax)             # (B, KVH)
+            out[name] = pool.at[page_ids].set(
+                page_quantize(pf, new, qmax, pool.dtype))
+            out[name + "_scale"] = sc.at[page_ids].set(new)
+        return out
+
+    def gather_paged_kv(self, cache, pages):
+        """Read ``pages`` ((n,) int32) out of the pool as a full-width
+        (1, n * page_size, KVH, Dh) k/v view — what a prefix-cache hit
+        prefill mounts READ-ONLY at the front of its contiguous cache.
+        Quantized pools dequantize against the pages' scales here, so
+        the borrower attends exactly the (lossy) values the donor's
+        decode attention sees."""
+        out = {}
+        for name in ("k", "v"):
+            x = cache[name][pages]                          # (n,ps,KVH,D)
+            if name + "_scale" in cache:
+                x = page_dequantize(x, cache[name + "_scale"][pages])
+            out[name] = x.reshape(1, -1, *x.shape[2:])
+        return out
+
+    def _paged_attention_ctx(self, qh, cache, page_table, write_pos,
                              row_len, prompt_pad, impl):
         """Shared attention body of the paged decode/verify paths: q
         (B, S, H, Dh) against the updated pool through the per-slot page
@@ -414,16 +568,21 @@ class MultiHeadAttention(Op):
             (B, L_max, KVH, Dh) cache and run _grouped_cache_attention:
             bitwise the dense-cache computation (tests/test_serving.py),
             the parity oracle. The gather re-materializes the ENTIRE
-            pool view in HBM every step.
+            pool view in HBM every step; on a quantized pool the
+            dequant fuses into the same gather (this branch is also the
+            dequant parity oracle).
           * ``pallas`` — paged_attention_fwd_pallas: the page-table
             lookup happens INSIDE the kernel grid, so only the slot's
             live pages stream through VMEM; online softmax replaces the
-            materialized (B, L_max) score row. Numerics match the
-            einsum path to kernel tolerance (accumulation order
+            materialized (B, L_max) score row. Quantized pages
+            dequantize per VMEM tile against their scalar-prefetched
+            scales — the wide KV never exists in HBM. Numerics match
+            the einsum path to kernel tolerance (accumulation order
             differs); greedy token streams are pinned identical by
-            tests/test_pallas_paged.py."""
+            tests/test_pallas_paged.py and test_quantized_serving.py."""
         resolved = resolve_paged_attention_impl(
             impl, getattr(self.model, "config", None))
+        ck, cv = cache["k"], cache["v"]
         if resolved == "pallas":
             from flexflow_tpu.ops.pallas_kernels import \
                 paged_attention_fwd_pallas
@@ -431,11 +590,16 @@ class MultiHeadAttention(Op):
             scale = 1.0 / math.sqrt(self.qk_head_dim)
             return paged_attention_fwd_pallas(
                 qh, ck, cv, page_table, write_pos, row_len, prompt_pad,
-                scale)
+                scale, k_scales=cache.get("k_scale"),
+                v_scales=cache.get("v_scale"))
         b = qh.shape[0]
         max_len = page_table.shape[1] * ck.shape[1]
-        gk = ck[page_table].reshape(b, max_len, *ck.shape[2:])
-        gv = cv[page_table].reshape(b, max_len, *cv.shape[2:])
+        gk, gv = ck[page_table], cv[page_table]     # (B, P, ps, KVH, D)
+        if "k_scale" in cache:
+            gk = page_dequantize(gk, cache["k_scale"][page_table])
+            gv = page_dequantize(gv, cache["v_scale"][page_table])
+        gk = gk.reshape(b, max_len, *gk.shape[3:])
+        gv = gv.reshape(b, max_len, *gv.shape[3:])
         idx = jnp.arange(max_len)
         live = (idx[None, None, :] < row_len[:, None, None]) \
             | ((idx[None, None, :] >= prompt_pad[:, None, None])
@@ -457,23 +621,23 @@ class MultiHeadAttention(Op):
         shared prompt_len): j < row_len  OR  prompt_pad <= j <= write_pos.
 
         The new token's k/v scatters into the pool at (page_table[b,
-        write_pos // page_size], write_pos % page_size); attention then
-        runs through _paged_attention_ctx — `impl` picks the page-gather
-        einsum oracle or the Pallas paged kernel."""
+        write_pos // page_size], write_pos % page_size) — through the
+        quantized-append protocol when the pool carries scales
+        (_paged_append); attention then runs through
+        _paged_attention_ctx — `impl` picks the page-gather einsum
+        oracle or the Pallas paged kernel."""
         page_size = cache["k"].shape[1]
         qh, kh, vh = self._project_qkv(params, xs[0], xs[1], xs[2],
                                        rope_offset=rope_pos)
         page_ids = jnp.take_along_axis(
             page_table, (write_pos // page_size)[:, None], axis=1)[:, 0]
         offs = write_pos % page_size
-        ck = cache["k"].at[page_ids, offs].set(
-            kh[:, 0].astype(cache["k"].dtype))
-        cv = cache["v"].at[page_ids, offs].set(
-            vh[:, 0].astype(cache["v"].dtype))
-        ctx = self._paged_attention_ctx(qh, ck, cv, page_table,
+        cache = self._paged_append(cache, kh[:, 0], vh[:, 0], page_ids,
+                                   offs)
+        ctx = self._paged_attention_ctx(qh, cache, page_table,
                                         write_pos[:, None], row_len,
                                         prompt_pad, impl)
-        return self._out_proj(params, ctx), {"k": ck, "v": cv}
+        return self._out_proj(params, ctx), cache
 
     def paged_verify_forward(self, params, xs, cache, page_table, write_pos,
                              rope_pos0, row_len, prompt_pad, impl=None):
@@ -494,20 +658,38 @@ class MultiHeadAttention(Op):
         never observable. ``rope_pos0`` (B,) is the slab's first LOGICAL
         position; position i rotates at rope_pos0 + i. Attention runs
         through _paged_attention_ctx (same einsum-oracle/Pallas-kernel
-        split as decode — the ONE kernel serves both shapes)."""
+        split as decode — the ONE kernel serves both shapes). On a
+        quantized pool the slab's positions append SEQUENTIALLY through
+        _paged_append (slab position i+1 may land in the page position i
+        just requantized; the running-max scale must see them in order),
+        so the final pool state is identical across impls — the
+        bitwise-pool contract the parity tests pin."""
         page_size = cache["k"].shape[1]
         qh, kh, vh = self._project_qkv(params, xs[0], xs[1], xs[2],
                                        rope_offset=rope_pos0)
         page_ids = jnp.take_along_axis(
             page_table, write_pos // page_size, axis=1)       # (B, S)
         offs = write_pos % page_size
-        ck = cache["k"].at[page_ids, offs].set(
-            kh.astype(cache["k"].dtype))
-        cv = cache["v"].at[page_ids, offs].set(
-            vh.astype(cache["v"].dtype))
-        ctx = self._paged_attention_ctx(qh, ck, cv, page_table, write_pos,
+        if "k_scale" in cache:
+            # S sequential single-token appends = S page round-trips per
+            # op per dispatch. Bounded: each is one (B, ps, KVH, D) page
+            # vs the table-wide attention that follows, and S = K+1 is
+            # small. A single final-scale pass would halve the traffic
+            # when the slab stays in one page, but slab positions can
+            # span pages — the per-position form is the one that is
+            # correct for every (write_pos, page boundary) layout.
+            for i in range(kh.shape[1]):
+                cache = self._paged_append(cache, kh[:, i], vh[:, i],
+                                           page_ids[:, i], offs[:, i])
+        else:
+            cache = dict(cache)
+            cache["k"] = cache["k"].at[page_ids, offs].set(
+                kh.astype(cache["k"].dtype))
+            cache["v"] = cache["v"].at[page_ids, offs].set(
+                vh.astype(cache["v"].dtype))
+        ctx = self._paged_attention_ctx(qh, cache, page_table, write_pos,
                                         row_len, prompt_pad, impl)
-        return self._out_proj(params, ctx), {"k": ck, "v": cv}
+        return self._out_proj(params, ctx), cache
 
     def _flash_ok(self, qh, kh) -> bool:
         """Use the hand-tiled Pallas flash kernel (ops/pallas_kernels.py) on

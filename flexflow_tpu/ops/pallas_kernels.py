@@ -589,15 +589,25 @@ flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 # (tests/test_pallas_paged.py).
 
 
-def _paged_attn_kernel(pt_ref, lp_ref, wp_ref, rl_ref, pp_ref, q_ref,
-                       k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                       s: int, kvh: int, grp: int, ps: int, scale: float):
+def _paged_attn_kernel(pt_ref, lp_ref, wp_ref, rl_ref, pp_ref, *rest,
+                       s: int, kvh: int, grp: int, ps: int, scale: float,
+                       quantized: bool = False):
     """One (slot, page) grid step: score the slot's (S, H, Dh) query slab
     against this page's (ps, KVH, Dh) k/v and fold into the running
     online softmax. Scalar-prefetch refs: page table (B, P), last live
     page (B,), per-position write frontier (B, S), row_len (B,),
-    prompt_pad (B,). Scratch rows are kv-head-major: row
+    prompt_pad (B,) — and, for a quantized pool, the per-(pool page,
+    kv head) f32 k/v scales (P_pool, KVH): the quantized payload streams
+    through VMEM and dequantizes HERE, against the scalar-prefetched
+    scale of the pool page this grid step fetched — the full-width KV
+    never exists in HBM (the Flex-TPU keep-it-resident rule applied to
+    quantization). Scratch rows are kv-head-major: row
     kh*(S*G) + i*G + g accumulates query head kh*G+g at slab position i."""
+    if quantized:
+        ks_ref, vs_ref, q_ref, k_ref, v_ref, o_ref, \
+            m_scr, l_scr, acc_scr = rest
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = rest
     b = pl.program_id(0)
     t = pl.program_id(1)
     nt = pl.num_programs(1)
@@ -619,6 +629,9 @@ def _paged_attn_kernel(pt_ref, lp_ref, wp_ref, rl_ref, pp_ref, q_ref,
         v = v_ref[0]                                # (ps, KVH, Dv)
         rl = rl_ref[b]
         pp = pp_ref[b]
+        # the pool page this step's k/v block came from (same lookup as
+        # kv_map's clamped DMA) — indexes the scale rows when quantized
+        pg = pt_ref[b, jnp.minimum(t, lp_ref[b])]
         # live mask rows in (slab position, group) order — each slab
         # position i attends at its OWN frontier wp[b, i], which gives
         # in-slab causality for the verify slab (position i's window
@@ -632,7 +645,20 @@ def _paged_attn_kernel(pt_ref, lp_ref, wp_ref, rl_ref, pp_ref, q_ref,
         for kh in range(kvh):
             sl = slice(kh * s * grp, (kh + 1) * s * grp)
             qk = q[:, kh * grp:(kh + 1) * grp, :].reshape(s * grp, -1)
-            sc = jnp.dot(qk, k[:, kh, :].T,
+            kk = k[:, kh, :]                        # (ps, Dqk)
+            vv = v[:, kh, :]                        # (ps, Dv)
+            if quantized:
+                # in-VMEM dequant: one scalar per (page, head), read
+                # from SMEM — the int8/fp8 tile was the only HBM read
+                kk = kk.astype(jnp.float32) * ks_ref[pg, kh]
+                vv = vv.astype(jnp.float32) * vs_ref[pg, kh]
+            elif kk.dtype != q.dtype:
+                # mixed-width pool (kv_cache_dtype='bf16' under f32
+                # compute): upcast in VMEM so the probs matmul runs at
+                # query precision, matching the einsum oracle's cast
+                kk = kk.astype(q.dtype)
+                vv = vv.astype(q.dtype)
+            sc = jnp.dot(qk, kk.T,
                          preferred_element_type=jnp.float32) * scale
             sc = jnp.where(live, sc, NEG_INF)
             m_prev = m_scr[sl, 0:1]
@@ -643,7 +669,7 @@ def _paged_attn_kernel(pt_ref, lp_ref, wp_ref, rl_ref, pp_ref, q_ref,
             p = jnp.exp(sc - m_new)
             l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
             acc_scr[sl, :] = acc_scr[sl, :] * alpha + jnp.dot(
-                p.astype(v.dtype), v[:, kh, :],
+                p.astype(vv.dtype), vv,
                 preferred_element_type=jnp.float32)
             m_scr[sl, :] = jnp.broadcast_to(m_new, (s * grp, LANES))
             l_scr[sl, :] = jnp.broadcast_to(l_new, (s * grp, LANES))
@@ -662,6 +688,7 @@ def _paged_attn_kernel(pt_ref, lp_ref, wp_ref, rl_ref, pp_ref, q_ref,
 
 def paged_attention_fwd_pallas(q, k_pages, v_pages, page_table, write_pos,
                                row_len, prompt_pad, scale: float,
+                               k_scales=None, v_scales=None,
                                interpret: Optional[bool] = None):
     """Paged-pool attention: q (B, S, H, Dqk) against k_pages/v_pages
     ((P_pool, page_size, KVH, D)) through per-slot page tables
@@ -675,6 +702,15 @@ def paged_attention_fwd_pallas(q, k_pages, v_pages, page_table, write_pos,
     the slot's LIVE pages, not the pool. Inference-only: no VJP (the
     serving engine never differentiates through decode).
 
+    ``k_scales``/``v_scales`` ((P_pool, KVH) f32, both or neither) mark
+    a QUANTIZED pool (int8/fp8 payload, ISSUE 11): they ride the
+    scalar-prefetch stream into SMEM next to the page table, and each
+    grid step dequantizes its VMEM-resident tile against its own page's
+    scale before the score/context matmuls — per-page HBM traffic is
+    the quantized bytes, and the full-width KV is never materialized
+    anywhere. The einsum page-gather path applies the same dequant
+    after its gather, staying the parity oracle.
+
     `interpret` defaults to the module rule (interpret off-TPU), which
     is how FFConfig.paged_attention_impl='pallas' executes the REAL
     kernel code path in every CPU CI tier."""
@@ -682,6 +718,9 @@ def paged_attention_fwd_pallas(q, k_pages, v_pages, page_table, write_pos,
     ps, kvh = k_pages.shape[1], k_pages.shape[2]
     dv = v_pages.shape[3]
     assert h % kvh == 0, f"heads {h} not a multiple of kv heads {kvh}"
+    assert (k_scales is None) == (v_scales is None), \
+        "quantized pools carry BOTH k and v scales"
+    quantized = k_scales is not None
     grp = h // kvh
     pps = page_table.shape[1]
     # last live page per slot: the live rule's bound is max(write
@@ -694,18 +733,26 @@ def paged_attention_fwd_pallas(q, k_pages, v_pages, page_table, write_pos,
     last_idx = jnp.maximum(jnp.max(write_pos, axis=1), row_len - 1)
     last_page = (last_idx // ps).astype(jnp.int32)
 
-    def q_map(bi, t, pt, lp, wp, rl, pp):
+    # extra trailing prefetch refs (the quantized scales) ride into the
+    # index maps as *_ — the maps only ever read the table + last page
+    def q_map(bi, t, pt, lp, *_):
         return (bi, 0, 0, 0)
 
-    def kv_map(bi, t, pt, lp, wp, rl, pp):
+    def kv_map(bi, t, pt, lp, *_):
         # the paged lookup: this grid step's k/v block IS pool page
         # page_table[slot, t], fetched straight from HBM — dead steps
         # (t past the frontier) clamp to the already-resident last live
         # page so they trigger no DMA
         return (pt[bi, jnp.minimum(t, lp[bi])], 0, 0, 0)
 
+    prefetch = [page_table.astype(jnp.int32), last_page,
+                write_pos.astype(jnp.int32), row_len.astype(jnp.int32),
+                prompt_pad.astype(jnp.int32)]
+    if quantized:
+        prefetch += [k_scales.astype(jnp.float32),
+                     v_scales.astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=5,
+        num_scalar_prefetch=len(prefetch),
         grid=(b, pps),
         in_specs=[
             pl.BlockSpec((1, s, h, dqk), q_map),
@@ -721,11 +768,9 @@ def paged_attention_fwd_pallas(q, k_pages, v_pages, page_table, write_pos,
     )
     return pl.pallas_call(
         functools.partial(_paged_attn_kernel, s=s, kvh=kvh, grp=grp,
-                          ps=ps, scale=scale),
+                          ps=ps, scale=scale, quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, s, h, dv), q.dtype),
         compiler_params=_compiler_params(("parallel", "arbitrary")),
         interpret=_interpret() if interpret is None else interpret,
-    )(page_table.astype(jnp.int32), last_page,
-      write_pos.astype(jnp.int32), row_len.astype(jnp.int32),
-      prompt_pad.astype(jnp.int32), q, k_pages, v_pages)
+    )(*prefetch, q, k_pages, v_pages)

@@ -84,9 +84,13 @@ class Generator:
     def __init__(self, model, temperature: float = 0.0, top_k: int = 0,
                  eos_id: Optional[int] = None, pad_id: int = 0,
                  quantize: Optional[str] = None):
-        if quantize not in (None, "int8"):
-            raise ValueError(f"quantize must be None or 'int8', "
+        if quantize not in (None, "int8", "fp8"):
+            raise ValueError(f"quantize must be None, 'int8' or 'fp8', "
                              f"got {quantize!r}")
+        if quantize == "fp8" and getattr(jnp, "float8_e4m3fn", None) is None:
+            raise ValueError(
+                "quantize='fp8' needs a jax build with jnp.float8_e4m3fn;"
+                " this build lacks it — use 'int8'")
         self.model = model
         self.temperature = float(temperature)
         self.top_k = int(top_k)
@@ -154,16 +158,26 @@ class Generator:
         self._last_attn_idx = max(i for i, op in enumerate(model.ops)
                                   if op in self.attn_ops)
 
-    # ---- weight-only int8 quantization -------------------------------------
+    # ---- weight-only quantization (int8 / fp8) -----------------------------
 
     def _quantized_params(self):
-        """Weight-only int8: every float weight with >= 2 dims stores as
-        {"q": int8, "s": f32 per-out-channel scale}; dequant happens
-        per-use inside the jitted decode program (the int8->compute
-        convert fuses into the consuming matmul, so the weight read from
-        HBM — the decode bottleneck — is the int8 bytes: half of bf16,
-        a quarter of f32). 1-D weights (norm scales, biases) stay exact.
-        Lossy by design: logits shift slightly vs full precision."""
+        """Weight-only quantization, dtype-parameterized (``self.
+        quantize`` = 'int8' or 'fp8'): every float weight with >= 2 dims
+        stores as {"q": int8|float8_e4m3fn, "s": f32 per-OUTPUT-CHANNEL
+        scale}; dequant happens per-use inside the jitted decode program
+        (the narrow->compute convert fuses into the consuming matmul, so
+        the weight read from HBM — the decode bottleneck — is the
+        quantized bytes: half of bf16, a quarter of f32). Scales vary
+        over every dim EXCEPT the leading (contraction-side) axis —
+        finer than per-tensor on every weight and finer than the old
+        per-last-dim scheme on 3-D attention weights (wq (in, H, Dh)
+        gets an (H, Dh) scale grid instead of sharing one scale across
+        heads); granularity is unconstrained for correctness because the
+        weight is dequantized before the matmul consumes it. 1-D weights
+        (norm scales, biases) stay exact. Lossy by design: logits shift
+        slightly vs full precision — tests/test_quantized_serving.py
+        pins per-channel strictly no worse than a per-tensor baseline on
+        every zoo layer."""
         import weakref
 
         # validity = version (bumped by the params setter / set_weights)
@@ -184,18 +198,26 @@ class Generator:
                 and self._q_refs is not None
                 and all(r() is not None for r in self._q_refs)):
             return self._qparams
+        if self.quantize == "fp8":
+            qdtype = jnp.float8_e4m3fn
+            qmax = float(jnp.finfo(qdtype).max)
+        else:
+            qdtype, qmax = jnp.int8, 127.0
         out = {}
         for op_name, ws in self.model.params.items():
             q_ws = {}
             for w_name, w in ws.items():
                 if w.ndim >= 2 and jnp.issubdtype(w.dtype, jnp.floating):
                     wf = jnp.asarray(w, jnp.float32)
-                    scale = jnp.max(jnp.abs(wf), axis=tuple(
-                        range(w.ndim - 1)), keepdims=True) / 127.0
+                    scale = jnp.max(jnp.abs(wf), axis=0,
+                                    keepdims=True) / qmax
                     scale = jnp.maximum(scale, 1e-12)
-                    q = jnp.clip(jnp.round(wf / scale), -127, 127
-                                 ).astype(jnp.int8)
-                    q_ws[w_name] = {"q": q, "s": scale}
+                    # clip BEFORE the cast: an fp8 overflow cast is nan,
+                    # not saturation
+                    q = jnp.clip(wf / scale, -qmax, qmax)
+                    if qdtype == jnp.int8:
+                        q = jnp.round(q)
+                    q_ws[w_name] = {"q": q.astype(qdtype), "s": scale}
                 else:
                     q_ws[w_name] = w
             out[op_name] = q_ws

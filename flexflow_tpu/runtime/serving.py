@@ -68,6 +68,22 @@ of it:
     greedy token, so the stream is token-identical to non-speculative
     greedy decode; the accept rate rides ``stats()``.
 
+  * QUANTIZED SERVING TIER (``FFConfig.kv_cache_dtype`` /
+    ``serve_weight_dtype``, ISSUE 11): the paged pool stores int8/fp8
+    payload with per-(page, kv-head) f32 scales alongside, so each page
+    holds 2-4x more tokens per HBM byte — prefix-cache capacity and
+    slots-per-chip multiply at fixed pool bytes while the allocator,
+    COW rule, radix trie, router affinity and speculation (all
+    page-granular) are untouched. Dequantization happens in VMEM:
+    inside the Pallas paged-attention kernel against scalar-prefetched
+    scales, or fused into the einsum gather (the parity oracle) — wide
+    KV never materializes in HBM. Serving weights quantize ONCE at
+    engine init (per-output-channel scales) and dequantize fused into
+    each consuming matmul. Quantization is lossy: greedy streams carry
+    a documented per-dtype divergence budget vs the full-width path
+    (docs/serving.md "Quantized tier"); pallas-vs-einsum token identity
+    and pool bitwise equality still hold exactly.
+
 Per-slot cache layout (identical to the ragged rule of
 MultiHeadAttention.decode_forward, with a per-slot prompt pad width):
 logical positions ``[0, row_len)`` hold the true prompt, ``[row_len,
@@ -338,7 +354,9 @@ class ServingEngine:
                  quantize: Optional[str] = None, seed: int = 0,
                  prefix_cache: Optional[bool] = None,
                  draft_model=None, speculate_k: Optional[int] = None,
-                 paged_attention_impl: Optional[str] = None):
+                 paged_attention_impl: Optional[str] = None,
+                 kv_cache_dtype: Optional[str] = None,
+                 weight_dtype: Optional[str] = None):
         cfg = model.config
         self.model = model
         self.slots = int(serve_slots or getattr(cfg, "serve_slots", 4))
@@ -374,18 +392,40 @@ class ServingEngine:
                 f"max_seq_len={self.max_seq_len} request "
                 f"(needs {1 + self.pages_per_slot} incl. scratch page 0)")
 
-        # decode attention impl over the paged pool: the per-engine
-        # override wins, else FFConfig.paged_attention_impl; resolved
-        # ONCE here ("auto" -> the backend's concrete choice) so every
-        # program this engine builds, and stats(), agree on it. The
-        # einsum page-gather stays the parity oracle — greedy streams
-        # are token-identical either way (tests/test_pallas_paged.py).
-        from flexflow_tpu.ops.attention import resolve_paged_attention_impl
+        # ---- quantized serving tier (ISSUE 11) ----
+        # weights: FFConfig.serve_weight_dtype (or the per-engine
+        # weight_dtype override) promotes the weight-only quantized
+        # decode path into a first-class serving mode — per-output-
+        # channel scales, quantized ONCE below so the fixed-shape
+        # programs trace against a stable quantized tree and never
+        # retrace. The legacy `quantize` arg keeps working; mixing the
+        # two with different values is a config error, not a silent pick.
+        wd = (weight_dtype if weight_dtype is not None
+              else getattr(cfg, "serve_weight_dtype", "native"))
+        if wd not in ("native", "int8", "fp8"):
+            raise ValueError(
+                f"weight_dtype={wd!r}: must be 'native', 'int8' or 'fp8'")
+        if wd != "native":
+            if quantize not in (None, wd):
+                raise ValueError(
+                    f"weight_dtype={wd!r} conflicts with quantize="
+                    f"{quantize!r}: pass one or the other")
+            quantize = wd
+        self.weight_dtype = quantize or "native"
+        # KV pool storage: FFConfig.kv_cache_dtype (or the per-engine
+        # override). int8/fp8 pools carry per-(page, kv-head) scales and
+        # dequantize in VMEM (inside the Pallas kernel / fused into the
+        # einsum gather); every page then holds 2-4x more tokens per HBM
+        # byte, multiplying prefix-cache capacity and slots-per-chip —
+        # the allocator, COW rule, radix trie, router affinity and
+        # speculation are page-granular and unchanged.
+        from flexflow_tpu.ops.attention import kv_storage_dtype
 
-        self.paged_attention_impl = resolve_paged_attention_impl(
-            paged_attention_impl, cfg)
-        fflogger.info("serving: paged decode attention impl=%s",
-                      self.paged_attention_impl)
+        kv_raw = (kv_cache_dtype if kv_cache_dtype is not None
+                  else getattr(cfg, "kv_cache_dtype", "native"))
+        kv_storage_dtype(kv_raw)  # validate early (incl. the fp8 gate)
+        self._kv_dtype_arg = (None if kv_raw in (None, "", "native")
+                              else kv_raw)
 
         # Generator supplies graph validation, the graph walk, prefill and
         # sampling — serving adds scheduling + the paged pool around them
@@ -394,6 +434,18 @@ class ServingEngine:
         self.eos_id = eos_id
         self.pad_id = pad_id
         cdtype = self.gen._compute_dtype()
+        if self._kv_dtype_arg is None:
+            self.kv_cache_dtype = jnp.dtype(cdtype).name
+        elif kv_raw == "bf16":
+            self.kv_cache_dtype = "bfloat16"
+        else:
+            self.kv_cache_dtype = kv_raw
+        if self.gen.quantize:
+            # quantize once at engine init: the cached quantized tree is
+            # what every program traces against — admission/decode never
+            # pays the quantization pass, and the params cache cannot
+            # invalidate mid-stream
+            self.gen._quantized_params()
         # the pool is COMMITTED (replicated on the model's mesh) up front:
         # an uncommitted fresh pool has a different pjit signature
         # (UnspecifiedValue) than the committed arrays every program
@@ -402,15 +454,70 @@ class ServingEngine:
         # that the recompile counter could not see
         from jax.sharding import NamedSharding, PartitionSpec
 
-        repl = NamedSharding(model.mesh, PartitionSpec(None, None, None,
-                                                       None))
+        repl = NamedSharding(model.mesh, PartitionSpec())
         self.pool = {
             op.name: jax.tree.map(
                 lambda a: jax.device_put(a, repl),
                 op.init_paged_cache(self.num_pages, self.page_size,
-                                    cdtype))
+                                    cdtype, kv_dtype=self._kv_dtype_arg))
             for op in self.gen.attn_ops}
         self._free_pages = list(range(self.num_pages - 1, 0, -1))
+
+        # pool-capacity observability (the router/bench signals ROADMAP
+        # item 1 calls for), computed once — the pool's geometry is fixed
+        # for the engine's life. The bf16 reference prices the SAME
+        # geometry at 2 bytes/element, so kv_capacity_vs_bf16 is exactly
+        # the capacity multiplier a quantized pool buys at equal HBM.
+        self._pool_bytes = sum(
+            int(a.nbytes) for a in jax.tree_util.tree_leaves(self.pool))
+        self._kv_bytes_per_token = (
+            self._pool_bytes / (self.num_pages * self.page_size))
+        self._bf16_bytes_per_token = sum(
+            op.num_kv_heads * (op.qk_head_dim + op.v_head_dim) * 2
+            for op in self.gen.attn_ops)
+
+        # decode attention impl over the paged pool: the per-engine
+        # override wins, else FFConfig.paged_attention_impl; resolved
+        # ONCE here ("auto" -> the backend's concrete choice) so every
+        # program this engine builds, and stats(), agree on it. Under
+        # "auto" a MEASURED winner persisted by search/kernel_tune.py's
+        # tune_paged_attention for this engine's exact (page geometry,
+        # heads, pool dtype) overrides the backend heuristic — the
+        # paper's measured-costs-over-heuristics rule applied to impl
+        # choice. The einsum page-gather stays the parity oracle —
+        # greedy streams are token-identical either way
+        # (tests/test_pallas_paged.py).
+        from flexflow_tpu.ops.attention import resolve_paged_attention_impl
+
+        requested = (paged_attention_impl
+                     if paged_attention_impl not in (None, "")
+                     else getattr(cfg, "paged_attention_impl", "auto")
+                     or "auto")
+        self.paged_attention_impl = resolve_paged_attention_impl(
+            requested, cfg)
+        from flexflow_tpu.search import kernel_tune
+
+        # snapshot the autotune-table counter baseline BEFORE the
+        # construction-time impl lookup below, so stats() shows that
+        # lookup too — the bench stamps it as proof the dtype-keyed
+        # entry governed an 'auto' engine
+        self._ktune_base = kernel_tune.stats()
+        if requested == "auto":
+            op0 = self.gen.attn_ops[0]
+            tuned = kernel_tune.lookup_paged_impl(
+                page_size=self.page_size,
+                pages_per_slot=self.pages_per_slot,
+                head_dim=op0.qk_head_dim,
+                dtype=self.pool[op0.name]["k"].dtype,
+                batch=self.slots, heads=op0.num_heads)
+            if tuned is not None:
+                self.paged_attention_impl = tuned
+        fflogger.info(
+            "serving: paged decode attention impl=%s kv_cache_dtype=%s "
+            "weight_dtype=%s (%.1f KV bytes/token, %.2fx bf16 capacity)",
+            self.paged_attention_impl, self.kv_cache_dtype,
+            self.weight_dtype, self._kv_bytes_per_token,
+            self._bf16_bytes_per_token / self._kv_bytes_per_token)
 
         # radix prefix cache: page-granular prompt-prefix sharing with
         # copy-on-write allocation (shared pages are read-only; every
@@ -457,18 +564,21 @@ class ServingEngine:
             self.draft_gen = Generator(
                 self.draft_model, temperature=0.0, top_k=0, eos_id=eos_id,
                 pad_id=pad_id, quantize=quantize)
+            if self.draft_gen.quantize:
+                self.draft_gen._quantized_params()  # once, at init
             ddtype = self.draft_gen._compute_dtype()
-            drepl = NamedSharding(self.draft_model.mesh,
-                                  PartitionSpec(None, None, None, None))
-            # the draft pool mirrors the target pool's page GEOMETRY and
-            # page IDS (its own KVH/Dh): one allocator, one page table,
-            # one radix trie govern both — a shared prefix page id means
-            # target AND draft prefix KV are both resident
+            drepl = NamedSharding(self.draft_model.mesh, PartitionSpec())
+            # the draft pool mirrors the target pool's page GEOMETRY,
+            # page IDS and storage dtype (its own KVH/Dh): one
+            # allocator, one page table, one radix trie govern both — a
+            # shared prefix page id means target AND draft prefix KV
+            # are both resident
             self.draft_pool = {
                 op.name: jax.tree.map(
                     lambda a: jax.device_put(a, drepl),
                     op.init_paged_cache(self.num_pages, self.page_size,
-                                        ddtype))
+                                        ddtype,
+                                        kv_dtype=self._kv_dtype_arg))
                 for op in self.draft_gen.attn_ops}
 
         # per-slot scheduler state (host side, shipped to device each step)
@@ -522,9 +632,9 @@ class ServingEngine:
         # approximate when training or a second engine traces alongside
         self._pages_touched = 0
         self._last_pages_touched = 0
-        from flexflow_tpu.search import kernel_tune
-
-        self._ktune_base = kernel_tune.stats()
+        # (the kernel-tune counter baseline _ktune_base is snapshotted
+        # in the impl-resolution block above, before the construction-
+        # time table lookup)
         import collections
 
         self._ttfts = collections.deque(maxlen=4096)
@@ -658,17 +768,18 @@ class ServingEngine:
     def _seed_prefix_caches(gen, bucket: int, p0: int, pool, prefix_pages):
         """Gather ``p0`` positions of cached prefix KV READ-ONLY into
         the front of a fresh contiguous per-request cache for every
-        attention op — the shared half of every hit prefill. Target and
-        draft builders use this one helper so the two pools (which share
-        page ids) can never drift apart."""
+        attention op — the shared half of every hit prefill. Quantized
+        pools dequantize in the gather (op.gather_paged_kv), so the
+        borrower attends exactly the lossy values the donor's decode
+        sees. Target and draft builders use this one helper so the two
+        pools (which share page ids) can never drift apart."""
         cdtype = gen._compute_dtype()
         caches = {}
         for op in gen.attn_ops:
             c = op.init_cache(1, bucket, cdtype)
+            g = op.gather_paged_kv(pool[op.name], prefix_pages)
             caches[op.name] = {
-                name: c[name].at[:, :p0].set(
-                    pool[op.name][name][prefix_pages].reshape(
-                        1, p0, *c[name].shape[2:]))
+                name: c[name].at[:, :p0].set(g[name].astype(c[name].dtype))
                 for name in ("k", "v")}
         return caches
 
@@ -1204,7 +1315,10 @@ class ServingEngine:
                                         "occupancy", "recompiles",
                                         "pages_in_use", "kv_pages_shared",
                                         "prefix_hit_rate",
-                                        "spec_accept_rate")},
+                                        "spec_accept_rate",
+                                        "kv_cache_dtype", "weight_dtype",
+                                        "kv_bytes_per_token",
+                                        "tokens_per_pool_gb")},
             }
 
     def load(self) -> Dict:
@@ -1272,6 +1386,25 @@ class ServingEngine:
             "kv_pages": self.num_pages,
             "kv_page_size": self.page_size,
             "serve_slots": self.slots,
+            # quantized-tier observability (ISSUE 11): what the pool and
+            # weights are stored as, what a token of KV costs in HBM
+            # (scales included), how many tokens a GB of pool holds, and
+            # the capacity multiplier vs a bf16 pool of the same
+            # geometry — effective page capacity = kv_page_size x that
+            # multiplier in bf16-equivalent tokens per page's bytes.
+            # These are the router/bench placement signals: a quantized
+            # replica advertises more tokens per byte, not more bytes.
+            "kv_cache_dtype": self.kv_cache_dtype,
+            "weight_dtype": self.weight_dtype,
+            "kv_pool_bytes": self._pool_bytes,
+            "kv_bytes_per_token": round(self._kv_bytes_per_token, 3),
+            "tokens_per_pool_gb": int((1 << 30)
+                                      / self._kv_bytes_per_token),
+            "kv_capacity_vs_bf16": round(
+                self._bf16_bytes_per_token / self._kv_bytes_per_token, 3),
+            "kv_effective_page_capacity": round(
+                self.page_size * self._bf16_bytes_per_token
+                / self._kv_bytes_per_token, 1),
             # KV-pool observability (ROADMAP item 1: the router balances
             # on these): in-use counts every non-free page (live-private
             # + cached), cached the pages the radix trie holds (warm,

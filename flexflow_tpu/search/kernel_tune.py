@@ -180,19 +180,28 @@ def reset_stats():
         _STATS[k] = 0
 
 
-def record(kernel: str, sig: str, blocks: Tuple[int, int],
+def record(kernel: str, sig: str, blocks: Optional[Tuple[int, int]],
            seconds: float, candidates: Optional[Dict] = None,
-           path: Optional[str] = None) -> str:
+           path: Optional[str] = None, impl: Optional[str] = None,
+           extra: Optional[Dict] = None) -> str:
     """Persist one winner (atomic tmp+rename write, the checkpoint.py
-    discipline) and refresh the in-memory cache. Returns the key."""
+    discipline) and refresh the in-memory cache. Returns the key.
+    ``blocks`` entries serve the block tuner (lookup_blocks);
+    ``impl`` entries serve the paged-attention impl choice
+    (lookup_paged_impl) — an entry can carry either or both."""
     path = path or default_table_path()
     entries = load_table(path, reload=True)
     key = _entry_key(kernel, sig)
     entries[key] = {
-        "blocks": [int(blocks[0]), int(blocks[1])],
         "seconds": float(seconds),
         "when": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    if blocks is not None:
+        entries[key]["blocks"] = [int(blocks[0]), int(blocks[1])]
+    if impl is not None:
+        entries[key]["impl"] = str(impl)
+    if extra:
+        entries[key].update(extra)
     if candidates:
         entries[key]["candidates"] = {
             f"{bq}x{bk}": float(s) for (bq, bk), s in candidates.items()}
@@ -204,6 +213,153 @@ def record(kernel: str, sig: str, blocks: Tuple[int, int],
     os.replace(tmp, path)
     _TABLES[path] = (_stat_sig(path), entries)
     return key
+
+
+def lookup_paged_impl(*, page_size: int, pages_per_slot: int,
+                      head_dim: int, dtype, batch: int, heads: int,
+                      s: int = 1, path: Optional[str] = None) \
+        -> Optional[str]:
+    """Measured paged-attention impl ('pallas' | 'einsum') for one
+    serving shape on THIS device/jax version, or None (the caller's
+    backend heuristic applies). ``dtype`` is the POOL STORAGE dtype —
+    int8/fp8/f32/bf16 — so a winner measured on quantized pages (whose
+    bandwidth/compute balance differs: the kernel streams half the
+    bytes but adds a dequant multiply per tile) can never be served
+    for a full-width pool. Consulted by ServingEngine under
+    paged_attention_impl='auto' at construction time only, with the
+    DECODE slab shape ``s=1`` — decode dominates a serving engine's
+    dispatches, and the engine picks ONE impl for its life; entries
+    tuned at verify shapes (``--slab`` > 1) are comparison data, not
+    steering input."""
+    entries = load_table(path)
+    sig = shape_sig(seq_q=s, seq_k=pages_per_slot * page_size,
+                    head_dim=head_dim, dtype=dtype, batch=batch,
+                    heads=heads, causal=True)
+    e = entries.get(_entry_key("paged_fwd", sig))
+    if e and e.get("impl") in ("pallas", "einsum"):
+        _STATS["hits"] += 1
+        return e["impl"]
+    _STATS["misses"] += 1
+    return None
+
+
+def tune_paged_attention(*, page_size: int = 16, pages_per_slot: int = 8,
+                         head_dim: int = 64, kv_heads: int = 2,
+                         heads: int = 4, slots: int = 4, s: int = 1,
+                         dtype="float32", kv_dtype: Optional[str] = None,
+                         warmup: int = 1, iters: int = 3,
+                         path: Optional[str] = None,
+                         verbose: bool = False) -> Dict:
+    """Measure the Pallas paged-attention kernel against the einsum
+    page-gather at ONE serving shape — optionally on a QUANTIZED pool
+    (``kv_dtype`` = 'int8' | 'fp8' | 'bf16': the kernel variant that
+    dequantizes in VMEM vs the gather that dequantizes in HBM) — and
+    persist the winning impl to the same table the block tuner uses.
+    The pool's storage dtype is the signature's dtype, so int8 and
+    full-width entries can never shadow each other. ServingEngine
+    consults the entry under paged_attention_impl='auto'
+    (lookup_paged_impl). Off-TPU the kernel runs in interpret mode:
+    the sweep exercises the full tune->persist->consume path (the CI
+    smoke + bench demonstration), it just measures the interpreter —
+    einsum wins there by construction, which is itself the right
+    'auto' answer for a CPU backend."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from flexflow_tpu.ops.attention import (kv_storage_dtype,
+                                            page_dequantize, page_quantize,
+                                            page_scale)
+    from flexflow_tpu.ops.pallas_kernels import paged_attention_fwd_pallas
+    from flexflow_tpu.search import measure
+
+    sdtype, qmax = kv_storage_dtype(kv_dtype)
+    store = sdtype if sdtype is not None else jnp.dtype(dtype)
+    rs = np.random.RandomState(0)
+    pool_pages = 1 + slots * pages_per_slot
+    max_len = pages_per_slot * page_size
+
+    def mk(d):
+        x = jnp.asarray(rs.randn(pool_pages, page_size, kv_heads, d),
+                        jnp.float32)
+        if qmax is None:
+            return x.astype(store), None
+        sc = page_scale(x, qmax)
+        return page_quantize(x, sc, qmax, store), sc
+
+    kq, ks = mk(head_dim)
+    vq, vs = mk(head_dim)
+    q = jnp.asarray(rs.randn(slots, s, heads, head_dim), dtype)
+    table = jnp.asarray(
+        1 + np.arange(slots * pages_per_slot).reshape(slots,
+                                                      pages_per_slot),
+        jnp.int32)
+    wp = jnp.minimum(
+        jnp.full((slots,), max_len - s, jnp.int32)[:, None]
+        + jnp.arange(s, dtype=jnp.int32)[None, :], max_len - 1)
+    row_len = jnp.full((slots,), page_size, jnp.int32)
+    prompt_pad = jnp.full((slots,), 2 * page_size, jnp.int32)
+    scale = 1.0 / math.sqrt(head_dim)
+    grp = heads // kv_heads
+
+    def pallas_step(q_, k_, v_):
+        out = paged_attention_fwd_pallas(q_, k_, v_, table, wp, row_len,
+                                         prompt_pad, scale, k_scales=ks,
+                                         v_scales=vs)
+        return jnp.sum(out.astype(jnp.float32))
+
+    def einsum_step(q_, k_, v_):
+        # standalone mirror of MultiHeadAttention._paged_attention_ctx's
+        # einsum branch (the tuner is model-free, so it cannot call the
+        # op method); drift between the two bodies is caught by the
+        # kernel-vs-oracle parity tests (test_pallas_paged /
+        # test_quantized_serving), which pin the SAME pair of
+        # computations against each other
+        gk, gv = k_[table], v_[table]
+        if qmax is not None:
+            gk = page_dequantize(gk, ks[table])
+            gv = page_dequantize(gv, vs[table])
+        gk = gk.reshape(slots, max_len, kv_heads, head_dim)
+        gv = gv.reshape(slots, max_len, kv_heads, head_dim)
+        idx = jnp.arange(max_len)
+        live = (idx[None, None, :] < row_len[:, None, None]) \
+            | ((idx[None, None, :] >= prompt_pad[:, None, None])
+               & (idx[None, None, :] <= wp[:, :, None]))
+        qg = q_.reshape(slots, s, kv_heads, grp, head_dim)
+        logits = jnp.einsum("bqkgd,bskd->bkgqs", qg,
+                            gk.astype(q_.dtype),
+                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(live[:, None, None, :, :], logits,
+                           jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q_.dtype)
+        out = jnp.einsum("bkgqs,bskd->bqkgd", probs, gv.astype(q_.dtype))
+        return jnp.sum(out.astype(jnp.float32))
+
+    timed = {}
+    for impl, step in (("einsum", einsum_step), ("pallas", pallas_step)):
+        timed[impl] = measure.time_scalar_program(
+            jax.jit(step), q, kq, vq, warmup=warmup, iters=iters)
+        if verbose:
+            print(f"[kernel_tune] paged_fwd ps{page_size} "
+                  f"pps{pages_per_slot} d{head_dim} "
+                  f"{np.dtype(store).name} {impl}: "
+                  f"{timed[impl] * 1e3:.3f} ms")
+    best = min(timed, key=timed.get)
+    sig = shape_sig(seq_q=s, seq_k=max_len, head_dim=head_dim,
+                    dtype=store, batch=slots, heads=heads, causal=True)
+    record("paged_fwd", sig, None, timed[best],
+           candidates=None, path=path, impl=best,
+           extra={f"{k}_seconds": float(v) for k, v in timed.items()})
+    rec = {
+        "kernel": "paged_fwd", "sig": sig, "device": device_key(),
+        "impl": best, "kv_dtype": np.dtype(store).name,
+        "seconds": timed[best],
+        "candidates": {k: float(v) for k, v in timed.items()},
+    }
+    if verbose:
+        print(f"[kernel_tune] paged winner {best} -> "
+              f"{path or default_table_path()}")
+    return rec
 
 
 def static_blocks(seq_q: int, seq_k: int) -> Tuple[int, int]:
@@ -295,11 +451,28 @@ def main(argv=None):
     import argparse
 
     p = argparse.ArgumentParser(
-        description="Tune flash-attention block sizes on this device and "
-                    "persist the winners (consulted automatically by "
-                    "ops/pallas_kernels at trace time).")
+        description="Tune flash-attention block sizes (default) or the "
+                    "paged-attention impl choice (--paged, optionally on "
+                    "a quantized pool via --kv-dtype) on this device and "
+                    "persist the winners (consulted automatically at "
+                    "trace / engine-construction time).")
+    p.add_argument("--paged", action="store_true",
+                   help="tune the paged-attention kernel-vs-einsum "
+                        "choice instead of flash blocks")
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--pages-per-slot", type=int, default=8)
+    p.add_argument("--kv-heads", type=int, default=2)
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--slab", type=int, default=1,
+                   help="query slab length (1 = decode — the entry "
+                        "engines steer by; K+1 = verify, recorded for "
+                        "comparison only)")
+    p.add_argument("--kv-dtype", type=str, default="native",
+                   choices=("native", "bf16", "int8", "fp8"),
+                   help="pool storage dtype for --paged (part of the "
+                        "table key)")
     p.add_argument("--seq", "--seq-q", dest="seq_q", type=int,
-                   required=True)
+                   default=None)
     p.add_argument("--seq-k", type=int, default=None)
     p.add_argument("--head-dim", type=int, default=64)
     p.add_argument("--dtype", type=str, default="float32",
@@ -314,6 +487,19 @@ def main(argv=None):
                    help="table path (default FF_KERNEL_TUNE_TABLE or "
                         "~/.cache/flexflow_tpu/kernel_tune.json)")
     args = p.parse_args(argv)
+    if args.paged:
+        rec = tune_paged_attention(
+            page_size=args.page_size, pages_per_slot=args.pages_per_slot,
+            head_dim=args.head_dim, kv_heads=args.kv_heads,
+            heads=args.heads, slots=args.slots, s=args.slab,
+            dtype=args.dtype,
+            kv_dtype=(None if args.kv_dtype == "native"
+                      else args.kv_dtype),
+            iters=args.iters, path=args.table or None, verbose=True)
+        print(json.dumps(rec))
+        return 0
+    if args.seq_q is None:
+        p.error("--seq is required (or pass --paged)")
     cand = None
     if args.candidates:
         cand = []

@@ -16,7 +16,16 @@ window must stay at zero recompiles (cold prefill, hit prefill, draft-free
 decode all warmed up front), and after drain() + flush_prefix_cache() the
 pool must hold exactly kv_pages - 1 free pages — the page-leak check.
 
-Usage: [FF_FAULT=nan_loss@serve:37] python scripts/serve_smoke.py [N]
+Phase `quant` (ci/run_ci.sh `quant` tier, run standalone as
+``python scripts/serve_smoke.py [N] quant``): the SAME skewed
+shared-prefix workload driven through a bf16-pool engine and an
+int8-pool engine (per-page-per-head scales, in-kernel dequant,
+weight-only int8) — the sharing machinery is dtype-blind, so the hit
+count and the zero-recompile warm window must MATCH the bf16 run
+exactly, and the quantized pool must report >= 1.8x the tokens-per-
+pool-GB of the bf16 pool.
+
+Usage: [FF_FAULT=nan_loss@serve:37] python scripts/serve_smoke.py [N] [quant]
 """
 
 import os
@@ -36,7 +45,9 @@ from flexflow_tpu.models.llama import llama_lm  # noqa: E402
 
 
 def main():
-    n_requests = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+    n_requests = int(sys.argv[1]) if len(sys.argv) > 1 \
+        and sys.argv[1].isdigit() else 200
+    quant_only = "quant" in sys.argv[1:]
     vocab = 128
     cfg = FFConfig(batch_size=2, mesh_shape={"data": 1}, serve_slots=4,
                    kv_page_size=8)
@@ -44,6 +55,11 @@ def main():
     _, logits = llama_lm(ff, 2, seq_len=16, hidden=64, layers=1, heads=4,
                          kv_heads=2, vocab_size=vocab)
     ff.compile(final_tensor=logits)
+
+    if quant_only:
+        quant_smoke(ff, np.random.RandomState(0), vocab, n_requests)
+        print("serve_smoke: PASSED")
+        return
 
     rs = np.random.RandomState(0)
     lens = [int(rs.randint(3, 25)) for _ in range(n_requests)]
@@ -122,10 +138,14 @@ def main():
     print("serve_smoke: PASSED")
 
 
-def prefix_smoke(ff, rs, vocab, n_requests):
+def prefix_smoke(ff, rs, vocab, n_requests, kv_cache_dtype=None,
+                 weight_dtype=None, tag=""):
     """Skewed shared-prefix workload: 80% of requests share a 64-token
     system prompt. Asserts prefix hits, warm-window recompile flatness,
-    and zero page leaks after drain + flush."""
+    and zero page leaks after drain + flush. ``kv_cache_dtype`` /
+    ``weight_dtype`` run the same workload on a quantized engine (the
+    `quant` phase drives a bf16/int8 pair through here); returns the
+    final stats snapshot so callers can compare pairs."""
     system = rs.randint(1, vocab, (64,)).astype(np.int32)
     n_skew = (n_requests * 8) // 10
     prompts = []
@@ -139,7 +159,9 @@ def prefix_smoke(ff, rs, vocab, n_requests):
 
     # pinned buckets: background traffic -> 32, system-prompt traffic
     # (65..71 tokens) -> 96; 96 + max_new 8 fits max_seq_len 112
-    eng = ff.make_serving_engine(max_seq_len=112, decode_buckets=[32, 96])
+    eng = ff.make_serving_engine(max_seq_len=112, decode_buckets=[32, 96],
+                                 kv_cache_dtype=kv_cache_dtype,
+                                 weight_dtype=weight_dtype)
     # warm every program the workload can need: cold prefill per bucket,
     # the (bucket 96, 8 matched pages) hit prefill, and the decode scan.
     # The first skewed warm request PUBLISHES the system pages, so the
@@ -161,11 +183,14 @@ def prefix_smoke(ff, rs, vocab, n_requests):
 
     done = [r for r in reqs if r.state == "done"]
     hits = st["prefix_hits"]
-    print(f"serve_smoke[prefix]: {len(done)}/{n_requests} done in {dt:.1f}s "
-          f"({st['tokens_generated'] / dt:.0f} tok/s), "
+    label = f"prefix{('/' + tag) if tag else ''}"
+    print(f"serve_smoke[{label}]: {len(done)}/{n_requests} done in "
+          f"{dt:.1f}s ({st['tokens_generated'] / dt:.0f} tok/s), "
           f"prefix hits {hits}/{st['prefix_lookups']} "
           f"(saved {st['prefill_tokens_saved']} prefill tokens), "
           f"shared-peak cached {st['kv_pages_cached']} pages, "
+          f"kv {st['kv_cache_dtype']} "
+          f"({st['kv_bytes_per_token']} B/token), "
           f"recompiles after warmup {eng.recompile_count - warm}")
     assert len(done) == n_requests, "requests lost in the prefix phase"
     assert hits >= n_skew - 1, (
@@ -182,6 +207,39 @@ def prefix_smoke(ff, rs, vocab, n_requests):
         f"cached != {st['kv_pages'] - 1}")
     eng.flush_prefix_cache()
     assert eng.stats()["free_pages"] == st["kv_pages"] - 1, "flush leaked"
+    st["recompiles_after_warmup"] = eng.recompile_count - warm
+    return st
+
+
+def quant_smoke(ff, rs, vocab, n_requests):
+    """The quantized-tier leg (ci/run_ci.sh `quant`): the SAME skewed
+    shared-prefix workload on a bf16 pool and an int8 pool (+ int8
+    weights). The sharing machinery is page-granular and dtype-blind,
+    so the int8 run's hit count and warm-window recompile flatness must
+    MATCH the bf16 run's exactly — and the quantized pool must report
+    near-2x tokens-per-pool-GB (scales cost a sliver below 2.0)."""
+    stats = {}
+    for tag, kv, wd in (("bf16", "bf16", None), ("int8", "int8", "int8")):
+        stats[tag] = prefix_smoke(ff, np.random.RandomState(1), vocab,
+                                  n_requests, kv_cache_dtype=kv,
+                                  weight_dtype=wd, tag=tag)
+    b, q = stats["bf16"], stats["int8"]
+    assert q["prefix_hits"] == b["prefix_hits"], (
+        f"int8 hit count {q['prefix_hits']} != bf16 {b['prefix_hits']}: "
+        f"quantization must not change the sharing machinery")
+    assert q["prefix_lookups"] == b["prefix_lookups"]
+    assert q["recompiles_after_warmup"] == 0 \
+        and b["recompiles_after_warmup"] == 0, (
+        f"warm-window recompiles: int8 {q['recompiles_after_warmup']}, "
+        f"bf16 {b['recompiles_after_warmup']} (must both be 0)")
+    ratio = q["tokens_per_pool_gb"] / b["tokens_per_pool_gb"]
+    assert ratio >= 1.8, (
+        f"int8 pool holds only {ratio:.3f}x the tokens/GB of bf16 "
+        f"(expected ~2x minus the per-page scale sliver)")
+    assert q["kv_cache_dtype"] == "int8" and q["weight_dtype"] == "int8"
+    print(f"serve_smoke[quant]: int8 matches bf16 — hits "
+          f"{q['prefix_hits']}=={b['prefix_hits']}, 0 warm recompiles "
+          f"both, tokens/GB ratio {ratio:.3f}x")
 
 
 if __name__ == "__main__":
