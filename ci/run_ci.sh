@@ -45,6 +45,18 @@
 #               skewed shared-prefix workload on a bf16/int8 engine
 #               pair — hit rate and zero warm-window recompiles must
 #               match across dtypes
+#   disagg    — disaggregated-fleet tier (ISSUE 12): the tiered-prefix-
+#               cache state machine suite (pure host: demote/promote
+#               ordering under the ordered publisher, cross-tier
+#               refcounts, host LRU, abandoned-migration generation
+#               check) + the fleet/engine integration suite (slab
+#               handoff bitwise + token identity, role split, tier
+#               faults, warmup variant sweep) + a 1-prefill/2-decode
+#               smoke on skewed shared-prefix traffic with FF_FAULT
+#               crashing the PREFILL replica mid-handoff — every
+#               request completes exactly once via cold-path fallback,
+#               token-identical, zero survivor recompiles — and a
+#               working-set-3x-pool tiered-cache leg
 #   router    — fleet-router tier: the multi-replica ServingRouter suite
 #               (failover exactly-once + token identity incl. prefix
 #               cache + speculation, deadline/shedding/affinity
@@ -54,7 +66,7 @@
 #               exactly once, zero lost/duplicated, zero warm recompiles
 #               on the survivor
 #
-# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|kernels|quant|router|all]
+# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|kernels|quant|disagg|router|all]
 set -e
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -209,6 +221,15 @@ run_quant() {
   python scripts/serve_smoke.py 120 quant
 }
 
+# disagg tier: the tier state machine + fleet integration suites, then
+# the role-split smoke under a deterministic mid-handoff crash of the
+# prefill replica (identity-indexed, so warmup consumes nothing; tick
+# 12 lands while background handoffs stream through replica 0).
+run_disagg() {
+  python -m pytest tests/test_tiered_prefix.py tests/test_disagg.py -q
+  FF_FAULT="crash(6)@replica:0" python scripts/disagg_smoke.py 160
+}
+
 # router tier: the fleet suite (failover/deadline/shedding/affinity +
 # the concurrent-submit engine stress in test_serving), then the
 # 2-replica smoke under a deterministic mid-flight crash of replica 0
@@ -235,8 +256,9 @@ case "$TIER" in
   elastic)  run_elastic ;;
   kernels)  run_kernels ;;
   quant)    run_quant ;;
+  disagg)   run_disagg ;;
   router)   run_router ;;
-  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_kernels; run_quant; run_router; run_native; run_docs; run_sweep ;;
+  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_kernels; run_quant; run_disagg; run_router; run_native; run_docs; run_sweep ;;
   *) echo "unknown tier $TIER"; exit 2 ;;
 esac
 echo "ci($TIER): PASSED"

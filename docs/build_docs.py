@@ -31,7 +31,7 @@ PAGES = [("index", os.path.join(ROOT, "README.md"), "Overview"),
           "Fault tolerance & elastic recovery"),
          ("serving", os.path.join(DOCS, "serving.md"),
           "Serving (continuous batching, prefix cache, fleet router, "
-          "quantized tier)"),
+          "quantized tier, disaggregated fleet + tiered cache)"),
          ("performance", os.path.join(DOCS, "performance.md"),
           "Performance (host + in-graph overlap, Pallas kernel tier)"),
          ("analysis", os.path.join(DOCS, "analysis.md"),

@@ -274,6 +274,25 @@ class FFConfig:
     # Greedy serving streams are token-identical under either impl
     # (tests/test_pallas_paged.py pins it).
     paged_attention_impl: str = "auto"
+    # ---- disaggregated fleet + tiered prefix cache (ISSUE 12) ----
+    # pinned host-memory second tier under the radix prefix cache
+    # (runtime/serving.py): refcount-0 KV pages evicted under pool
+    # pressure DEMOTE to host RAM (async ordered D2H) instead of dying,
+    # and a trie match against a host-resident edge PROMOTES the page
+    # back (H2D, bitwise). Sized in pages of kv_page_size positions —
+    # the effective shared-prefix corpus becomes host-RAM-sized instead
+    # of HBM-sized. 0 = off (the PR-6 evict-means-die behavior).
+    host_kv_pages: int = 0
+    # fleet replica roles (runtime/router.py ServingRouter): ""
+    # (default) = every replica "mixed", bit-identical to the pre-role
+    # fleet. A comma-separated list, one per replica (e.g.
+    # "prefill,decode,decode"), turns on the disaggregated role split:
+    # prefill replicas absorb long-prompt admission and hand the
+    # finished KV pages off to decode replicas as a serialized page
+    # slab, keeping decode slot occupancy high under bursty long-prompt
+    # traffic. Roles are placement preferences, never constraints — a
+    # dead tier degrades to the mixed-fleet path.
+    serve_replica_roles: str = ""
     # jax persistent compilation cache directory ("" = off): set before
     # the first trace (FFModel.compile / launcher) so repeated runs skip
     # recompiles; serving logs hit/miss per program build
@@ -346,6 +365,20 @@ class FFConfig:
             raise ValueError(
                 f"serve_max_queue={self.serve_max_queue}: must be >= 0 "
                 f"(0 = unbounded router queue)")
+        if self.host_kv_pages < 0:
+            raise ValueError(
+                f"host_kv_pages={self.host_kv_pages}: must be >= 0 "
+                f"(0 = no host tier)")
+        if self.serve_replica_roles:
+            roles = [t.strip()
+                     for t in self.serve_replica_roles.split(",")]
+            bad = [t for t in roles
+                   if t not in ("prefill", "decode", "mixed")]
+            if bad or not all(roles):
+                raise ValueError(
+                    f"serve_replica_roles={self.serve_replica_roles!r}: "
+                    f"comma-separated 'prefill'|'decode'|'mixed', one "
+                    f"per replica (bad: {bad or 'empty entry'})")
         if self.paged_attention_impl not in ("auto", "pallas", "einsum"):
             raise ValueError(
                 f"paged_attention_impl={self.paged_attention_impl!r}: "
@@ -460,6 +493,16 @@ class FFConfig:
         p.add_argument("--serve-max-queue", type=int, default=0,
                        help="fleet-router queue bound: submissions past "
                             "it are rejected fast (0 = unbounded)")
+        p.add_argument("--host-kv-pages", type=int, default=0,
+                       help="pinned host-memory tier under the radix "
+                            "prefix cache, in kv_page_size pages: "
+                            "evicted ref-0 pages demote to host RAM "
+                            "and promote back on a hit (0 = off)")
+        p.add_argument("--serve-replica-roles", type=str, default="",
+                       help="fleet replica roles, comma-separated "
+                            "prefill|decode|mixed, one per replica "
+                            "('' = all mixed); prefill replicas hand "
+                            "finished KV pages off to decode replicas")
         p.add_argument("--paged-attention-impl", type=str, default="auto",
                        choices=("auto", "pallas", "einsum"),
                        help="decode attention over the paged pool: "
@@ -520,6 +563,8 @@ class FFConfig:
             serve_prefix_cache=not args.no_prefix_cache,
             serve_speculate_k=args.serve_speculate_k,
             serve_max_queue=args.serve_max_queue,
+            host_kv_pages=args.host_kv_pages,
+            serve_replica_roles=args.serve_replica_roles,
             paged_attention_impl=args.paged_attention_impl,
             kv_cache_dtype=args.kv_cache_dtype,
             serve_weight_dtype=args.serve_weight_dtype,

@@ -1287,11 +1287,16 @@ class FFModel:
         ``weight_dtype="int8"|"fp8"``) stores KV pages and/or weights
         narrow with in-kernel dequant: 2-4x the tokens per pool byte at
         a documented per-dtype divergence budget (docs/serving.md
-        "Quantized tier"). Knobs default to this model's FFConfig
-        (serve_slots, kv_page_size, kv_pages, decode_buckets,
-        serve_prefix_cache, serve_speculate_k, draft_model,
-        kv_cache_dtype, serve_weight_dtype); kwargs override per engine
-        (see ServingEngine)."""
+        "Quantized tier"). ``host_kv_pages`` adds a pinned host-memory
+        tier under the prefix cache (evicted ref-0 pages demote to host
+        RAM and promote back on a hit — the shared-prefix corpus
+        becomes host-RAM-sized), and ``warmup(prompts)`` drives every
+        reachable prefill variant so timed windows never compile. Knobs
+        default to this model's FFConfig (serve_slots, kv_page_size,
+        kv_pages, decode_buckets, serve_prefix_cache, host_kv_pages,
+        serve_speculate_k, draft_model, kv_cache_dtype,
+        serve_weight_dtype); kwargs override per engine (see
+        ServingEngine)."""
         from flexflow_tpu.runtime.serving import ServingEngine
 
         return ServingEngine(self, **kwargs)
@@ -1315,10 +1320,15 @@ class FFModel:
         its work resubmitted to survivors exactly once), per-request
         deadlines, overload shedding (``max_queue`` /
         FFConfig.serve_max_queue) and least-loaded + prefix-affinity
-        placement on the replicas' live health counters. Router kwargs
-        (``max_queue``, ``health_timeout_s``, ``dispatch_backlog``,
-        ``start``) are split out; everything else is forwarded to every
-        replica's ServingEngine."""
+        placement on the replicas' live health counters. ``roles=``
+        (or FFConfig.serve_replica_roles) disaggregates the fleet:
+        ``prefill`` replicas absorb long-prompt admission and hand the
+        finished KV pages off to ``decode`` replicas as a serialized
+        page slab — greedy streams stay token-identical, and a dead
+        tier degrades to the mixed path. Router kwargs (``max_queue``,
+        ``health_timeout_s``, ``dispatch_backlog``, ``roles``,
+        ``handoff_min_pages``, ``start``) are split out; everything
+        else is forwarded to every replica's ServingEngine."""
         from flexflow_tpu.runtime.router import ServingRouter
 
         return ServingRouter(self, replicas=replicas, **kwargs)

@@ -542,6 +542,40 @@ class MultiHeadAttention(Op):
             out[name + "_scale"] = sc.at[page_ids].set(new)
         return out
 
+    def export_page(self, cache, page):
+        """Slice pool page(s) out as the serializable migration payload
+        — the unit both the prefill->decode fleet handoff and the
+        HBM->host tier demotion move (runtime/serving.py). ``page`` is a
+        scalar or a (n,) index array (ONE gather per pool array serves a
+        whole demotion sweep). Returns device arrays (the caller starts
+        ``copy_to_host_async`` and resolves to numpy off the hot path);
+        quantized pools include the pages' per-kv-head scales so a
+        re-imported page is BITWISE the donor's — dequantized attention
+        on the importer sees exactly what the donor's decode saw."""
+        out = {"k": cache["k"][page], "v": cache["v"][page]}
+        for name in ("k_scale", "v_scale"):
+            if name in cache:
+                out[name] = cache[name][page]
+        return out
+
+    def import_page(self, cache, page, payload):
+        """Write exported page payload(s) back into the pool at
+        ``page`` (scalar, or a (n,) traced index vector — the serving
+        engine pads batches to a fixed width with scratch page 0, so
+        ONE compiled writer serves every promotion/import batch) — the
+        decode half of the handoff and the H2D tier promotion. Payload
+        bytes are copied verbatim (no requantization: scales ride the
+        payload), so export -> import round-trips bitwise. Only ever
+        targets freshly allocated pages (the copy-on-write rule: a
+        published page is never written), so a wholesale replace cannot
+        touch shared state."""
+        out = dict(cache)
+        for name, x in payload.items():
+            pool = cache[name]
+            out[name] = pool.at[page].set(
+                jnp.asarray(x).astype(pool.dtype))
+        return out
+
     def gather_paged_kv(self, cache, pages):
         """Read ``pages`` ((n,) int32) out of the pool as a full-width
         (1, n * page_size, KVH, Dh) k/v view — what a prefix-cache hit

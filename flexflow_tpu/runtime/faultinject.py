@@ -51,7 +51,13 @@ Consumers:
     tick counter reaches the trigger);
   * ``ServingEngine._admit`` checks ``slow(<ms>)@serve:<n>`` and stalls
     the n-th admission host-side by ``<ms>`` — the slow-replica drill
-    that expires an in-flight deadline deterministically.
+    that expires an in-flight deadline deterministically;
+  * the tiered prefix cache (``runtime/serving.py RadixPrefixCache``)
+    checks ``d2h_fail@migrate:<n>`` on the n-th HBM->host demotion (the
+    page dies exactly as it would without a host tier) and
+    ``h2d_fail@promote:<n>`` on the n-th host->HBM promotion (the host
+    copy is killed and admission falls back to cold prefill) — neither
+    may stall the scheduler or mount a corrupt page.
 
 The active plan is parsed lazily from ``FF_FAULT`` and re-parsed (with
 occurrence counters reset) whenever the env value changes; tests that

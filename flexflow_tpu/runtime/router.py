@@ -48,6 +48,31 @@ level up: this router routes, sheds and fails over on the live
     never a constraint — a fenced or saturated home replica falls back
     to least-loaded, so affinity can neither black-hole nor starve.
 
+  * ROLE-SPLIT DISAGGREGATION (ISSUE 12) — replicas carry a role:
+    ``mixed`` (the default: every replica does everything, bit-identical
+    to the pre-role fleet), ``prefill`` or ``decode``. The paper's core
+    claim — role-specialized placement beats treating every device
+    identically (the Operator/Parameter split of "Beyond Data and Model
+    Parallelism") — applied to serving: one bursty long-prompt admission
+    on a mixed fleet stalls decode slot occupancy fleet-wide, so
+    prefill-heavy replicas absorb long-prompt admission
+    (``handoff_min_pages`` full pages or more) and HAND OFF the finished
+    prompt's KV pages + quantized scales to a decode replica as a
+    serialized page slab (ServingEngine.prefill_into_cache ->
+    export_prefix_slab -> import_prefix_slab: the paged pool is the
+    serialization boundary, decode-side ingestion is a page scatter +
+    trie publish through one fixed-shape writer, and the decode
+    replica's submit admits as a prefix HIT — the handoff moves pages,
+    never tokens, so greedy streams stay token-identical). Placement is
+    role- and queue-depth-aware least-loaded; every role preference
+    falls back (a dead prefill tier downgrades work to the cold path on
+    decode replicas; a fleet with only prefill replicas alive decodes
+    there) so the split can never strand work. Prefix affinity gains a
+    TIER dimension: the home replica's engine reports depth-1
+    demotions/promotions (drain_tier_events), so an affinity entry
+    whose pages demoted to the host tier keeps routing home (promotion
+    beats recompute) and only drops when the prefix dies in both tiers.
+
 Failure drills are deterministic in CI via FF_FAULT
 (runtime/faultinject.py): ``crash@replica:<r>`` kills replica r's driver
 at its first busy tick (``crash(<t>)@replica:<r>`` at its t-th),
@@ -93,7 +118,16 @@ class FleetRequest:
     # queued | dispatched | done | failed | timeout | rejected
     state: str = "queued"
     replica: int = -1               # current/last replica
-    attempts: int = 0               # dispatches (attempts-1 = failovers)
+    attempts: int = 0               # dispatches (a clean role-split
+    #                                 handoff uses 2: prefill + decode)
+    losses: int = 0                 # replicas that died under this
+    #                                 request (the exactly-once cap: 2)
+    # role-split lifecycle: "direct" = the classic single-dispatch path;
+    # "prefill" = headed to a prefill replica for prefill-only + slab
+    # export; "decode" = slab in hand, headed to a decode replica
+    phase: str = "direct"
+    slab: Optional[Dict] = None     # exported page slab (host bytes)
+    handoff: bool = False           # ever routed through a prefill tier
     tokens: List[int] = field(default_factory=list)
     error: str = ""
     t_submit: float = 0.0
@@ -133,10 +167,13 @@ class ServingRouter:
     # meaningful.
     DEFAULT_HEALTH_TIMEOUT_S = 60.0
 
+    ROLES = ("prefill", "decode", "mixed")
+
     def __init__(self, model, replicas: int = 2,
                  max_queue: Optional[int] = None,
                  health_timeout_s: Optional[float] = None,
                  dispatch_backlog: Optional[int] = None,
+                 roles=None, handoff_min_pages: int = 1,
                  start: bool = True, **engine_kwargs):
         if health_timeout_s is None:
             health_timeout_s = self.DEFAULT_HEALTH_TIMEOUT_S
@@ -148,6 +185,38 @@ class ServingRouter:
         cfg = model.config
         self.model = model
         self.n = int(replicas)
+        # replica roles (ISSUE 12): default "mixed" for every replica —
+        # bit-identical to the pre-role fleet, so existing tests, benches
+        # and smokes measure the same machine. A per-replica list (or
+        # FFConfig.serve_replica_roles as "prefill,decode,decode") turns
+        # on the disaggregated placement + handoff below.
+        raw = (roles if roles is not None
+               else getattr(cfg, "serve_replica_roles", "") or "")
+        if isinstance(raw, str):
+            role_list = [t.strip() for t in raw.split(",") if t.strip()]
+        else:
+            role_list = [str(t) for t in raw]
+        if not role_list:
+            role_list = ["mixed"] * self.n
+        if len(role_list) != self.n:
+            raise ValueError(
+                f"roles={role_list}: need one role per replica "
+                f"({self.n}), one of {self.ROLES}")
+        bad = [t for t in role_list if t not in self.ROLES]
+        if bad:
+            raise ValueError(
+                f"roles={role_list}: unknown role(s) {bad} — each must "
+                f"be one of {self.ROLES}")
+        if all(t == "prefill" for t in role_list):
+            raise ValueError(
+                f"roles={role_list}: a fleet of only prefill replicas "
+                f"has nowhere to decode — include a 'decode' or "
+                f"'mixed' replica")
+        self.roles = role_list
+        self.handoff_min_pages = int(handoff_min_pages)
+        if self.handoff_min_pages < 1:
+            raise ValueError(
+                f"handoff_min_pages={handoff_min_pages}: must be >= 1")
         self.max_queue = int(max_queue if max_queue is not None
                              else getattr(cfg, "serve_max_queue", 0))
         if self.max_queue < 0:
@@ -166,6 +235,12 @@ class ServingRouter:
                                     if dispatch_backlog is not None
                                     else slots)
         self._cap = slots + self.dispatch_backlog
+        # the role split hands off through the radix trie: without it a
+        # prefill replica has nowhere to publish, so the fleet quietly
+        # degrades to direct placement (roles still shape placement)
+        self._handoff_capable = (
+            any(t == "prefill" for t in self.roles)
+            and self.engines[0].prefix_cache is not None)
 
         self._lock = threading.RLock()
         self._queue: collections.deque = collections.deque()  # FleetRequest
@@ -194,6 +269,12 @@ class ServingRouter:
         self._rejected = 0
         self._fenced_count = 0
         self._resubmitted = 0
+        # role-split ledger: completed handoffs (prefill done, slab
+        # moved to the decode queue), downgrades to the cold path (no
+        # prefill replica alive / prefill-side pressure), and slab
+        # imports that fell back cold on the decode side
+        self._handoffs = 0
+        self._handoff_fallbacks = 0
         self._ttfts = collections.deque(maxlen=4096)
         self._threads: List[threading.Thread] = []
         self._started = False
@@ -305,14 +386,29 @@ class ServingRouter:
 
     def warmup(self, prompts, max_new_tokens: int = 4):
         """Drive ``prompts`` through EVERY replica engine directly
-        (bypassing the router queue) so all replicas compile the same
-        program set before measured traffic: failover traffic onto a
-        survivor then hits only warm programs — the smoke asserts zero
-        survivor recompiles through a mid-flight crash. Call while the
-        fleet is quiet (before submitting routed traffic)."""
+        (bypassing the router queue) via ``ServingEngine.warmup`` — all
+        cold-prefill buckets, every (bucket, matched_pages) hit variant
+        the set can reach (two passes: publish, then saturated repeat),
+        the decode/verify programs, and (for role-split or tiered
+        fleets) the shared page-import writer — so failover AND handoff
+        traffic later hits only warm programs: the smoke asserts zero
+        survivor recompiles through a mid-flight crash of the prefill
+        replica. Call while the fleet is quiet (before routed
+        traffic)."""
+        plist = [np.asarray(p, np.int32).reshape(-1) for p in prompts]
         for eng in self.engines:
-            eng.run([np.asarray(p, np.int32) for p in prompts],
-                    max_new_tokens=max_new_tokens)
+            eng.warmup(plist, max_new_tokens=max_new_tokens)
+        if self._handoff_capable:
+            cand = max((p for p in plist if p.size >= self.page_size),
+                       key=lambda p: p.size, default=None)
+            for r, eng in enumerate(self.engines):
+                if eng.prefix_cache is None:
+                    continue
+                if cand is None or not eng.warm_page_import(cand):
+                    fflogger.warning(
+                        "router: warmup could not warm replica %d's "
+                        "page-import writer — its first handoff will "
+                        "compile it", r)
 
     def drain(self) -> Dict:
         """Graceful fleet shutdown: stop admitting, let the drivers
@@ -354,46 +450,117 @@ class ServingRouter:
         # SUBSET of outstanding — never add the two)
         return len(self._outstanding[r])
 
-    def _pick_replica_locked(self, req: FleetRequest) -> Optional[int]:
+    def _eligible_locked(self, phase: str) -> List[int]:
+        """Live replicas whose role fits the request phase. Roles are a
+        preference, never a constraint: with the decode side gone,
+        prefill replicas decode (the fleet degrades to mixed); with the
+        prefill side gone, _classify_locked already downgraded the work
+        to the cold path."""
         alive = self._alive()
-        if not alive:
-            return None
-        if req.affinity is not None:
-            home = self._affinity.get(req.affinity)
-            if home is not None and not self._fenced[home] \
-                    and self._load(home) < self._cap:
-                return home
-        cands = [r for r in alive if self._load(r) < self._cap]
+        if phase == "prefill":
+            return [r for r in alive if self.roles[r] == "prefill"]
+        cands = [r for r in alive if self.roles[r] != "prefill"]
+        return cands or alive
+
+    def _classify_locked(self, req: FleetRequest):
+        """Pick the request's phase at dispatch time (roles and liveness
+        change between submit and dispatch): long prompts (>=
+        handoff_min_pages matchable full pages) route through a live
+        prefill replica for prefill-only + slab handoff — unless their
+        prefix is already homed on a live decode-side replica, where a
+        direct dispatch is a guaranteed trie hit and the handoff would
+        move bytes for nothing. Everything else (and every downgrade
+        when the prefill tier is dead or failed) takes the classic
+        direct path."""
+        if req.phase == "decode":
+            return                  # slab in hand, decode placement only
+        was_prefill = req.phase == "prefill"
+        req.phase = "direct"
+        if not self._handoff_capable:
+            return
+        matchable = (req.prompt.size - 1) // self.page_size
+        if matchable < self.handoff_min_pages:
+            return
+        if not any(self.roles[r] == "prefill" for r in self._alive()):
+            if was_prefill:
+                # the prefill tier died under this request: cold-path
+                # fallback on the decode side, never stranded
+                self._handoff_fallbacks += 1
+            return
+        entry = (self._affinity.get(req.affinity)
+                 if req.affinity is not None else None)
+        if entry is not None and not self._fenced[entry[0]] \
+                and self.roles[entry[0]] != "prefill":
+            return                  # warm home: direct hit beats handoff
+        req.phase = "prefill"
+
+    def _pick_replica_locked(self, req: FleetRequest) -> Optional[int]:
+        cands = self._eligible_locked(req.phase)
         if not cands:
             return None
-        return min(cands, key=lambda r: (self._load(r), r))
+        if req.affinity is not None and req.phase != "prefill":
+            entry = self._affinity.get(req.affinity)
+            if entry is not None:
+                home, _tier = entry
+                if home in cands and self._load(home) < self._cap:
+                    return home
+        cands = [r for r in cands if self._load(r) < self._cap]
+        if not cands:
+            return None
+        # role- and queue-depth-aware least-loaded: the router's exact
+        # outstanding ledger first, the engine's live queue depth (the
+        # lock-free probe) as the tie-break
+        return min(cands, key=lambda r: (
+            self._load(r), self.engines[r].load()["queued"], r))
 
     def _dispatch_locked(self):
         """Assign queued work: expired requests retire as timeout
         BEFORE placement (never dispatched), the rest go to the affinity
-        home when it is live and has room, else the least-loaded live
-        replica with room. Assignment only moves the request onto the
-        replica's hand-off deque — the driver thread performs the actual
-        engine.submit on its own lock, so dispatch never blocks behind a
-        replica mid-tick."""
+        home when it is live and has room, else the least-loaded
+        role-eligible replica with room. Assignment only moves the
+        request onto the replica's hand-off deque — the driver thread
+        performs the actual engine.submit on its own lock, so dispatch
+        never blocks behind a replica mid-tick.
+
+        FIFO is per ROLE TIER, not fleet-wide: a phase-"prefill" head
+        that cannot place (prefill tier saturated) is SKIPPED — direct
+        and decode work behind it still flows to the decode side (one
+        full role tier must not stall the whole fleet; prefill requests
+        stay FIFO among themselves). A direct/decode request that
+        cannot place stops the scan — the decode side is genuinely
+        full, which is the pre-role blocking rule."""
         now = time.perf_counter()
-        while self._queue:
-            req = self._queue[0]
+        prefill_blocked = False
+        i = 0
+        while i < len(self._queue):
+            req = self._queue[i]
             if req.deadline is not None and now >= req.deadline:
-                self._queue.popleft()
+                del self._queue[i]
                 self._finalize_locked(
                     req, "timeout", "deadline expired in router queue")
                 continue
+            self._classify_locked(req)
+            if prefill_blocked and req.phase == "prefill":
+                i += 1
+                continue
             r = self._pick_replica_locked(req)
             if r is None:
+                if req.phase == "prefill":
+                    prefill_blocked = True
+                    i += 1
+                    continue
                 return
-            self._queue.popleft()
+            del self._queue[i]
             req.state = "dispatched"
             req.replica = r
             req.attempts += 1
             self._dispatched += 1
-            if req.affinity is not None:
-                self._affinity[req.affinity] = r
+            if req.affinity is not None and req.phase != "prefill":
+                # the affinity home is where the prefix DECODES (and
+                # therefore publishes); a prefill dispatch must not
+                # steal the key from the decode side. Tier starts hbm;
+                # the replica's tier events keep it current.
+                self._affinity[req.affinity] = (r, "hbm")
                 self._affinity.move_to_end(req.affinity)
                 while len(self._affinity) > self._affinity_cap:
                     self._affinity.popitem(last=False)
@@ -417,8 +584,11 @@ class ServingRouter:
     def _fence_locked(self, r: int, reason: str):
         """Fence replica r: mark it dead, requeue its outstanding work.
         Exactly-once resubmission: a request is resubmitted only from
-        state "dispatched" on THIS replica, at most once overall
-        (attempts caps at 2), and never after its deadline — an expired
+        state "dispatched" on THIS replica, at most once overall (the
+        cap counts replica LOSSES, not dispatches: ``losses`` caps at 2,
+        since a clean role-split handoff legitimately dispatches twice
+        — prefill then decode — and a failed-over handoff three times),
+        and never after its deadline — an expired
         in-flight request is already worthless, so it retires as timeout
         instead of burning survivor capacity."""
         if self._fenced[r]:
@@ -434,11 +604,15 @@ class ServingRouter:
         for _, (req, _ereq) in sorted(out.items()):
             if req.state != "dispatched" or req.replica != r:
                 continue
+            req.losses += 1     # a replica died under this request —
+            #                     the exactly-once cap counts LOSSES,
+            #                     not dispatches (a clean role-split
+            #                     handoff legitimately dispatches twice)
             if req.deadline is not None and now >= req.deadline:
                 self._finalize_locked(
                     req, "timeout",
                     f"deadline expired in flight on fenced replica {r}")
-            elif req.attempts >= 2:
+            elif req.losses >= 2:
                 self._finalize_locked(
                     req, "failed",
                     f"replica lost twice (last: {reason})")
@@ -448,6 +622,10 @@ class ServingRouter:
                 req.tokens = []   # discard the dead replica's partial
                 #                   stream: the survivor re-decodes the
                 #                   identical greedy tokens from scratch
+                #                   (a phase-"prefill" victim re-
+                #                   classifies at dispatch: with the
+                #                   prefill tier gone it downgrades to
+                #                   the cold path on a decode replica)
                 requeued.append(req)
                 self._resubmitted += 1
         # front of the queue, original order: failover work has waited
@@ -455,7 +633,7 @@ class ServingRouter:
         for req in reversed(requeued):
             self._queue.appendleft(req)
         # shared-prefix homes pointing at the corpse re-home on next use
-        for key in [k for k, v in self._affinity.items() if v == r]:
+        for key in [k for k, v in self._affinity.items() if v[0] == r]:
             del self._affinity[key]
         fflogger.warning(
             "router: replica %d FENCED (%s) — %d requests resubmitted, "
@@ -531,6 +709,28 @@ class ServingRouter:
                     if self._maybe_injected_fault(r):
                         return
                 for req in assigned:
+                    if req.phase == "prefill":
+                        # prefill-replica half of the handoff: prefill
+                        # only, export the slab, bounce the request back
+                        # to the router queue for decode placement. An
+                        # engine death in here propagates to the fence
+                        # below — the exactly-once machinery requeues.
+                        self._handoff_prefill(r, eng, req)
+                        continue
+                    if req.slab is not None:
+                        # decode-side ingestion: page scatter + trie
+                        # publish; the submit below then admits as a
+                        # prefix HIT. Any import problem falls back to
+                        # the cold path — always correct, never lost.
+                        try:
+                            eng.import_prefix_slab(req.slab)
+                        except Exception as e:  # noqa: BLE001
+                            fflogger.warning(
+                                "router: slab import on replica %d "
+                                "failed (%s) — cold-path fallback", r, e)
+                            with self._lock:
+                                self._handoff_fallbacks += 1
+                        req.slab = None
                     ereq = eng.submit(req.prompt, req.max_new_tokens,
                                       deadline=req.deadline)
                     with self._lock:
@@ -546,8 +746,60 @@ class ServingRouter:
                 return
             self._heartbeat[r] = time.monotonic()
             self._collect(r)
+            self._collect_tier_events(r)
             if not progressed and not assigned:
                 time.sleep(0.002)   # idle: don't spin the host
+
+    def _handoff_prefill(self, r: int, eng, req: FleetRequest):
+        """Prefill-replica half of the role split: run the prefill-only
+        admission through the replica's warm bucket programs, export the
+        finished prompt's KV pages (+ quantized scales, draft pool
+        included) as a host-memory slab, and move the request — slab in
+        hand — to the FRONT of the router queue for decode placement
+        (handoff work has waited longest). Pool pressure or a failed
+        export downgrades to the cold path on a decode replica; an
+        engine death propagates to the driver's fence handler, whose
+        exactly-once requeue re-classifies the request at its next
+        dispatch."""
+        slab = None
+        if eng.prefill_into_cache(req.prompt) is not None:
+            slab = eng.export_prefix_slab(req.prompt)
+        with self._lock:
+            if self._fenced[r]:
+                return          # the fence already requeued this request
+            if req.state != "dispatched" or req.replica != r:
+                return          # stale: resubmitted elsewhere meanwhile
+            self._outstanding[r].pop(req.rid, None)
+            req.state = "queued"
+            req.replica = -1
+            req.phase = "decode" if slab is not None else "direct"
+            req.slab = slab
+            if slab is not None:
+                req.handoff = True
+                self._handoffs += 1
+            else:
+                self._handoff_fallbacks += 1
+            self._queue.appendleft(req)
+
+    def _collect_tier_events(self, r: int):
+        """Fold the replica's depth-1 tier transitions into the affinity
+        map's TIER dimension: a demoted prefix keeps routing home (the
+        host copy + H2D promotion beats a cold re-prefill anywhere
+        else), and a prefix dead in BOTH tiers drops its entry so
+        cold-prefix traffic stops chasing a page that no longer
+        exists."""
+        events = self.engines[r].drain_tier_events()
+        if not events:
+            return
+        with self._lock:
+            for key, tier in events:
+                entry = self._affinity.get(key)
+                if entry is None or entry[0] != r:
+                    continue
+                if tier is None:
+                    del self._affinity[key]
+                else:
+                    self._affinity[key] = (r, tier)
 
     def _collect(self, r: int):
         """Finalize engine requests that settled on replica r. Runs on
@@ -581,11 +833,19 @@ class ServingRouter:
     # ---- observability ------------------------------------------------------
 
     def stats(self) -> Dict:
-        """Fleet ledger + per-replica engine stats. The router counters
-        (fenced, resubmitted, timeouts, rejected) are the failure-drill
+        """Fleet ledger + per-replica engine stats + the FLEET ROLLUP
+        (the ISSUE-12 satellite): per-replica ``ServingEngine.stats()``
+        merged into one ``"fleet"`` dict — aggregate prefix hit rate,
+        pages by tier (hbm/host), handoff and migration counters, and
+        per-role queue depths — so callers stop looping replicas and
+        re-deriving rates by hand. The router counters (fenced,
+        resubmitted, timeouts, rejected) are the failure-drill
         acceptance surface; TTFT percentiles cover COMPLETED requests
         and measure router-submit -> first token (queue wait included —
-        that is what shedding bounds)."""
+        that is what shedding bounds). Engine snapshots are taken
+        OUTSIDE the router lock (each serializes behind its own
+        replica's tick only)."""
+        eng_stats = [eng.stats() for eng in self.engines]
         with self._lock:
             ttfts = sorted(self._ttfts)
 
@@ -596,7 +856,8 @@ class ServingRouter:
 
             per_replica = []
             for r, eng in enumerate(self.engines):
-                row = {"replica": r, "fenced": self._fenced[r],
+                row = {"replica": r, "role": self.roles[r],
+                       "fenced": self._fenced[r],
                        "fence_reason": self._fence_reason[r],
                        "outstanding": self._load(r),
                        **eng.load()}
@@ -604,6 +865,7 @@ class ServingRouter:
             return {
                 "replicas": self.n,
                 "alive": len(self._alive()),
+                "roles": list(self.roles),
                 "submitted": self._submitted,
                 "dispatched": self._dispatched,
                 "completed": self._completed,
@@ -612,13 +874,55 @@ class ServingRouter:
                 "rejected": self._rejected,
                 "fenced": self._fenced_count,
                 "resubmitted": self._resubmitted,
+                "handoffs": self._handoffs,
+                "handoff_fallbacks": self._handoff_fallbacks,
                 "queued": len(self._queue),
                 "max_queue": self.max_queue,
                 "ttft_p50_ms": round(pct(0.50) * 1e3, 3),
                 "ttft_p99_ms": round(pct(0.99) * 1e3, 3),
                 "affinity_keys": len(self._affinity),
+                "affinity_host_keys": sum(
+                    1 for v in self._affinity.values() if v[1] == "host"),
                 "per_replica": per_replica,
+                "fleet": self._fleet_rollup_locked(eng_stats),
             }
+
+    def _fleet_rollup_locked(self, eng_stats: List[Dict]) -> Dict:
+        """Merge per-replica engine stats into ONE fleet dict."""
+        agg = {k: sum(s[k] for s in eng_stats)
+               for k in ("requests", "completed", "failed", "timeouts",
+                         "tokens_generated", "recompiles",
+                         "prefix_lookups", "prefix_hits",
+                         "prefill_tokens_saved", "prefix_evictions",
+                         "kv_pages_hbm", "kv_pages_host",
+                         "tier_demotions", "tier_promotions",
+                         "tier_demote_failures", "tier_promote_failures",
+                         "tier_host_evictions", "tier_pending_migrations",
+                         "prefill_only_requests", "prefix_slab_exports",
+                         "prefix_slab_imports", "prefix_pages_imported",
+                         "spec_proposed", "spec_accepted")}
+        agg["prefix_hit_rate"] = round(
+            agg["prefix_hits"] / max(1, agg["prefix_lookups"]), 4)
+        agg["spec_accept_rate"] = round(
+            agg["spec_accepted"] / max(1, agg["spec_proposed"]), 4)
+        agg["pages_by_tier"] = {"hbm": agg.pop("kv_pages_hbm"),
+                                "host": agg.pop("kv_pages_host")}
+        agg["handoffs"] = self._handoffs
+        agg["handoff_fallbacks"] = self._handoff_fallbacks
+        per_role: Dict[str, Dict] = {}
+        for r, role in enumerate(self.roles):
+            row = per_role.setdefault(role, {
+                "replicas": 0, "alive": 0, "outstanding": 0,
+                "queued": 0, "active_slots": 0})
+            row["replicas"] += 1
+            if not self._fenced[r]:
+                load = self.engines[r].load()
+                row["alive"] += 1
+                row["outstanding"] += self._load(r)
+                row["queued"] += load["queued"]
+                row["active_slots"] += load["active_slots"]
+        agg["per_role"] = per_role
+        return agg
 
     def health(self) -> Dict:
         """Cheap fleet probe: never takes an engine lock (per-replica

@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""CI disaggregated-fleet smoke (ci/run_ci.sh `disagg` tier).
+
+Phase 1 — role-split crash drill: a skewed shared-prefix workload on a
+1-prefill/2-decode fleet, with FF_FAULT ``crash(<t>)@replica:0`` felling
+the PREFILL replica mid-handoff. Proves the ISSUE-12 acceptance end to
+end on CPU:
+
+  * long-prompt admission routes through the prefill replica and hands
+    off as page slabs (handoffs > 0, zero routed completions there);
+  * when the prefill tier dies, in-flight and later long prompts fall
+    back to the COLD path on decode replicas — every request completes
+    EXACTLY ONCE (router ledger == decode-engine completions), none
+    lost, none duplicated, each losing at most one replica;
+  * greedy streams stay token-identical to solo generate through the
+    handoff AND through the fallback;
+  * ZERO survivor recompiles: router.warmup() drove every (bucket,
+    matched_pages) variant plus the page-import writer on every replica.
+
+Phase 2 — tiered prefix cache: a prefix working set ~3x the HBM pool on
+one engine with a host tier. Demotions and promotions fire, repeat
+traffic hits where an untiered pool would go cold, streams stay
+identical to a pressure-free engine, and drain leaves no refcounts, no
+pending migrations and no leaked pages.
+
+Usage: [FF_FAULT=crash(6)@replica:0] python scripts/disagg_smoke.py [N]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flexflow_tpu._env import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(1)
+
+import numpy as np  # noqa: E402
+
+from flexflow_tpu import FFConfig, FFModel  # noqa: E402
+from flexflow_tpu.models.llama import llama_lm  # noqa: E402
+
+VOCAB = 128
+PS = 8
+
+
+def build_model():
+    cfg = FFConfig(batch_size=2, mesh_shape={"data": 1}, serve_slots=4,
+                   kv_page_size=PS)
+    ff = FFModel(cfg)
+    _, logits = llama_lm(ff, 2, seq_len=16, hidden=64, layers=1, heads=4,
+                         kv_heads=2, vocab_size=VOCAB)
+    ff.compile(final_tensor=logits)
+    return ff
+
+
+def skewed_prompts(rs, n, system):
+    """60% share the 64-token system prompt (8 full pages, handed off
+    once then affinity-homed); 40% distinct backgrounds of 1-2 full
+    pages — EVERY one handoff-eligible, so the prefill replica stays
+    busy and the crash genuinely lands mid-handoff."""
+    prompts = []
+    for i in range(n):
+        if i % 5 < 3:
+            tail = rs.randint(1, VOCAB, (int(rs.randint(2, 9)),))
+            prompts.append(np.concatenate([system, tail.astype(np.int32)]))
+        else:
+            prompts.append(rs.randint(
+                1, VOCAB, (int(rs.randint(9, 25)),)).astype(np.int32))
+    return prompts
+
+
+def fleet_phase(ff, n_requests):
+    fault = os.environ.get("FF_FAULT", "")
+    rs = np.random.RandomState(0)
+    system = rs.randint(1, VOCAB, (64,)).astype(np.int32)
+    prompts = skewed_prompts(rs, n_requests, system)
+
+    router = ff.make_serving_router(
+        replicas=3, roles=["prefill", "decode", "decode"],
+        max_seq_len=112, decode_buckets=[32, 96], start=False)
+    # warm every (bucket, matched_pages) variant the workload — and its
+    # post-crash cold fallbacks — can reach, on EVERY replica, plus the
+    # page-import writer (ServingEngine.warmup does the two-pass sweep;
+    # crash@replica is identity-indexed, so warmup consumes nothing)
+    warm_tail = rs.randint(1, VOCAB, (3,)).astype(np.int32)
+    router.warmup([rs.randint(1, VOCAB, (10,)).astype(np.int32),
+                   rs.randint(1, VOCAB, (18,)).astype(np.int32),
+                   np.concatenate([system, warm_tail]),
+                   np.concatenate([system, warm_tail + 1])],
+                  max_new_tokens=4)
+    for r, eng in enumerate(router.engines):
+        assert eng.stats()["prefix_hits"] >= 1, \
+            f"replica {r} warmup never ran the hit prefill"
+        assert ("page_import",) in eng._programs, \
+            f"replica {r} warmup never compiled the page-import writer"
+    warm_compiles = [e.recompile_count for e in router.engines]
+    warm_done = [e.stats()["completed"] for e in router.engines]
+
+    t0 = time.perf_counter()
+    reqs = router.run(prompts, max_new_tokens=12, timeout=1800)
+    dt = time.perf_counter() - t0
+    st = router.stats()
+    done = [r for r in reqs if r.state == "done"]
+    print(f"disagg_smoke[fleet]: {len(done)}/{n_requests} done in "
+          f"{dt:.1f}s — handoffs {st['handoffs']}, fallbacks "
+          f"{st['handoff_fallbacks']}, fenced {st['fenced']}, "
+          f"resubmitted {st['resubmitted']}, fleet hit rate "
+          f"{st['fleet']['prefix_hit_rate']}")
+
+    # exactly once, nothing lost, nothing duplicated
+    assert len(done) == n_requests, \
+        f"{n_requests - len(done)} requests did not complete"
+    assert st["completed"] == n_requests
+    engine_done = sum(e.stats()["completed"] - w
+                      for e, w in zip(router.engines, warm_done))
+    assert engine_done == n_requests, (
+        f"engines completed {engine_done} != {n_requests}: duplicated "
+        f"or lost work")
+    # the prefill replica routed ZERO completions — prefill-only is its
+    # whole job (its engine_done delta is counted above and must be 0)
+    assert router.engines[0].stats()["completed"] == warm_done[0], \
+        "the prefill replica completed routed work"
+    assert router.engines[0].stats()["prefill_only_requests"] > 0
+    assert st["handoffs"] >= 1, "no prompt ever handed off"
+    assert all(r.losses <= 1 for r in reqs), "a request lost 2 replicas"
+
+    if "crash" in fault and "@replica:0" in fault:
+        assert st["fenced"] == 1, \
+            f"crash fault armed but fenced == {st['fenced']}"
+        assert st["handoff_fallbacks"] >= 1, (
+            "the crash was supposed to catch handoff work in flight "
+            "(cold-path fallback never fired)")
+        for r in (1, 2):
+            assert router.engines[r].recompile_count \
+                == warm_compiles[r], (
+                    f"survivor {r} recompile leak: "
+                    f"{router.engines[r].recompile_count - warm_compiles[r]}"
+                    f" programs built after warmup")
+        print(f"disagg_smoke[fleet]: prefill replica crashed mid-handoff"
+              f" ({st['per_replica'][0]['fence_reason']}); "
+              f"{st['handoff_fallbacks']} cold-path fallbacks, survivors"
+              f" built 0 new programs")
+    else:
+        assert st["fenced"] == 0
+        for r, eng in enumerate(router.engines):
+            assert eng.recompile_count == warm_compiles[r], \
+                f"replica {r} recompile leak without any fault"
+
+    # token identity through handoff AND fallback: every failed-over
+    # request + a sample of the rest vs solo generate
+    resub = [r for r in reqs if r.losses >= 1]
+    for r in resub + done[:: max(1, len(done) // 10)]:
+        solo = ff.generate(r.prompt[None, :], max_new_tokens=12)
+        np.testing.assert_array_equal(
+            np.asarray(r.tokens, np.int32), solo[0, r.prompt.size:],
+            err_msg=f"request {r.rid} (handoff={r.handoff}, losses="
+                    f"{r.losses}) diverged from its solo run")
+    print(f"disagg_smoke[fleet]: token identity held for {len(resub)} "
+          f"failed-over + sampled requests")
+
+
+def tier_phase(ff):
+    rs = np.random.RandomState(1)
+    # 18 distinct 2-page prefixes (+ tails) vs a pool that can cache
+    # only a few: the working set is ~3x the HBM pool, so the untiered
+    # engine would churn-and-die where the host tier keeps every prefix
+    prompts = [rs.randint(1, VOCAB, (18,)).astype(np.int32)
+               for _ in range(18)]
+    roomy = ff.make_serving_engine(serve_slots=2, kv_page_size=PS,
+                                   max_seq_len=48)
+    want = [[list(r.tokens) for r in roomy.run(prompts, max_new_tokens=6)]
+            for _ in range(2)]
+    eng = ff.make_serving_engine(serve_slots=2, kv_page_size=PS,
+                                 max_seq_len=48, kv_pages=20,
+                                 host_kv_pages=64)
+    got = [[list(r.tokens) for r in eng.run(prompts, max_new_tokens=6)]
+           for _ in range(2)]
+    assert got == want, "tier migrations changed a greedy stream"
+    st = eng.stats()
+    print(f"disagg_smoke[tier]: demotions {st['tier_demotions']}, "
+          f"promotions {st['tier_promotions']}, hits "
+          f"{st['prefix_hits']}/{st['prefix_lookups']}, host pages "
+          f"{st['kv_pages_host']}")
+    assert st["tier_demotions"] > 0 and st["tier_promotions"] > 0
+    assert st["prefix_hits"] >= len(prompts), \
+        "round 2 should hit every prefix via the host tier"
+    snap = eng.drain()
+    assert snap["prefix_refs_live"] == 0
+    assert snap["tier_pending_migrations"] == 0
+    freed = eng.flush_prefix_cache()
+    assert eng.stats()["free_pages"] == eng.num_pages - 1, \
+        "tier migrations leaked pool pages"
+    print(f"disagg_smoke[tier]: drained clean, flush reclaimed {freed} "
+          f"pages, zero leaks")
+
+
+def main():
+    n_requests = int(sys.argv[1]) if len(sys.argv) > 1 else 160
+    ff = build_model()
+    fleet_phase(ff, n_requests)
+    tier_phase(ff)
+    print("disagg_smoke: PASSED")
+
+
+if __name__ == "__main__":
+    main()

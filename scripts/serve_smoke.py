@@ -66,14 +66,15 @@ def main():
     prompts = [rs.randint(1, vocab, (n,)).astype(np.int32) for n in lens]
 
     eng = ff.make_serving_engine(max_seq_len=64)
-    # warmup: one request per bucket the lengths can hit (8, 16, 32).
-    # Warmup admissions CONSUME FF_FAULT serve occurrences, so the fault
-    # index in ci/run_ci.sh must exceed N_WARM — asserted below, loudly,
-    # instead of leaving the coupling implicit
+    # warmup via ServingEngine.warmup (one exemplar per bucket the
+    # lengths can hit — 8, 16, 32; warmup's second pass covers the
+    # repeat-hit variants). Warmup admissions CONSUME FF_FAULT serve
+    # occurrences, so the fault index in ci/run_ci.sh must exceed
+    # N_WARM — asserted below, loudly, instead of leaving the coupling
+    # implicit
     warm_prompts = [rs.randint(1, vocab, (n,)).astype(np.int32)
                     for n in (8, 16, 24)]
-    eng.run(warm_prompts, max_new_tokens=4)
-    n_warm = len(warm_prompts)
+    n_warm = eng.warmup(warm_prompts, max_new_tokens=4)["requests"]
     warm = eng.recompile_count
 
     t0 = time.perf_counter()
@@ -162,15 +163,15 @@ def prefix_smoke(ff, rs, vocab, n_requests, kv_cache_dtype=None,
     eng = ff.make_serving_engine(max_seq_len=112, decode_buckets=[32, 96],
                                  kv_cache_dtype=kv_cache_dtype,
                                  weight_dtype=weight_dtype)
-    # warm every program the workload can need: cold prefill per bucket,
-    # the (bucket 96, 8 matched pages) hit prefill, and the decode scan.
-    # The first skewed warm request PUBLISHES the system pages, so the
-    # second takes the hit path — the measured window then compiles
-    # nothing.
+    # ServingEngine.warmup drives every program the workload can need:
+    # cold prefill per bucket, the (bucket 96, 8 matched pages) hit
+    # prefill (pass 1 publishes the system pages, the repeats hit), and
+    # the decode scan — the measured window then compiles nothing.
     warm_tail = rs.randint(1, vocab, (3,)).astype(np.int32)
-    eng.run([rs.randint(1, vocab, (10,)).astype(np.int32),
-             np.concatenate([system, warm_tail]),
-             np.concatenate([system, warm_tail + 1])], max_new_tokens=4)
+    eng.warmup([rs.randint(1, vocab, (10,)).astype(np.int32),
+                np.concatenate([system, warm_tail]),
+                np.concatenate([system, warm_tail + 1])],
+               max_new_tokens=4)
     warm = eng.recompile_count
     assert eng.stats()["prefix_hits"] >= 1, "warmup hit prefill never ran"
 

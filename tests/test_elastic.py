@@ -132,6 +132,34 @@ def test_fault_parser_replica_kinds():
     assert s.at_step("nan_loss", 7) and not s.at_step("nan_loss", 7)
 
 
+def test_fault_parser_tier_migration_kinds():
+    """The tiered-prefix-cache drill grammar (ISSUE 12):
+    d2h_fail@migrate:<n> fails the n-th HBM->host demotion (the page
+    dies exactly as it would without a host tier) and
+    h2d_fail@promote:<n> fails the n-th host->HBM promotion (cold-
+    prefill fallback). Both ride the occurrence-counted site machinery —
+    migrate and promote counters are independent."""
+    p = FaultPlan.parse("d2h_fail@migrate:2,h2d_fail@promote:1")
+    assert ("d2h_fail", "migrate", 2) in p.events
+    assert ("h2d_fail", "promote", 1) in p.events
+    # occurrence counting per (kind, site): demotion 1 passes, 2 fails
+    assert not p.fire("d2h_fail", "migrate")
+    assert p.fire("d2h_fail", "migrate")
+    assert not p.fire("d2h_fail", "migrate"), "occurrence 3 clean"
+    # the promote counter never advanced while demotions fired
+    assert p.fire("h2d_fail", "promote")
+    assert not p.fire("h2d_fail", "promote")
+    # ranges expand (a flaky-host drill fails a run of migrations)
+    r = FaultPlan.parse("d2h_fail@migrate:1-3")
+    assert [r.fire("d2h_fail", "migrate") for _ in range(4)] \
+        == [True, True, True, False]
+    # an unrelated plan never accumulates migrate/promote counters
+    q = FaultPlan.parse("nan_loss@step:1")
+    for _ in range(5):
+        assert not q.fire("d2h_fail", "migrate")
+    assert ("d2h_fail", "migrate") not in q._counts
+
+
 # ------------------------------------------------- integrity manifest
 
 

@@ -7,7 +7,9 @@ Correctness anchors:
     complete greedy decode, never a splice;
   * failover is exactly-once: a crashed/hung replica is fenced, its
     in-flight and queued requests resubmit to survivors at most once
-    (attempts caps at 2), nothing is lost, nothing is duplicated;
+    (losses cap at 2; attempts == 2 on a mixed fleet, where no
+    handoff double-dispatch exists), nothing is lost, nothing is
+    duplicated;
   * deadlines are honored at the cheapest point: expired-while-queued
     requests retire as "timeout" with zero dispatch (and zero compiles);
     expired in-flight work on a fenced replica is NOT resubmitted;
