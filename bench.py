@@ -384,6 +384,48 @@ def _run_serving_tier(n_dev, backend, dev_kind):
     st = eng.stats()
     extra_recompiles = eng.recompile_count - warm_recompiles
     ok = all(r.state == "done" for r in timed_reqs)
+
+    # telemetry honesty (ISSUE 13): re-run the same workload with the
+    # telemetry plane on vs hard-off, INTERLEAVED (on, off, on, off, …)
+    # so slow host drift hits both arms equally — the best-of tokens/s
+    # delta is the measurement's own perturbation, stamped as
+    # telemetry_overhead_pct instead of silently riding every serving
+    # number; the registry's shape rides the config block so a series
+    # explosion is visible in the trajectory too. Off-window recompiles
+    # must stay zero (telemetry never touches compiled programs).
+    _phase("time_serving_telemetry_off")
+    from flexflow_tpu.runtime import telemetry as _tm
+
+    _tm_prev = _tm.enabled()
+    t_on2 = t_off = 0.0
+    on2_tokens = off_tokens = 0
+    off_recompiles = 0
+    try:
+        # 5 interleaved pairs, TOTAL time per arm (not best-of): the
+        # windows are ~100ms, so a min over so few rounds just picks
+        # the luckiest burst — the interleaved mean is the unbiased
+        # estimate of the delta
+        for _ in range(5):
+            for arm_on in (True, False):
+                _tm.set_enabled(arm_on)
+                before_arm = eng.stats()["tokens_generated"]
+                rc0 = eng.recompile_count
+                t0 = time.perf_counter()
+                eng.run(prompts, max_new_tokens=SERVE_MAX_NEW)
+                dt = time.perf_counter() - t0
+                toks = eng.stats()["tokens_generated"] - before_arm
+                if arm_on:
+                    on2_tokens += toks
+                    t_on2 += dt
+                else:
+                    off_tokens += toks
+                    t_off += dt
+                    # off-ARM recompiles only: a compile in an on arm
+                    # must not be stamped under the off-window key
+                    off_recompiles += eng.recompile_count - rc0
+    finally:
+        _tm.set_enabled(_tm_prev)
+    telemetry_registry = _tm.registry().describe()
     # timed-window metrics only: TTFT percentiles from this window's
     # requests (the engine's lifetime stats would smuggle the warmup's
     # compile-inflated TTFTs into p99), occupancy from snapshot deltas
@@ -400,6 +442,13 @@ def _run_serving_tier(n_dev, backend, dev_kind):
 
     serve_tps = tokens / t_serve
     seq_tps = seq_tokens / t_seq
+    off_tps = off_tokens / t_off
+    on2_tps = on2_tokens / t_on2
+    # positive = telemetry costs throughput; small negatives are host
+    # noise. Computed from the INTERLEAVED arms (not the headline
+    # window) so run-order drift cancels. The ISSUE-13 budget is <= 2%.
+    telemetry_overhead_pct = round(
+        100.0 * (off_tps - on2_tps) / max(off_tps, 1e-9), 2)
     common = {"backend": backend, "device_kind": dev_kind,
               "n_devices": n_dev,
               "config": {"requests": SERVE_REQUESTS,
@@ -420,7 +469,15 @@ def _run_serving_tier(n_dev, backend, dev_kind):
                          # serving decodes, it never runs the training
                          # dispatch-ahead engine
                          "dispatch_ahead": 0,
-                         "host_wait_fraction": 0.0}}
+                         "host_wait_fraction": 0.0,
+                         # measurement honesty (ISSUE 13): what the
+                         # telemetry plane itself cost this window, and
+                         # the registry's series/histogram counts
+                         "telemetry_overhead_pct":
+                             telemetry_overhead_pct,
+                         "telemetry_off_tokens_per_s":
+                             round(off_tps, 2),
+                         "telemetry_registry": telemetry_registry}}
     yield {
         "metric": "decode_throughput", "tier": "decode_throughput",
         "value": round(serve_tps, 2), "unit": "tokens/s",
@@ -429,6 +486,7 @@ def _run_serving_tier(n_dev, backend, dev_kind):
         "sequential_tokens_per_s": round(seq_tps, 2),
         "tokens": tokens, "all_done": ok,
         "recompiles_after_warmup": extra_recompiles,
+        "recompiles_in_telemetry_off_window": off_recompiles,
         "occupancy": round(occupancy, 4), **common,
     }
     yield {

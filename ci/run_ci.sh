@@ -57,6 +57,19 @@
 #               request completes exactly once via cold-path fallback,
 #               token-identical, zero survivor recompiles — and a
 #               working-set-3x-pool tiered-cache leg
+#   obs       — unified-telemetry tier (ISSUE 13): the registry/tracing
+#               suite (labeled series, histogram bucket math + quantile
+#               estimates, concurrent-increment stress, Prometheus
+#               exposition golden, trace-ring bounds, handoff/failover
+#               span continuity, stats()/health() key superset pins) +
+#               an obs smoke: a 1-prefill/2-decode fleet on skewed
+#               shared-prefix traffic with a decode-replica crash
+#               drill, /metrics scraped MID-RUN (TTFT/ITL histograms +
+#               failover counters as labeled series over all replicas)
+#               and the trace ring exported as perfetto-loadable
+#               Chrome JSON in which every request has a complete span
+#               tree and the failover/handoff requests each cross
+#               replicas under ONE trace id
 #   router    — fleet-router tier: the multi-replica ServingRouter suite
 #               (failover exactly-once + token identity incl. prefix
 #               cache + speculation, deadline/shedding/affinity
@@ -66,7 +79,7 @@
 #               exactly once, zero lost/duplicated, zero warm recompiles
 #               on the survivor
 #
-# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|kernels|quant|disagg|router|all]
+# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|kernels|quant|disagg|obs|router|all]
 set -e
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -230,6 +243,15 @@ run_disagg() {
   FF_FAULT="crash(6)@replica:0" python scripts/disagg_smoke.py 160
 }
 
+# obs tier: the telemetry suite (slow-marked span-continuity variants
+# included — pytest -q runs the whole file), then the observability
+# smoke: mid-run /metrics scrape + perfetto-loadable trace export with
+# complete per-request span trees through a crash drill and a handoff.
+run_obs() {
+  python -m pytest tests/test_telemetry.py -q
+  python scripts/obs_smoke.py 120
+}
+
 # router tier: the fleet suite (failover/deadline/shedding/affinity +
 # the concurrent-submit engine stress in test_serving), then the
 # 2-replica smoke under a deterministic mid-flight crash of replica 0
@@ -257,8 +279,9 @@ case "$TIER" in
   kernels)  run_kernels ;;
   quant)    run_quant ;;
   disagg)   run_disagg ;;
+  obs)      run_obs ;;
   router)   run_router ;;
-  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_kernels; run_quant; run_disagg; run_router; run_native; run_docs; run_sweep ;;
+  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_kernels; run_quant; run_disagg; run_obs; run_router; run_native; run_docs; run_sweep ;;
   *) echo "unknown tier $TIER"; exit 2 ;;
 esac
 echo "ci($TIER): PASSED"

@@ -34,6 +34,9 @@ PAGES = [("index", os.path.join(ROOT, "README.md"), "Overview"),
           "quantized tier, disaggregated fleet + tiered cache)"),
          ("performance", os.path.join(DOCS, "performance.md"),
           "Performance (host + in-graph overlap, Pallas kernel tier)"),
+         ("observability", os.path.join(DOCS, "observability.md"),
+          "Observability (metrics registry, per-request tracing, "
+          "Prometheus/JSON export)"),
          ("analysis", os.path.join(DOCS, "analysis.md"),
           "fflint static analysis"),
          ("install", os.path.join(ROOT, "INSTALL.md"), "Install")]

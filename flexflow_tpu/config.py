@@ -297,6 +297,19 @@ class FFConfig:
     # the first trace (FFModel.compile / launcher) so repeated runs skip
     # recompiles; serving logs hit/miss per program build
     compilation_cache_dir: str = ""
+    # ---- unified telemetry plane (runtime/telemetry.py, ISSUE 13) ----
+    # "on" (default): the metrics registry records counters/histograms
+    # and the trace ring records per-request / per-step spans — the
+    # substrate stats()/health() export through. "off": span creation
+    # returns a shared no-op and every observe/inc short-circuits at one
+    # predicate (the bench's telemetry_overhead_pct control arm).
+    telemetry: str = "on"
+    # serve a Prometheus text endpoint (/metrics), a JSON snapshot
+    # (/metrics.json) and the Chrome trace ring (/trace.json) on
+    # 127.0.0.1:<port> from a stdlib http.server daemon thread. 0 = no
+    # server (the default; the registry still records — export is pull).
+    # Engines/routers/fit start it lazily on first use; one per process.
+    metrics_port: int = 0
 
     # populated at FFModel construction
     strategies: Dict[str, "ParallelConfig"] = dataclasses.field(default_factory=dict)
@@ -379,6 +392,13 @@ class FFConfig:
                     f"serve_replica_roles={self.serve_replica_roles!r}: "
                     f"comma-separated 'prefill'|'decode'|'mixed', one "
                     f"per replica (bad: {bad or 'empty entry'})")
+        if self.telemetry not in ("on", "off"):
+            raise ValueError(
+                f"telemetry={self.telemetry!r}: must be 'on' or 'off'")
+        if self.metrics_port < 0 or self.metrics_port > 65535:
+            raise ValueError(
+                f"metrics_port={self.metrics_port}: must be 0 (no "
+                f"server) or a valid TCP port")
         if self.paged_attention_impl not in ("auto", "pallas", "einsum"):
             raise ValueError(
                 f"paged_attention_impl={self.paged_attention_impl!r}: "
@@ -518,6 +538,15 @@ class FFConfig:
                        help="serving weight storage (weight-only "
                             "quantization with per-output-channel "
                             "scales, quantized once at engine init)")
+        p.add_argument("--telemetry", type=str, default="on",
+                       choices=("on", "off"),
+                       help="unified telemetry plane: metrics registry "
+                            "+ per-request trace ring (off = every "
+                            "emit short-circuits)")
+        p.add_argument("--metrics-port", type=int, default=0,
+                       help="serve Prometheus /metrics (+ /metrics.json"
+                            ", /trace.json) on 127.0.0.1:<port> "
+                            "(0 = no server)")
         # e.g. --mesh data=4,model=2 (replaces -ll:gpu device-count knobs)
         p.add_argument("--mesh", type=str, default="")
         args, _ = p.parse_known_args(argv)
@@ -568,4 +597,6 @@ class FFConfig:
             paged_attention_impl=args.paged_attention_impl,
             kv_cache_dtype=args.kv_cache_dtype,
             serve_weight_dtype=args.serve_weight_dtype,
+            telemetry=args.telemetry,
+            metrics_port=args.metrics_port,
         )

@@ -6,18 +6,64 @@ Usage:
     from flexflow_tpu.logger import fflogger
     fflogger.info("compile done")
 
-FLEXFLOW_LOG_LEVEL: debug|info|warning|error (default warning)
-FLEXFLOW_LOG_FILE:  path (default stderr)
+Env knobs — each accepts BOTH the reference's ``FF_LOGGING_*`` name and
+this package's ``FLEXFLOW_LOG_*`` name; when both are set the
+``FLEXFLOW_*`` (new) name wins:
+
+FLEXFLOW_LOG_LEVEL  / FF_LOGGING_LEVEL:  debug|info|warning|error
+                                         (default warning)
+FLEXFLOW_LOG_FILE   / FF_LOGGING_FILE:   path (default stderr)
+FLEXFLOW_LOG_FORMAT / FF_LOGGING_FORMAT: "text" (default) | "json" —
+    JSON-lines output, one object per line with ``ts``, ``level``,
+    ``logger``, ``msg`` and (when a telemetry span is active on the
+    logging thread) ``trace_id``, so log lines join against the trace
+    ring / exported Chrome trace by request id
+    (runtime/telemetry.py, docs/observability.md).
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import sys
+import time
 
 _LEVELS = {"debug": logging.DEBUG, "info": logging.INFO,
            "warning": logging.WARNING, "error": logging.ERROR}
+
+
+def _env(new: str, old: str, default: str = "") -> str:
+    """Read a knob under both its names; the new name wins when both are
+    set (the docstring's contract — the reference's names keep working)."""
+    v = os.environ.get(new, "")
+    return v if v else os.environ.get(old, default)
+
+
+class _JsonFormatter(logging.Formatter):
+    """JSON-lines log format carrying the active telemetry trace id so
+    log lines can be joined against per-request traces."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        row = {
+            "ts": round(record.created, 6),
+            "time": time.strftime("%Y-%m-%dT%H:%M:%S",
+                                  time.localtime(record.created)),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        try:    # deferred import: telemetry imports this module at top
+            from flexflow_tpu.runtime.telemetry import current_trace_id
+
+            tid = current_trace_id()
+            if tid is not None:
+                row["trace_id"] = tid
+        except Exception:
+            pass
+        if record.exc_info:
+            row["exc"] = self.formatException(record.exc_info)
+        return json.dumps(row, ensure_ascii=False)
 
 
 def _make_logger() -> logging.Logger:
@@ -25,14 +71,16 @@ def _make_logger() -> logging.Logger:
     if logger.handlers:
         return logger
     level = _LEVELS.get(
-        os.environ.get("FLEXFLOW_LOG_LEVEL", "warning").lower(),
+        _env("FLEXFLOW_LOG_LEVEL", "FF_LOGGING_LEVEL", "warning").lower(),
         logging.WARNING)
     logger.setLevel(level)
-    path = os.environ.get("FLEXFLOW_LOG_FILE", "")
+    path = _env("FLEXFLOW_LOG_FILE", "FF_LOGGING_FILE")
     handler = (logging.FileHandler(path) if path
                else logging.StreamHandler(sys.stderr))
-    handler.setFormatter(logging.Formatter(
-        "[%(levelname)s %(asctime)s flexflow_tpu] %(message)s"))
+    fmt = _env("FLEXFLOW_LOG_FORMAT", "FF_LOGGING_FORMAT", "text").lower()
+    handler.setFormatter(
+        _JsonFormatter() if fmt == "json" else logging.Formatter(
+            "[%(levelname)s %(asctime)s flexflow_tpu] %(message)s"))
     logger.addHandler(handler)
     logger.propagate = False
     return logger

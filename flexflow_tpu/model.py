@@ -916,6 +916,21 @@ class FFModel:
         # and stored on self.last_step_breakdown at the end of fit
         bd = {"host_wait": 0.0, "h2d": 0.0, "dispatch": 0.0,
               "device": 0.0, "steps": 0}
+        # unified telemetry plane (runtime/telemetry.py): each step's
+        # measured breakdown becomes a span tree on the "train" track
+        # (trace id "step-<n>"), supervisor events (checkpoint publish,
+        # rewind, watchdog) land on the same timeline from
+        # resilience.py, and step wall time feeds an SLO histogram —
+        # one exported trace shows the overlap schedule end to end
+        from flexflow_tpu.runtime import telemetry as _telemetry
+
+        tm_on = getattr(self.config, "telemetry", "on") != "off"
+        if tm_on and getattr(self.config, "metrics_port", 0):
+            _telemetry.start_http_server(self.config.metrics_port)
+        tm_step_hist = (_telemetry.registry().histogram(
+            "ff_train_step_seconds",
+            "fit() per-step wall time (host wait + h2d + dispatch)")
+            if tm_on else None)
         # host-overlap step engine (runtime/pipeline_loader.py): a worker
         # thread prefetches + commits batches to device ahead of the loop,
         # and a dispatch-ahead ring below keeps up to
@@ -1008,7 +1023,16 @@ class FFModel:
                     while it < num_batches:
                         if num_batches - it >= self.config.scan_steps:
                             chunk = self.config.scan_steps
+                            t_c0 = time.perf_counter()
                             _, smets = self.train_scanned(chunk)
+                            if tm_on:
+                                # dispatch time of one scanned chunk
+                                # (device completion is async; the
+                                # epoch_sync span carries the wait)
+                                _telemetry.tracer().complete(
+                                    "train_scan_chunk", t_c0,
+                                    time.perf_counter() - t_c0,
+                                    track="train", steps=chunk)
                             epoch_mets.append((smets, bs, chunk))
                         else:
                             # ragged epoch tail: n_steps is static to the
@@ -1050,6 +1074,20 @@ class FFModel:
                         bd["h2d"] += t_s - t_h
                         bd["dispatch"] += t_d - t_s
                         bd["steps"] += 1
+                        if tm_on:
+                            sid = f"step-{self._step_count}"
+                            tr = _telemetry.tracer()
+                            tr.complete("train_step", t_b, t_d - t_b,
+                                        trace_id=sid, track="train",
+                                        step=self._step_count)
+                            tr.complete("host_wait", t_b, t_h - t_b,
+                                        trace_id=sid, track="train")
+                            if t_s > t_h:
+                                tr.complete("h2d", t_h, t_s - t_h,
+                                            trace_id=sid, track="train")
+                            tr.complete("dispatch", t_s, t_d - t_s,
+                                        trace_id=sid, track="train")
+                            tm_step_hist.observe(t_d - t_b)
                         epoch_mets.append((mets, bs, 1))
                         total += bs
                         if warm is None:
@@ -1073,7 +1111,12 @@ class FFModel:
                                       if sup is not None
                                       else contextlib.nullcontext()):
                                     jax.block_until_ready(old)
-                                bd["device"] += time.perf_counter() - t_w
+                                dt_w = time.perf_counter() - t_w
+                                bd["device"] += dt_w
+                                if tm_on:
+                                    _telemetry.tracer().complete(
+                                        "device_wait", t_w, dt_w,
+                                        track="train")
                         if sup is not None:
                             step_before = self._step_count
                             if sup.after_step():
@@ -1116,7 +1159,12 @@ class FFModel:
                             self._perf.update(
                                 {k: float(a[j] if a.ndim else a)
                                  for k, a in arrs.items()}, bs)
-                bd["device"] += time.perf_counter() - t_sync
+                dt_sync = time.perf_counter() - t_sync
+                bd["device"] += dt_sync
+                if tm_on:
+                    _telemetry.tracer().complete(
+                        "epoch_sync", t_sync, dt_sync, track="train",
+                        epoch=epoch, steps=len(epoch_mets))
                 ring.clear()  # everything in flight just synced above
                 if verbose:
                     print(f"epoch {epoch}: loss={float(self._last_loss):.4f} "

@@ -70,6 +70,23 @@ import os
 from typing import Dict, List, Optional, Tuple
 
 
+def _annotate(kind: str, site: str, index: int,
+              value: Optional[int] = None):
+    """Report a FIRED injection to the telemetry plane (an instant
+    ``fault`` trace annotation + ``ff_fault_fired_total`` counter), so
+    every drill's trace shows exactly where the fault landed — asserted
+    by router_smoke/disagg_smoke/obs_smoke. Deferred import (telemetry
+    never imports this module back) and best-effort: injection must
+    work even if telemetry is torn down mid-test."""
+    try:
+        from flexflow_tpu.runtime import telemetry
+
+        telemetry.annotate("fault", kind=kind, site=site, index=index,
+                           value=value)
+    except Exception:
+        pass
+
+
 class InjectedFault(OSError):
     """Raised by ``maybe_fail``: an IO-flavored injected failure (OSError
     subclass so generic retry(retryable=(OSError,)) policies cover it)."""
@@ -143,6 +160,7 @@ class FaultPlan:
         if ev in self.events and ev not in self._consumed:
             self._consumed.add(ev)
             self.last_value = self.values.get(ev)
+            _annotate(kind, site, int(index), self.last_value)
             return True
         return False
 
@@ -183,6 +201,7 @@ class FaultPlan:
             if (k == kind and s == "step" and lo < i <= hi
                     and ev not in self._consumed):
                 self._consumed.add(ev)
+                _annotate(kind, "step", i)
                 fired = True
         return fired
 
@@ -197,6 +216,7 @@ class FaultPlan:
         self._counts[key] = n = self._counts.get(key, 0) + 1
         if (kind, site, n) in self.events:
             self.last_value = self.values.get((kind, site, n))
+            _annotate(kind, site, n, self.last_value)
             return True
         return False
 

@@ -48,7 +48,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from flexflow_tpu.logger import fflogger
-from flexflow_tpu.runtime import faultinject
+from flexflow_tpu.runtime import faultinject, telemetry
 from flexflow_tpu.runtime.resilience import retry
 
 
@@ -63,9 +63,13 @@ class PipelineLoader:
     def __init__(self, pull: Callable[[], Optional[Dict]],
                  shard: Callable[[Dict], Dict], *, depth: int = 2,
                  cursors: Optional[Callable[[], Dict]] = None,
-                 restore: Optional[Callable[[Dict], None]] = None):
+                 restore: Optional[Callable[[Dict], None]] = None,
+                 telemetry_on: bool = True):
         if depth < 1:
             raise ValueError(f"PipelineLoader depth must be >= 1, got {depth}")
+        # FFConfig.telemetry="off" reaches the worker through the model
+        # constructors below — the off contract covers the loader track
+        self._tm_on = bool(telemetry_on)
         self._shard = shard
         self._cursors = cursors
         self._restore = restore
@@ -117,7 +121,9 @@ class PipelineLoader:
                     dl.next_index = int(snap[dl.name])
 
         return cls(pull, model.executor.shard_batch, depth=depth,
-                   cursors=cursors, restore=restore)
+                   cursors=cursors, restore=restore,
+                   telemetry_on=getattr(model.config, "telemetry",
+                                        "on") != "off")
 
     @classmethod
     def from_native(cls, native_dl, model, depth: int = 2) -> "PipelineLoader":
@@ -126,7 +132,9 @@ class PipelineLoader:
         cursor cannot seek, so there is no cursor contract — resume under
         the native loader replays batches by count, exactly as before."""
         return cls(native_dl.next_batch, model.executor.shard_batch,
-                   depth=depth)
+                   depth=depth,
+                   telemetry_on=getattr(model.config, "telemetry",
+                                        "on") != "off")
 
     # ---- worker ------------------------------------------------------------
 
@@ -171,6 +179,15 @@ class PipelineLoader:
                     self.stats["pull_s"] += t1 - t0
                     self.stats["h2d_s"] += t2 - t1
                     self.stats["batches"] += 1
+                    # telemetry: the worker's overlapped phases on their
+                    # own "loader" track — the exported trace shows
+                    # prefetch running UNDER the train steps (that is
+                    # the overlap schedule, end to end)
+                    if self._tm_on:
+                        telemetry.tracer().complete(
+                            "prefetch_pull", t0, t1 - t0, track="loader")
+                        telemetry.tracer().complete(
+                            "prefetch_h2d", t1, t2 - t1, track="loader")
                 self._cv.notify_all()
 
     # ---- training-thread API ----------------------------------------------

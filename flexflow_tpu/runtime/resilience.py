@@ -49,7 +49,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from flexflow_tpu.logger import fflogger
-from flexflow_tpu.runtime import faultinject
+from flexflow_tpu.runtime import faultinject, telemetry
 
 # process-wide resilience counters (skipped steps / restarts / retries …);
 # read via counters(), cleared via reset_counters()
@@ -130,6 +130,8 @@ class Watchdog:
         self.on_timeout = on_timeout
         self.dump_path = dump_path
         self.fired = False
+        # the owning supervisor clears this under FFConfig.telemetry=off
+        self.telemetry_on = True
 
     def _dump(self, label: str, timeout_s: float):
         import faulthandler
@@ -190,6 +192,10 @@ class Watchdog:
                     return  # completed before we fired: healthy run
                 self.fired = True
                 COUNTERS["watchdog_fires"] += 1
+                if self.telemetry_on:
+                    telemetry.tracer().instant(
+                        "watchdog_fire", track="train", label=label,
+                        timeout_s=timeout_s)
                 self._dump(label, timeout_s)  # stacks first, while they
                 # still show the hang; the slow profiler snapshot trails
                 if self.on_timeout is not None:
@@ -215,9 +221,16 @@ class Watchdog:
         t = threading.Timer(timeout_s, fire)
         t.daemon = True
         t.start()
+        t_arm = time.perf_counter()
         try:
             yield
         finally:
+            # telemetry: the armed window as a span — how long each
+            # guarded device fetch actually blocked, fire or no fire
+            if self.telemetry_on:
+                telemetry.tracer().complete(
+                    "watchdog_armed", t_arm, time.perf_counter() - t_arm,
+                    track="train", label=label, fired=self.fired)
             t.cancel()
             with lock:  # blocks until an in-flight fire() finishes, so
                 # the grace list is complete before we cancel
@@ -301,8 +314,12 @@ class TrainSupervisor:
         # stay synchronous (the caller is about to stop or to read the
         # directory), and rewind/finalize quiesce pending publishes first
         self.async_saves = bool(getattr(cfg, "async_checkpointing", False))
+        # FFConfig.telemetry="off" silences the supervisor's spans and
+        # histograms too (the "off short-circuits every emit" contract)
+        self._tm_on = getattr(cfg, "telemetry", "on") != "off"
         self.watchdog = Watchdog(step_timeout_s if step_timeout_s is not None
                                  else getattr(cfg, "step_timeout_s", 0.0))
+        self.watchdog.telemetry_on = self._tm_on
         self.faults = faults  # None -> the FF_FAULT env plan, read lazily
         self.verbose = verbose
         # poll the guard's per-step nonfinite flag on the host? True for
@@ -416,9 +433,24 @@ class TrainSupervisor:
             return None
         extra = self._extra_meta()
         extra["reason"] = reason
+        t0 = time.perf_counter()
         path = save_checkpoint(self.model, self.directory, step=step,
                                extra_meta=extra, keep=self.keep,
                                async_save=async_ok)
+        stall = time.perf_counter() - t0
+        # telemetry: the STALL this save cost the training loop (an
+        # async publish returns after the in-step snapshot; the
+        # background IO is invisible here — which is the point), as a
+        # span on the train track + the checkpoint-stall SLO histogram
+        if self._tm_on:
+            telemetry.tracer().complete(
+                "checkpoint_save", t0, stall, track="train", step=step,
+                reason=reason, published_async=async_ok)
+            telemetry.registry().histogram(
+                "ff_checkpoint_stall_seconds",
+                "training-loop stall per checkpoint save (async "
+                "publishes cost only the in-step snapshot)").observe(
+                    stall)
         self._last_saved_step = step
         COUNTERS["checkpoints_saved"] += 1
         if self.verbose:
@@ -573,6 +605,11 @@ class TrainSupervisor:
             self.model._step_count, step)
         # losses[i] is the loss of step _loss_base + i + 1: truncate the
         # steps being discarded (index relative to the resume offset)
+        if self._tm_on:
+            telemetry.tracer().instant(
+                "rewind", track="train",
+                from_step=self.model._step_count,
+                to_step=step, bad_streak=self._bad_streak)
         del self.losses[max(step - self._loss_base, 0):]
         self._restore(step)
         COUNTERS["rewinds"] += 1

@@ -92,13 +92,18 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from flexflow_tpu.logger import fflogger
-from flexflow_tpu.runtime import faultinject
+from flexflow_tpu.runtime import faultinject, telemetry
 
 
 class ReplicaCrash(RuntimeError):
     """Injected replica loss (FF_FAULT ``crash@replica:<r>``): raised on
     the replica's driver thread to simulate the whole engine dying
     mid-dispatch."""
+
+
+# process-wide router ids: trace ids must be unique across fleets in one
+# process (two routers both start their rids at 0)
+_ROUTER_IDS = iter(range(1 << 30))
 
 
 @dataclass
@@ -133,6 +138,11 @@ class FleetRequest:
     t_submit: float = 0.0
     ttft: float = 0.0               # router submit -> first token (s)
     t_done: float = 0.0
+    # telemetry: the fleet-wide trace id every span of this request
+    # carries (it survives resubmission and the prefill->decode
+    # handoff), and the open root-span handle closed at settlement
+    trace_id: str = ""
+    root_span: int = 0
 
     @property
     def output(self) -> np.ndarray:
@@ -276,6 +286,27 @@ class ServingRouter:
         self._handoffs = 0
         self._handoff_fallbacks = 0
         self._ttfts = collections.deque(maxlen=4096)
+        # unified telemetry plane (ISSUE 13): fleet identity on every
+        # replica's metric labels + trace track, the fleet TTFT
+        # histogram, and a scrape-time collector exporting the router
+        # ledger (fenced/resubmitted/timeouts/rejected/handoffs) and the
+        # fleet rollup as first-class series
+        self._tm_on = getattr(cfg, "telemetry", "on") != "off"
+        self._tm_uid = next(_ROUTER_IDS)
+        for r, eng in enumerate(self.engines):
+            eng.set_telemetry_identity(r, self.roles[r])
+        self._tm_ttft = None
+        if self._tm_on:
+            if getattr(cfg, "metrics_port", 0):
+                telemetry.start_http_server(cfg.metrics_port)
+            # resolve the settle-path histogram child once (the engine's
+            # _tm_bind_children discipline): no registry lookup per
+            # completion
+            self._tm_ttft = telemetry.registry().histogram(
+                "ff_router_ttft_seconds",
+                "router submit -> first token (queue wait included — "
+                "what shedding bounds)").labels()
+            telemetry.registry().add_collector(self._tm_collect)
         self._threads: List[threading.Thread] = []
         self._started = False
         if start:
@@ -330,13 +361,23 @@ class ServingRouter:
                 deadline=(now + deadline_s if deadline_s is not None
                           else None),
                 affinity=affinity, t_submit=now)
+            req.trace_id = f"req-{self._tm_uid}-{req.rid}"
             self._next_rid += 1
             self._submitted += 1
+            if self._tm_on:
+                # the fleet-wide root span: open until settlement, so
+                # every engine/handoff/failover span nests inside it
+                req.root_span = telemetry.tracer().begin(
+                    "request", trace_id=req.trace_id, track="router",
+                    prompt_tokens=int(prompt.size),
+                    max_new_tokens=int(max_new_tokens))
             if self.max_queue and len(self._queue) >= self.max_queue:
                 req.state = "rejected"
                 req.error = f"router queue full ({self.max_queue})"
                 req.t_done = time.perf_counter()
                 self._rejected += 1
+                telemetry.tracer().end(req.root_span, state="rejected")
+                req.root_span = 0
                 return req
             self._queue.append(req)
         return req
@@ -555,6 +596,10 @@ class ServingRouter:
             req.replica = r
             req.attempts += 1
             self._dispatched += 1
+            if self._tm_on:
+                telemetry.tracer().instant(
+                    "dispatch", trace_id=req.trace_id, track="router",
+                    replica=r, phase=req.phase, attempt=req.attempts)
             if req.affinity is not None and req.phase != "prefill":
                 # the affinity home is where the prefix DECODES (and
                 # therefore publishes); a prefill dispatch must not
@@ -576,10 +621,17 @@ class ServingRouter:
             self._completed += 1
             if req.ttft:
                 self._ttfts.append(req.ttft)
+                if self._tm_ttft is not None:
+                    self._tm_ttft.observe(req.ttft)
         elif state == "timeout":
             self._timeouts += 1
         else:
             self._failed += 1
+        telemetry.tracer().end(
+            req.root_span, state=state, replica=req.replica,
+            attempts=req.attempts, handoff=req.handoff,
+            **({"error": error} if error else {}))
+        req.root_span = 0
 
     def _fence_locked(self, r: int, reason: str):
         """Fence replica r: mark it dead, requeue its outstanding work.
@@ -596,6 +648,9 @@ class ServingRouter:
         self._fenced[r] = True
         self._fence_reason[r] = reason
         self._fenced_count += 1
+        if self._tm_on:
+            telemetry.tracer().instant("fence", track="router",
+                                       replica=r, reason=reason)
         out = self._outstanding[r]
         self._outstanding[r] = {}
         self._to_submit[r].clear()
@@ -628,6 +683,13 @@ class ServingRouter:
                 #                   the cold path on a decode replica)
                 requeued.append(req)
                 self._resubmitted += 1
+                if self._tm_on:
+                    # the trace context SURVIVES resubmission: the same
+                    # trace_id rides the requeued request, so its spans
+                    # on the survivor join the original tree
+                    telemetry.tracer().instant(
+                        "resubmit", trace_id=req.trace_id,
+                        track="router", from_replica=r, reason=reason)
         # front of the queue, original order: failover work has waited
         # longest
         for req in reversed(requeued):
@@ -723,7 +785,13 @@ class ServingRouter:
                         # prefix HIT. Any import problem falls back to
                         # the cold path — always correct, never lost.
                         try:
-                            eng.import_prefix_slab(req.slab)
+                            with telemetry.tracer().span(
+                                    "handoff_import",
+                                    trace_id=req.trace_id,
+                                    track=f"replica{r}",
+                                    pages=len(req.slab.get(
+                                        "payload", []))):
+                                eng.import_prefix_slab(req.slab)
                         except Exception as e:  # noqa: BLE001
                             fflogger.warning(
                                 "router: slab import on replica %d "
@@ -732,7 +800,8 @@ class ServingRouter:
                                 self._handoff_fallbacks += 1
                         req.slab = None
                     ereq = eng.submit(req.prompt, req.max_new_tokens,
-                                      deadline=req.deadline)
+                                      deadline=req.deadline,
+                                      trace_id=req.trace_id)
                     with self._lock:
                         if self._fenced[r]:     # fenced mid-hand-off
                             return
@@ -762,8 +831,12 @@ class ServingRouter:
         exactly-once requeue re-classifies the request at its next
         dispatch."""
         slab = None
-        if eng.prefill_into_cache(req.prompt) is not None:
-            slab = eng.export_prefix_slab(req.prompt)
+        with telemetry.tracer().span("handoff_export",
+                                     trace_id=req.trace_id,
+                                     track=f"replica{r}") as sp:
+            if eng.prefill_into_cache(req.prompt) is not None:
+                slab = eng.export_prefix_slab(req.prompt)
+            sp.annotate(exported=slab is not None)
         with self._lock:
             if self._fenced[r]:
                 return          # the fence already requeued this request
@@ -831,6 +904,49 @@ class ServingRouter:
                         req, "failed", ereq.error or "engine failure")
 
     # ---- observability ------------------------------------------------------
+
+    def recent_traces(self, n: int = 32) -> List[Dict]:
+        """Span trees of the most recent fleet requests still in the
+        bounded trace ring (newest last): per request the root span,
+        every child span across replicas (handoff/failover included —
+        the trace id survives both), the instant annotations
+        (dispatch/resubmit/fault), and a ``complete`` verdict. Export
+        the raw ring with ``telemetry.export_chrome_trace()``."""
+        tr = telemetry.tracer()
+        mine = f"req-{self._tm_uid}-"
+        ids = [t for t in tr.trace_ids() if t.startswith(mine)]
+        return [tr.trace_tree(t) for t in ids[-n:]]
+
+    def _tm_collect(self, reg):
+        """Scrape-time collector: the fleet ledger as ``ff_router_*``
+        series (the failure-drill acceptance surface: fenced,
+        resubmitted, timeouts, rejected, handoffs), the fleet rollup as
+        ``ff_fleet_*``, and per-replica liveness/load labeled
+        (replica, role). Engine collectors export their own series."""
+        st = self.stats()
+        for k, v in st.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            reg.gauge(f"ff_router_{k}",
+                      f"ServingRouter stats()['{k}']").set(v)
+        for k, v in st["fleet"].items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            reg.gauge(f"ff_fleet_{k}",
+                      f"fleet rollup stats()['fleet']['{k}']").set(v)
+        for tier, pages in st["fleet"]["pages_by_tier"].items():
+            reg.gauge("ff_fleet_kv_pages", "fleet KV pages by tier",
+                      labels=("tier",)).labels(tier).set(pages)
+        live = reg.gauge("ff_router_replica_up",
+                         "1 = replica live, 0 = fenced",
+                         labels=("replica", "role"))
+        outg = reg.gauge("ff_router_replica_outstanding",
+                         "router outstanding ledger per replica",
+                         labels=("replica", "role"))
+        for row in st["per_replica"]:
+            lab = (str(row["replica"]), row["role"])
+            live.labels(*lab).set(0 if row["fenced"] else 1)
+            outg.labels(*lab).set(row["outstanding"])
 
     def stats(self) -> Dict:
         """Fleet ledger + per-replica engine stats + the FLEET ROLLUP

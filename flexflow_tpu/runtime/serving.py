@@ -124,8 +124,12 @@ import numpy as np
 
 from flexflow_tpu._env import compilation_cache_entries
 from flexflow_tpu.logger import fflogger
-from flexflow_tpu.runtime import faultinject
+from flexflow_tpu.runtime import faultinject, telemetry
 from flexflow_tpu.runtime.generation import Generator
+
+# process-wide engine ids: the default telemetry `replica` label when no
+# router assigns a fleet identity (set_telemetry_identity)
+_ENGINE_IDS = iter(range(1 << 30))
 
 
 def _ktune_stats():
@@ -162,6 +166,14 @@ class Request:
     ttft: float = 0.0               # submit -> first emitted token (s)
     t_done: float = 0.0
     error: str = ""
+    # telemetry (runtime/telemetry.py): the trace id this request's
+    # spans carry — a router-assigned fleet id survives resubmission and
+    # the prefill->decode handoff; engine-local requests get their own.
+    # t_last_tok clocks the inter-token-latency histogram; decode_span
+    # is the open cross-thread span handle closed at retirement.
+    trace_id: str = ""
+    t_last_tok: float = 0.0
+    decode_span: int = 0
 
     @property
     def output(self) -> np.ndarray:
@@ -1060,6 +1072,82 @@ class ServingEngine:
 
         self._ttfts = collections.deque(maxlen=4096)
 
+        # ---- unified telemetry plane (ISSUE 13) ----
+        # the engine's latency histograms (TTFT / inter-token / queue
+        # wait) are observed at the event sites below; everything
+        # stats() already counts is exported by the scrape-time
+        # collector (_tm_collect), so the ad-hoc dict and the registry
+        # can never disagree — the dict IS the collector's source.
+        # FFConfig.telemetry="off" skips every emit at one predicate.
+        self._tm_on = getattr(cfg, "telemetry", "on") != "off"
+        self._tm_labels = {"replica": f"engine{next(_ENGINE_IDS)}",
+                           "role": "solo"}
+        self._tm_ch: Dict = {}
+        if self._tm_on:
+            if getattr(cfg, "metrics_port", 0):
+                telemetry.start_http_server(cfg.metrics_port)
+            self._tm_bind_children()
+            telemetry.registry().add_collector(self._tm_collect)
+
+    # ---- telemetry ----------------------------------------------------------
+
+    def set_telemetry_identity(self, replica, role: str):
+        """Fleet identity for this engine's metric labels and trace
+        track (the router stamps replica index + role at construction;
+        standalone engines keep their process-unique engine id). The
+        scrape topology is one fleet per process — a second router's
+        replica 0 shares the first's labeled series
+        (docs/observability.md)."""
+        self._tm_labels = {"replica": str(replica), "role": str(role)}
+        if self._tm_on:
+            self._tm_bind_children()
+
+    def _tm_bind_children(self):
+        """Resolve the hot-path histogram children ONCE per identity:
+        per-token emits then cost a single predicate + one lock-cheap
+        observe — no registry/family lookup, no label-tuple build."""
+        reg = telemetry.registry()
+        lab = (self._tm_labels["replica"], self._tm_labels["role"])
+        self._tm_ch = {
+            "ttft": reg.histogram(
+                "ff_serving_ttft_seconds",
+                "engine submit -> first token",
+                labels=("replica", "role")).labels(*lab),
+            "itl": reg.histogram(
+                "ff_serving_intertoken_seconds",
+                "gap between consecutive emitted tokens",
+                labels=("replica", "role")).labels(*lab),
+            "queue": reg.histogram(
+                "ff_serving_queue_wait_seconds",
+                "engine queue wait: submit -> admission",
+                labels=("replica", "role")).labels(*lab),
+        }
+
+    @property
+    def _tm_track(self) -> str:
+        return f"replica{self._tm_labels['replica']}"
+
+    def _tm_collect(self, reg):
+        """Scrape-time collector: publish every numeric stats() key as
+        a ``ff_serving_<key>`` gauge labeled (replica, role), plus one
+        info series carrying the engine's dtype/impl identity. stats()
+        serializes behind a running tick — scrapes are rare and the
+        snapshot is exact."""
+        st = self.stats()
+        lab = (self._tm_labels["replica"], self._tm_labels["role"])
+        for k, v in st.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            reg.gauge(f"ff_serving_{k}",
+                      f"ServingEngine stats()['{k}']",
+                      labels=("replica", "role")).labels(*lab).set(v)
+        reg.gauge("ff_serving_engine_info",
+                  "engine identity (value is always 1)",
+                  labels=("replica", "role", "kv_cache_dtype",
+                          "weight_dtype", "impl")).labels(
+            *lab, st["kv_cache_dtype"], st["weight_dtype"],
+            st["paged_attention_impl"]).set(1)
+
     # ---- request lifecycle --------------------------------------------------
 
     def _bucket(self, prompt_len: int) -> int:
@@ -1073,11 +1161,16 @@ class ServingEngine:
         return _pow2_bucket(prompt_len)
 
     def submit(self, prompt, max_new_tokens: int,
-               deadline: Optional[float] = None) -> Request:
+               deadline: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Request:
         """Queue one request. ``deadline`` is an absolute
         ``time.perf_counter()`` instant: a request still queued past it
         retires as ``"timeout"`` without ever prefilling (an admitted
-        request is never cancelled — see Request.deadline)."""
+        request is never cancelled — see Request.deadline).
+        ``trace_id`` threads an existing fleet trace through this
+        engine's spans (the router passes its request id, so a
+        resubmitted or handed-off request keeps ONE span tree); None
+        mints an engine-local id."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -1101,6 +1194,8 @@ class ServingEngine:
             req = Request(rid=self._next_rid, prompt=prompt,
                           max_new_tokens=int(max_new_tokens), bucket=bucket,
                           deadline=deadline, t_submit=time.perf_counter())
+            req.trace_id = trace_id or (
+                f"{self._tm_labels['replica']}-r{req.rid}")
             self._next_rid += 1
             self._submitted += 1
             self._queue.append(req)
@@ -1121,6 +1216,11 @@ class ServingEngine:
             self._failed += 1
         if req.ttft:
             self._ttfts.append(req.ttft)
+        # close the cross-thread decode span (0-handle = telemetry off)
+        telemetry.tracer().end(req.decode_span, state=state,
+                               tokens=len(req.tokens),
+                               **({"error": error} if error else {}))
+        req.decode_span = 0
         # COW teardown: pages the trie owns (matched prefix + the pages
         # this request published) are DECREF'd — they stay cached, warm
         # for the next hit, until the evictor needs them. Only the
@@ -1149,8 +1249,18 @@ class ServingEngine:
             return
         req.tokens.append(int(tok))
         self._tokens_emitted += 1
+        now = time.perf_counter()
         if not req.ttft:
-            req.ttft = time.perf_counter() - req.t_submit
+            req.ttft = now - req.t_submit
+            if self._tm_on:
+                self._tm_ch["ttft"].observe(req.ttft)
+        elif self._tm_on:
+            # host-observed inter-token latency: tokens inside one
+            # decode_chunk dispatch arrive together, so sub-chunk gaps
+            # read ~0 and the chunk boundary carries the dispatch time —
+            # the histogram measures what a streaming caller would see
+            self._tm_ch["itl"].observe(now - req.t_last_tok)
+        req.t_last_tok = now
         self.emitted[slot] += 1
         self.last_tok[slot] = tok
         if (self.eos_id is not None and tok == self.eos_id) \
@@ -1498,6 +1608,10 @@ class ServingEngine:
                 req.error = "deadline expired while queued"
                 req.t_done = now
                 self._timeouts += 1
+                if self._tm_on:
+                    telemetry.tracer().instant(
+                        "timeout", trace_id=req.trace_id,
+                        track=self._tm_track, where="engine_queue")
             else:
                 kept.append(req)
         self._queue = kept
@@ -1557,6 +1671,18 @@ class ServingEngine:
                 if len(self._free_pages) < need:
                     return  # raced shortfall after a failed promotion
             self._queue.pop(0)
+            # telemetry: the engine queue wait ends here (admission
+            # starts); the prefill span opens here and closes after the
+            # dispatch below, tagged cold vs hit (a handoff-import shows
+            # as a preceding handoff_import span on the same trace id)
+            tm = self._tm_on and telemetry.enabled()
+            t_adm = time.perf_counter() if tm else 0.0
+            if tm:
+                wait = t_adm - req.t_submit
+                self._tm_ch["queue"].observe(wait)
+                telemetry.tracer().complete(
+                    "queue_wait", req.t_submit, wait,
+                    trace_id=req.trace_id, track=self._tm_track)
             # fault injection: FF_FAULT=slow(<ms>)@serve:<n> stalls the
             # n-th admission host-side — the deterministic slow-replica
             # drill (a deadline set tighter than <ms> expires while this
@@ -1643,6 +1769,15 @@ class ServingEngine:
                         padded, self.draft_pool,
                         np.asarray(req.pages[:n_prefill], np.int32))
             ok_host = bool(np.asarray(ok)[0])
+            if tm:
+                telemetry.tracer().complete(
+                    "prefill", t_adm, time.perf_counter() - t_adm,
+                    trace_id=req.trace_id, track=self._tm_track,
+                    kind="hit" if full else "cold", bucket=req.bucket,
+                    matched_pages=full, ok=ok_host)
+                req.decode_span = telemetry.tracer().begin(
+                    "decode", trace_id=req.trace_id,
+                    track=self._tm_track)
             if self.prefix_cache is not None and ok_host:
                 # publish this prompt's FULL pages beyond the matched
                 # prefix for future sharing (poisoned/non-finite prefills
@@ -2083,10 +2218,23 @@ class ServingEngine:
                                    bool(t_oks[slot, m]))
 
     def _decode_tick(self):
+        tm = self._tm_on and telemetry.enabled()
+        if tm:
+            t0 = time.perf_counter()
+            slots = int(self.active.sum())
+            toks0 = self._tokens_emitted
         if self.speculate_k > 0 and self.draft_gen is not None:
             self._spec_step()
         else:
             self._decode_step()
+        if tm:
+            # one engine-track span per decode dispatch: the fleet
+            # timeline shows each replica's chunk cadence without
+            # per-token events
+            telemetry.tracer().complete(
+                "decode_chunk", t0, time.perf_counter() - t0,
+                track=self._tm_track, slots=slots,
+                tokens=self._tokens_emitted - toks0)
 
     def step(self) -> bool:
         """One scheduler tick: admit what fits (unless draining), then one

@@ -132,6 +132,15 @@ def fleet_phase(ff, n_requests):
         assert st["handoff_fallbacks"] >= 1, (
             "the crash was supposed to catch handoff work in flight "
             "(cold-path fallback never fired)")
+        # the drill's trace annotation marks exactly where the fault
+        # landed (runtime/telemetry.py; faultinject reports every fire)
+        from flexflow_tpu.runtime import telemetry
+
+        assert any(e["args"]["kind"] == "crash"
+                   and e["args"]["site"] == "replica"
+                   and e["args"]["index"] == 0
+                   for e in telemetry.fault_events()), \
+            "crash fired but left no fault annotation in the trace ring"
         for r in (1, 2):
             assert router.engines[r].recompile_count \
                 == warm_compiles[r], (

@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""CI observability smoke (ci/run_ci.sh `obs` tier, ISSUE 13).
+
+A 1-prefill/2-decode fleet serves a skewed shared-prefix workload with
+FF_FAULT crashing a DECODE replica mid-flight (handoffs keep flowing
+through the prefill tier while failover runs). Mid-run, the Prometheus
+endpoint is scraped; afterwards the trace ring is exported as Chrome
+trace-event JSON. Proves the ISSUE-13 acceptance end to end on CPU:
+
+  * the mid-run scrape carries the TTFT and inter-token HISTOGRAMS and
+    the router failover counters (fenced/resubmitted/timeouts/rejected)
+    as labeled series, with engine series covering ALL replicas;
+  * every submitted request has a COMPLETE span tree (root "request"
+    span + queue/prefill/decode children, every span starting inside
+    the root);
+  * a crash-failover request and a prefill->decode handoff request each
+    show a single CONNECTED span tree across replicas (one trace id:
+    resubmit annotation + spans on two replicas; handoff_export on the
+    prefill replica + handoff_import/decode on a decode replica);
+  * the fault drill's trace annotation marks where the crash landed;
+  * the exported JSON is perfetto-loadable (traceEvents list, complete
+    events carry name/ph/ts/pid/tid/dur).
+
+Usage: python scripts/obs_smoke.py [N]
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flexflow_tpu._env import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(1)
+
+import numpy as np  # noqa: E402
+
+from flexflow_tpu import FFConfig, FFModel  # noqa: E402
+from flexflow_tpu.models.llama import llama_lm  # noqa: E402
+from flexflow_tpu.runtime import faultinject, telemetry  # noqa: E402
+
+VOCAB = 128
+PS = 8
+CRASH_REPLICA = 1       # a decode replica: handoffs keep flowing
+
+
+def build_model():
+    cfg = FFConfig(batch_size=2, mesh_shape={"data": 1}, serve_slots=4,
+                   kv_page_size=PS, metrics_port=0)
+    ff = FFModel(cfg)
+    _, logits = llama_lm(ff, 2, seq_len=16, hidden=64, layers=1, heads=4,
+                         kv_heads=2, vocab_size=VOCAB)
+    ff.compile(final_tensor=logits)
+    return ff
+
+
+def skewed_prompts(rs, n, system):
+    """60% share the 64-token system prompt (handoff-eligible via the
+    prefill tier); 40% shorter distinct backgrounds."""
+    prompts = []
+    for i in range(n):
+        if i % 5 < 3:
+            tail = rs.randint(1, VOCAB, (int(rs.randint(2, 9)),))
+            prompts.append(np.concatenate([system, tail.astype(np.int32)]))
+        else:
+            prompts.append(rs.randint(
+                1, VOCAB, (int(rs.randint(3, 25)),)).astype(np.int32))
+    return prompts
+
+
+def scrape(port, path="/metrics"):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.read().decode()
+
+
+def assert_scrape(text):
+    """The Prometheus exposition must carry the SLO histograms and the
+    failover counters as labeled series covering every replica."""
+    for needle in ("ff_serving_ttft_seconds_bucket",
+                   "ff_serving_intertoken_seconds_bucket",
+                   "ff_serving_queue_wait_seconds_bucket",
+                   "ff_router_ttft_seconds_bucket",
+                   "ff_router_fenced", "ff_router_resubmitted",
+                   "ff_router_timeouts", "ff_router_rejected",
+                   "ff_router_handoffs", "ff_fleet_prefix_hits",
+                   "ff_router_replica_up"):
+        assert needle in text, f"scrape missing {needle}"
+    for r, role in ((0, "prefill"), (1, "decode"), (2, "decode")):
+        assert f'replica="{r}",role="{role}"' in text, \
+            f"scrape has no series for replica {r} ({role})"
+    print("obs_smoke[scrape]: histograms + failover counters present, "
+          "series cover all 3 replicas")
+
+
+def assert_trace_file(path):
+    """Perfetto-loadability: a JSON object with a traceEvents list whose
+    events carry the Chrome trace-event required keys."""
+    doc = json.load(open(path))
+    evs = doc["traceEvents"]
+    assert isinstance(evs, list) and evs, "empty traceEvents"
+    for ev in evs:
+        assert {"name", "ph", "ts", "pid", "tid"} <= set(ev), ev
+        assert ev["ph"] in ("X", "i"), ev
+        if ev["ph"] == "X":
+            assert "dur" in ev and ev["dur"] >= 0, ev
+    print(f"obs_smoke[trace]: {len(evs)} events, chrome/perfetto schema "
+          f"valid -> {path}")
+    return evs
+
+
+def main():
+    n_requests = int(sys.argv[1]) if len(sys.argv) > 1 else 120
+    os.environ["FF_FAULT"] = f"crash(6)@replica:{CRASH_REPLICA}"
+    faultinject.reset()
+    ff = build_model()
+    rs = np.random.RandomState(0)
+    system = rs.randint(1, VOCAB, (64,)).astype(np.int32)
+    prompts = skewed_prompts(rs, n_requests, system)
+
+    port = telemetry.start_http_server(0)
+    router = ff.make_serving_router(
+        replicas=3, roles=["prefill", "decode", "decode"],
+        max_seq_len=112, decode_buckets=[32, 96], start=False)
+    warm_tail = rs.randint(1, VOCAB, (3,)).astype(np.int32)
+    router.warmup([rs.randint(1, VOCAB, (10,)).astype(np.int32),
+                   rs.randint(1, VOCAB, (18,)).astype(np.int32),
+                   np.concatenate([system, warm_tail]),
+                   np.concatenate([system, warm_tail + 1])],
+                  max_new_tokens=4)
+    warm_compiles = [e.recompile_count for e in router.engines]
+
+    t0 = time.perf_counter()
+    reqs = [router.submit(p, 12) for p in prompts]
+    router.start()
+    # mid-run scrape: wait for partial progress, then hit /metrics while
+    # the fleet is still decoding
+    mid_text = None
+    while any(not r.settled for r in reqs):
+        done = sum(r.state == "done" for r in reqs)
+        if mid_text is None and 5 <= done < n_requests:
+            mid_text = scrape(port)
+        time.sleep(0.02)
+        if time.perf_counter() - t0 > 1800:
+            raise TimeoutError("fleet did not settle")
+    if mid_text is None:        # everything settled between polls
+        mid_text = scrape(port)
+    router.wait(reqs, timeout=60)
+    dt = time.perf_counter() - t0
+    st = router.stats()
+    print(f"obs_smoke: {st['completed']}/{n_requests} done in {dt:.1f}s "
+          f"— handoffs {st['handoffs']}, fenced {st['fenced']}, "
+          f"resubmitted {st['resubmitted']}")
+    assert st["completed"] == n_requests, "requests lost under the drill"
+    assert st["fenced"] == 1 and st["resubmitted"] >= 1
+    assert st["handoffs"] >= 1
+
+    # (a) the scrape
+    assert_scrape(mid_text)
+    # JSON snapshot API serves the same registry
+    snap = json.loads(scrape(port, "/metrics.json"))
+    assert snap["ff_serving_ttft_seconds"]["type"] == "histogram"
+
+    # (b) the trace file
+    out = os.environ.get("OBS_TRACE_OUT", "/tmp/ff_obs_trace.json")
+    telemetry.export_chrome_trace(out)
+    assert_trace_file(out)
+
+    # every submitted request in the ring has a complete span tree.
+    # The ring is bounded — under a huge N old spans fall off; this
+    # smoke's volume fits, and we assert that assumption too.
+    missing = 0
+    for r in reqs:
+        tree = telemetry.trace_tree(r.trace_id)
+        if not tree["complete"]:
+            missing += 1
+            continue
+        assert tree["root"]["name"] == "request"
+        assert {"queue_wait", "prefill", "decode"} <= set(tree["names"]), \
+            (r.trace_id, tree["names"])
+    assert missing == 0, f"{missing} requests lack a complete span tree"
+    print(f"obs_smoke[spans]: all {n_requests} requests have complete "
+          f"span trees")
+
+    # crash-failover request: one connected tree across two replicas
+    resub = [r for r in reqs if r.losses >= 1 and r.state == "done"]
+    assert resub, "the crash caught no in-flight work"
+    crossed = 0
+    for r in resub:
+        tree = telemetry.trace_tree(r.trace_id)
+        marks = [e["name"] for e in tree["annotations"]]
+        assert "resubmit" in marks, (r.trace_id, marks)
+        tracks = {e["pid"] for e in tree["spans"]
+                  if e["pid"].startswith("replica")}
+        if len(tracks) >= 2:
+            crossed += 1
+    assert crossed >= 1, "no failover trace crossed two replicas"
+    print(f"obs_smoke[failover]: {len(resub)} failed-over requests, "
+          f"{crossed} with spans on both replicas under one trace id")
+
+    # handoff request: prefill-replica export + decode-replica import,
+    # one tree
+    handed = [r for r in reqs if r.handoff and r.state == "done"]
+    assert handed, "no request went through the handoff path"
+    ok_handoff = 0
+    for r in handed:
+        tree = telemetry.trace_tree(r.trace_id)
+        by = {}
+        for e in tree["spans"]:
+            by.setdefault(e["name"], set()).add(e["pid"])
+        if ("handoff_export" in by and "handoff_import" in by
+                and f"replica{0}" in by["handoff_export"]
+                and by.get("decode", set()) - {"replica0"}):
+            ok_handoff += 1
+    assert ok_handoff >= 1, "no handoff trace spans prefill AND decode"
+    print(f"obs_smoke[handoff]: {ok_handoff}/{len(handed)} handoff "
+          f"traces connect prefill export -> decode import")
+
+    # the fault annotation marks the drill's landing site
+    faults = telemetry.fault_events()
+    assert any(e["args"]["kind"] == "crash"
+               and e["args"]["site"] == "replica"
+               and e["args"]["index"] == CRASH_REPLICA
+               for e in faults), faults
+    print("obs_smoke[fault]: crash annotation present at "
+          f"replica:{CRASH_REPLICA}")
+
+    # zero survivor recompiles through all of it: telemetry must not
+    # perturb the compiled-program story
+    for r in (0, 2):
+        assert router.engines[r].recompile_count == warm_compiles[r], \
+            f"replica {r} recompiled after warmup"
+    router.close()
+    telemetry.stop_http_server()
+    print("obs_smoke: PASSED")
+
+
+if __name__ == "__main__":
+    main()

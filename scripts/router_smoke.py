@@ -118,6 +118,15 @@ def main():
             f"{st['fenced']}"
         assert st["resubmitted"] >= 1 and resubmitted, \
             "the crash was supposed to catch work in flight"
+        # the drill's trace annotation marks exactly where the fault
+        # landed (runtime/telemetry.py; faultinject reports every fire)
+        from flexflow_tpu.runtime import telemetry
+
+        assert any(e["args"]["kind"] == "crash"
+                   and e["args"]["site"] == "replica"
+                   and e["args"]["index"] == 0
+                   for e in telemetry.fault_events()), \
+            "crash fired but left no fault annotation in the trace ring"
         # the survivor saw failover traffic yet compiled NOTHING new
         assert router.engines[1].recompile_count == warm_compiles[1], (
             f"survivor recompile leak: "
