@@ -78,8 +78,23 @@
 #               replica 0 mid-flight — all non-expired requests complete
 #               exactly once, zero lost/duplicated, zero warm recompiles
 #               on the survivor
+#   tenancy   — multi-tenant serving tier (ISSUE 14): per-slot sampling
+#               (counter-based seeded RNG, greedy bitwise at
+#               temperature 0) + the paged LoRA adapter pool (host
+#               allocator/LRU state machine, merged-weights stream
+#               oracle, 8-tenant mixed-config zero-recompile pin,
+#               per-adapter prefix-cache isolation) + rejection-sampled
+#               speculation property tests (spec vs non-spec token
+#               frequencies at K=1/3/8, small-draft and self-draft) +
+#               seeded-reproducibility drills (slot reassignment,
+#               engine instances, fleet failover) + an 8-adapter
+#               mixed-sampling 2-replica fleet smoke under a mid-flight
+#               crash: every seeded stream token-identical through
+#               failover, adapter evicted + re-faulted under pool
+#               pressure, zero warm-window recompiles, per-adapter
+#               telemetry series present
 #
-# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|kernels|quant|disagg|obs|router|all]
+# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|kernels|quant|disagg|obs|router|tenancy|all]
 set -e
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -265,6 +280,19 @@ run_router() {
   FF_FAULT="crash(10)@replica:0" python scripts/router_smoke.py 200
 }
 
+# tenancy tier (ISSUE 14): the multi-tenant suites — per-slot sampling
+# + paged LoRA adapter pool (test_tenancy) and rejection-sampled
+# speculation property/reproducibility tests (test_sampled_spec, slow
+# variants included: the K=1/3/8 distribution sweep and the sampled
+# failover drill) — then the 8-adapter mixed-sampling fleet smoke under
+# a deterministic mid-flight crash of replica 0 (tick 6: the drill must
+# catch seeded sampled streams genuinely mid-decode; identity-indexed,
+# so the smoke's warmup consumes nothing from the plan).
+run_tenancy() {
+  python -m pytest tests/test_tenancy.py tests/test_sampled_spec.py -q
+  FF_FAULT="crash(6)@replica:0" python scripts/tenancy_smoke.py 48
+}
+
 case "$TIER" in
   unit)     run_unit ;;
   sweep)    run_sweep ;;
@@ -281,7 +309,8 @@ case "$TIER" in
   disagg)   run_disagg ;;
   obs)      run_obs ;;
   router)   run_router ;;
-  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_kernels; run_quant; run_disagg; run_obs; run_router; run_native; run_docs; run_sweep ;;
+  tenancy)  run_tenancy ;;
+  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_kernels; run_quant; run_disagg; run_obs; run_router; run_tenancy; run_native; run_docs; run_sweep ;;
   *) echo "unknown tier $TIER"; exit 2 ;;
 esac
 echo "ci($TIER): PASSED"

@@ -31,7 +31,8 @@ PAGES = [("index", os.path.join(ROOT, "README.md"), "Overview"),
           "Fault tolerance & elastic recovery"),
          ("serving", os.path.join(DOCS, "serving.md"),
           "Serving (continuous batching, prefix cache, fleet router, "
-          "quantized tier, disaggregated fleet + tiered cache)"),
+          "quantized tier, disaggregated fleet + tiered cache, "
+          "sampling + multi-tenant LoRA)"),
          ("performance", os.path.join(DOCS, "performance.md"),
           "Performance (host + in-graph overlap, Pallas kernel tier)"),
          ("observability", os.path.join(DOCS, "observability.md"),

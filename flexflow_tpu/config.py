@@ -293,6 +293,25 @@ class FFConfig:
     # traffic. Roles are placement preferences, never constraints — a
     # dead tier degrades to the mixed-fleet path.
     serve_replica_roles: str = ""
+    # ---- multi-tenant serving (ISSUE 14) ----
+    # per-request sampling DEFAULTS (submit() overrides per request;
+    # the values ride the one fixed-shape slot program as per-slot
+    # scalars — ops/sampling.py): temperature 0 = greedy argmax
+    # (bitwise the pre-sampling path), top_p in (0, 1] (1 = off),
+    # top_k >= 0 (0 = off). Sample streams are counter-based on the
+    # request seed, so they reproduce across slot reassignment and
+    # failover resubmission.
+    serve_temperature: float = 0.0
+    serve_top_p: float = 1.0
+    serve_top_k: int = 0
+    # paged LoRA adapter pool (runtime/lora.py + ops/lora.py): device
+    # pages for concurrently-resident adapters (0 = no pool). Each page
+    # holds one adapter's (a, b) weights for every LoRA-targeted Linear
+    # op at rank serve_lora_rank; a host allocator/LRU faults
+    # registered adapters in through ONE fixed-shape writer, so N
+    # tenants share a replica with zero recompiles.
+    serve_adapter_pool_pages: int = 0
+    serve_lora_rank: int = 8
     # jax persistent compilation cache directory ("" = off): set before
     # the first trace (FFModel.compile / launcher) so repeated runs skip
     # recompiles; serving logs hit/miss per program build
@@ -392,6 +411,21 @@ class FFConfig:
                     f"serve_replica_roles={self.serve_replica_roles!r}: "
                     f"comma-separated 'prefill'|'decode'|'mixed', one "
                     f"per replica (bad: {bad or 'empty entry'})")
+        # ONE validation rule for sampling params, shared with
+        # engine/router submit paths (ops/sampling.py) — config-time and
+        # submit-time acceptance can never diverge
+        from flexflow_tpu.ops.sampling import validate_sampling
+
+        validate_sampling(
+            self.serve_temperature, self.serve_top_p, self.serve_top_k,
+            "FFConfig (serve_temperature/serve_top_p/serve_top_k)")
+        if self.serve_adapter_pool_pages < 0:
+            raise ValueError(
+                f"serve_adapter_pool_pages={self.serve_adapter_pool_pages}"
+                f": must be >= 0 (0 = no adapter pool)")
+        if self.serve_lora_rank < 1:
+            raise ValueError(
+                f"serve_lora_rank={self.serve_lora_rank}: must be >= 1")
         if self.telemetry not in ("on", "off"):
             raise ValueError(
                 f"telemetry={self.telemetry!r}: must be 'on' or 'off'")
@@ -518,6 +552,23 @@ class FFConfig:
                             "prefix cache, in kv_page_size pages: "
                             "evicted ref-0 pages demote to host RAM "
                             "and promote back on a hit (0 = off)")
+        p.add_argument("--serve-temperature", type=float, default=0.0,
+                       help="default sampling temperature for serving "
+                            "requests (0 = greedy argmax; per-request "
+                            "submit() overrides)")
+        p.add_argument("--serve-top-p", type=float, default=1.0,
+                       help="default nucleus (top-p) filter in (0, 1] "
+                            "(1 = off)")
+        p.add_argument("--serve-top-k", type=int, default=0,
+                       help="default top-k filter (0 = off)")
+        p.add_argument("--serve-adapter-pool-pages", type=int, default=0,
+                       help="paged LoRA adapter pool: device pages for "
+                            "concurrently-resident adapters (0 = no "
+                            "pool); tenants share one program, zero "
+                            "recompiles")
+        p.add_argument("--serve-lora-rank", type=int, default=8,
+                       help="LoRA rank of the adapter pool's fixed page "
+                            "geometry")
         p.add_argument("--serve-replica-roles", type=str, default="",
                        help="fleet replica roles, comma-separated "
                             "prefill|decode|mixed, one per replica "
@@ -593,6 +644,11 @@ class FFConfig:
             serve_speculate_k=args.serve_speculate_k,
             serve_max_queue=args.serve_max_queue,
             host_kv_pages=args.host_kv_pages,
+            serve_temperature=args.serve_temperature,
+            serve_top_p=args.serve_top_p,
+            serve_top_k=args.serve_top_k,
+            serve_adapter_pool_pages=args.serve_adapter_pool_pages,
+            serve_lora_rank=args.serve_lora_rank,
             serve_replica_roles=args.serve_replica_roles,
             paged_attention_impl=args.paged_attention_impl,
             kv_cache_dtype=args.kv_cache_dtype,

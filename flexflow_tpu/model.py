@@ -1339,11 +1339,21 @@ class FFModel:
         tier under the prefix cache (evicted ref-0 pages demote to host
         RAM and promote back on a hit — the shared-prefix corpus
         becomes host-RAM-sized), and ``warmup(prompts)`` drives every
-        reachable prefill variant so timed windows never compile. Knobs
-        default to this model's FFConfig (serve_slots, kv_page_size,
-        kv_pages, decode_buckets, serve_prefix_cache, host_kv_pages,
-        serve_speculate_k, draft_model, kv_cache_dtype,
-        serve_weight_dtype); kwargs override per engine (see
+        reachable prefill variant so timed windows never compile.
+        Multi-tenant serving (ISSUE 14): per-request
+        temperature/top-p/top-k/seed ride ``submit()`` as slot-resident
+        state (greedy = temperature 0, bitwise; counter-based seeded
+        streams reproduce across slots and failover), sampled requests
+        speculate via the rejection-sampled accept rule
+        (distribution-identical to the plain sampler), and
+        ``adapter_pool_pages > 0`` + ``register_adapter()`` serve
+        per-request LoRA adapters from a paged device pool with zero
+        recompiles. Knobs default to this model's FFConfig
+        (serve_slots, kv_page_size, kv_pages, decode_buckets,
+        serve_prefix_cache, host_kv_pages, serve_speculate_k,
+        draft_model, kv_cache_dtype, serve_weight_dtype,
+        serve_temperature/top_p/top_k, serve_adapter_pool_pages,
+        serve_lora_rank); kwargs override per engine (see
         ServingEngine)."""
         from flexflow_tpu.runtime.serving import ServingEngine
 

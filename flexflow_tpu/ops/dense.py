@@ -65,10 +65,18 @@ class Linear(Op):
             ws.append(WeightSpec("bias", (self.out_dim,), init="zero"))
         return ws
 
-    def forward(self, params, xs, *, training=False, rng=None):
+    def forward(self, params, xs, *, training=False, rng=None, lora=None):
         x = xs[0]
         y = jnp.einsum("...i,io->...o", x, params["kernel"],
                        preferred_element_type=x.dtype)
+        if lora is not None:
+            # gathered per-row LoRA delta (ops/lora.py): added BEFORE
+            # bias/activation so it composes exactly like a merged
+            # W + a@b*scale kernel would
+            from flexflow_tpu.ops.lora import lora_delta
+
+            a, b, scale = lora
+            y = y + lora_delta(x, a, b, scale)
         if self.use_bias:
             y = y + params["bias"]
         return [apply_activation(y, self.activation)]

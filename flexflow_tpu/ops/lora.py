@@ -1,0 +1,106 @@
+"""Paged LoRA adapter pool — device side (ISSUE 14).
+
+Multi-tenant serving wants N tenants' low-rank adapters live on one
+replica with ZERO recompiles: adapter weights therefore live in a
+fixed-geometry device POOL (the paged-KV design applied to weights) and
+the adapter a slot applies is *data* — a per-slot page index gathered
+inside the one compiled slot program, exactly like the KV page table.
+
+Pool layout (one pool per served model): for every LoRA-targeted Linear
+op, two arrays
+
+    a: (pages, in_dim, rank)    b: (pages, rank, out_dim)
+
+plus one shared ``"_scale"`` array (pages,) holding each adapter's
+``alpha / rank``. Page 0 is the NULL adapter (all zeros, scale 0): a
+request with no adapter indexes page 0 and its gathered delta is
+exactly zero — the base model, at the cost of one rank-r matmul the
+fixed program always executes. Pages are written by ONE fixed-shape
+writer program when the host allocator (runtime/lora.py) faults an
+adapter in; the gather below never changes shape, so admitting tenant
+#1000 compiles nothing.
+
+The gathered (batched/segmented) LoRA matmul: with x (B, S, in) and
+per-slot pages (B,),
+
+    delta[b] = (x[b] @ a[pages[b]]) @ b[pages[b]] * scale[pages[b]]
+
+— two thin einsums whose inner dim is the rank, added to the base
+``x @ W`` BEFORE bias/activation (ops/dense.py Linear.forward). The
+delta computes in f32 (ranks are tiny; the base matmul's dtype
+dominates cost) and casts to the base dtype at the add.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_lora_pool(targets: List, pages: int, rank: int) -> Dict:
+    """Zero-filled adapter pool for ``targets`` (Linear ops): ``pages``
+    usable pages PLUS the reserved null page 0. f32 storage — adapter
+    tensors are rank-thin, so pool bytes are marginal next to the KV
+    pool."""
+    pool = {
+        op.name: {
+            "a": jnp.zeros((pages + 1, op.in_dim, rank), jnp.float32),
+            "b": jnp.zeros((pages + 1, rank, op.out_dim), jnp.float32),
+        }
+        for op in targets}
+    pool["_scale"] = jnp.zeros((pages + 1,), jnp.float32)
+    return pool
+
+
+def write_adapter_page(pool: Dict, page, payload: Dict, scale):
+    """Scatter one adapter's weights into ``page`` of every target's
+    pool arrays (the body of the engine's fixed-shape writer program;
+    ``page`` is a traced scalar so one compile serves every fault-in).
+    ``payload`` maps op name -> {"a", "b"}; ops the adapter does not
+    target carry zeros."""
+    out = {}
+    for name, arrs in pool.items():
+        if name == "_scale":
+            continue
+        sub = payload[name]
+        out[name] = {
+            "a": arrs["a"].at[page].set(sub["a"].astype(jnp.float32)),
+            "b": arrs["b"].at[page].set(sub["b"].astype(jnp.float32)),
+        }
+    out["_scale"] = pool["_scale"].at[page].set(
+        jnp.asarray(scale, jnp.float32))
+    return out
+
+
+def gather_op_lora(pool: Dict, op_name: str, pages):
+    """Per-slot operands for one op's gathered LoRA matmul:
+    (a (B, in, r), b (B, r, out), scale (B,)) — or None when the op is
+    not LoRA-targeted."""
+    arrs = pool.get(op_name)
+    if arrs is None:
+        return None
+    pages = jnp.asarray(pages, jnp.int32)
+    return (arrs["a"][pages], arrs["b"][pages], pool["_scale"][pages])
+
+
+def lora_delta(x, a, b, scale):
+    """The batched segmented LoRA delta: x (B, ..., in) with PER-ROW
+    adapters a (B, in, r), b (B, r, out), scale (B,) ->
+    (B, ..., out) in x.dtype. f32 accumulation through the thin rank
+    dim; one slot's tokens only ever touch that slot's adapter rows —
+    the segmented-matmul property that lets mixed tenants share one
+    dispatch."""
+    xf = x.astype(jnp.float32)
+    h = jnp.einsum("b...i,bir->b...r", xf, a)
+    d = jnp.einsum("b...r,bro->b...o", h, b)
+    s = scale.reshape((-1,) + (1,) * (x.ndim - 1))
+    return (d * s).astype(x.dtype)
+
+
+def zero_payload(targets: List, rank: int) -> Dict:
+    """Host-side all-zero payload template (np arrays) for the writer."""
+    return {op.name: {"a": np.zeros((op.in_dim, rank), np.float32),
+                      "b": np.zeros((rank, op.out_dim), np.float32)}
+            for op in targets}

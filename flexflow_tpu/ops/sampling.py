@@ -1,0 +1,179 @@
+"""Per-slot sampling for the fixed-shape serving programs (ISSUE 14).
+
+The serving engine runs ONE compiled slot-decode program for its whole
+life; per-request sampling configs therefore cannot be trace-time
+constants (a program per temperature would recompile per tenant). This
+module makes sampling *data*: temperature / top-p / top-k / seed ride
+the dispatch as per-slot scalar arrays — exactly like ``write_pos`` —
+and every function here is shape-stable in the slot dimension, so N
+tenants with N different sampling configs share one XLA program.
+
+Counter-based RNG: a request's sample stream is a pure function of
+``(seed, stream tag, draw index)`` — ``fold_in(fold_in(PRNGKey(seed),
+tag), index)`` — never of the engine's key state, the slot index, or
+the replica. Draw index = the position of the token being sampled
+(``len(request.tokens)`` at dispatch), so a request replayed after
+failover resubmission, or admitted into a different slot, reproduces
+its stream bit-for-bit. Four independent streams per request:
+
+  TAG_TARGET   — the non-speculative sampler's token draws (draw i
+                 samples token i; the prefill's first token is draw 0)
+  TAG_DRAFT    — the draft model's proposal draws under speculation
+  TAG_ACCEPT   — the rejection-sampling accept uniforms (host rule)
+  TAG_RESAMPLE — the residual re-draw after a rejection (in-graph)
+
+Greedy is the ``temperature == 0`` degenerate case, not a separate
+program: rows with temperature 0 return ``argmax(logits)`` computed
+exactly as the pre-sampling greedy path did (f32 cast then argmax), so
+greedy streams are bitwise-identical to a greedy-only engine.
+
+Warping semantics (shared by the sampler and ``sampling_probs`` — the
+rejection-sampling accept rule depends on the two agreeing): logits are
+divided by temperature, then the top-k and top-p keep-sets are computed
+independently on that warped distribution and intersected; the top-1
+token always survives. The sampling distribution is the softmax over
+the surviving logits.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# stream tags (fold_in domain separators): see module docstring
+TAG_TARGET = 1
+TAG_DRAFT = 2
+TAG_ACCEPT = 3
+TAG_RESAMPLE = 4
+
+
+def validate_sampling(temperature, top_p, top_k, where: str = "sampling"):
+    """Shared host-side validation (FFConfig, engine, router, submit):
+    temperature >= 0 (0 = greedy), 0 < top_p <= 1 (1 = off),
+    top_k >= 0 (0 = off)."""
+    t = float(temperature)
+    p = float(top_p)
+    k = int(top_k)
+    if not t >= 0.0:        # catches NaN too
+        raise ValueError(
+            f"{where}: temperature={temperature}: must be >= 0 "
+            f"(0 = greedy argmax)")
+    if not (0.0 < p <= 1.0):
+        raise ValueError(
+            f"{where}: top_p={top_p}: must be in (0, 1] "
+            f"(1 = no nucleus filter)")
+    if k < 0:
+        raise ValueError(
+            f"{where}: top_k={top_k}: must be >= 0 (0 = no top-k filter)")
+    return t, p, k
+
+
+def slot_keys(seeds, counters, tag: int):
+    """(B,) seeds + (B,) draw indices -> (B, 2) uint32 PRNG keys on the
+    ``tag`` stream. Pure per-row: row b's key depends only on
+    (seeds[b], tag, counters[b])."""
+
+    def one(s, c):
+        k = jax.random.PRNGKey(s)
+        k = jax.random.fold_in(k, tag)
+        return jax.random.fold_in(k, c)
+
+    return jax.vmap(one)(jnp.asarray(seeds, jnp.int32),
+                         jnp.asarray(counters, jnp.int32))
+
+
+def _masked_warped(logits, temps, top_ps, top_ks):
+    """(B, V) f32 masked warped logits for the temperature>0 rows (rows
+    with temperature 0 are resolved by the callers via argmax). The
+    surviving set is (top-k keep) AND (top-p keep), computed on the
+    warped distribution; rank 0 always survives."""
+    logits = logits.astype(jnp.float32)
+    temps = temps.astype(jnp.float32)
+    safe_t = jnp.where(temps > 0.0, temps, 1.0)[:, None]
+    warped = logits / safe_t
+    # rank every vocab position by warped value (jnp.argsort is stable,
+    # so ties break by vocab index — the lax.top_k order)
+    order = jnp.argsort(-warped, axis=-1)
+    ranks = jnp.argsort(order, axis=-1)
+    k = jnp.asarray(top_ks, jnp.int32)[:, None]
+    keep_k = (k <= 0) | (ranks < k)
+    probs = jax.nn.softmax(warped, axis=-1)
+    sorted_probs = jnp.take_along_axis(probs, order, axis=-1)
+    csum = jnp.cumsum(sorted_probs, axis=-1)
+    # keep sorted position j iff the mass strictly BEFORE it is < top_p:
+    # the smallest prefix reaching top_p survives, rank 0 always does
+    keep_sorted = (csum - sorted_probs) < top_ps.astype(jnp.float32)[:, None]
+    keep_p = jnp.take_along_axis(keep_sorted, ranks, axis=-1)
+    keep = keep_k & keep_p
+    return jnp.where(keep, warped, -jnp.inf)
+
+
+def sampling_probs(logits, temps, top_ps, top_ks):
+    """The per-row sampling distribution as (B, V) f32 probabilities —
+    the operand of the rejection-sampling accept rule (``p`` for the
+    target, ``q`` for the draft). Rows with temperature 0 are the
+    degenerate one-hot at argmax (their "distribution" is the greedy
+    choice)."""
+    logits = logits.astype(jnp.float32)
+    masked = _masked_warped(logits, temps, top_ps, top_ks)
+    probs = jax.nn.softmax(masked, axis=-1)
+    greedy = jax.nn.one_hot(jnp.argmax(logits, axis=-1),
+                            logits.shape[-1], dtype=jnp.float32)
+    return jnp.where((temps > 0.0)[:, None], probs, greedy)
+
+
+def sample_tokens(logits, temps, top_ps, top_ks, seeds, counters,
+                  tag: int = TAG_TARGET):
+    """One token per row from the warped distribution; (B,) int32.
+    temperature-0 rows take ``argmax(f32(logits))`` — bitwise the
+    pre-sampling greedy decode. Draw b is a pure function of
+    (seeds[b], tag, counters[b]): slot- and replica-invariant."""
+    logits = logits.astype(jnp.float32)
+    temps = jnp.asarray(temps, jnp.float32)
+    masked = _masked_warped(logits, temps, top_ps, top_ks)
+    keys = slot_keys(seeds, counters, tag)
+    sampled = jax.vmap(
+        lambda k, row: jax.random.categorical(k, row))(keys, masked)
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
+
+
+def accept_uniforms(seeds, counters, k: int):
+    """(B, k) accept-rule uniforms: row b, proposal i draws from the
+    ACCEPT stream at index counters[b] + i. The host compares
+    ``u * q(d) <= p(d)`` — accept with probability min(1, p/q)."""
+    seeds = jnp.asarray(seeds, jnp.int32)
+    counters = jnp.asarray(counters, jnp.int32)
+
+    def one(s, c):
+        def per_i(i):
+            key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(s), TAG_ACCEPT),
+                c + i)
+            return jax.random.uniform(key, ())
+
+        return jax.vmap(per_i)(jnp.arange(k, dtype=jnp.int32))
+
+    return jax.vmap(one)(seeds, counters)
+
+
+def residual_sample(p, q, seeds, counters):
+    """The in-graph rejection re-draw: sample from the residual
+    distribution ``norm(max(p - q, 0))`` — what makes accept/resample
+    speculation distribution-identical to sampling from ``p`` directly.
+    ``p``/``q`` are (B, V) sampling distributions (the draft's q is all
+    zeros for the bonus position after a fully accepted window, so the
+    residual degenerates to ``p`` itself). A numerically-empty residual
+    (q >= p everywhere — only reachable when p == q up to float error,
+    where rejection has probability ~0) falls back to ``p``. Draws ride
+    the RESAMPLE stream at the emitting token's index."""
+    p = jnp.asarray(p, jnp.float32)
+    q = jnp.asarray(q, jnp.float32)
+    r = jnp.maximum(p - q, 0.0)
+    norm = jnp.sum(r, axis=-1, keepdims=True)
+    dist = jnp.where(norm > 1e-12, r / jnp.maximum(norm, 1e-12), p)
+    keys = slot_keys(seeds, counters, TAG_RESAMPLE)
+    logits = jnp.log(jnp.maximum(dist, 1e-38))
+    return jax.vmap(
+        lambda k, row: jax.random.categorical(k, row))(keys, logits
+                                                       ).astype(jnp.int32)

@@ -242,7 +242,7 @@ class Generator:
     def _walk(self, params, state, tokens, caches, pos, last_only=False,
               rope_pos=None, row_lengths=None, prompt_len=None,
               chunk_start=None, skip_tail=False, gather_last=False,
-              paged=None):
+              paged=None, lora=None):
         """Interpret the graph on a (B, S) token slab. pos=None means
         prefill (positions 0..S-1, fills cache); otherwise S == 1 and pos
         is the traced cache slot of the token. last_only=True narrows the
@@ -345,6 +345,16 @@ class Generator:
                     kwargs = {}
                     if getattr(op, "wants_shard_ctx", False):
                         kwargs["shard_ctx"] = None
+                    if lora is not None \
+                            and op.name in lora["pool"] \
+                            and op.op_type == OperatorType.OP_LINEAR:
+                        # multi-tenant serving (runtime/serving.py): the
+                        # per-slot adapter-page gather + batched LoRA
+                        # delta, inside the one fixed-shape program
+                        from flexflow_tpu.ops.lora import gather_op_lora
+
+                        kwargs["lora"] = gather_op_lora(
+                            lora["pool"], op.name, lora["pages"])
                     if op.op_type == OperatorType.OP_MOE:
                         # inference capacity = the slab's token count:
                         # guarantees zero drops (see MoE.forward), hence
@@ -363,7 +373,7 @@ class Generator:
         return vals[self.model._final_tensor], new_caches
 
     def _prefill(self, params, state, tokens, caches, row_lengths,
-                 prefill_chunk):
+                 prefill_chunk, lora=None):
         """Whole-prompt prefill, or chunked (`prefill_chunk` > 0 and the
         prompt longer than it): each chunk writes its k/v and attends the
         static prefix slice under the same causal rule — score memory is
@@ -383,25 +393,25 @@ class Generator:
         if not prefill_chunk or s0 <= prefill_chunk:
             return self._walk(params, state, tokens, caches, None,
                               last_only=True, row_lengths=row_lengths,
-                              prompt_len=s0)
+                              prompt_len=s0, lora=lora)
         starts = list(range(0, s0, prefill_chunk))
         if row_lengths is not None:
             for st in starts:
                 _, caches = self._walk(
                     params, state, tokens[:, st:st + prefill_chunk],
-                    caches, None, chunk_start=st, skip_tail=True)
+                    caches, None, chunk_start=st, skip_tail=True, lora=lora)
             tok_last = jnp.take_along_axis(
                 tokens, (row_lengths - 1)[:, None], axis=1)      # (B, 1)
             return self._walk(params, state, tok_last, caches, None,
                               last_only=True, row_lengths=row_lengths,
-                              gather_last=True)
+                              gather_last=True, lora=lora)
         for st in starts[:-1]:
             _, caches = self._walk(
                 params, state, tokens[:, st:st + prefill_chunk], caches,
-                None, chunk_start=st, skip_tail=True)
+                None, chunk_start=st, skip_tail=True, lora=lora)
         st = starts[-1]
         return self._walk(params, state, tokens[:, st:], caches, None,
-                          last_only=True, chunk_start=st)
+                          last_only=True, chunk_start=st, lora=lora)
 
     # ---- sampling ----------------------------------------------------------
 

@@ -92,7 +92,9 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from flexflow_tpu.logger import fflogger
+from flexflow_tpu.ops import sampling as sampling_ops
 from flexflow_tpu.runtime import faultinject, telemetry
+from flexflow_tpu.runtime.serving import RadixPrefixCache
 
 
 class ReplicaCrash(RuntimeError):
@@ -115,6 +117,16 @@ class FleetRequest:
     rid: int
     prompt: np.ndarray              # (S,) int32
     max_new_tokens: int
+    # per-request sampling config + LoRA adapter (ISSUE 14): assigned
+    # at ROUTER submit (the seed defaults to the fleet rid) so a
+    # failover resubmission replays the identical counter-based sample
+    # stream on the survivor — sampled streams are as failover-stable
+    # as greedy ones
+    temperature: float = 0.0
+    top_p: float = 1.0
+    top_k: int = 0
+    seed: int = 0
+    adapter: Optional[str] = None
     # absolute time.perf_counter() deadline (None = none)
     deadline: Optional[float] = None
     # first full KV page of the prompt (the radix trie's first edge);
@@ -328,7 +340,12 @@ class ServingRouter:
             t.start()
 
     def submit(self, prompt, max_new_tokens: int,
-               deadline_s: Optional[float] = None) -> FleetRequest:
+               deadline_s: Optional[float] = None,
+               temperature: Optional[float] = None,
+               top_p: Optional[float] = None,
+               top_k: Optional[int] = None,
+               seed: Optional[int] = None,
+               adapter: Optional[str] = None) -> FleetRequest:
         """Queue one request (validated synchronously against replica
         0's admission rules, so a malformed request raises HERE, not on
         a driver thread). Over ``max_queue``, returns immediately with
@@ -347,9 +364,29 @@ class ServingRouter:
                 f"({max_new_tokens}) exceeds max_seq_len {eng0.max_seq_len}")
         if deadline_s is not None and deadline_s < 0:
             raise ValueError(f"deadline_s={deadline_s}: must be >= 0")
+        t, p, k = sampling_ops.validate_sampling(
+            temperature if temperature is not None
+            else eng0.default_temperature,
+            top_p if top_p is not None else eng0.default_top_p,
+            top_k if top_k is not None else eng0.default_top_k,
+            "router.submit")
+        if adapter is not None:
+            if eng0.lora is None:
+                raise ValueError(
+                    f"adapter={adapter!r}: this fleet has no adapter "
+                    f"pool (build replicas with adapter_pool_pages > 0)")
+            if adapter not in eng0.lora.registry:
+                raise ValueError(
+                    f"adapter {adapter!r} is not registered (known: "
+                    f"{sorted(eng0.lora.registry)}) — "
+                    f"router.register_adapter first")
         now = time.perf_counter()
-        affinity = (tuple(int(t) for t in prompt[:self.page_size])
-                    if prompt.size >= self.page_size else None)
+        # adapter-aware affinity: the key IS the trie's (namespaced)
+        # first edge, so equal key still guarantees a trie hit on the
+        # home replica — tenants never alias each other's homes
+        affinity = (RadixPrefixCache.first_chunk(
+            prompt[:self.page_size], adapter)
+            if prompt.size >= self.page_size else None)
         with self._lock:
             if self._draining:
                 raise RuntimeError(
@@ -358,6 +395,12 @@ class ServingRouter:
             req = FleetRequest(
                 rid=self._next_rid, prompt=prompt,
                 max_new_tokens=int(max_new_tokens),
+                temperature=t, top_p=p, top_k=k,
+                # the seed is assigned HERE (fleet rid, stable across
+                # failover) unless the caller pins one
+                seed=(int(seed) if seed is not None
+                      else self._next_rid & 0x7FFFFFFF),
+                adapter=adapter,
                 deadline=(now + deadline_s if deadline_s is not None
                           else None),
                 affinity=affinity, t_submit=now)
@@ -384,14 +427,47 @@ class ServingRouter:
 
     def run(self, prompts, max_new_tokens: int = 32,
             deadline_s: Optional[float] = None,
-            timeout: Optional[float] = None) -> List[FleetRequest]:
+            timeout: Optional[float] = None,
+            **submit_kw) -> List[FleetRequest]:
         """Submit ``prompts`` and block until every one settles; returns
-        the requests in submission order (rejected/expired included)."""
+        the requests in submission order (rejected/expired included).
+        Extra kwargs (temperature/top_p/top_k/seed/adapter) forward to
+        submit()."""
         self.start()
-        reqs = [self.submit(p, max_new_tokens, deadline_s=deadline_s)
+        reqs = [self.submit(p, max_new_tokens, deadline_s=deadline_s,
+                            **submit_kw)
                 for p in prompts]
         self.wait(reqs, timeout=timeout)
         return reqs
+
+    def register_adapter(self, name: str, weights: Dict,
+                         alpha: Optional[float] = None) -> None:
+        """Register a LoRA adapter on EVERY replica (the fleet shares
+        one registry view, so failover and handoff always find the
+        adapter wherever a request lands). Replacement is pre-validated
+        across the whole fleet BEFORE any replica mutates: if the
+        adapter is pinned by live slots anywhere, nothing changes — a
+        partial fan-out would serve two weight versions under one name,
+        and a failover between them would splice streams. (Quiesce the
+        tenant's traffic before replacing an adapter: the pre-check
+        races in-flight admissions by design — it closes the ordering
+        gap, not the concurrency one.)"""
+        pinned = []
+        for r, eng in enumerate(self.engines):
+            if eng.lora is None:
+                raise RuntimeError(
+                    "this fleet has no adapter pool: build replicas "
+                    "with adapter_pool_pages > 0")
+            res = eng.lora.resident.get(name)
+            if res is not None and res.ref > 0:
+                pinned.append(r)
+        if pinned:
+            raise ValueError(
+                f"adapter {name!r} is pinned by live slots on "
+                f"replica(s) {pinned}: drain its traffic before "
+                f"replacing it (no replica was modified)")
+        for eng in self.engines:
+            eng.register_adapter(name, weights, alpha)
 
     def wait(self, reqs: Optional[List[FleetRequest]] = None,
              timeout: Optional[float] = None):
@@ -801,7 +877,11 @@ class ServingRouter:
                         req.slab = None
                     ereq = eng.submit(req.prompt, req.max_new_tokens,
                                       deadline=req.deadline,
-                                      trace_id=req.trace_id)
+                                      trace_id=req.trace_id,
+                                      temperature=req.temperature,
+                                      top_p=req.top_p, top_k=req.top_k,
+                                      seed=req.seed,
+                                      adapter=req.adapter)
                     with self._lock:
                         if self._fenced[r]:     # fenced mid-hand-off
                             return
@@ -834,8 +914,10 @@ class ServingRouter:
         with telemetry.tracer().span("handoff_export",
                                      trace_id=req.trace_id,
                                      track=f"replica{r}") as sp:
-            if eng.prefill_into_cache(req.prompt) is not None:
-                slab = eng.export_prefix_slab(req.prompt)
+            if eng.prefill_into_cache(req.prompt,
+                                      adapter=req.adapter) is not None:
+                slab = eng.export_prefix_slab(req.prompt,
+                                              adapter=req.adapter)
             sp.annotate(exported=slab is not None)
         with self._lock:
             if self._fenced[r]:
@@ -1016,7 +1098,10 @@ class ServingRouter:
                          "tier_host_evictions", "tier_pending_migrations",
                          "prefill_only_requests", "prefix_slab_exports",
                          "prefix_slab_imports", "prefix_pages_imported",
-                         "spec_proposed", "spec_accepted")}
+                         "spec_proposed", "spec_accepted",
+                         "sampled_requests", "adapter_faults",
+                         "adapter_evictions", "adapter_pages_in_use",
+                         "adapters_resident")}
         agg["prefix_hit_rate"] = round(
             agg["prefix_hits"] / max(1, agg["prefix_lookups"]), 4)
         agg["spec_accept_rate"] = round(

@@ -62,11 +62,32 @@ of it:
     pool (same page ids — the prefix cache shares draft pages too), and
     ONE fixed-shape verify program scores all K+1 positions against the
     target in a single dispatch
-    (MultiHeadAttention.paged_verify_forward). The host accepts the
-    longest prefix of proposals matching the target's greedy argmax and
-    emits accepted + 1 tokens — every emitted token is the TARGET's
-    greedy token, so the stream is token-identical to non-speculative
-    greedy decode; the accept rate rides ``stats()``.
+    (MultiHeadAttention.paged_verify_forward). Greedy slots accept the
+    longest prefix of proposals matching the target's argmax (the
+    stream is token-identical to non-speculative greedy decode);
+    SAMPLED slots run the REJECTION-SAMPLED accept rule (ISSUE 14):
+    accept proposal i w.p. min(1, p_i(d_i)/q_i(d_i)), re-draw the
+    first rejection in-graph from the residual norm(max(p - q, 0)) —
+    distribution-identical to the non-speculative sampler by
+    construction. The accept rate rides ``stats()``.
+
+  * PER-REQUEST SAMPLING (ISSUE 14): temperature / top-p / top-k /
+    seed are SLOT-RESIDENT STATE inside the one fixed-shape program
+    (per-slot scalar arrays, like ``write_pos``) — mixed sampling
+    configs never recompile, and greedy is the bitwise temperature-0
+    degenerate case. Sample streams are counter-based
+    (ops/sampling.py): a pure function of (seed, stream, token index),
+    reproducible across slot reassignment and failover resubmission.
+
+  * PAGED LoRA ADAPTER POOL (ISSUE 14): per-request adapters served
+    from a fixed-geometry device pool mirroring the KV pool's design —
+    host allocator/LRU with refcounts (runtime/lora.py), ONE
+    fixed-shape fault-in writer, per-slot adapter pages gathered into
+    batched segmented LoRA matmuls inside the slot program
+    (ops/lora.py; page 0 = the zero null adapter). The radix trie and
+    router affinity are namespaced per adapter (KV depends on the
+    adapter), and telemetry gains per-adapter labeled series. N
+    tenants share a replica with zero recompiles.
 
   * QUANTIZED SERVING TIER (``FFConfig.kv_cache_dtype`` /
     ``serve_weight_dtype``, ISSUE 11): the paged pool stores int8/fp8
@@ -124,8 +145,10 @@ import numpy as np
 
 from flexflow_tpu._env import compilation_cache_entries
 from flexflow_tpu.logger import fflogger
+from flexflow_tpu.ops import sampling as sampling_ops
 from flexflow_tpu.runtime import faultinject, telemetry
 from flexflow_tpu.runtime.generation import Generator
+from flexflow_tpu.runtime.lora import LoraAdapterPool
 
 # process-wide engine ids: the default telemetry `replica` label when no
 # router assigns a fleet identity (set_telemetry_identity)
@@ -146,6 +169,21 @@ class Request:
     prompt: np.ndarray              # (S,) int32, true (unpadded) prompt
     max_new_tokens: int
     state: str = "queued"       # queued | running | done | failed | timeout
+    # per-request sampling config (ISSUE 14): slot-resident scalars in
+    # the ONE fixed-shape program — temperature 0 is the greedy
+    # degenerate case (bitwise the pre-sampling argmax). ``seed`` keys
+    # the request's counter-based sample streams (ops/sampling.py): the
+    # stream is a pure function of (seed, stream, token index), so it
+    # reproduces across slot reassignment and failover resubmission.
+    temperature: float = 0.0
+    top_p: float = 1.0
+    top_k: int = 0
+    seed: int = 0
+    # multi-tenant LoRA (ISSUE 14): the registered adapter this request
+    # decodes under (None = base model / null adapter page 0), and the
+    # adapter-pool page pinned for it while the slot is live
+    adapter: Optional[str] = None
+    adapter_page: int = 0
     # absolute time.perf_counter() deadline (None = none): a request that
     # expires while QUEUED retires as "timeout" without ever prefilling
     # (no pages, no dispatch); an already-admitted request is never
@@ -318,11 +356,29 @@ class RadixPrefixCache:
         # died entirely (affinity entries pointing at it should drop)
         self.tier_events = collections.deque(maxlen=4096)
 
-    def _chunk(self, prompt, i: int):
+    def _chunk(self, prompt, i: int, ns=None):
         ps = self.page_size
-        return tuple(int(t) for t in prompt[i * ps:(i + 1) * ps])
+        tup = tuple(int(t) for t in prompt[i * ps:(i + 1) * ps])
+        if ns is not None and i == 0:
+            # namespace salt (ISSUE 14): KV depends on the LoRA adapter
+            # the prompt was prefilled under, so cached prefixes must
+            # never cross tenants — salting the FIRST edge partitions
+            # the whole trie per adapter (every deeper edge hangs under
+            # it). The salted first chunk is also the router's
+            # adapter-aware affinity key (first_chunk()).
+            return ("ns", ns) + tup
+        return tup
 
-    def match(self, prompt, max_pages: int) -> List[_TrieNode]:
+    @staticmethod
+    def first_chunk(tokens, ns=None):
+        """The trie's first-edge key for ``tokens`` (one page worth of
+        prompt) under adapter namespace ``ns`` — the fleet router's
+        affinity hash, kept in one place so the two layers cannot
+        drift."""
+        tup = tuple(int(t) for t in tokens)
+        return (("ns", ns) + tup) if ns is not None else tup
+
+    def match(self, prompt, max_pages: int, ns=None) -> List[_TrieNode]:
         """Longest cached page-aligned prefix of ``prompt``, capped at
         ``max_pages``; returns the node path root-down (possibly empty).
         Does NOT take references or bump hit statistics — the caller
@@ -333,7 +389,7 @@ class RadixPrefixCache:
         node, path = self.root, []
         limit = min(int(max_pages), len(prompt) // self.page_size)
         for i in range(limit):
-            child = node.children.get(self._chunk(prompt, i))
+            child = node.children.get(self._chunk(prompt, i, ns))
             if child is None:
                 break
             if child.tier == "dead":
@@ -379,7 +435,7 @@ class RadixPrefixCache:
                     f"prefix-cache refcount underflow on page {n.page}")
 
     def insert(self, prompt, matched, start: int,
-               pages: List[int]) -> List[_TrieNode]:
+               pages: List[int], ns=None) -> List[_TrieNode]:
         """Publish a finished prefill's full-prompt pages: ``pages[j]``
         holds chunk ``start + j`` of ``prompt``, appended under the
         ``matched`` path. Each created node starts at ref 1 (the
@@ -390,7 +446,7 @@ class RadixPrefixCache:
         node = matched[-1] if matched else self.root
         created = []
         for j, page in enumerate(pages):
-            chunk = self._chunk(prompt, start + j)
+            chunk = self._chunk(prompt, start + j, ns)
             if chunk in node.children:
                 break
             child = _TrieNode(chunk, page, node)
@@ -712,17 +768,44 @@ class RadixPrefixCache:
                 self._cv.wait(left)
             return True
 
-    def forget(self, prompt) -> List[int]:
+    def forget(self, prompt, ns=None) -> List[int]:
         """Kill the deepest unmounted, childless tail of ``prompt``'s
         cached path (any tier); returns freed HBM pages. The
         warm-the-import-writer helper: export, forget, re-import leaves
         the trie state unchanged with the writer program compiled."""
-        path = self.match(prompt, len(prompt) // self.page_size)
+        path = self.match(prompt, len(prompt) // self.page_size, ns)
         freed: List[int] = []
         for n in reversed(path):
             if n.children or n.ref:
                 break
             freed.extend(self._kill_subtree(n))
+        return freed
+
+    def flush_namespace(self, ns) -> List[int]:
+        """Kill EVERY cached page under adapter namespace ``ns``, both
+        tiers: the adapter's weights are being replaced, so KV computed
+        under the old weights must never serve a prefix hit for the new
+        ones (it would splice two weight versions into one stream).
+        Refuses while any namespace page is mounted — impossible when
+        the adapter itself is unpinned, since a mounted ns page always
+        belongs to a live request holding the adapter. Returns the
+        freed HBM pages."""
+        roots = [c for c in self.root.children.values()
+                 if isinstance(c.chunk, tuple) and len(c.chunk) >= 2
+                 and c.chunk[0] == "ns" and c.chunk[1] == ns]
+        for node in roots:
+            stack = [node]
+            while stack:
+                n = stack.pop()
+                if n.ref:
+                    raise ValueError(
+                        f"adapter namespace {ns!r} has a mounted cached "
+                        f"page (ref={n.ref}): drain its requests before "
+                        f"replacing the adapter")
+                stack.extend(n.children.values())
+        freed: List[int] = []
+        for node in roots:
+            freed.extend(self._kill_subtree(node))
         return freed
 
     def drain_tier_events(self) -> List:
@@ -753,8 +836,11 @@ class ServingEngine:
                  kv_page_size: Optional[int] = None,
                  kv_pages: Optional[int] = None,
                  decode_buckets: Optional[List[int]] = None,
-                 max_seq_len: int = 1024, temperature: float = 0.0,
-                 top_k: int = 0, eos_id: Optional[int] = None,
+                 max_seq_len: int = 1024,
+                 temperature: Optional[float] = None,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 eos_id: Optional[int] = None,
                  pad_id: int = 0, prefill_chunk: int = 0,
                  decode_chunk: int = 8,
                  quantize: Optional[str] = None, seed: int = 0,
@@ -763,9 +849,31 @@ class ServingEngine:
                  draft_model=None, speculate_k: Optional[int] = None,
                  paged_attention_impl: Optional[str] = None,
                  kv_cache_dtype: Optional[str] = None,
-                 weight_dtype: Optional[str] = None):
+                 weight_dtype: Optional[str] = None,
+                 adapter_pool_pages: Optional[int] = None,
+                 lora_rank: Optional[int] = None,
+                 lora_targets: Optional[List[str]] = None):
         cfg = model.config
         self.model = model
+        # ---- per-request sampling defaults (ISSUE 14) ----
+        # requests carry their own temperature/top_p/top_k/seed as
+        # slot-resident state inside the one fixed-shape program
+        # (ops/sampling.py); the engine-level values are only the
+        # submit() defaults. temperature 0 = greedy argmax, bitwise the
+        # pre-sampling path.
+        t0 = (temperature if temperature is not None
+              else getattr(cfg, "serve_temperature", 0.0))
+        p0 = (top_p if top_p is not None
+              else getattr(cfg, "serve_top_p", 1.0))
+        k0 = (top_k if top_k is not None
+              else getattr(cfg, "serve_top_k", 0))
+        self.default_temperature, self.default_top_p, self.default_top_k \
+            = sampling_ops.validate_sampling(t0, p0, k0, "ServingEngine")
+        # request-seed base: a submit() without an explicit seed gets a
+        # deterministic per-rid seed derived from the engine seed. Fleet
+        # routers pass explicit seeds (stable across failover
+        # resubmission — engine rids differ between replicas).
+        self._seed_base = (int(seed) * 1000003) & 0x7FFFFFFF
         self.slots = int(serve_slots or getattr(cfg, "serve_slots", 4))
         # decode steps per device dispatch (an in-graph lax.scan): host
         # round-trips amortize over the chunk — the per-token dispatch of
@@ -834,9 +942,11 @@ class ServingEngine:
         self._kv_dtype_arg = (None if kv_raw in (None, "", "native")
                               else kv_raw)
 
-        # Generator supplies graph validation, the graph walk, prefill and
-        # sampling — serving adds scheduling + the paged pool around them
-        self.gen = Generator(model, temperature=temperature, top_k=top_k,
+        # Generator supplies graph validation, the graph walk and prefill
+        # — serving adds scheduling, the paged pool and the PER-SLOT
+        # sampler (ops/sampling.py) around them, so the Generator's own
+        # engine-wide sampler is never used by serving programs
+        self.gen = Generator(model, temperature=0.0, top_k=0,
                              eos_id=eos_id, pad_id=pad_id, quantize=quantize)
         self.eos_id = eos_id
         self.pad_id = pad_id
@@ -973,13 +1083,6 @@ class ServingEngine:
                     "speculate_k > 0 needs a draft model (FFConfig."
                     "draft_model or the draft_model constructor arg): "
                     "speculative decoding verifies a DRAFT's proposals")
-            if temperature > 0.0:
-                raise ValueError(
-                    "speculative decoding is greedy-only (temperature="
-                    f"{temperature}): the accept rule compares the "
-                    "draft's proposal to the target's argmax; a sampled "
-                    "path needs rejection sampling, which this engine "
-                    "does not implement")
             tgt_v = int(model._final_tensor.dims[-1])
             dft_v = int(self.draft_model._final_tensor.dims[-1])
             if tgt_v != dft_v:
@@ -1007,6 +1110,52 @@ class ServingEngine:
                                         kv_dtype=self._kv_dtype_arg))
                 for op in self.draft_gen.attn_ops}
 
+        # ---- paged LoRA adapter pool (ISSUE 14) ----
+        # fixed-geometry adapter pages mirroring the KV pool's design: a
+        # host allocator/LRU (runtime/lora.py) decides residency, ONE
+        # fixed-shape writer program faults adapters in, and the slot
+        # program gathers each slot's adapter page (page 0 = null
+        # adapter) into batched segmented LoRA matmuls — N tenants, one
+        # replica, zero recompiles.
+        app = int(adapter_pool_pages if adapter_pool_pages is not None
+                  else getattr(cfg, "serve_adapter_pool_pages", 0))
+        if app < 0:
+            raise ValueError(
+                f"adapter_pool_pages={app}: must be >= 0 (0 = no "
+                f"adapter pool)")
+        self.adapter_pool_pages = app
+        self.lora = None
+        self.lora_pool = None
+        self.lora_rank = int(lora_rank if lora_rank is not None
+                             else getattr(cfg, "serve_lora_rank", 8))
+        if app > 0:
+            from flexflow_tpu.ffconst import OperatorType
+            from flexflow_tpu.ops import lora as lora_ops
+
+            targets = [op for op in model.ops
+                       if op.op_type == OperatorType.OP_LINEAR]
+            if lora_targets is not None:
+                want = set(lora_targets)
+                unknown = want - {op.name for op in targets}
+                if unknown:
+                    raise ValueError(
+                        f"lora_targets {sorted(unknown)} are not Linear "
+                        f"ops of this graph (Linear ops: "
+                        f"{sorted(op.name for op in targets)})")
+                targets = [op for op in targets if op.name in want]
+            if not targets:
+                raise ValueError(
+                    "adapter_pool_pages > 0 but the graph has no "
+                    "LoRA-targetable Linear ops")
+            self._lora_ops = lora_ops
+            self._lora_targets = targets
+            self.lora = LoraAdapterPool(app, self.lora_rank, targets)
+            self.lora_pool = jax.tree.map(
+                lambda a: jax.device_put(a, repl),
+                lora_ops.init_lora_pool(targets, app, self.lora_rank))
+            self._zero_payload = lora_ops.zero_payload(targets,
+                                                       self.lora_rank)
+
         # per-slot scheduler state (host side, shipped to device each step)
         n = self.slots
         self.page_tables = np.zeros((n, self.pages_per_slot), np.int32)
@@ -1017,6 +1166,16 @@ class ServingEngine:
         self.active = np.zeros((n,), bool)
         self.poison = np.zeros((n,), np.float32)
         self.slot_req: List[Optional[Request]] = [None] * n
+        # slot-resident sampling state (ISSUE 14): just more per-slot
+        # scalars, like write_pos — idle slots sit at the greedy
+        # defaults and their draws are discarded with the scratch writes
+        self.temps = np.zeros((n,), np.float32)
+        self.top_ps = np.ones((n,), np.float32)
+        self.top_ks = np.zeros((n,), np.int32)
+        self.seeds = np.zeros((n,), np.int32)
+        # per-slot adapter-pool page (0 = null adapter)
+        self.lora_pages = np.zeros((n,), np.int32)
+        self._vocab = int(model._final_tensor.dims[-1])
 
         self._queue: List[Request] = []
         self._draining = False
@@ -1071,6 +1230,19 @@ class ServingEngine:
         import collections
 
         self._ttfts = collections.deque(maxlen=4096)
+        # per-adapter ledgers (ISSUE 14 telemetry satellite): requests,
+        # spec proposals/accepts — keyed by adapter label ("none" for
+        # base-model traffic); bounded by the registry, not by traffic
+        self._adapter_requests: Dict[str, int] = {}
+        self._adapter_spec: Dict[str, List[int]] = {}
+        self._sampled_requests = 0
+        if self.lora is not None:
+            # compile + run the one fixed-shape adapter writer NOW
+            # (writing the null page's zeros is a no-op): every later
+            # fault-in of a real adapter reuses this program, so tenant
+            # churn never compiles — and recompile-flatness tests see
+            # the build at construction, outside any warm window
+            self._write_adapter_page(0, self._zero_payload, 0.0)
 
         # ---- unified telemetry plane (ISSUE 13) ----
         # the engine's latency histograms (TTFT / inter-token / queue
@@ -1122,6 +1294,29 @@ class ServingEngine:
                 "engine queue wait: submit -> admission",
                 labels=("replica", "role")).labels(*lab),
         }
+        # per-adapter families (ISSUE 14): children resolved lazily per
+        # adapter label and cached (bounded by the adapter registry)
+        self._tm_fam_req = reg.counter(
+            "ff_serving_requests_total",
+            "requests submitted, labeled by LoRA adapter "
+            "('none' = base model)",
+            labels=("replica", "role", "adapter"))
+        self._tm_fam_attft = reg.histogram(
+            "ff_serving_adapter_ttft_seconds",
+            "engine submit -> first token, labeled by LoRA adapter",
+            labels=("replica", "role", "adapter"))
+        self._tm_adapter_ch = {}
+
+    def _tm_adapter(self, adapter: Optional[str]):
+        key = adapter or "none"
+        ch = self._tm_adapter_ch.get(key)
+        if ch is None:
+            lab = (self._tm_labels["replica"], self._tm_labels["role"],
+                   key)
+            ch = self._tm_adapter_ch[key] = (
+                self._tm_fam_req.labels(*lab),
+                self._tm_fam_attft.labels(*lab))
+        return ch
 
     @property
     def _tm_track(self) -> str:
@@ -1147,6 +1342,19 @@ class ServingEngine:
                           "weight_dtype", "impl")).labels(
             *lab, st["kv_cache_dtype"], st["weight_dtype"],
             st["paged_attention_impl"]).set(1)
+        # per-adapter speculation accept rate (ISSUE 14): one labeled
+        # series per adapter that has seen speculative traffic
+        if self._adapter_spec:
+            fam = reg.gauge(
+                "ff_serving_spec_accept_rate_by_adapter",
+                "speculative accept rate, labeled by LoRA adapter",
+                labels=("replica", "role", "adapter"))
+            with self._lock:
+                rows = {k: (v[0], v[1])
+                        for k, v in self._adapter_spec.items()}
+            for name, (prop, acc) in rows.items():
+                fam.labels(*lab, name).set(
+                    round(acc / max(1, prop), 4))
 
     # ---- request lifecycle --------------------------------------------------
 
@@ -1162,7 +1370,12 @@ class ServingEngine:
 
     def submit(self, prompt, max_new_tokens: int,
                deadline: Optional[float] = None,
-               trace_id: Optional[str] = None) -> Request:
+               trace_id: Optional[str] = None,
+               temperature: Optional[float] = None,
+               top_p: Optional[float] = None,
+               top_k: Optional[int] = None,
+               seed: Optional[int] = None,
+               adapter: Optional[str] = None) -> Request:
         """Queue one request. ``deadline`` is an absolute
         ``time.perf_counter()`` instant: a request still queued past it
         retires as ``"timeout"`` without ever prefilling (an admitted
@@ -1181,6 +1394,23 @@ class ServingEngine:
             raise ValueError(
                 f"bucketed prompt ({bucket}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_seq_len {self.max_seq_len}")
+        t, p, k = sampling_ops.validate_sampling(
+            temperature if temperature is not None
+            else self.default_temperature,
+            top_p if top_p is not None else self.default_top_p,
+            top_k if top_k is not None else self.default_top_k,
+            "submit")
+        if adapter is not None:
+            if self.lora is None:
+                raise ValueError(
+                    f"adapter={adapter!r}: this engine has no adapter "
+                    f"pool (build with adapter_pool_pages > 0 / "
+                    f"--serve-adapter-pool-pages)")
+            if adapter not in self.lora.registry:
+                raise ValueError(
+                    f"adapter {adapter!r} is not registered (known: "
+                    f"{sorted(self.lora.registry)}) — register_adapter"
+                    f" first")
         with self._lock:
             if self._draining:
                 # the serving-side preemption notice: a draining engine is
@@ -1193,11 +1423,23 @@ class ServingEngine:
                     "router)")
             req = Request(rid=self._next_rid, prompt=prompt,
                           max_new_tokens=int(max_new_tokens), bucket=bucket,
-                          deadline=deadline, t_submit=time.perf_counter())
+                          deadline=deadline, t_submit=time.perf_counter(),
+                          temperature=t, top_p=p, top_k=k,
+                          seed=(int(seed) if seed is not None
+                                else (self._seed_base + self._next_rid)
+                                & 0x7FFFFFFF),
+                          adapter=adapter)
             req.trace_id = trace_id or (
                 f"{self._tm_labels['replica']}-r{req.rid}")
             self._next_rid += 1
             self._submitted += 1
+            if t > 0.0:
+                self._sampled_requests += 1
+            akey = adapter or "none"
+            self._adapter_requests[akey] = \
+                self._adapter_requests.get(akey, 0) + 1
+            if self._tm_on:
+                self._tm_adapter(adapter)[0].inc()
             self._queue.append(req)
         return req
 
@@ -1231,6 +1473,10 @@ class ServingEngine:
             req.trie_nodes = []
         self._free_pages.extend(req.private_pages)
         req.private_pages = []
+        # unpin the adapter page (it stays RESIDENT, warm for the
+        # tenant's next request, until adapter-pool pressure evicts it)
+        if req.adapter is not None and self.lora is not None:
+            self.lora.release(req.adapter)
         req.slot = -1
         self.slot_req[slot] = None
         self.active[slot] = False
@@ -1239,6 +1485,12 @@ class ServingEngine:
         self.row_len[slot] = 0
         self.prompt_pad[slot] = 0
         self.emitted[slot] = 0
+        # idle-slot sampling state back to the greedy defaults
+        self.temps[slot] = 0.0
+        self.top_ps[slot] = 1.0
+        self.top_ks[slot] = 0
+        self.seeds[slot] = 0
+        self.lora_pages[slot] = 0
 
     def _record_token(self, slot: int, tok: int, ok: bool):
         """Append a sampled token to the slot's request and retire on
@@ -1254,6 +1506,7 @@ class ServingEngine:
             req.ttft = now - req.t_submit
             if self._tm_on:
                 self._tm_ch["ttft"].observe(req.ttft)
+                self._tm_adapter(req.adapter)[1].observe(req.ttft)
         elif self._tm_on:
             # host-observed inter-token latency: tokens inside one
             # decode_chunk dispatch arrive together, so sub-chunk gaps
@@ -1449,16 +1702,23 @@ class ServingEngine:
     def _build_prefill(self, bucket: int, n_pages: int):
         gen = self.gen
         cdtype = gen._compute_dtype()
+        has_lora = self.lora_pool is not None
 
         def prefill(params, state, tokens, length, pool, pages, poison,
-                    key):
+                    temps, top_ps, top_ks, seeds, lora_pool, lora_pages):
             caches = {op.name: op.init_cache(1, bucket, cdtype)
                       for op in gen.attn_ops}
+            lora = ({"pool": lora_pool, "pages": lora_pages}
+                    if has_lora else None)
             logits, caches = gen._prefill(params, state, tokens, caches,
-                                          length, self.prefill_chunk)
+                                          length, self.prefill_chunk,
+                                          lora=lora)
             logits = logits[:, -1] + poison            # (1, V)
             ok = jnp.isfinite(logits).all(axis=-1)
-            tok, _ = gen._sample(logits, key)
+            # the request's first emitted token is TARGET-stream draw 0
+            tok = sampling_ops.sample_tokens(
+                logits, temps, top_ps, top_ks, seeds,
+                jnp.zeros_like(seeds))
             return tok, ok, self._scatter_tail(gen, pool, caches, pages)
 
         return jax.jit(prefill, donate_argnums=(4,))
@@ -1475,20 +1735,27 @@ class ServingEngine:
         prefix's partial last page is re-materialized here too)."""
         gen = self.gen
         p0 = full * self.page_size
+        has_lora = self.lora_pool is not None
 
         def prefill(params, state, tokens_tail, tok_last, length, pool,
-                    prefix_pages, tail_pages, poison, key):
+                    prefix_pages, tail_pages, poison,
+                    temps, top_ps, top_ks, seeds, lora_pool, lora_pages):
+            lora = ({"pool": lora_pool, "pages": lora_pages}
+                    if has_lora else None)
             caches = self._seed_prefix_caches(gen, bucket, p0, pool,
                                               prefix_pages)
             _, caches = gen._walk(params, state, tokens_tail, caches,
-                                  None, chunk_start=p0, skip_tail=True)
+                                  None, chunk_start=p0, skip_tail=True,
+                                  lora=lora)
             logits, caches = gen._walk(params, state, tok_last, caches,
                                        None, last_only=True,
                                        row_lengths=length,
-                                       gather_last=True)
+                                       gather_last=True, lora=lora)
             logits = logits[:, -1] + poison            # (1, V)
             ok = jnp.isfinite(logits).all(axis=-1)
-            tok, _ = gen._sample(logits, key)
+            tok = sampling_ops.sample_tokens(
+                logits, temps, top_ps, top_ks, seeds,
+                jnp.zeros_like(seeds))
             return tok, ok, self._scatter_tail(gen, pool, caches,
                                                tail_pages, p0)
 
@@ -1534,40 +1801,100 @@ class ServingEngine:
         the target graph with paged_verify_forward writing each
         position's k/v at its own (host-clamped) slot and attending at
         its own frontier. Returns the target's greedy argmax at every
-        position plus per-position finiteness; acceptance is host-side
-        (compare proposals to argmax, emit the matching prefix + 1)."""
+        position, the per-slot WARPED sampling distribution at every
+        position (the rejection-sampling ``p`` — one-hot at argmax for
+        greedy slots), and per-position finiteness. Acceptance stays
+        host-side: greedy slots compare proposals to argmax, sampled
+        slots run the accept/resample rule (_spec_step)."""
         gen = self.gen
+        has_lora = self.lora_pool is not None
 
         def verify(params, state, pool, page_table, slab, write_pos,
-                   rope_pos0, row_len, prompt_pad, poison):
+                   rope_pos0, row_len, prompt_pad, poison,
+                   temps, top_ps, top_ks, lora_pool, lora_pages):
             paged = {"page_table": page_table, "write_pos": write_pos,
                      "rope_pos": rope_pos0, "row_len": row_len,
                      "prompt_pad": prompt_pad,
                      "impl": self.paged_attention_impl}
+            lora = ({"pool": lora_pool, "pages": lora_pages}
+                    if has_lora else None)
             logits, pool = gen._walk(params, state, slab, pool, None,
-                                     paged=paged)
+                                     paged=paged, lora=lora)
             logits = logits.astype(jnp.float32) \
                 + poison[:, None, None]                # (B, K+1, V)
             ok = jnp.isfinite(logits).all(axis=-1)     # (B, K+1)
             toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return toks, ok, pool
+            b, s, v = logits.shape
+            probs = sampling_ops.sampling_probs(
+                logits.reshape(b * s, v),
+                jnp.repeat(temps, s), jnp.repeat(top_ps, s),
+                jnp.repeat(top_ks, s)).reshape(b, s, v)
+            return toks, probs, ok, pool
 
         return jax.jit(verify, donate_argnums=(2,))
 
-    def _build_decode(self, n_steps: int, gen=None):
-        gen = gen or self.gen
+    def _build_decode(self, n_steps: int):
+        gen = self.gen
+        has_lora = self.lora_pool is not None
 
         def decode(params, state, pool, page_table, last_tok, write_pos0,
-                   rope_pos0, row_len, prompt_pad, budget, poison, key):
+                   rope_pos0, row_len, prompt_pad, budget, poison,
+                   temps, top_ps, top_ks, seeds, ctr0,
+                   lora_pool, lora_pages):
             """`n_steps` slot-decode steps as ONE in-graph scan. Past a
             slot's own budget (prompt_pad + its max_new_tokens) the write
             position and RoPE clamp to the final allocated slot — those
             steps only produce tokens the host truncates, and the
-            repeated overwrite stays inside the slot's own pages."""
+            repeated overwrite stays inside the slot's own pages. Step i
+            samples TARGET-stream draw ctr0 + i per slot (counter-based:
+            no engine key state) and applies each slot's own
+            temperature/top-p/top-k — temperature-0 slots take argmax,
+            bitwise the greedy program this replaced."""
+            rope_cap = budget - prompt_pad + row_len - 1
+            lora = ({"pool": lora_pool, "pages": lora_pages}
+                    if has_lora else None)
+
+            def body(carry, i):
+                pool, tok = carry
+                paged = {
+                    "page_table": page_table,
+                    "write_pos": jnp.minimum(write_pos0 + i, budget - 1),
+                    "rope_pos": jnp.minimum(rope_pos0 + i, rope_cap),
+                    "row_len": row_len, "prompt_pad": prompt_pad,
+                    "impl": self.paged_attention_impl}
+                logits, pool = gen._walk(params, state, tok[:, None],
+                                         pool, None, paged=paged,
+                                         lora=lora)
+                logits = logits[:, 0] + poison[:, None]  # (B_slots, V)
+                ok = jnp.isfinite(logits).all(axis=-1)
+                nxt = sampling_ops.sample_tokens(
+                    logits, temps, top_ps, top_ks, seeds, ctr0 + i)
+                return (pool, nxt), (nxt, ok)
+
+            (pool, _), (toks, oks) = jax.lax.scan(
+                body, (pool, last_tok),
+                jnp.arange(n_steps, dtype=jnp.int32))
+            return toks, oks, pool                     # (n_steps, B_slots)
+
+        return jax.jit(decode, donate_argnums=(2,))
+
+    def _build_draft_propose(self, n_steps: int):
+        """Speculative draft proposals: the draft's own K-step paged
+        decode scan, sampling each proposal from the DRAFT stream under
+        the REQUEST's sampling config (greedy slots propose argmax —
+        the pre-sampling draft decode bitwise), and returning the
+        draft's per-step sampling distribution ``q`` — the denominator
+        of the host accept rule and the subtrahend of the residual
+        resample."""
+        gen = self.draft_gen
+
+        def propose(params, state, pool, page_table, last_tok,
+                    write_pos0, rope_pos0, row_len, prompt_pad, budget,
+                    temps, top_ps, top_ks, seeds, ctr0):
             rope_cap = budget - prompt_pad + row_len - 1
 
             def body(carry, i):
-                pool, tok, key = carry
+                pool, tok = carry
                 paged = {
                     "page_table": page_table,
                     "write_pos": jnp.minimum(write_pos0 + i, budget - 1),
@@ -1576,22 +1903,94 @@ class ServingEngine:
                     "impl": self.paged_attention_impl}
                 logits, pool = gen._walk(params, state, tok[:, None],
                                          pool, None, paged=paged)
-                logits = logits[:, 0] + poison[:, None]  # (B_slots, V)
-                ok = jnp.isfinite(logits).all(axis=-1)
-                key, sub = jax.random.split(key)
-                nxt, _ = gen._sample(logits, sub)
-                return (pool, nxt, key), (nxt, ok)
+                logits = logits[:, 0].astype(jnp.float32)  # (B, V)
+                nxt = sampling_ops.sample_tokens(
+                    logits, temps, top_ps, top_ks, seeds, ctr0 + i,
+                    tag=sampling_ops.TAG_DRAFT)
+                probs = sampling_ops.sampling_probs(
+                    logits, temps, top_ps, top_ks)
+                return (pool, nxt), (nxt, probs)
 
-            (pool, _, _), (toks, oks) = jax.lax.scan(
-                body, (pool, last_tok, key),
+            (pool, _), (toks, probs) = jax.lax.scan(
+                body, (pool, last_tok),
                 jnp.arange(n_steps, dtype=jnp.int32))
-            return toks, oks, pool                     # (n_steps, B_slots)
+            return toks, probs, pool        # (k, B), (k, B, V)
 
-        return jax.jit(decode, donate_argnums=(2,))
+        return jax.jit(propose, donate_argnums=(2,))
 
     def _split_key(self):
         self._key, sub = jax.random.split(self._key)
         return sub
+
+    # ---- per-request sampling / adapter plumbing (ISSUE 14) ---------------
+
+    def _sampling_args_1(self, req: Request):
+        """(1,)-shaped sampling-state arrays for the prefill programs."""
+        return (np.asarray([req.temperature], np.float32),
+                np.asarray([req.top_p], np.float32),
+                np.asarray([req.top_k], np.int32),
+                np.asarray([req.seed], np.int32))
+
+    def _lora_args_1(self, adapter_page: int):
+        """(lora_pool, (1,) page) prefill args; (None, None) — empty
+        pytrees to jit — when the engine has no adapter pool."""
+        if self.lora_pool is None:
+            return (None, None)
+        return (self.lora_pool, np.asarray([adapter_page], np.int32))
+
+    def _lora_args_slots(self):
+        if self.lora_pool is None:
+            return (None, None)
+        return (self.lora_pool, self.lora_pages)
+
+    def register_adapter(self, name: str, weights: Dict,
+                         alpha: Optional[float] = None) -> None:
+        """Register a LoRA adapter for multi-tenant serving: host-RAM
+        weights ({Linear op name -> {"a": (in, rank), "b": (rank,
+        out)}}, ops omitted get a zero delta; scale = alpha / rank,
+        alpha defaults to rank). Registration is host-only — the
+        adapter faults into a device pool page on its first
+        ``submit(adapter=name)`` and stays resident (LRU at refcount 0)
+        until pool pressure evicts it. Re-registering REPLACES the
+        adapter (rejected while live slots are pinned to it): the old
+        device copy is dropped and the adapter's prefix-cache namespace
+        is flushed — KV computed under the old weights must never serve
+        a hit for the new ones."""
+        if self.lora is None:
+            raise RuntimeError(
+                "this engine has no adapter pool: build with "
+                "adapter_pool_pages > 0 (--serve-adapter-pool-pages)")
+        with self._lock:
+            replacing = name in self.lora.registry
+            self.lora.register(name, weights, alpha)
+            if replacing and self.prefix_cache is not None:
+                self._free_pages.extend(
+                    self.prefix_cache.flush_namespace(name))
+
+    def _write_adapter_page(self, page: int, payload: Dict, scale: float):
+        """Fault an adapter into pool ``page`` through the ONE
+        fixed-shape writer program (``page`` is traced data, so tenant
+        churn never compiles; the null-page write at construction
+        compiles it once)."""
+        buf = {}
+        for op in self._lora_targets:
+            sub = payload.get(op.name)
+            if sub is None:
+                sub = self._zero_payload[op.name]
+            buf[op.name] = {"a": np.asarray(sub["a"], np.float32),
+                            "b": np.asarray(sub["b"], np.float32)}
+        lora_ops = self._lora_ops
+
+        def build():
+            def write(pool, page, payload, scale):
+                return lora_ops.write_adapter_page(pool, page, payload,
+                                                   scale)
+
+            return jax.jit(write, donate_argnums=(0,))
+
+        self.lora_pool = self._compiled_call(
+            ("adapter_write",), build, self.lora_pool,
+            np.int32(page), buf, np.float32(scale))
 
     # ---- the scheduler loop -------------------------------------------------
 
@@ -1638,7 +2037,10 @@ class ServingEngine:
             matched: List[_TrieNode] = []
             if self.prefix_cache is not None:
                 cap = (req.prompt.size - 1) // self.page_size
-                matched = self.prefix_cache.match(req.prompt, cap)
+                # the trie is namespaced per adapter (KV depends on the
+                # adapter's deltas): tenants never share prefix pages
+                matched = self.prefix_cache.match(req.prompt, cap,
+                                                  ns=req.adapter)
             full = len(matched)
             # host-resident matched pages each need a fresh HBM page to
             # promote into before they can be mounted read-only
@@ -1670,6 +2072,21 @@ class ServingEngine:
                 #                         list; the rest is fresh pages
                 if len(self._free_pages) < need:
                     return  # raced shortfall after a failed promotion
+            adapter_page = 0
+            if req.adapter is not None:
+                # pin the tenant's adapter page; a miss FAULTS it in
+                # through the one fixed-shape writer (compiled at
+                # construction). A pool full of pinned pages leaves the
+                # request queued — the KV-pool-pressure rule: progress
+                # resumes when a retirement releases a page.
+                got = self.lora.checkout(req.adapter)
+                if got is None:
+                    return
+                adapter_page, ent = got
+                if ent is not None:
+                    self._write_adapter_page(adapter_page,
+                                             ent["payload"],
+                                             ent["scale"])
             self._queue.pop(0)
             # telemetry: the engine queue wait ends here (admission
             # starts); the prefill span opens here and closes after the
@@ -1701,7 +2118,15 @@ class ServingEngine:
             req.pages = [n.page for n in matched] + fresh
             req.slot = slot
             req.state = "running"
+            req.adapter_page = adapter_page
             self.slot_req[slot] = req
+            # slot-resident sampling + adapter state: the fixed-shape
+            # programs read these arrays every dispatch
+            self.temps[slot] = req.temperature
+            self.top_ps[slot] = req.top_p
+            self.top_ks[slot] = req.top_k
+            self.seeds[slot] = req.seed
+            self.lora_pages[slot] = adapter_page
 
             n_prefill = math.ceil(req.bucket / self.page_size)
             # fault injection: FF_FAULT=nan_loss@serve:<n> poisons the
@@ -1737,7 +2162,9 @@ class ServingEngine:
                     tok_last, np.asarray([req.prompt.size], np.int32),
                     self.pool, np.asarray(req.pages[:full], np.int32),
                     np.asarray(req.pages[full:n_prefill], np.int32),
-                    np.float32(self.poison[slot]), self._split_key())
+                    np.float32(self.poison[slot]),
+                    *self._sampling_args_1(req),
+                    *self._lora_args_1(adapter_page))
             else:
                 padded = np.full((1, req.bucket), self.pad_id, np.int32)
                 padded[0, :req.prompt.size] = req.prompt
@@ -1747,7 +2174,9 @@ class ServingEngine:
                     self.gen._params(), self.model.bn_state, padded,
                     np.asarray([req.prompt.size], np.int32), self.pool,
                     np.asarray(req.pages[:n_prefill], np.int32),
-                    np.float32(self.poison[slot]), self._split_key())
+                    np.float32(self.poison[slot]),
+                    *self._sampling_args_1(req),
+                    *self._lora_args_1(adapter_page))
             if self.draft_gen is not None:
                 # the draft model's prefix KV rides the same page ids, so
                 # its prefill mirrors the target's hit/cold split exactly
@@ -1788,7 +2217,8 @@ class ServingEngine:
                 last = req.prompt.size // self.page_size
                 if last > full:
                     created = self.prefix_cache.insert(
-                        req.prompt, matched, full, req.pages[full:last])
+                        req.prompt, matched, full, req.pages[full:last],
+                        ns=req.adapter)
                     if created:
                         adopted = {n.page for n in created}
                         req.trie_nodes.extend(created)
@@ -1799,7 +2229,14 @@ class ServingEngine:
 
     # ---- disaggregated fleet: prefill-only + page-slab handoff -----------
 
-    def prefill_into_cache(self, prompt) -> Optional[int]:
+    def _sampling_args_greedy(self):
+        """Dummy (1,) greedy sampling args for prefill-only admissions
+        (the sampled token is discarded — no slot is seeded)."""
+        return (np.zeros((1,), np.float32), np.ones((1,), np.float32),
+                np.zeros((1,), np.int32), np.zeros((1,), np.int32))
+
+    def prefill_into_cache(self, prompt,
+                           adapter: Optional[str] = None) -> Optional[int]:
         """Prefill-only admission — the prefill half of the
         disaggregated fleet (runtime/router.py): run the prompt's (cold
         or prefix-hit) prefill through the NORMAL bucket-shaped programs
@@ -1825,10 +2262,34 @@ class ServingEngine:
                 f"bucketed prompt ({bucket}) exceeds max_seq_len "
                 f"{self.max_seq_len}")
         with self._lock:
+            apage = 0
+            if adapter is not None:
+                if self.lora is None or adapter not in self.lora.registry:
+                    raise ValueError(
+                        f"adapter {adapter!r} is not registered on this "
+                        f"engine")
+                got = self.lora.checkout(adapter)
+                if got is None:
+                    return None     # adapter-pool pressure: fall back
+                apage, ent = got
+                if ent is not None:
+                    self._write_adapter_page(apage, ent["payload"],
+                                             ent["scale"])
+            try:
+                # the checkout pins the adapter only for the duration of
+                # the prefill (no slot holds it afterwards)
+                return self._prefill_into_cache_locked(prompt, bucket,
+                                                       adapter, apage)
+            finally:
+                if adapter is not None:
+                    self.lora.release(adapter)
+
+    def _prefill_into_cache_locked(self, prompt, bucket: int,
+                                   adapter: Optional[str], apage: int):
             ps_sz = self.page_size
             last = prompt.size // ps_sz     # publishable full pages
             cap = (prompt.size - 1) // ps_sz
-            matched = self.prefix_cache.match(prompt, cap)
+            matched = self.prefix_cache.match(prompt, cap, ns=adapter)
             full = len(matched)
             if last <= full:
                 return last                 # already fully published
@@ -1864,7 +2325,8 @@ class ServingEngine:
                     tok_last, np.asarray([prompt.size], np.int32),
                     self.pool, prefix_pages,
                     np.asarray(fresh, np.int32), np.float32(0.0),
-                    self._split_key())
+                    *self._sampling_args_greedy(),
+                    *self._lora_args_1(apage))
             else:
                 padded = np.full((1, bucket), self.pad_id, np.int32)
                 padded[0, :prompt.size] = prompt
@@ -1874,7 +2336,8 @@ class ServingEngine:
                     self.gen._params(), self.model.bn_state, padded,
                     np.asarray([prompt.size], np.int32), self.pool,
                     np.asarray(fresh, np.int32), np.float32(0.0),
-                    self._split_key())
+                    *self._sampling_args_greedy(),
+                    *self._lora_args_1(apage))
             if self.draft_gen is not None:
                 # the slab must carry the draft pool's prefix KV too —
                 # it rides the same page ids on the decode replica
@@ -1902,7 +2365,7 @@ class ServingEngine:
                 return None
             pages = [n.page for n in matched] + fresh
             created = self.prefix_cache.insert(
-                prompt, matched, full, pages[full:last])
+                prompt, matched, full, pages[full:last], ns=adapter)
             # the publisher holds no mount: published pages sit warm at
             # refcount 0, exportable and evictable like any cached page
             self.prefix_cache.release(created)
@@ -1911,7 +2374,8 @@ class ServingEngine:
             self._prefill_only += 1
             return last
 
-    def export_prefix_slab(self, prompt) -> Optional[Dict]:
+    def export_prefix_slab(self, prompt,
+                           adapter: Optional[str] = None) -> Optional[Dict]:
         """Serialize the prompt's cached full-page prefix as a
         host-memory page slab — the bytes a prefill->decode handoff
         moves: per page, every attention op's pool storage (target and
@@ -1926,7 +2390,7 @@ class ServingEngine:
             last = prompt.size // self.page_size
             if last < 1:
                 return None
-            path = self.prefix_cache.match(prompt, last)
+            path = self.prefix_cache.match(prompt, last, ns=adapter)
             if len(path) < last:
                 return None
             # host-tier pages export from their pinned payloads; the
@@ -1947,6 +2411,7 @@ class ServingEngine:
             self._slab_exports += 1
             return {"page_size": self.page_size,
                     "tokens": prompt[:last * self.page_size].copy(),
+                    "ns": adapter,
                     "payload": payloads}
 
     def import_prefix_slab(self, slab) -> int:
@@ -2001,8 +2466,9 @@ class ServingEngine:
                         f"quantized and full-width pools cannot exchange"
                         f" pages")
             tokens = np.asarray(slab["tokens"], np.int32).reshape(-1)
+            ns = slab.get("ns")
             n = len(slab["payload"])
-            path = self.prefix_cache.match(tokens, n)
+            path = self.prefix_cache.match(tokens, n, ns=ns)
             # only extend under a fully HBM-resident prefix: inserting
             # fresh hbm nodes below a host-tier tail would break the
             # hbm*-then-host* path invariant that promotion truncation
@@ -2029,7 +2495,7 @@ class ServingEngine:
             node_path = list(path)
             for j, page in enumerate(pages, start=start):
                 created = self.prefix_cache.insert(
-                    tokens, node_path, j, [page])
+                    tokens, node_path, j, [page], ns=ns)
                 if not created:
                     break
                 self.prefix_cache.release(created)
@@ -2082,6 +2548,26 @@ class ServingEngine:
         before = self.recompile_count
         req0 = self._submitted
         self.run(list(plist), max_new_tokens=max_new_tokens)
+        if self.speculate_k > 0 and self.draft_gen is not None:
+            # force-build the sampled-speculation helpers (accept
+            # uniforms + residual resample): they only dispatch when a
+            # sampled slot is live, so a greedy-only warmup would leave
+            # them cold and the first sampled tenant mid-traffic would
+            # compile. Both are pure functions — running them mutates no
+            # engine state.
+            k = self.speculate_k
+            self._compiled_call(
+                ("spec_uniforms", k),
+                lambda: jax.jit(
+                    lambda s, c: sampling_ops.accept_uniforms(s, c, k)),
+                self.seeds, self.emitted.astype(np.int32))
+            self._compiled_call(
+                ("spec_resample",),
+                lambda: jax.jit(sampling_ops.residual_sample),
+                np.full((self.slots, self._vocab), 1.0 / self._vocab,
+                        np.float32),
+                np.zeros((self.slots, self._vocab), np.float32),
+                self.seeds, self.emitted.astype(np.int32))
         if self.prefix_cache is not None:
             self.run(list(plist), max_new_tokens=max_new_tokens)
             if self.host_kv_pages:
@@ -2141,12 +2627,16 @@ class ServingEngine:
         k = self.decode_chunk
         write_pos, rope_pos, budget = self._slot_decode_state()
         self._note_pages_touched(write_pos + k - 1, budget)
+        # per-slot draw counters: the next token's index is exactly the
+        # count already emitted — slot- and replica-invariant, so a
+        # failover replay reproduces the stream
         toks, oks, self.pool = self._compiled_call(
             ("decode", k), lambda: self._build_decode(k),
             self.gen._params(), self.model.bn_state, self.pool,
             self.page_tables, self.last_tok, write_pos, rope_pos,
             self.row_len, self.prompt_pad, budget, self.poison,
-            self._split_key())
+            self.temps, self.top_ps, self.top_ks, self.seeds,
+            self.emitted.copy(), *self._lora_args_slots())
         toks = np.asarray(toks)                        # (k, B_slots)
         oks = np.asarray(oks)
         self.decode_steps += k
@@ -2162,27 +2652,58 @@ class ServingEngine:
                                    bool(oks[t, slot]))
 
     def _spec_step(self):
-        """One speculative iteration: the draft proposes K greedy tokens
-        per slot (one K-step scan over its own paged pool), the target
-        scores all K+1 candidate positions in ONE verify dispatch, and
-        the host emits the longest proposal prefix matching the target's
-        argmax plus the target's own next token — between 1 and K+1
-        TARGET-greedy tokens per slot per iteration, token-identical to
-        the non-speculative stream. k/v written for rejected positions
-        sit past the slot's new write frontier and are overwritten by the
-        next dispatch before anything can attend them."""
+        """One speculative iteration: the draft proposes K tokens per
+        slot from its OWN sampling distribution ``q`` (greedy slots:
+        argmax — the pre-sampling path bitwise), the target scores all
+        K+1 candidate positions in ONE verify dispatch (argmax + the
+        warped sampling distribution ``p``), and the host applies the
+        accept rule per slot:
+
+          * greedy (temperature 0): emit the longest proposal prefix
+            matching the target's argmax, plus the target's own next
+            token — every emitted token is the TARGET's argmax, so the
+            stream is token-identical to non-speculative greedy decode
+            at any K (unchanged from PR 6);
+          * sampled: REJECTION-SAMPLED — proposal i is accepted with
+            probability min(1, p_i(d_i) / q_i(d_i)) against an
+            ACCEPT-stream uniform; the first rejection re-draws from
+            the residual distribution norm(max(p - q, 0)) in-graph
+            (ops/sampling.py residual_sample), and a fully-accepted
+            window draws its bonus token from ``p_K`` (q = 0 residual).
+            Emitted tokens are then EXACTLY distributed as the
+            non-speculative sampler's (the classic rejection-sampling
+            identity) — property-tested in tests/test_sampled_spec.py.
+
+        All draws are counter-based on the request's seed (draw index =
+        the emitted token's position), so the whole trajectory replays
+        bit-for-bit after failover resubmission. k/v written for
+        rejected positions sit past the slot's new write frontier and
+        are overwritten by the next dispatch before anything can attend
+        them — the resampled token's k/v is written by the NEXT
+        iteration's slab position 0, exactly like the greedy path's
+        mismatch token."""
         k = self.speculate_k
         write_pos, rope_pos, budget = self._slot_decode_state()
+        ctr0 = self.emitted.copy().astype(np.int32)
+        # greedy-only iterations never read the p/q probability tensors
+        # — skip their device-to-host transfers (B*(K+1)*V floats per
+        # dispatch at real vocab sizes) and the uniforms/resample
+        # dispatches; the device arrays themselves are cheap (softmax
+        # over logits the walk already materialized)
+        sampled_live = bool(self.active.any()) and bool(
+            np.any(self.temps[self.active] > 0.0))
         # verify-slab frontier (the draft's decode mirrors the same pages)
         self._note_pages_touched(write_pos + k, budget)
-        d_toks, _, self.draft_pool = self._compiled_call(
-            ("draft_decode", k),
-            lambda: self._build_decode(k, gen=self.draft_gen),
+        d_toks, d_probs, self.draft_pool = self._compiled_call(
+            ("draft_propose", k),
+            lambda: self._build_draft_propose(k),
             self.draft_gen._params(), self.draft_model.bn_state,
             self.draft_pool, self.page_tables, self.last_tok, write_pos,
             rope_pos, self.row_len, self.prompt_pad, budget,
-            np.zeros((self.slots,), np.float32), self._split_key())
+            self.temps, self.top_ps, self.top_ks, self.seeds, ctr0)
         d_toks = np.asarray(d_toks)                    # (k, B_slots)
+        if sampled_live:
+            d_probs = np.asarray(d_probs)              # (k, B_slots, V)
         slab = np.concatenate(
             [self.last_tok[:, None].astype(np.int32), d_toks.T], axis=1)
         # per-position write slots, clamped to each request's own budget
@@ -2192,30 +2713,96 @@ class ServingEngine:
         pos = np.minimum(
             write_pos[:, None] + np.arange(k + 1, dtype=np.int32)[None, :],
             (budget - 1)[:, None]).astype(np.int32)
-        t_toks, t_oks, self.pool = self._compiled_call(
+        t_toks, t_probs, t_oks, self.pool = self._compiled_call(
             ("verify", k), lambda: self._build_verify(k),
             self.gen._params(), self.model.bn_state, self.pool,
             self.page_tables, slab, pos, rope_pos, self.row_len,
-            self.prompt_pad, self.poison)
+            self.prompt_pad, self.poison,
+            self.temps, self.top_ps, self.top_ks,
+            *self._lora_args_slots())
         t_toks = np.asarray(t_toks)                    # (B_slots, k+1)
+        if sampled_live:
+            t_probs = np.asarray(t_probs)              # (B, k+1, V)
         t_oks = np.asarray(t_oks)
         self.decode_steps += k + 1
         self._spec_dispatches += 1
+        # sampled slots need the accept uniforms; greedy-only iterations
+        # skip the dispatch (warmup() force-builds the programs so a
+        # first sampled request mid-traffic compiles nothing)
+        u = None
+        if sampled_live:
+            u = np.asarray(self._compiled_call(
+                ("spec_uniforms", k),
+                lambda: jax.jit(
+                    lambda s, c: sampling_ops.accept_uniforms(s, c, k)),
+                self.seeds, ctr0))                     # (B_slots, k)
+        # ---- the HOST-side accept rule --------------------------------
+        accepts = np.zeros((self.slots,), np.int32)
+        p_rows = np.zeros((self.slots, self._vocab), np.float32)
+        q_rows = np.zeros((self.slots, self._vocab), np.float32)
         for slot in range(self.slots):
             if not self.active[slot]:
                 continue
-            self._spec_proposed += k
             accepted = 0
-            while accepted < k \
-                    and d_toks[accepted, slot] == t_toks[slot, accepted]:
-                accepted += 1
+            if self.temps[slot] <= 0.0:
+                while accepted < k \
+                        and d_toks[accepted, slot] == t_toks[slot,
+                                                             accepted]:
+                    accepted += 1
+            else:
+                while accepted < k:
+                    d = int(d_toks[accepted, slot])
+                    pd = float(t_probs[slot, accepted, d])
+                    qd = float(d_probs[accepted, slot, d])
+                    # accept w.p. min(1, p/q): u*q < p, STRICT — u is
+                    # uniform over [0, 1), so strictness leaves the
+                    # accept probability unchanged for p > 0 but
+                    # guarantees a proposal OUTSIDE the target's
+                    # top-k/top-p keep-set (p == 0 exactly) is always
+                    # rejected, even on a u == 0.0 draw (q > 0 always —
+                    # the draft just sampled d from q)
+                    if u[slot, accepted] * qd < pd:
+                        accepted += 1
+                    else:
+                        break
+                p_rows[slot] = t_probs[slot, accepted]
+                if accepted < k:   # bonus draw after a clean window
+                    #                keeps q = 0 (residual == p)
+                    q_rows[slot] = d_probs[accepted, slot]
+            accepts[slot] = accepted
+        res = None
+        if sampled_live:
+            # the in-graph residual re-draw (one fixed-shape dispatch
+            # covers every sampled slot's rejection OR bonus draw; the
+            # draw index is the emitted token's position)
+            res = np.asarray(self._compiled_call(
+                ("spec_resample",),
+                lambda: jax.jit(sampling_ops.residual_sample),
+                p_rows, q_rows, self.seeds,
+                (ctr0 + accepts).astype(np.int32)))
+        # ---- emit -----------------------------------------------------
+        for slot in range(self.slots):
+            if not self.active[slot]:
+                continue
+            req = self.slot_req[slot]
+            arow = self._adapter_spec.setdefault(
+                (req.adapter or "none") if req else "none", [0, 0])
+            accepted = int(accepts[slot])
+            self._spec_proposed += k
             self._spec_accepted += accepted
+            arow[0] += k
+            arow[1] += accepted
+            sampled = self.temps[slot] > 0.0
             for m in range(accepted + 1):
                 if not self.active[slot]:
                     break  # retired mid-window: the rest is truncated
                 self._occupancy_sum += 1
-                self._record_token(slot, int(t_toks[slot, m]),
-                                   bool(t_oks[slot, m]))
+                if sampled:
+                    tok = (int(d_toks[m, slot]) if m < accepted
+                           else int(res[slot]))
+                else:
+                    tok = int(t_toks[slot, m])
+                self._record_token(slot, tok, bool(t_oks[slot, m]))
 
     def _decode_tick(self):
         tm = self._tm_on and telemetry.enabled()
@@ -2254,13 +2841,17 @@ class ServingEngine:
                 return bool(self.active.any())
             return self.pending()
 
-    def run(self, prompts=None, max_new_tokens: int = 32) -> List[Request]:
+    def run(self, prompts=None, max_new_tokens: int = 32,
+            **submit_kw) -> List[Request]:
         """Submit `prompts` (list of 1-D int32 arrays) and drive the
         scheduler until the engine is idle; returns THIS call's requests
         in submission order (with prompts=None: whatever was pending at
-        entry). The engine holds no reference to retired requests."""
+        entry). Extra kwargs (temperature/top_p/top_k/seed/adapter)
+        forward to submit(). The engine holds no reference to retired
+        requests."""
         if prompts is not None:
-            batch = [self.submit(p, max_new_tokens) for p in prompts]
+            batch = [self.submit(p, max_new_tokens, **submit_kw)
+                     for p in prompts]
         else:
             batch = [r for r in self.slot_req if r is not None] \
                 + list(self._queue)
@@ -2465,6 +3056,27 @@ class ServingEngine:
             "spec_accepted": self._spec_accepted,
             "spec_accept_rate": round(
                 self._spec_accepted / max(1, self._spec_proposed), 4),
+            # per-request sampling + multi-tenant adapter pool
+            # (ISSUE 14): requests that sampled (temperature > 0), the
+            # engine-level submit() defaults, and the adapter pool's
+            # occupancy/fault/eviction ledger (zeros without a pool —
+            # the keys are pinned either way). spec_accept_by_adapter
+            # mirrors the labeled telemetry series for host callers.
+            "sampled_requests": self._sampled_requests,
+            "serve_temperature": self.default_temperature,
+            "serve_top_p": self.default_top_p,
+            "serve_top_k": self.default_top_k,
+            "lora_rank": self.lora_rank,
+            **(self.lora.stats() if self.lora is not None else {
+                "adapter_pool_pages": 0, "adapters_registered": 0,
+                "adapters_resident": 0, "adapter_pages_in_use": 0,
+                "adapter_pool_occupancy": 0.0, "adapter_lookups": 0,
+                "adapter_hits": 0, "adapter_faults": 0,
+                "adapter_evictions": 0, "adapter_refs_live": 0}),
+            "spec_accept_by_adapter": {
+                name: round(v[1] / max(1, v[0]), 4)
+                for name, v in self._adapter_spec.items()},
+            "requests_by_adapter": dict(self._adapter_requests),
             # decode-attention hot-path observability (ISSUE 7): which
             # impl this engine's programs trace, how many pool pages the
             # last dispatch's attention read (vs the table-width gather

@@ -612,14 +612,25 @@ def test_recompile_flat_with_prefix_and_speculation(ff, draft):
 
 
 def test_speculative_validation(ff, draft):
-    """The accept rule's preconditions are enforced at construction."""
+    """The accept rule's preconditions are enforced at construction.
+    (temperature > 0 + speculation is no longer an error: ISSUE 14's
+    rejection-sampled speculation serves sampled requests — the sampling
+    params themselves are validated instead.)"""
     with pytest.raises(ValueError, match="draft model"):
         ff.make_serving_engine(speculate_k=2)
-    with pytest.raises(ValueError, match="greedy-only"):
-        ff.make_serving_engine(speculate_k=2, draft_model=draft,
-                               temperature=0.7)
     with pytest.raises(ValueError, match="must be >= 0"):
         ff.make_serving_engine(speculate_k=-1, draft_model=draft)
+    # sampled speculation constructs fine; bad sampling params do not
+    eng = ff.make_serving_engine(speculate_k=2, draft_model=draft,
+                                 temperature=0.7, kv_page_size=4,
+                                 max_seq_len=64)
+    assert eng.speculate_k == 2 and eng.default_temperature == 0.7
+    with pytest.raises(ValueError, match="temperature"):
+        ff.make_serving_engine(temperature=-0.5)
+    with pytest.raises(ValueError, match="top_p"):
+        ff.make_serving_engine(top_p=0.0)
+    with pytest.raises(ValueError, match="top_k"):
+        ff.make_serving_engine(top_k=-3)
 
 
 @pytest.mark.slow  # 8 s; one extra model compile
