@@ -427,6 +427,60 @@ def _run_serving_tier(n_dev, backend, dev_kind):
     finally:
         _tm.set_enabled(_tm_prev)
     telemetry_registry = _tm.registry().describe()
+
+    # flight-recorder honesty (ISSUE 15): the same interleaved
+    # discipline for the recorder + SLO evaluator — ON (bundle dir
+    # configured, generous non-breaching SLO ceilings evaluated at a
+    # deliberately sub-window cadence so the evaluator genuinely runs
+    # in the timed arms) vs the module gate OFF. The delta is stamped
+    # as flightrec_overhead_pct (budget <= 2%), and off-arm recompiles
+    # must stay zero — the health plane never touches compiled
+    # programs.
+    _phase("time_serving_flightrec_off")
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from flexflow_tpu.runtime import flightrec as _fr
+
+    fr_dir = _tempfile.mkdtemp(prefix="ff_bench_flightrec_")
+    _fr.configure(FFConfig(
+        batch_size=2, mesh_shape={"data": 1},
+        flight_recorder_dir=fr_dir,
+        flight_cooldown_s=3600.0, flight_debounce_s=3600.0,
+        slo_ttft_p99_s=60.0, slo_queue_wait_p99_s=60.0,
+        # 0.25 s: ~40x the production default cadence, so the evaluator
+        # judges several full windows inside every timed arm while the
+        # stamp still reflects a recognizable deployment shape
+        slo_window_s=0.25))
+    t_fr_on = t_fr_off = 0.0
+    fr_on_tokens = fr_off_tokens = 0
+    fr_off_recompiles = 0
+    try:
+        for _ in range(5):
+            for arm_on in (True, False):
+                _fr.set_enabled(arm_on)
+                before_arm = eng.stats()["tokens_generated"]
+                rc0 = eng.recompile_count
+                t0 = time.perf_counter()
+                eng.run(prompts, max_new_tokens=SERVE_MAX_NEW)
+                dt = time.perf_counter() - t0
+                toks = eng.stats()["tokens_generated"] - before_arm
+                if arm_on:
+                    fr_on_tokens += toks
+                    t_fr_on += dt
+                else:
+                    fr_off_tokens += toks
+                    t_fr_off += dt
+                    fr_off_recompiles += eng.recompile_count - rc0
+    finally:
+        _fr.set_enabled(True)
+        _fr.reset()   # drop the bench dir/specs: later tiers' FF_FAULT
+        #               drills must not write bundles
+        _shutil.rmtree(fr_dir, ignore_errors=True)
+    fr_off_tps = fr_off_tokens / t_fr_off
+    fr_on_tps = fr_on_tokens / t_fr_on
+    flightrec_overhead_pct = round(
+        100.0 * (fr_off_tps - fr_on_tps) / max(fr_off_tps, 1e-9), 2)
     # timed-window metrics only: TTFT percentiles from this window's
     # requests (the engine's lifetime stats would smuggle the warmup's
     # compile-inflated TTFTs into p99), occupancy from snapshot deltas
@@ -478,7 +532,14 @@ def _run_serving_tier(n_dev, backend, dev_kind):
                              telemetry_overhead_pct,
                          "telemetry_off_tokens_per_s":
                              round(off_tps, 2),
-                         "telemetry_registry": telemetry_registry}}
+                         "telemetry_registry": telemetry_registry,
+                         # ISSUE 15: the flight-recorder + SLO plane's
+                         # own marginal cost (interleaved arms, same
+                         # discipline; budget <= 2%)
+                         "flightrec_overhead_pct":
+                             flightrec_overhead_pct,
+                         "flightrec_off_tokens_per_s":
+                             round(fr_off_tps, 2)}}
     yield {
         "metric": "decode_throughput", "tier": "decode_throughput",
         "value": round(serve_tps, 2), "unit": "tokens/s",
@@ -488,6 +549,7 @@ def _run_serving_tier(n_dev, backend, dev_kind):
         "tokens": tokens, "all_done": ok,
         "recompiles_after_warmup": extra_recompiles,
         "recompiles_in_telemetry_off_window": off_recompiles,
+        "recompiles_in_flightrec_off_window": fr_off_recompiles,
         "occupancy": round(occupancy, 4), **common,
     }
     yield {

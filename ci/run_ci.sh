@@ -69,7 +69,16 @@
 #               and the trace ring exported as perfetto-loadable
 #               Chrome JSON in which every request has a complete span
 #               tree and the failover/handoff requests each cross
-#               replicas under ONE trace id
+#               replicas under ONE trace id; plus the flight-recorder /
+#               SLO health plane (ISSUE 15): the recorder state-machine
+#               suite (ring bounds, trigger debounce/cooldown, bundle
+#               atomicity + torn-write drill, keep-K retention, SLO
+#               window math with hysteresis, HBM ledger, /healthz
+#               rollup), a post-mortem leg (the crash drill yields
+#               exactly ONE manifest-intact bundle with complete
+#               failed-over span trees) and an SLO leg (deterministic
+#               slow()-fault TTFT breach: /healthz flips to breach
+#               within one window and recovers)
 #   router    — fleet-router tier: the multi-replica ServingRouter suite
 #               (failover exactly-once + token identity incl. prefix
 #               cache + speculation, deadline/shedding/affinity
@@ -259,11 +268,13 @@ run_disagg() {
 }
 
 # obs tier: the telemetry suite (slow-marked span-continuity variants
-# included — pytest -q runs the whole file), then the observability
-# smoke: mid-run /metrics scrape + perfetto-loadable trace export with
-# complete per-request span trees through a crash drill and a handoff.
+# included — pytest -q runs the whole file) + the flight-recorder /
+# SLO / HBM-ledger suite (ISSUE 15), then the observability smoke:
+# mid-run /metrics scrape + perfetto-loadable trace export with
+# complete per-request span trees through a crash drill and a handoff,
+# a post-mortem bundle leg and a /healthz SLO breach-and-recover leg.
 run_obs() {
-  python -m pytest tests/test_telemetry.py -q
+  python -m pytest tests/test_telemetry.py tests/test_flightrec.py -q
   python scripts/obs_smoke.py 120
 }
 

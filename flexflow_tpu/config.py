@@ -329,6 +329,44 @@ class FFConfig:
     # server (the default; the registry still records — export is pull).
     # Engines/routers/fit start it lazily on first use; one per process.
     metrics_port: int = 0
+    # ---- flight recorder + SLO health plane (runtime/flightrec.py,
+    # ISSUE 15) ----
+    # post-mortem bundle directory: every trigger (watchdog fire,
+    # replica fence, nonfinite rewind, uncaught engine/driver
+    # exception, SIGTERM preempt, any fired FF_FAULT, an SLO breach
+    # with slo_trip_recorder, or a manual dump_flight_record()) writes
+    # an atomic manifest-hashed bundle here (trace window + metrics
+    # snapshot + recent logs + trigger cause/stack + config/env
+    # fingerprint + per-engine stats + the HBM ledger). "" = auto
+    # triggers disabled (the in-memory window still records;
+    # FF_FLIGHT_DIR is the env fallback). telemetry="off" disables the
+    # recorder at the same single predicate as every other emit.
+    flight_recorder_dir: str = ""
+    flight_keep: int = 4          # retention: newest K bundles survive
+    # one bundle per cooldown window — a crash storm writes one bundle,
+    # the rest count as suppressed in the next bundle's trigger.json
+    flight_cooldown_s: float = 30.0
+    # triggers arriving within this of the first merge into ONE pending
+    # bundle (the storm's causes are all listed); flush() forces the
+    # pending write immediately
+    flight_debounce_s: float = 1.0
+    flight_window_s: float = 120.0  # trace-ring window a bundle captures
+    # declarative SLOs, evaluated over sliding windows of the telemetry
+    # histograms / engine counters (runtime/flightrec.py SLOMonitor).
+    # 0 = that SLO is off. A breach fires only after a full window,
+    # emits ff_slo_breach_total{slo,replica} + a margin gauge + an
+    # alert log + a trace annotation, flips /healthz to "breach", and
+    # clears after slo_clear_windows consecutive healthy windows.
+    slo_ttft_p99_s: float = 0.0          # ceiling: p99 TTFT per replica
+    slo_queue_wait_p99_s: float = 0.0    # ceiling: engine queue wait p99
+    slo_prefix_hit_rate_min: float = 0.0  # floor: prefix-cache hit rate
+    slo_spec_accept_min: float = 0.0     # floor: speculative accept rate
+    slo_step_time_p99_s: float = 0.0     # ceiling: train step p99
+    slo_checkpoint_stall_s: float = 0.0  # ceiling: checkpoint stall p99
+    slo_window_s: float = 10.0           # sliding evaluation window
+    slo_clear_windows: int = 2           # hysteresis: healthy windows
+    #                                      required to clear a breach
+    slo_trip_recorder: bool = False      # breach also trips the recorder
 
     # populated at FFModel construction
     strategies: Dict[str, "ParallelConfig"] = dataclasses.field(default_factory=dict)
@@ -433,6 +471,37 @@ class FFConfig:
             raise ValueError(
                 f"metrics_port={self.metrics_port}: must be 0 (no "
                 f"server) or a valid TCP port")
+        if self.flight_keep < 1:
+            raise ValueError(
+                f"flight_keep={self.flight_keep}: must be >= 1 (the "
+                f"bundle that just fired must survive its own retention)")
+        if self.flight_cooldown_s < 0 or self.flight_debounce_s < 0:
+            raise ValueError(
+                f"flight_cooldown_s={self.flight_cooldown_s} and "
+                f"flight_debounce_s={self.flight_debounce_s} must be "
+                f">= 0")
+        if self.flight_window_s <= 0:
+            raise ValueError(
+                f"flight_window_s={self.flight_window_s}: must be > 0")
+        for knob in ("slo_ttft_p99_s", "slo_queue_wait_p99_s",
+                     "slo_step_time_p99_s", "slo_checkpoint_stall_s"):
+            if getattr(self, knob) < 0:
+                raise ValueError(
+                    f"{knob}={getattr(self, knob)}: must be >= 0 "
+                    f"(0 = SLO off)")
+        for knob in ("slo_prefix_hit_rate_min", "slo_spec_accept_min"):
+            v = getattr(self, knob)
+            if v < 0 or v > 1:
+                raise ValueError(
+                    f"{knob}={v}: must be in [0, 1] (0 = SLO off; it "
+                    f"is a rate floor)")
+        if self.slo_window_s <= 0:
+            raise ValueError(
+                f"slo_window_s={self.slo_window_s}: must be > 0")
+        if self.slo_clear_windows < 1:
+            raise ValueError(
+                f"slo_clear_windows={self.slo_clear_windows}: must be "
+                f">= 1 (a breach must be clearable)")
         if self.paged_attention_impl not in ("auto", "pallas", "einsum"):
             raise ValueError(
                 f"paged_attention_impl={self.paged_attention_impl!r}: "
@@ -596,8 +665,55 @@ class FFConfig:
                             "emit short-circuits)")
         p.add_argument("--metrics-port", type=int, default=0,
                        help="serve Prometheus /metrics (+ /metrics.json"
-                            ", /trace.json) on 127.0.0.1:<port> "
-                            "(0 = no server)")
+                            ", /trace.json, /healthz, /slo.json) on "
+                            "127.0.0.1:<port> (0 = no server)")
+        p.add_argument("--flight-recorder-dir", type=str, default="",
+                       help="post-mortem bundle directory: triggers "
+                            "(watchdog/fence/rewind/fault/preempt/SLO "
+                            "breach/manual) snapshot the recent trace "
+                            "window + metrics + logs + HBM ledger into "
+                            "atomic manifest-hashed bundles ('' = auto "
+                            "triggers off)")
+        p.add_argument("--flight-keep", type=int, default=4,
+                       help="bundle retention: newest K survive")
+        p.add_argument("--flight-cooldown-s", type=float, default=30.0,
+                       help="one bundle per cooldown — a crash storm "
+                            "writes one bundle, not N")
+        p.add_argument("--flight-debounce-s", type=float, default=1.0,
+                       help="triggers within this of the first merge "
+                            "into ONE pending bundle (the storm's "
+                            "causes all listed)")
+        p.add_argument("--flight-window-s", type=float, default=120.0,
+                       help="trace-ring window a bundle captures, in "
+                            "seconds")
+        p.add_argument("--slo-ttft-p99-s", type=float, default=0.0,
+                       help="SLO ceiling: windowed p99 TTFT per replica "
+                            "(0 = off)")
+        p.add_argument("--slo-queue-wait-p99-s", type=float, default=0.0,
+                       help="SLO ceiling: windowed p99 engine queue "
+                            "wait (0 = off)")
+        p.add_argument("--slo-prefix-hit-rate-min", type=float,
+                       default=0.0,
+                       help="SLO floor: windowed prefix-cache hit rate "
+                            "(0 = off)")
+        p.add_argument("--slo-spec-accept-min", type=float, default=0.0,
+                       help="SLO floor: windowed speculative accept "
+                            "rate (0 = off)")
+        p.add_argument("--slo-step-time-p99-s", type=float, default=0.0,
+                       help="SLO ceiling: windowed p99 train step time "
+                            "(0 = off)")
+        p.add_argument("--slo-checkpoint-stall-s", type=float,
+                       default=0.0,
+                       help="SLO ceiling: windowed p99 checkpoint "
+                            "stall (0 = off)")
+        p.add_argument("--slo-window-s", type=float, default=10.0,
+                       help="SLO sliding-window length in seconds")
+        p.add_argument("--slo-clear-windows", type=int, default=2,
+                       help="hysteresis: consecutive healthy windows "
+                            "required to clear a breach")
+        p.add_argument("--slo-trip-recorder", action="store_true",
+                       help="an SLO breach also trips the flight "
+                            "recorder (needs --flight-recorder-dir)")
         # e.g. --mesh data=4,model=2 (replaces -ll:gpu device-count knobs)
         p.add_argument("--mesh", type=str, default="")
         args, _ = p.parse_known_args(argv)
@@ -655,4 +771,18 @@ class FFConfig:
             serve_weight_dtype=args.serve_weight_dtype,
             telemetry=args.telemetry,
             metrics_port=args.metrics_port,
+            flight_recorder_dir=args.flight_recorder_dir,
+            flight_keep=args.flight_keep,
+            flight_cooldown_s=args.flight_cooldown_s,
+            flight_debounce_s=args.flight_debounce_s,
+            flight_window_s=args.flight_window_s,
+            slo_ttft_p99_s=args.slo_ttft_p99_s,
+            slo_queue_wait_p99_s=args.slo_queue_wait_p99_s,
+            slo_prefix_hit_rate_min=args.slo_prefix_hit_rate_min,
+            slo_spec_accept_min=args.slo_spec_accept_min,
+            slo_step_time_p99_s=args.slo_step_time_p99_s,
+            slo_checkpoint_stall_s=args.slo_checkpoint_stall_s,
+            slo_window_s=args.slo_window_s,
+            slo_clear_windows=args.slo_clear_windows,
+            slo_trip_recorder=args.slo_trip_recorder,
         )

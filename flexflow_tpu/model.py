@@ -43,6 +43,10 @@ from flexflow_tpu.runtime.loss import loss_type_from_name
 from flexflow_tpu.runtime.metrics import PerfMetrics, metrics_from_names
 from flexflow_tpu.tensor import Tensor
 
+# process-wide model ids: the HBM ledger's per-instance source name
+# (two FFModels in one process must not overwrite each other's rows)
+_MODEL_IDS = iter(range(1 << 30))
+
 
 class FFModel:
     def __init__(self, config: Optional[FFConfig] = None):
@@ -89,6 +93,16 @@ class FFModel:
         # host_wait/h2d/dispatch/device breakdown
         self._pipeline = None
         self.last_step_breakdown: Optional[Dict[str, float]] = None
+        # fflint's per-chip HBM footprint estimate, stashed by compile's
+        # lint pass for the flight recorder's accounting cross-check;
+        # the ledger row name is per-instance so two models in one
+        # process keep distinct rows
+        self._lint_hbm_estimate: Optional[float] = None
+        self._hbm_name = f"model-{next(_MODEL_IDS)}"
+        # identity of the ledger this model registered on: a
+        # flightrec.reset() swaps the singleton, so a plain once-flag
+        # would permanently drop this model's row from later scrapes
+        self._hbm_registered_on = None
 
     @property
     def params(self):
@@ -544,6 +558,13 @@ class FFModel:
             if cfg.strategy_lint == "strict" and report.errors():
                 raise StrategyLintError(report)
             report.log(fflogger)
+            # stash the footprint pass's per-chip HBM estimate for the
+            # accounting ledger's cross-check (runtime/flightrec.py:
+            # ff_hbm_lint_estimated_bytes vs the tracked byte ledger) —
+            # the lint already computed it, this costs nothing
+            rows = report.by_code("hbm-footprint")
+            if rows and rows[0].est_bytes:
+                self._lint_hbm_estimate = float(rows[0].est_bytes)
 
         self._final_tensor = final_tensor or self.ops[-1].outputs[0]
         # fused softmax + cross-entropy, the reference semantics: its CE
@@ -633,6 +654,24 @@ class FFModel:
             from flexflow_tpu.runtime.profiler import export_sim_taskgraph
 
             export_sim_taskgraph(self, cfg.taskgraph_file)
+
+        if getattr(cfg, "telemetry", "on") != "off":
+            # HBM accounting ledger (runtime/flightrec.py, ISSUE 15):
+            # params/opt-state byte rows + the lint footprint
+            # cross-check, published as ff_hbm_* gauges at scrape time
+            # and embedded in every post-mortem bundle. Registered ONCE
+            # per model (a recompile must not duplicate the source),
+            # under a per-instance name (two models in one process must
+            # not overwrite each other's rows).
+            from flexflow_tpu.runtime import flightrec
+
+            led = flightrec.hbm_ledger()
+            if self._hbm_registered_on is not led:
+                self._hbm_registered_on = led
+                led.add_source(self._hbm_source)
+            if self._lint_hbm_estimate is not None:
+                flightrec.hbm_ledger().set_lint_estimate(
+                    self._lint_hbm_estimate)
 
     def _maybe_fuse_optimizer(self, opt):
         """FFConfig.fused_optimizer: replicated-param strategies (single
@@ -922,9 +961,14 @@ class FFModel:
         # rewind, watchdog) land on the same timeline from
         # resilience.py, and step wall time feeds an SLO histogram —
         # one exported trace shows the overlap schedule end to end
+        from flexflow_tpu.runtime import flightrec as _flightrec
         from flexflow_tpu.runtime import telemetry as _telemetry
 
         tm_on = getattr(self.config, "telemetry", "on") != "off"
+        # unconditional: configure() is how telemetry="off" reaches the
+        # recorder's own gate (the train step-time and checkpoint-stall
+        # SLOs window the histograms fit and the supervisor feed)
+        _flightrec.configure(self.config)
         if tm_on and getattr(self.config, "metrics_port", 0):
             _telemetry.start_http_server(self.config.metrics_port)
         tm_step_hist = (_telemetry.registry().histogram(
@@ -1088,6 +1132,11 @@ class FFModel:
                             tr.complete("dispatch", t_s, t_d - t_s,
                                         trace_id=sid, track="train")
                             tm_step_hist.observe(t_d - t_b)
+                            # train-side SLO tick for unsupervised fits
+                            # (the supervisor's after_step ticks when
+                            # one is installed): one predicate + one
+                            # time compare until a window has elapsed
+                            _flightrec.slo_monitor().maybe_evaluate()
                         epoch_mets.append((mets, bs, 1))
                         total += bs
                         if warm is None:
@@ -1320,6 +1369,38 @@ class FFModel:
                    prompt_lengths=prompt_lengths,
                    prefill_chunk=prefill_chunk,
                    return_scores=return_scores, early_exit=early_exit)
+
+    def _hbm_source(self):
+        """HBM-ledger row (runtime/flightrec.py): what this model's
+        training state holds on device, per subsystem."""
+        def _nbytes(tree):
+            return sum(int(getattr(a, "nbytes", 0))
+                       for a in jax.tree_util.tree_leaves(tree))
+
+        subs = {"params": _nbytes(self.params)}
+        if self.opt_state is not None:
+            subs["opt_state"] = _nbytes(self.opt_state)
+        if self.bn_state:
+            subs["bn_state"] = _nbytes(self.bn_state)
+        return (self._hbm_name, subs)
+
+    def dump_flight_record(self, directory: Optional[str] = None,
+                           **note) -> Optional[str]:
+        """Manual post-mortem bundle (runtime/flightrec.py, ISSUE 15):
+        synchronously snapshot the recent trace window, metrics
+        registry, log ring, HBM ledger, per-engine stats and the
+        config/env fingerprint into an atomic, manifest-hashed bundle
+        directory; returns its path. ``directory`` overrides
+        ``FFConfig.flight_recorder_dir`` (one of the two must be set).
+        Returns None when ``FFConfig.telemetry="off"`` — the off
+        contract covers manual dumps too."""
+        from flexflow_tpu.runtime import flightrec
+
+        # recorder-only configure: re-arming the SLO monitor here would
+        # reset live breach state on an operator's dump
+        flightrec.recorder().configure(self.config)
+        return flightrec.dump("manual", directory=directory,
+                              source="model", **note)
 
     def make_serving_engine(self, **kwargs):
         """Continuous-batching serving engine (runtime/serving.py): one

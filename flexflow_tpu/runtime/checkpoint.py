@@ -111,6 +111,47 @@ def write_manifest(step_dir: str) -> str:
     return path
 
 
+def verify_dir_manifest(step_dir: str, label: Optional[str] = None,
+                        require: bool = False):
+    """Recompute the content-hash manifest of any published directory
+    (checkpoint step dirs AND flight-recorder post-mortem bundles share
+    this verifier) and raise ``CheckpointCorruptError`` naming the first
+    mismatching file. Without a manifest: passes when ``require`` is
+    False (pre-manifest checkpoints), raises when True (a bundle is
+    born with its proof — a manifest-less one IS a torn write)."""
+    label = label or step_dir
+    mpath = os.path.join(step_dir, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        if require:
+            raise CheckpointCorruptError(
+                f"{label}: no {MANIFEST_NAME} — torn or foreign write")
+        return
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"{label}: unreadable manifest {mpath}: {e}")
+    files = manifest.get("files", {})
+    present = {rel: full for rel, full in _manifest_files(step_dir)}
+    missing = [rel for rel in files if rel not in present]
+    if missing:
+        raise CheckpointCorruptError(
+            f"{label}: {len(missing)} manifest file(s) "
+            f"missing, first {missing[0]!r}")
+    for rel, rec in files.items():
+        full = present[rel]
+        if os.path.getsize(full) != rec.get("bytes"):
+            raise CheckpointCorruptError(
+                f"{label}: {rel!r} is "
+                f"{os.path.getsize(full)} bytes, manifest records "
+                f"{rec.get('bytes')}")
+        if _sha256(full) != rec.get("sha256"):
+            raise CheckpointCorruptError(
+                f"{label}: content hash mismatch on {rel!r} "
+                f"— payload corrupted after save")
+
+
 def verify_checkpoint(directory: str, step: int):
     """Recompute the manifest hashes of ``step_<step>`` and raise
     ``CheckpointCorruptError`` naming the first mismatching file. A
@@ -118,33 +159,7 @@ def verify_checkpoint(directory: str, step: int):
     there is nothing to verify it against, and refusing every pre-existing
     checkpoint would turn an upgrade into data loss."""
     step_dir = os.path.join(os.path.abspath(directory), f"step_{step}")
-    mpath = os.path.join(step_dir, MANIFEST_NAME)
-    if not os.path.exists(mpath):
-        return
-    try:
-        with open(mpath) as f:
-            manifest = json.load(f)
-    except (OSError, ValueError) as e:
-        raise CheckpointCorruptError(
-            f"checkpoint step {step}: unreadable manifest {mpath}: {e}")
-    files = manifest.get("files", {})
-    present = {rel: full for rel, full in _manifest_files(step_dir)}
-    missing = [rel for rel in files if rel not in present]
-    if missing:
-        raise CheckpointCorruptError(
-            f"checkpoint step {step}: {len(missing)} manifest file(s) "
-            f"missing, first {missing[0]!r}")
-    for rel, rec in files.items():
-        full = present[rel]
-        if os.path.getsize(full) != rec.get("bytes"):
-            raise CheckpointCorruptError(
-                f"checkpoint step {step}: {rel!r} is "
-                f"{os.path.getsize(full)} bytes, manifest records "
-                f"{rec.get('bytes')}")
-        if _sha256(full) != rec.get("sha256"):
-            raise CheckpointCorruptError(
-                f"checkpoint step {step}: content hash mismatch on {rel!r} "
-                f"— payload corrupted after save")
+    verify_dir_manifest(step_dir, label=f"checkpoint step {step}")
 
 
 def verify_step(directory: str, step: int) -> bool:

@@ -85,6 +85,17 @@ def _annotate(kind: str, site: str, index: int,
                            value=value)
     except Exception:
         pass
+    try:
+        # every fired injection is also a flight-recorder trigger
+        # (runtime/flightrec.py): a no-op unless a bundle directory is
+        # configured, debounced/cooled-down so a drill's fault storm
+        # yields one post-mortem bundle naming every cause
+        from flexflow_tpu.runtime import flightrec
+
+        flightrec.trip("fault", kind=kind, site=site, index=index,
+                       value=value)
+    except Exception:
+        pass
 
 
 class InjectedFault(OSError):

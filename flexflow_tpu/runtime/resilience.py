@@ -49,7 +49,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from flexflow_tpu.logger import fflogger
-from flexflow_tpu.runtime import faultinject, telemetry
+from flexflow_tpu.runtime import faultinject, flightrec, telemetry
 
 # process-wide resilience counters (skipped steps / restarts / retries …);
 # read via counters(), cleared via reset_counters()
@@ -196,8 +196,21 @@ class Watchdog:
                     telemetry.tracer().instant(
                         "watchdog_fire", track="train", label=label,
                         timeout_s=timeout_s)
+                    # the post-mortem trigger: capture the last N
+                    # seconds of spans/metrics/logs before the abort
+                    # path tears the process down (the write happens on
+                    # the recorder's own daemon timer — this only
+                    # schedules)
+                    flightrec.trip("watchdog_fire", label=label,
+                                   timeout_s=timeout_s)
                 self._dump(label, timeout_s)  # stacks first, while they
                 # still show the hang; the slow profiler snapshot trails
+                if self.telemetry_on:
+                    # TERMINAL trigger: the abort below may end the
+                    # process before the debounce timer fires, so the
+                    # bundle must be written synchronously NOW — the
+                    # whole point is evidence that survives the death
+                    flightrec.recorder().flush(timeout=15.0)
                 if self.on_timeout is not None:
                     self.on_timeout(label)
                 else:
@@ -317,6 +330,13 @@ class TrainSupervisor:
         # FFConfig.telemetry="off" silences the supervisor's spans and
         # histograms too (the "off short-circuits every emit" contract)
         self._tm_on = getattr(cfg, "telemetry", "on") != "off"
+        # unconditional: flight recorder + SLO monitor adopt this run's
+        # knobs INCLUDING telemetry="off" — configure() is how the off
+        # state reaches the recorder's own gate. (Watchdog fires,
+        # nonfinite rewinds and SIGTERM preempts are trigger sites; the
+        # train step-time / checkpoint-stall SLOs window the histograms
+        # the saves/steps already feed.)
+        flightrec.configure(cfg)
         self.watchdog = Watchdog(step_timeout_s if step_timeout_s is not None
                                  else getattr(cfg, "step_timeout_s", 0.0))
         self.watchdog.telemetry_on = self._tm_on
@@ -610,6 +630,9 @@ class TrainSupervisor:
                 "rewind", track="train",
                 from_step=self.model._step_count,
                 to_step=step, bad_streak=self._bad_streak)
+            flightrec.trip("nonfinite_rewind",
+                           from_step=self.model._step_count,
+                           to_step=step, bad_streak=self._bad_streak)
         del self.losses[max(step - self._loss_base, 0):]
         self._restore(step)
         COUNTERS["rewinds"] += 1
@@ -656,6 +679,14 @@ class TrainSupervisor:
         if self._preempted.is_set():
             self.save(reason="preempt")
             COUNTERS["preempt_stops"] += 1
+            if self._tm_on:
+                # TERMINAL trigger: the caller stops (and typically
+                # exits) after the preempt checkpoint — write the
+                # bundle synchronously, don't leave it on a daemon
+                # debounce timer the interpreter teardown would kill
+                flightrec.trip("sigterm_preempt",
+                               step=self.model._step_count)
+                flightrec.recorder().flush(timeout=15.0)
             fflogger.warning(
                 "supervisor: preemption notice — checkpointed step %d, "
                 "stopping", self.model._step_count)
@@ -665,6 +696,11 @@ class TrainSupervisor:
                      or step_no - self._last_saved_step
                      >= self.checkpoint_every)):
             self.save(reason="periodic")
+        if self._tm_on:
+            # the train-side SLO tick (step-time / checkpoint-stall
+            # budgets): one predicate + one time compare until a full
+            # window has elapsed
+            flightrec.slo_monitor().maybe_evaluate()
         return False
 
     def nan_due(self) -> bool:

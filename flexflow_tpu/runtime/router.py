@@ -93,7 +93,7 @@ import numpy as np
 
 from flexflow_tpu.logger import fflogger
 from flexflow_tpu.ops import sampling as sampling_ops
-from flexflow_tpu.runtime import faultinject, telemetry
+from flexflow_tpu.runtime import faultinject, flightrec, telemetry
 from flexflow_tpu.runtime.serving import RadixPrefixCache
 
 
@@ -308,6 +308,10 @@ class ServingRouter:
         for r, eng in enumerate(self.engines):
             eng.set_telemetry_identity(r, self.roles[r])
         self._tm_ttft = None
+        # unconditional: configure() is how telemetry="off" reaches the
+        # recorder's own gate (an env FF_FLIGHT_DIR must not keep it
+        # live under an off config)
+        flightrec.configure(cfg)
         if self._tm_on:
             if getattr(cfg, "metrics_port", 0):
                 telemetry.start_http_server(cfg.metrics_port)
@@ -319,6 +323,12 @@ class ServingRouter:
                 "router submit -> first token (queue wait included — "
                 "what shedding bounds)").labels()
             telemetry.registry().add_collector(self._tm_collect)
+            # flight recorder + SLO health plane (ISSUE 15): the fleet
+            # ledger rides every post-mortem bundle, and health() — the
+            # probe that never blocks behind a mid-tick replica — feeds
+            # the /healthz rollup (ok|degraded|breach)
+            flightrec.recorder().attach_source(self._flightrec_source)
+            flightrec.register_health_source(self._health_probe)
         self._threads: List[threading.Thread] = []
         self._started = False
         if start:
@@ -526,6 +536,10 @@ class ServingRouter:
                         "router: warmup could not warm replica %d's "
                         "page-import writer — its first handoff will "
                         "compile it", r)
+        if self._tm_on:
+            # every replica's warmup already rebaselined; one more after
+            # the LAST replica restarts the fleet-wide window clock too
+            flightrec.slo_monitor().rebaseline()
 
     def drain(self) -> Dict:
         """Graceful fleet shutdown: stop admitting, let the drivers
@@ -727,6 +741,12 @@ class ServingRouter:
         if self._tm_on:
             telemetry.tracer().instant("fence", track="router",
                                        replica=r, reason=reason)
+            # a fence IS the incident the flight recorder exists for:
+            # snapshot the window (debounced — the crash that caused
+            # this fence already opened the pending bundle, so the two
+            # triggers merge into one)
+            flightrec.trip("replica_fence", replica=r, reason=reason,
+                           role=self.roles[r])
         out = self._outstanding[r]
         self._outstanding[r] = {}
         self._to_submit[r].clear()
@@ -896,6 +916,10 @@ class ServingRouter:
             self._heartbeat[r] = time.monotonic()
             self._collect(r)
             self._collect_tier_events(r)
+            if self._tm_on:
+                # fleet-side SLO tick: returns at one time compare
+                # until a full window has elapsed
+                flightrec.slo_monitor().maybe_evaluate()
             if not progressed and not assigned:
                 time.sleep(0.002)   # idle: don't spin the host
 
@@ -986,6 +1010,28 @@ class ServingRouter:
                         req, "failed", ereq.error or "engine failure")
 
     # ---- observability ------------------------------------------------------
+
+    def _flightrec_source(self):
+        """Post-mortem bundle payload: the fleet ledger + per-replica
+        engine rows (stats() reads each engine outside the router lock;
+        the recorder's per-source timeout bounds a wedged replica)."""
+        return ("router", {"stats": self.stats(),
+                           "health": self.health()})
+
+    def _health_probe(self):
+        """The /healthz fleet row — health() never takes an engine
+        lock, so the rollup answers mid-tick."""
+        return {"kind": "router", **self.health()}
+
+    def dump_flight_record(self, directory: Optional[str] = None,
+                           **note) -> Optional[str]:
+        """Manual post-mortem bundle (the router half of the ISSUE-15
+        trigger API): synchronous, always writes (merging any pending
+        debounced triggers), returns the bundle path — or None when
+        telemetry is off. Raises without a configured
+        ``FFConfig.flight_recorder_dir`` and no ``directory``."""
+        return flightrec.dump("manual", directory=directory,
+                              source="router", **note)
 
     def recent_traces(self, n: int = 32) -> List[Dict]:
         """Span trees of the most recent fleet requests still in the

@@ -146,7 +146,7 @@ import numpy as np
 from flexflow_tpu._env import compilation_cache_entries
 from flexflow_tpu.logger import fflogger
 from flexflow_tpu.ops import sampling as sampling_ops
-from flexflow_tpu.runtime import faultinject, telemetry
+from flexflow_tpu.runtime import faultinject, flightrec, telemetry
 from flexflow_tpu.runtime.generation import Generator
 from flexflow_tpu.runtime.lora import LoraAdapterPool
 
@@ -1255,11 +1255,27 @@ class ServingEngine:
         self._tm_labels = {"replica": f"engine{next(_ENGINE_IDS)}",
                            "role": "solo"}
         self._tm_ch: Dict = {}
+        # flight recorder + SLO plane adopt the config's knobs
+        # UNCONDITIONALLY: configure() is how telemetry="off" reaches
+        # the recorder's own gate — skipping it when off would leave an
+        # env-configured FF_FLIGHT_DIR recorder live under an "off"
+        # config
+        flightrec.configure(cfg)
         if self._tm_on:
             if getattr(cfg, "metrics_port", 0):
                 telemetry.start_http_server(cfg.metrics_port)
             self._tm_bind_children()
             telemetry.registry().add_collector(self._tm_collect)
+            # ISSUE 15: register this engine as a post-mortem bundle
+            # source (stats/health snapshot), an HBM-ledger source (KV
+            # pool incl. host tier, adapter pool, quantized serving
+            # weights), an SLO ratio source (prefix-hit / spec-accept
+            # window floors) and a lock-free health probe for /healthz
+            # — all weakly referenced, same off predicate
+            flightrec.recorder().attach_source(self._flightrec_source)
+            flightrec.hbm_ledger().add_source(self._hbm_source)
+            flightrec.slo_monitor().add_source(self._slo_source)
+            flightrec.register_health_source(self._health_probe)
 
     # ---- telemetry ----------------------------------------------------------
 
@@ -1355,6 +1371,67 @@ class ServingEngine:
             for name, (prop, acc) in rows.items():
                 fam.labels(*lab, name).set(
                     round(acc / max(1, prop), 4))
+
+    # ---- flight recorder / SLO / HBM sources (ISSUE 15) ---------------------
+
+    def _flightrec_source(self):
+        """Post-mortem bundle payload: the full stats/health snapshot.
+        Takes the engine lock — the recorder collects sources with a
+        per-source timeout, so a wedged replica yields an error row in
+        its own incident's bundle instead of hanging the write."""
+        return (f"engine-{self._tm_labels['replica']}",
+                {"stats": self.stats(), "health": self.health()})
+
+    def _slo_source(self):
+        """Lock-free counter reads for the ratio-floor SLOs (windowed
+        prefix hit rate / speculative accept rate). Plain int attribute
+        reads racing the tick by design — a monitoring window tolerates
+        one tick of skew; a monitor stalled behind the tick does not."""
+        pc = self.prefix_cache
+        return (self._tm_labels["replica"], {
+            "prefix_hits": pc.hits if pc else 0,
+            "prefix_lookups": pc.lookups if pc else 0,
+            "spec_accepted": self._spec_accepted,
+            "spec_proposed": self._spec_proposed})
+
+    def _hbm_source(self):
+        """HBM ledger row: what this engine holds in device (and pinned
+        host) memory, per subsystem — the per-pool resolution the
+        memory-objective search consumes. Geometry is fixed for the
+        engine's life, so these are cheap nbytes sums."""
+        import jax as _jax
+
+        def _nbytes(tree):
+            return sum(int(a.nbytes)
+                       for a in _jax.tree_util.tree_leaves(tree))
+
+        subs = {"kv_pool": self._pool_bytes}
+        pc = self.prefix_cache
+        if pc is not None and pc.host_pages:
+            page_bytes = self._pool_bytes / max(1, self.num_pages)
+            subs["kv_host_tier"] = int(pc.host_used * page_bytes)
+        if self.draft_pool is not None:
+            subs["kv_draft_pool"] = _nbytes(self.draft_pool)
+        if self.lora_pool is not None:
+            subs["adapter_pool"] = _nbytes(self.lora_pool)
+        if self.gen.quantize:
+            # a quantized serving copy is a SEPARATE device allocation
+            # (native-weight serving reads the model params, which the
+            # model's own ledger row counts — never double-book)
+            subs["serve_weights"] = _nbytes(self.gen._quantized_params())
+        dg = getattr(self, "draft_gen", None)
+        if dg is not None and dg.quantize:
+            subs["draft_weights"] = _nbytes(dg._quantized_params())
+        return (f"engine-{self._tm_labels['replica']}", subs)
+
+    def _health_probe(self):
+        """Lock-free /healthz row: never compiles, never blocks behind
+        a mid-tick replica (the load() discipline)."""
+        return {"kind": "engine",
+                "replica": self._tm_labels["replica"],
+                "role": self._tm_labels["role"],
+                "status": "draining" if self._draining else "up",
+                **self.load()}
 
     # ---- request lifecycle --------------------------------------------------
 
@@ -2580,6 +2657,12 @@ class ServingEngine:
                         " writer (no full-page prompt, pool pressure, "
                         "or nothing to re-import) — the first real "
                         "promotion/handoff will compile it")
+        if self._tm_on:
+            # restart the SLO window clock past the warmup: a
+            # compile-inflated warmup TTFT must never be judged as a
+            # breach (the bench's warm-window discipline, applied to
+            # the health plane)
+            flightrec.slo_monitor().rebaseline()
         return {"programs": self.recompile_count - before,
                 "requests": self._submitted - req0,
                 "variants": sorted(self._programs.keys(), key=repr)}
@@ -2832,14 +2915,31 @@ class ServingEngine:
         whole tick: concurrent submit()/stats() callers serialize behind
         it (thread-per-replica routers drive step from one thread, so
         the tick itself never contends)."""
-        with self._lock:
-            if not self._draining:
-                self._admit()
-            if self.active.any():
-                self._decode_tick()
-            if self._draining:
-                return bool(self.active.any())
-            return self.pending()
+        try:
+            with self._lock:
+                if not self._draining:
+                    self._admit()
+                if self.active.any():
+                    self._decode_tick()
+                if self._draining:
+                    out = bool(self.active.any())
+                else:
+                    out = self.pending()
+        except Exception as e:  # noqa: BLE001 — an uncaught engine
+            #   exception is a flight-recorder trigger (the lock is
+            #   released by the time we get here; trip() only schedules,
+            #   so the bundle's stats source cannot deadlock)
+            if self._tm_on:
+                flightrec.trip(
+                    "engine_exception", exc=e,
+                    replica=self._tm_labels["replica"],
+                    error=f"{type(e).__name__}: {e}")
+            raise
+        if self._tm_on:
+            # serving-side SLO tick: one predicate + one time compare
+            # until a full window has elapsed
+            flightrec.slo_monitor().maybe_evaluate()
+        return out
 
     def run(self, prompts=None, max_new_tokens: int = 32,
             **submit_kw) -> List[Request]:

@@ -66,7 +66,7 @@ __all__ = [
     "registry", "tracer", "reset", "set_enabled", "enabled",
     "annotate", "fault_events", "export_chrome_trace", "trace_tree",
     "start_http_server", "stop_http_server", "current_trace_id",
-    "DEFAULT_LATENCY_BOUNDS", "log_bounds",
+    "DEFAULT_LATENCY_BOUNDS", "log_bounds", "now_us", "bucket_quantile",
 ]
 
 # ---------------------------------------------------------------- switch
@@ -110,6 +110,31 @@ def log_bounds(lo: float, hi: float, growth: float = 2.0) -> Tuple[float, ...]:
 # 100us .. ~210s in x2 steps: wide enough for TTFT on a cold CPU compile
 # and tight enough for inter-token latency — 22 buckets, fixed memory
 DEFAULT_LATENCY_BOUNDS = log_bounds(1e-4, 200.0)
+
+
+def bucket_quantile(bounds: Tuple[float, ...], counts, q: float) -> float:
+    """The one bucket-interpolated quantile estimator — shared by live
+    histogram children and the SLO monitor's window deltas, so the
+    windowed p99 an SLO judges can never diverge from the exported p99
+    operators compare it against. ``counts`` has ``len(bounds) + 1``
+    entries (the +Inf bucket last); 0.0 when empty; values past the
+    last bound clamp to it (the +Inf bucket has no upper edge to
+    interpolate against)."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    seen = 0.0
+    for i, c in enumerate(counts):
+        if seen + c >= rank and c > 0:
+            if i >= len(bounds):            # +Inf bucket
+                return bounds[-1]
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i]
+            frac = (rank - seen) / c
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        seen += c
+    return bounds[-1]
 
 
 class _Series:
@@ -169,26 +194,11 @@ class _HistSeries:
             self.count += 1
 
     def quantile(self, q: float) -> float:
-        """Estimate the q-quantile (0..1) from the buckets. 0.0 when
-        empty; values past the last bound clamp to it (the +Inf bucket
-        has no upper edge to interpolate against)."""
+        """Estimate the q-quantile (0..1) from the buckets (the shared
+        ``bucket_quantile`` estimator)."""
         with self._lock:
-            total = self.count
             counts = list(self.counts)
-        if total == 0:
-            return 0.0
-        rank = q * total
-        seen = 0.0
-        for i, c in enumerate(counts):
-            if seen + c >= rank and c > 0:
-                if i >= len(self.bounds):       # +Inf bucket
-                    return self.bounds[-1]
-                lo = self.bounds[i - 1] if i > 0 else 0.0
-                hi = self.bounds[i]
-                frac = (rank - seen) / c
-                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
-            seen += c
-        return self.bounds[-1]
+        return bucket_quantile(self.bounds, counts, q)
 
 
 class _Family:
@@ -297,6 +307,13 @@ class Registry:
             fam = _Family(name, help_, kind, labelnames, bounds)
             self._families[name] = fam
             return fam
+
+    def family(self, name: str) -> Optional[_Family]:
+        """Look up an existing family by name (None when absent) — the
+        SLO monitor windows registered histograms without creating
+        them."""
+        with self._lock:
+            return self._families.get(name)
 
     def counter(self, name: str, help: str = "",
                 labels: Iterable[str] = ()) -> _Family:
@@ -427,6 +444,12 @@ _EPOCH = time.perf_counter()
 
 def _now_us() -> float:
     return (time.perf_counter() - _EPOCH) * 1e6
+
+
+def now_us() -> float:
+    """Microseconds since the trace epoch — the ``ts`` clock every ring
+    event carries (the flight recorder windows the ring against it)."""
+    return _now_us()
 
 
 _tls = threading.local()
@@ -755,6 +778,7 @@ def start_http_server(port: int) -> int:
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):
+                code = 200
                 if self.path.startswith("/metrics.json"):
                     body = json.dumps(_registry.snapshot()).encode()
                     ctype = "application/json"
@@ -765,11 +789,32 @@ def start_http_server(port: int) -> int:
                     body = json.dumps(
                         {"traceEvents": _tracer.events()}).encode()
                     ctype = "application/json"
+                elif self.path.startswith("/healthz"):
+                    # fleet health rollup (ok|degraded|breach with
+                    # per-SLO reasons) from the lock-free probes —
+                    # never compiles, never blocks behind a mid-tick
+                    # replica (runtime/flightrec.py; deferred import:
+                    # flightrec imports this module at top)
+                    from flexflow_tpu.runtime import flightrec
+
+                    roll = flightrec.health_rollup()
+                    body = json.dumps(roll).encode()
+                    ctype = "application/json"
+                    # an alerting scraper keys on the status code: only
+                    # a BREACH is load-shed-worthy; degraded still
+                    # serves
+                    code = 503 if roll["status"] == "breach" else 200
+                elif self.path.startswith("/slo.json"):
+                    from flexflow_tpu.runtime import flightrec
+
+                    body = json.dumps(
+                        flightrec.slo_monitor().describe()).encode()
+                    ctype = "application/json"
                 else:
                     self.send_response(404)
                     self.end_headers()
                     return
-                self.send_response(200)
+                self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""CI observability smoke (ci/run_ci.sh `obs` tier, ISSUE 13).
+"""CI observability smoke (ci/run_ci.sh `obs` tier, ISSUES 13 + 15).
 
 A 1-prefill/2-decode fleet serves a skewed shared-prefix workload with
 FF_FAULT crashing a DECODE replica mid-flight (handoffs keep flowing
@@ -21,13 +21,26 @@ trace-event JSON. Proves the ISSUE-13 acceptance end to end on CPU:
   * the exported JSON is perfetto-loadable (traceEvents list, complete
     events carry name/ph/ts/pid/tid/dur).
 
+The ISSUE-15 legs on top:
+
+  * POST-MORTEM — the crash drill's trigger storm (fault annotation +
+    replica fence) must yield exactly ONE manifest-intact bundle naming
+    its trigger cause, whose embedded trace holds COMPLETE span trees
+    for every failed-over request;
+  * SLO — a deterministic TTFT breach via the ``slow(<ms>)@serve:<n>``
+    fault flips ``/healthz`` to "breach" within one evaluation window,
+    raises ``ff_slo_breach_total``, and recovers (hysteresis-cleared)
+    under healthy traffic.
+
 Usage: python scripts/obs_smoke.py [N]
 """
 
 import json
 import os
 import sys
+import tempfile
 import time
+import urllib.error
 import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -40,16 +53,26 @@ import numpy as np  # noqa: E402
 
 from flexflow_tpu import FFConfig, FFModel  # noqa: E402
 from flexflow_tpu.models.llama import llama_lm  # noqa: E402
-from flexflow_tpu.runtime import faultinject, telemetry  # noqa: E402
+from flexflow_tpu.runtime import (faultinject, flightrec,  # noqa: E402
+                                  telemetry)
 
 VOCAB = 128
 PS = 8
 CRASH_REPLICA = 1       # a decode replica: handoffs keep flowing
+FLIGHT_DIR = tempfile.mkdtemp(prefix="ff_obs_flightrec_")
 
 
 def build_model():
     cfg = FFConfig(batch_size=2, mesh_shape={"data": 1}, serve_slots=4,
-                   kv_page_size=PS, metrics_port=0)
+                   kv_page_size=PS, metrics_port=0,
+                   # ISSUE 15: bundle on trigger. The debounce is huge so
+                   # the drill's whole trigger storm stays ONE pending
+                   # record that flush() publishes after the fleet
+                   # settles — the bundle's trace then holds the
+                   # failover aftermath, not just the crash instant
+                   flight_recorder_dir=FLIGHT_DIR,
+                   flight_debounce_s=600.0, flight_cooldown_s=600.0,
+                   flight_window_s=600.0)
     ff = FFModel(cfg)
     _, logits = llama_lm(ff, 2, seq_len=16, hidden=64, layers=1, heads=4,
                          kv_heads=2, vocab_size=VOCAB)
@@ -87,7 +110,10 @@ def assert_scrape(text):
                    "ff_router_fenced", "ff_router_resubmitted",
                    "ff_router_timeouts", "ff_router_rejected",
                    "ff_router_handoffs", "ff_fleet_prefix_hits",
-                   "ff_router_replica_up"):
+                   "ff_router_replica_up",
+                   # ISSUE 15: the HBM accounting ledger rides every
+                   # scrape (per-subsystem device-memory gauges)
+                   "ff_hbm_bytes", "ff_hbm_total_tracked_bytes"):
         assert needle in text, f"scrape missing {needle}"
     for r, role in ((0, "prefill"), (1, "decode"), (2, "decode")):
         assert f'replica="{r}",role="{role}"' in text, \
@@ -110,6 +136,124 @@ def assert_trace_file(path):
     print(f"obs_smoke[trace]: {len(evs)} events, chrome/perfetto schema "
           f"valid -> {path}")
     return evs
+
+
+def _bundle_tree_complete(evs, trace_id):
+    """Span-tree completeness re-derived from the BUNDLE's trace file
+    (not the live ring): a root "request" span exists and every other
+    span of the trace id starts inside it."""
+    mine = [e for e in evs
+            if e.get("args", {}).get("trace_id") == trace_id
+            and e["ph"] == "X"]
+    roots = [e for e in mine if e["name"] == "request"]
+    if not roots:
+        return False
+    root = max(roots, key=lambda e: e.get("dur", 0.0))
+    t0, t1 = root["ts"], root["ts"] + root.get("dur", 0.0)
+    return all(t0 - 1.0 <= e["ts"] <= t1 + 1.0
+               for e in mine if e is not root)
+
+
+def postmortem_leg(reqs):
+    """ISSUE 15: the crash drill's trigger storm (crash fault + replica
+    fence) must have produced exactly ONE intact bundle naming its
+    cause, whose trace holds complete span trees for every failed-over
+    request."""
+    path = flightrec.recorder().flush()
+    assert path, "the drill tripped no flight record"
+    bundles = flightrec.list_bundles(FLIGHT_DIR)
+    assert len(bundles) == 1, \
+        f"crash storm must write ONE bundle, found {bundles}"
+    flightrec.verify_bundle(path)          # manifest-intact
+    trig = json.load(open(os.path.join(path, "trigger.json")))
+    causes = [trig["cause"]] + [m["cause"]
+                                for m in trig["merged_triggers"]]
+    # the crash fault annotation fires first (it opens the pending
+    # record); the fence it causes merges in
+    assert trig["cause"] == "fault" \
+        and trig["args"]["kind"] == "crash", trig
+    assert "replica_fence" in causes, causes
+    assert trig["stack"]
+    evs = json.load(open(os.path.join(path, "trace.json")))["traceEvents"]
+    failed_over = [r for r in reqs if r.losses >= 1 and r.state == "done"]
+    assert failed_over, "the crash caught no in-flight work"
+    for r in failed_over:
+        assert _bundle_tree_complete(evs, r.trace_id), \
+            f"bundle trace incomplete for failed-over {r.trace_id}"
+    engines = json.load(open(os.path.join(path, "engines.json")))
+    assert "router" in engines and engines["router"]["stats"]["fenced"] == 1
+    hbm = json.load(open(os.path.join(path, "hbm.json")))
+    assert any(s.get("kv_pool", 0) > 0 for s in hbm["sources"].values())
+    print(f"obs_smoke[postmortem]: ONE intact bundle ({causes}), "
+          f"{len(failed_over)} failed-over span trees complete, "
+          f"router + HBM ledger embedded -> {path}")
+
+
+def healthz(port):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def slo_leg(ff, port):
+    """ISSUE 15: a deterministic TTFT breach (the slow() admission
+    fault) flips /healthz to "breach" within one evaluation window and
+    recovers under healthy traffic (hysteresis)."""
+    window_s = 0.5
+    ff.config.slo_ttft_p99_s = 0.15
+    ff.config.slo_window_s = window_s
+    ff.config.slo_clear_windows = 2
+    try:
+        eng = ff.make_serving_engine(max_seq_len=64,
+                                     decode_buckets=[16])
+        eng.set_telemetry_identity("slo", "solo")
+        rs = np.random.RandomState(17)
+        prompts = [rs.randint(1, VOCAB, (8,)).astype(np.int32)
+                   for _ in range(4)]
+        eng.warmup(prompts, max_new_tokens=4)  # also rebaselines SLOs
+        code, roll = healthz(port)
+        assert code == 200 and roll["status"] != "breach", roll
+        # the drill: stall the next admission 400ms >> the 150ms ceiling
+        os.environ["FF_FAULT"] = "slow(400)@serve:1"
+        faultinject.reset()
+        t0 = time.perf_counter()
+        eng.run(prompts, max_new_tokens=4)
+        deadline = t0 + 12 * window_s
+        code = 200
+        while time.perf_counter() < deadline:
+            code, roll = healthz(port)     # the GET drives evaluation
+            if roll["status"] == "breach":
+                break
+            time.sleep(0.05)
+        t_breach = time.perf_counter() - t0
+        assert roll["status"] == "breach", \
+            f"no breach within {deadline - t0:.1f}s: {roll}"
+        assert code == 503
+        assert isinstance(roll["slos"]["ttft_p99"], list)
+        text = scrape(port)
+        assert 'ff_slo_breach_total{slo="ttft_p99"' in text
+        assert 'ff_slo_margin{slo="ttft_p99"' in text
+        # recovery: healthy traffic through clear_windows windows
+        deadline = time.perf_counter() + 30 * window_s
+        while time.perf_counter() < deadline:
+            eng.run(prompts[:2], max_new_tokens=2)
+            code, roll = healthz(port)
+            if roll["status"] != "breach":
+                break
+            time.sleep(0.05)
+        assert roll["status"] != "breach", f"breach never cleared: {roll}"
+        assert code == 200
+        print(f"obs_smoke[slo]: /healthz flipped to breach "
+              f"{t_breach:.2f}s after the slow() fault "
+              f"(window {window_s}s) and recovered to "
+              f"{roll['status']!r}")
+    finally:
+        os.environ.pop("FF_FAULT", None)
+        faultinject.reset()
+        ff.config.slo_ttft_p99_s = 0.0
 
 
 def main():
@@ -233,7 +377,22 @@ def main():
     for r in (0, 2):
         assert router.engines[r].recompile_count == warm_compiles[r], \
             f"replica {r} recompiled after warmup"
+
+    # ISSUE 15 leg 1: the crash drill's post-mortem bundle
+    postmortem_leg(reqs)
+
     router.close()
+    # drop the drilled fleet so its weakly-held health probes die —
+    # the SLO leg's recovery must read the solo engine's health, not a
+    # permanently-fenced corpse
+    del router
+    import gc
+
+    gc.collect()
+
+    # ISSUE 15 leg 2: deterministic SLO breach + /healthz flip + recovery
+    slo_leg(ff, port)
+
     telemetry.stop_http_server()
     print("obs_smoke: PASSED")
 

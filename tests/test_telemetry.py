@@ -467,13 +467,22 @@ def test_engine_prefix_hit_span_kind(ff):
     assert k2["kind"] == "hit" and k2["matched_pages"] == 2
 
 
-def test_engine_telemetry_off_is_silent(ff):
+def test_engine_telemetry_off_is_silent(ff, tmp_path):
+    from flexflow_tpu.runtime import flightrec
+
     cfg_prev = ff.config.telemetry
+    fr_prev = ff.config.flight_recorder_dir
     ff.config.telemetry = "off"
+    # the flight recorder + SLO evaluator (ISSUE 15) must short-circuit
+    # at the SAME single predicate: even with a bundle directory and an
+    # SLO spec configured, "off" silences them alongside every emit
+    ff.config.flight_recorder_dir = str(tmp_path)
+    ff.config.slo_ttft_p99_s = 0.001
     try:
         eng = ff.make_serving_engine(max_seq_len=32, kv_page_size=8)
         eng.set_telemetry_identity("off0", "off-test")
         ring_before = len(telemetry.tracer())
+        log_before = len(flightrec.log_ring())
         reqs = eng.run(_prompts(7, [5, 9]), max_new_tokens=3)
         assert all(r.state == "done" for r in reqs)
         hist = telemetry.registry().histogram(
@@ -481,8 +490,20 @@ def test_engine_telemetry_off_is_silent(ff):
         assert hist.labels("off0", "off-test").count == 0
         assert not telemetry.tracer().events(trace_id=reqs[0].trace_id)
         assert len(telemetry.tracer()) == ring_before
+        # engine construction itself configured the recorder with
+        # telemetry="off" (the call is unconditional for exactly this):
+        # even with a directory and SLO specs set, every piece is silent
+        flightrec.trip("engine_exception", replica="off0")
+        assert flightrec.recorder().wait_pending(2.0)
+        assert flightrec.list_bundles(str(tmp_path)) == []
+        assert flightrec.slo_monitor().maybe_evaluate() == []
+        assert flightrec.slo_monitor().evaluate() == []
+        assert len(flightrec.log_ring()) == log_before
     finally:
         ff.config.telemetry = cfg_prev
+        ff.config.flight_recorder_dir = fr_prev
+        ff.config.slo_ttft_p99_s = 0.0
+        flightrec.reset()
 
 
 def test_router_trace_tree_complete(ff):
