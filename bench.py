@@ -481,6 +481,50 @@ def _run_serving_tier(n_dev, backend, dev_kind):
     fr_on_tps = fr_on_tokens / t_fr_on
     flightrec_overhead_pct = round(
         100.0 * (fr_off_tps - fr_on_tps) / max(fr_off_tps, 1e-9), 2)
+
+    # ffsan honesty (ISSUE 16): the sanitizer's marginal cost on the
+    # decode path, same interleaved discipline. The engine was built
+    # with the sanitizer off, so its locks are raw threading primitives
+    # in BOTH arms (proxying is decided at lock creation); the mode
+    # toggle here switches the armed retrace sentinel, which brackets
+    # every jit dispatch with a cache-size probe — the per-token
+    # dynamic cost. The off arm's residual is one module-global read
+    # per dispatch, a strict subset of the on arm, so this stamp
+    # upper-bounds the production sanitizer-off overhead (budget
+    # <= 0.5%).
+    _phase("time_serving_sanitize_off")
+    from flexflow_tpu.runtime import locks as _san
+
+    t_sz_on = t_sz_off = 0.0
+    sz_on_tokens = sz_off_tokens = 0
+    sz_off_recompiles = sz_retraces = 0
+    _san_prev = _san.mode()
+    try:
+        for _ in range(5):
+            for arm_on in (True, False):
+                _san.set_mode("on" if arm_on else "off")
+                before_arm = eng.stats()["tokens_generated"]
+                rc0 = eng.recompile_count
+                t0 = time.perf_counter()
+                eng.run(prompts, max_new_tokens=SERVE_MAX_NEW)
+                dt = time.perf_counter() - t0
+                toks = eng.stats()["tokens_generated"] - before_arm
+                if arm_on:
+                    sz_on_tokens += toks
+                    t_sz_on += dt
+                else:
+                    sz_off_tokens += toks
+                    t_sz_off += dt
+                    sz_off_recompiles += eng.recompile_count - rc0
+    finally:
+        sz_retraces = len(_san.retrace_log())
+        _san.set_mode(_san_prev)
+        _san.reset()   # the warm bench engine must not retrace; any
+        #                hit is reported below, not left in the ring
+    sz_off_tps = sz_off_tokens / t_sz_off
+    sz_on_tps = sz_on_tokens / t_sz_on
+    sanitize_overhead_pct = round(
+        100.0 * (sz_off_tps - sz_on_tps) / max(sz_off_tps, 1e-9), 2)
     # timed-window metrics only: TTFT percentiles from this window's
     # requests (the engine's lifetime stats would smuggle the warmup's
     # compile-inflated TTFTs into p99), occupancy from snapshot deltas
@@ -539,7 +583,13 @@ def _run_serving_tier(n_dev, backend, dev_kind):
                          "flightrec_overhead_pct":
                              flightrec_overhead_pct,
                          "flightrec_off_tokens_per_s":
-                             round(fr_off_tps, 2)}}
+                             round(fr_off_tps, 2),
+                         # ISSUE 16: the runtime sanitizer's marginal
+                         # cost (armed retrace sentinel; budget <= 0.5%)
+                         "sanitize_overhead_pct":
+                             sanitize_overhead_pct,
+                         "sanitize_off_tokens_per_s":
+                             round(sz_off_tps, 2)}}
     yield {
         "metric": "decode_throughput", "tier": "decode_throughput",
         "value": round(serve_tps, 2), "unit": "tokens/s",
@@ -550,6 +600,8 @@ def _run_serving_tier(n_dev, backend, dev_kind):
         "recompiles_after_warmup": extra_recompiles,
         "recompiles_in_telemetry_off_window": off_recompiles,
         "recompiles_in_flightrec_off_window": fr_off_recompiles,
+        "recompiles_in_sanitize_off_window": sz_off_recompiles,
+        "sanitizer_retraces_in_on_window": sz_retraces,
         "occupancy": round(occupancy, 4), **common,
     }
     yield {

@@ -102,8 +102,15 @@
 #               failover, adapter evicted + re-faulted under pool
 #               pressure, zero warm-window recompiles, per-adapter
 #               telemetry series present
+#   sanitize  — ffsan plane (ISSUE 16): static concurrency/
+#               tracestability passes clean over runtime/ (tiered exit:
+#               warnings fail too) + the seeded-violation harness, then
+#               the router and disagg crash-drill smokes re-run under
+#               FF_SANITIZE=1 (order-asserting lock proxies + armed
+#               retrace sentinels) asserting zero violations and zero
+#               post-warmup retraces
 #
-# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|kernels|quant|disagg|obs|router|tenancy|all]
+# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|kernels|quant|disagg|obs|router|tenancy|sanitize|all]
 set -e
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -291,6 +298,26 @@ run_router() {
   FF_FAULT="crash(10)@replica:0" python scripts/router_smoke.py 200
 }
 
+# sanitize tier (ISSUE 16): the ffsan plane, both halves. Static: the
+# concurrency + tracestability source passes must be CLEAN over
+# flexflow_tpu/runtime (severity-tiered exit codes: any error OR
+# warning fails the tier) and the seeded-violation harness in
+# tests/test_ffsan.py must still catch every planted bug class.
+# Dynamic: the router and disagg smokes re-run with their crash drills
+# under FF_SANITIZE=1 — every runtime lock is an order-asserting proxy
+# and every engine sentinel is armed after warmup; the smokes assert
+# zero lock-order violations and zero post-warmup retraces before
+# printing PASSED.
+run_sanitize() {
+  python -m flexflow_tpu.analysis \
+    --passes concurrency,tracestability --tiered-exit
+  python -m pytest tests/test_ffsan.py -q
+  FF_SANITIZE=1 FF_FAULT="crash(10)@replica:0" \
+    python scripts/router_smoke.py 200
+  FF_SANITIZE=1 FF_FAULT="crash(6)@replica:0" \
+    python scripts/disagg_smoke.py 160
+}
+
 # tenancy tier (ISSUE 14): the multi-tenant suites — per-slot sampling
 # + paged LoRA adapter pool (test_tenancy) and rejection-sampled
 # speculation property/reproducibility tests (test_sampled_spec, slow
@@ -321,7 +348,8 @@ case "$TIER" in
   obs)      run_obs ;;
   router)   run_router ;;
   tenancy)  run_tenancy ;;
-  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_kernels; run_quant; run_disagg; run_obs; run_router; run_tenancy; run_native; run_docs; run_sweep ;;
+  sanitize) run_sanitize ;;
+  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_kernels; run_quant; run_disagg; run_obs; run_router; run_tenancy; run_sanitize; run_native; run_docs; run_sweep ;;
   *) echo "unknown tier $TIER"; exit 2 ;;
 esac
 echo "ci($TIER): PASSED"

@@ -39,7 +39,8 @@ PAGES = [("index", os.path.join(ROOT, "README.md"), "Overview"),
           "Observability (metrics registry, per-request tracing, "
           "Prometheus/JSON export)"),
          ("analysis", os.path.join(DOCS, "analysis.md"),
-          "fflint static analysis"),
+          "fflint static analysis (strategy passes + ffsan "
+          "concurrency/trace-stability passes & runtime sanitizer)"),
          ("install", os.path.join(ROOT, "INSTALL.md"), "Install")]
 # every round-notes file, newest first (numeric: round10 > round9)
 _rounds = []

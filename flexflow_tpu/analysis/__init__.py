@@ -7,15 +7,27 @@ rejected in milliseconds with a named op + pass + rule instead of a
 40-second collective-rendezvous hang or an XLA compile error with no
 line back to the offending axis.
 
-Three passes (each a module here):
+Three strategy passes (each a module here):
   legality  — can this strategy execute on this mesh at all?
   perf      — legal but pathological: ranked reshard collectives,
               replicated big weights, HBM footprint, pipeline bubbles.
   schema    — the strategy text file itself + exact save/load round-trip.
 
+Two ffsan SOURCE passes (sanitize/ package, ISSUE 16) applying the
+same millisecond-static-rejection philosophy to the threaded runtime
+itself — no model or strategy file needed:
+  concurrency     — lock-order inversions against the declared
+                    hierarchy (runtime/locks.py), locks held across
+                    blocking calls, raw-lock registry bypasses.
+  tracestability  — retrace hazards: uncommitted device_put,
+                    shape-dependent device-array slicing in serving
+                    hot paths, jnp.* dispatch under a lock.
+
 Entry points:
   analyze(model, ...)        -> Report            (library)
+  sanitize.analyze_sources() -> Report            (library, ffsan)
   python -m flexflow_tpu.analysis MODEL FILE      (CLI, see __main__)
+  python -m flexflow_tpu.analysis --passes concurrency,tracestability
   FFModel.compile()                               (FFConfig.strategy_lint:
                                                    "off" | "warn" | "strict")
 """
@@ -28,9 +40,12 @@ from flexflow_tpu.analysis.report import (Report, StrategyLintError,
                                           Violation)
 
 __all__ = ["analyze", "Report", "Violation", "StrategyLintError",
-           "ALL_PASSES"]
+           "ALL_PASSES", "SOURCE_PASSES"]
 
 ALL_PASSES = ("legality", "perf", "schema")
+# the ffsan source passes (analysis/sanitize): selected by name in
+# the CLI alongside the strategy passes, run via analyze_sources()
+SOURCE_PASSES = ("concurrency", "tracestability")
 
 
 def analyze(model, strategies: Optional[Dict] = None,

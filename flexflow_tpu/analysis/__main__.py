@@ -1,17 +1,31 @@
 """fflint CLI.
 
+Strategy/graph passes (need a model + strategy file):
+
     python -m flexflow_tpu.analysis MODEL STRATEGY_FILE \
-        [--mesh data=4,model=2] [--strict] [--json] \
+        [--mesh data=4,model=2] [--strict] [--format json] \
         [--passes legality,perf,schema] [--model-arg k=v ...]
+
+ffsan source passes (no model, no strategy file — pure AST over
+flexflow_tpu/runtime by default):
+
+    python -m flexflow_tpu.analysis --passes concurrency,tracestability \
+        [--path DIR_OR_FILE ...] [--format json] [--tiered-exit]
 
 MODEL: a builtin graph name (mlp | transformer | dlrm | pipeline), a
 `package.module:callable` spec, or `none` for a schema-only check of the
-file. Exit codes: 0 = clean (info notes allowed), 1 = violations found
-(errors; warnings too under --strict), 2 = usage / model-build failure.
+file. The two pass families compose: naming both runs both and merges
+the reports.
+
+Exit codes (default, pinned since PR 1): 0 = clean (info notes
+allowed), 1 = violations found (errors; warnings too under --strict),
+2 = usage / model-build failure. With --tiered-exit (what the CI lint
+tier consumes): 0 = clean, 1 = warnings only, 2 = errors,
+64 = usage / model-build failure.
 
 Pure static analysis: no jax.sharding.Mesh is built and nothing traces —
-a bad strategy is named in milliseconds, not after a 40 s collective
-rendezvous timeout.
+a bad strategy (or a lock-order inversion) is named in milliseconds, not
+after a 40 s collective rendezvous timeout.
 """
 
 from __future__ import annotations
@@ -21,6 +35,9 @@ import sys
 
 from flexflow_tpu.analysis import ALL_PASSES, analyze
 from flexflow_tpu.analysis.models import BUILTIN, build_model
+from flexflow_tpu.analysis.sanitize import SOURCE_PASSES, analyze_sources
+
+EX_USAGE = 64       # --tiered-exit usage/build failure (sysexits.h)
 
 
 def parse_mesh(spec: str):
@@ -52,52 +69,99 @@ def _parse_model_args(pairs):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m flexflow_tpu.analysis",
-        description="fflint: static strategy & sharding analyzer")
-    ap.add_argument("model",
+        description="fflint: static strategy/sharding + concurrency/"
+                    "trace-stability analyzer")
+    ap.add_argument("model", nargs="?", default=None,
                     help=f"builtin graph ({', '.join(sorted(BUILTIN))}), "
-                         f"'module:callable', or 'none' for schema-only")
-    ap.add_argument("strategy_file", help="strategy file to analyze")
+                         f"'module:callable', or 'none' for schema-only "
+                         f"(optional when only source passes run)")
+    ap.add_argument("strategy_file", nargs="?", default=None,
+                    help="strategy file to analyze (optional when only "
+                         "source passes run)")
     ap.add_argument("--mesh", default="data=8",
                     help="mesh shape, e.g. data=4,model=2 (default data=8)")
     ap.add_argument("--passes", default=",".join(ALL_PASSES),
                     help="comma-separated subset of: "
-                         + ",".join(ALL_PASSES))
+                         + ",".join(ALL_PASSES + SOURCE_PASSES)
+                         + f" (default: {','.join(ALL_PASSES)})")
+    ap.add_argument("--path", action="append", default=[],
+                    metavar="DIR_OR_FILE",
+                    help="source-pass target (repeatable; default: "
+                         "flexflow_tpu/runtime)")
     ap.add_argument("--model-arg", action="append", default=[],
                     metavar="K=V", help="builder kwarg (repeatable), "
                     "e.g. --model-arg layers=4")
     ap.add_argument("--strict", action="store_true",
                     help="warnings also fail (exit 1)")
+    ap.add_argument("--format", choices=("text", "json"), default=None,
+                    dest="fmt",
+                    help="report format on stdout (default text)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="machine-readable report on stdout")
+                    help="alias for --format json")
+    ap.add_argument("--tiered-exit", action="store_true",
+                    help="severity-tiered exit codes: 0 clean, "
+                         "1 warnings only, 2 errors, 64 usage (the CI "
+                         "contract; default keeps the pinned 0/1/2)")
     ap.add_argument("--quiet", action="store_true",
                     help="suppress info notes in text output")
     args = ap.parse_args(argv)
+    as_json = args.as_json or args.fmt == "json"
+
+    def usage(msg: str) -> int:
+        if args.tiered_exit:
+            print(f"fflint: {msg}", file=sys.stderr)
+            return EX_USAGE
+        ap.error(msg)   # exits 2
 
     passes = tuple(p.strip() for p in args.passes.split(",") if p.strip())
-    unknown = [p for p in passes if p not in ALL_PASSES]
+    unknown = [p for p in passes
+               if p not in ALL_PASSES + SOURCE_PASSES]
     if unknown:
-        ap.error(f"unknown pass(es) {unknown}; valid: {ALL_PASSES}")
+        return usage(f"unknown pass(es) {unknown}; valid: "
+                     f"{ALL_PASSES + SOURCE_PASSES}")
+    model_passes = tuple(p for p in passes if p in ALL_PASSES)
+    source_passes = tuple(p for p in passes if p in SOURCE_PASSES)
+    if model_passes and (args.model is None or args.strategy_file is None):
+        return usage(
+            f"passes {model_passes} analyze a model + strategy file — "
+            f"give both positionals, or select only source passes "
+            f"({', '.join(SOURCE_PASSES)})")
     try:
         mesh = parse_mesh(args.mesh)
         model_args = _parse_model_args(args.model_arg)
     except ValueError as e:
-        ap.error(str(e))
+        return usage(str(e))
 
-    model = None
-    if args.model != "none":
-        try:
-            model = build_model(args.model, mesh, model_args)
-        except Exception as e:
-            print(f"fflint: cannot build model {args.model!r}: "
-                  f"{type(e).__name__}: {e}", file=sys.stderr)
-            return 2
+    report = None
+    if model_passes:
+        model = None
+        if args.model != "none":
+            try:
+                model = build_model(args.model, mesh, model_args)
+            except Exception as e:
+                print(f"fflint: cannot build model {args.model!r}: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+                return EX_USAGE if args.tiered_exit else 2
+        report = analyze(model, mesh_shape=mesh, passes=model_passes,
+                         strategy_file=args.strategy_file)
+    if source_passes:
+        src_report = analyze_sources(
+            paths=args.path or None, passes=source_passes)
+        if report is None:
+            report = src_report
+        else:
+            report.extend(src_report.violations)
 
-    report = analyze(model, mesh_shape=mesh, passes=passes,
-                     strategy_file=args.strategy_file)
-    if args.as_json:
+    if as_json:
         print(report.to_json())
     else:
         print(report.format_text(include_notes=not args.quiet))
+    if args.tiered_exit:
+        if report.errors():
+            return 2
+        if report.warnings():
+            return 1
+        return 0
     failed = bool(report.errors()) or (args.strict
                                        and bool(report.warnings()))
     return 1 if failed else 0

@@ -32,19 +32,28 @@ SEVERITIES = ("error", "warning", "info")
 @dataclasses.dataclass
 class Violation:
     code: str            # stable kebab-case rule id, e.g. "axis-unknown"
-    pass_name: str       # "legality" | "perf" | "schema"
+    pass_name: str       # "legality" | "perf" | "schema" | the ffsan
+    #                      source passes "concurrency" | "tracestability"
     severity: str        # "error" | "warning" | "info"
     message: str
-    op_name: Optional[str] = None   # offending op (None for whole-file issues)
+    op_name: Optional[str] = None   # offending op (None for whole-file
+    #                                 issues); ffsan passes put the
+    #                                 function/method qualname here
     # perf ranking key: estimated bytes moved by the flagged collective
     est_bytes: Optional[float] = None
     est_seconds: Optional[float] = None
+    # source location (ffsan passes — None for strategy/graph passes,
+    # which have no file:line to point at)
+    file: Optional[str] = None
+    line: Optional[int] = None
 
     def __post_init__(self):
         assert self.severity in SEVERITIES, self.severity
 
     def __str__(self) -> str:
         where = f" op {self.op_name!r}" if self.op_name else ""
+        if self.file:
+            where += f" {self.file}:{self.line}"
         return (f"{self.severity}[{self.pass_name}/{self.code}]{where}: "
                 f"{self.message}")
 

@@ -1,0 +1,114 @@
+"""ffsan — static concurrency & trace-stability analysis (ISSUE 16).
+
+fflint's philosophy (millisecond static rejection instead of a
+40-second runtime hang) applied to the two bug classes that have cost
+this repo the most debugging time: lock-order deadlocks in the threaded
+serving stack and silent jit retraces of warm programs.
+
+Two source-level passes over ``flexflow_tpu/runtime`` (no model, no
+strategy file, no jax import — pure ``ast``):
+
+  concurrency     — extracts the lock graph (which locks each function
+                    acquires, ``with self._lock``-style attributes
+                    resolved through the declared hierarchy in
+                    runtime/locks.py, propagated through the intra-repo
+                    call graph) and reports acquisition-order
+                    inversions, locks held across blocking calls, and
+                    raw ``threading.Lock()`` creations that bypass the
+                    registry.
+  tracestability  — retrace hazards: un-committed ``device_put`` (the
+                    PR-3 lesson: an uncommitted array feeding a jitted
+                    program silently retraces it), shape-dependent
+                    Python slicing of device arrays, and ``jnp.*``
+                    dispatch while holding a runtime lock (op-by-op
+                    tracing under a lock every tick).
+
+By-design sites are waived with an end-of-line pragma::
+
+    something()   # ffsan: allow(<code>) — why this is safe
+
+and the ONE structural waiver both passes share: the ENGINE lock is
+documented (serving.py tick contract) to be held across the whole tick
+including the device dispatch, so engine-lock-across-dispatch is not a
+finding. The runtime sanitizer (FF_SANITIZE=1, runtime/locks.py) is the
+dynamic complement that catches what the AST cannot see.
+
+Entry points:
+  analyze_sources(paths, passes) -> Report       (library)
+  python -m flexflow_tpu.analysis --passes concurrency,tracestability
+                                                 (CLI, see __main__)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, List, Optional
+
+from flexflow_tpu.analysis.report import Report, Violation
+
+__all__ = ["SOURCE_PASSES", "analyze_sources", "default_paths"]
+
+SOURCE_PASSES = ("concurrency", "tracestability")
+
+
+def default_paths() -> List[str]:
+    """The default analysis target: every .py file in
+    flexflow_tpu/runtime (the threaded, jit-dispatching layer whose
+    invariants these passes pin)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    runtime = os.path.join(os.path.dirname(os.path.dirname(here)),
+                           "runtime")
+    return [runtime]
+
+
+def _py_files(paths: Iterable[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for name in sorted(os.listdir(p)):
+                if name.endswith(".py"):
+                    out.append(os.path.join(p, name))
+        elif p.endswith(".py"):
+            out.append(p)
+        else:
+            raise FileNotFoundError(
+                f"ffsan: {p!r} is neither a directory nor a .py file")
+    return out
+
+
+def analyze_sources(paths: Optional[Iterable[str]] = None,
+                    passes: Iterable[str] = SOURCE_PASSES) -> Report:
+    """Run the requested source passes. Same contract as analyze():
+    nothing raises on bad code — everything is a Violation; an internal
+    analyzer fault degrades to an ``internal-error`` warning."""
+    from flexflow_tpu.analysis.sanitize.concurrency import check_concurrency
+    from flexflow_tpu.analysis.sanitize.lockgraph import build_lockgraph
+    from flexflow_tpu.analysis.sanitize.tracestability import (
+        check_tracestability)
+
+    report = Report()
+    files = _py_files(paths if paths is not None else default_paths())
+    try:
+        graph = build_lockgraph(files)
+    except Exception as e:   # never let the analyzer crash the caller
+        report.add(Violation(
+            code="internal-error", pass_name="concurrency",
+            severity="warning",
+            message=f"lock-graph extraction crashed: "
+                    f"{type(e).__name__}: {e}"))
+        return report
+    if "concurrency" in passes:
+        _guard(report, "concurrency", lambda: check_concurrency(graph))
+    if "tracestability" in passes:
+        _guard(report, "tracestability",
+               lambda: check_tracestability(graph))
+    return report
+
+
+def _guard(report: Report, name: str, fn) -> None:
+    try:
+        report.extend(fn())
+    except Exception as e:
+        report.add(Violation(
+            code="internal-error", pass_name=name, severity="warning",
+            message=f"{name} pass crashed: {type(e).__name__}: {e}"))

@@ -366,6 +366,18 @@ class FFConfig:
     slo_window_s: float = 10.0           # sliding evaluation window
     slo_clear_windows: int = 2           # hysteresis: healthy windows
     #                                      required to clear a breach
+    # ---- ffsan runtime sanitizer (runtime/locks.py, ISSUE 16) ----
+    # "" (default) leaves the env-derived FF_SANITIZE mode alone
+    # (off unless the env sets it). "on": runtime locks created
+    # from here on become order-asserting proxies checking every
+    # acquisition against the declared hierarchy, and the engines'
+    # retrace sentinel reports any post-warmup jit cache miss —
+    # both routed to the flight recorder as incidents. "strict":
+    # same checks, but violations raise. "off": force-disable.
+    # Module-level locks (telemetry, native loader) are created at
+    # import, before any FFConfig exists — set FF_SANITIZE for
+    # process-wide coverage (what the CI sanitize tier does).
+    sanitize: str = ""
     slo_trip_recorder: bool = False      # breach also trips the recorder
 
     # populated at FFModel construction
@@ -464,6 +476,10 @@ class FFConfig:
         if self.serve_lora_rank < 1:
             raise ValueError(
                 f"serve_lora_rank={self.serve_lora_rank}: must be >= 1")
+        if self.sanitize not in ("", "off", "on", "strict"):
+            raise ValueError(
+                f"sanitize={self.sanitize!r}: must be '', 'off', "
+                f"'on' or 'strict'")
         if self.telemetry not in ("on", "off"):
             raise ValueError(
                 f"telemetry={self.telemetry!r}: must be 'on' or 'off'")
@@ -663,6 +679,12 @@ class FFConfig:
                        help="unified telemetry plane: metrics registry "
                             "+ per-request trace ring (off = every "
                             "emit short-circuits)")
+        p.add_argument("--sanitize", type=str, default="",
+                       choices=("", "off", "on", "strict"),
+                       help="ffsan runtime sanitizer: lock-order "
+                            "asserting proxies + post-warmup "
+                            "retrace sentinel ('' = follow "
+                            "FF_SANITIZE; strict raises)")
         p.add_argument("--metrics-port", type=int, default=0,
                        help="serve Prometheus /metrics (+ /metrics.json"
                             ", /trace.json, /healthz, /slo.json) on "
@@ -770,6 +792,7 @@ class FFConfig:
             kv_cache_dtype=args.kv_cache_dtype,
             serve_weight_dtype=args.serve_weight_dtype,
             telemetry=args.telemetry,
+            sanitize=args.sanitize,
             metrics_port=args.metrics_port,
             flight_recorder_dir=args.flight_recorder_dir,
             flight_keep=args.flight_keep,

@@ -51,7 +51,7 @@ import numpy as np
 
 from flexflow_tpu.parallel.strategy import (load_strategies_from_file,
                                             save_strategies_to_file)
-from flexflow_tpu.runtime import faultinject
+from flexflow_tpu.runtime import faultinject, locks
 from flexflow_tpu.runtime.resilience import retry
 
 
@@ -599,7 +599,7 @@ class _AsyncSaver:
     ``wait_pending_saves`` first."""
 
     def __init__(self):
-        self._cond = threading.Condition()
+        self._cond = locks.make_condition("checkpoint-saver")
         self._queue: collections.deque = collections.deque()
         self._active: Optional[str] = None  # directory being published
         self._errors: List[tuple] = []
@@ -840,6 +840,9 @@ def _restore_into(model, directory: str, step: int) -> int:
         fresh = model.optimizer.init_state(model.params)
         model.opt_state = _merge_restored(fresh, restored["opt_state"])
     if "bn_state" in restored:
+        # ffsan: allow(uncommitted-device-put) — one-time restore
+        # placement of replicated BN state, matching how init
+        # placed it; the post-restore step compiles fresh anyway
         model.bn_state = {k: {n: jax.device_put(np.asarray(v))
                               for n, v in s.items()}
                           for k, s in restored["bn_state"].items()}

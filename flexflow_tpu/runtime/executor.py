@@ -693,6 +693,9 @@ def reshard_tree(host_tree, shardings):
         out[op_name] = {
             name: jax.device_put(np.asarray(v), per_op.get(name))
             if per_op.get(name) is not None
+            # ffsan: allow(uncommitted-device-put) — ops without a
+            # recorded sharding deliberately take default placement
+            # (restore-time, before any program is warm)
             else jax.device_put(np.asarray(v))
             for name, v in ws.items()}
     return out

@@ -67,7 +67,7 @@ import weakref
 from typing import Callable, Dict, List, Optional, Tuple
 
 from flexflow_tpu.logger import fflogger
-from flexflow_tpu.runtime import telemetry
+from flexflow_tpu.runtime import locks, telemetry
 
 __all__ = [
     "FlightRecorder", "SLOMonitor", "HBMLedger", "LogRing",
@@ -134,7 +134,7 @@ class _WeakCallables:
     refs."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("weak-callables")
         self._refs: List[weakref.ref] = []
 
     def register(self, fn: Callable):
@@ -225,7 +225,7 @@ class FlightRecorder:
     not be rate-limited, nor mask the next real incident."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = locks.make_rlock("flightrec")
         self._cfg_on = True           # FFConfig.telemetry != "off"
         self.directory = os.environ.get("FF_FLIGHT_DIR", "")
         self.keep = 4
@@ -493,6 +493,13 @@ class FlightRecorder:
         _write_json(tmp, "engines.json", self._collect_sources())
         _write_json(tmp, "hbm.json", _hbm.snapshot())
         _write_json(tmp, "slo.json", _slo.describe())
+        # ffsan state (ISSUE 16): the declared lock hierarchy, the
+        # live tracked locks, and the violation/retrace evidence
+        # rings — for sanitizer_lock_order / sanitizer_retrace
+        # incidents this IS the post-mortem; for every other cause
+        # it answers "was the sanitizer watching, and was it clean"
+        _write_json(tmp, "sanitizer.json",
+                    locks.lock_graph_snapshot())
         # the manifest is the LAST write into tmp (it covers every other
         # file), then the publish rename — the checkpoint layer's
         # torn-write discipline: a bundle either verifies or never
@@ -658,7 +665,7 @@ class SLOMonitor:
     hysteresis that keeps a flapping metric from strobing alerts."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = locks.make_rlock("slo-monitor")
         self._cfg_on = True
         self.window_s = 10.0
         self.clear_windows = 2
@@ -945,7 +952,7 @@ class HBMLedger:
     ``hbm-footprint`` estimate its compile-time lint already computed)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("hbm-ledger")
         self._sources = _WeakCallables()
         self._registered_on = None
         self.lint_estimated_bytes: Optional[float] = None

@@ -28,6 +28,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from flexflow_tpu.runtime import locks
+
 
 class _Resident:
     __slots__ = ("page", "ref", "last_use")
@@ -49,6 +51,11 @@ class LoraAdapterPool:
             raise ValueError(f"lora rank={rank}: must be >= 1")
         self.pages = int(pages)
         self.rank = int(rank)
+        # The engine lock (rank 20) already serializes every caller;
+        # the pool's own ranked lock (rank 40, nested inner to the
+        # engine's) exists so multi-engine sharing stays safe and so
+        # the sanitizer sees the engine->adapter-pool edge by name.
+        self._lock = locks.make_rlock("adapter-pool")
         # op name -> (in_dim, out_dim): the fixed page geometry every
         # registered adapter must match
         self.geometry = {op.name: (op.in_dim, op.out_dim)
@@ -82,45 +89,46 @@ class LoraAdapterPool:
         corrupt its stream. The caller (ServingEngine.register_adapter)
         also flushes the adapter's prefix-cache namespace — cached KV
         was computed under the old weights."""
-        if not name:
-            raise ValueError("adapter name must be non-empty")
-        res = self.resident.get(name)
-        if res is not None:
-            if res.ref > 0:
+        with self._lock:
+            if not name:
+                raise ValueError("adapter name must be non-empty")
+            res = self.resident.get(name)
+            if res is not None:
+                if res.ref > 0:
+                    raise ValueError(
+                        f"adapter {name!r} is pinned by {res.ref} live "
+                        f"slot(s): re-registering would swap weights under "
+                        f"a running request — drain its users first")
+                # unpinned resident copy: drop it so the next checkout
+                # faults the NEW weights (not counted as a pressure
+                # eviction — that counter is a pool signal)
+                del self.resident[name]
+                self._free.append(res.page)
+            if not isinstance(weights, dict) or not weights:
                 raise ValueError(
-                    f"adapter {name!r} is pinned by {res.ref} live "
-                    f"slot(s): re-registering would swap weights under "
-                    f"a running request — drain its users first")
-            # unpinned resident copy: drop it so the next checkout
-            # faults the NEW weights (not counted as a pressure
-            # eviction — that counter is a pool signal)
-            del self.resident[name]
-            self._free.append(res.page)
-        if not isinstance(weights, dict) or not weights:
-            raise ValueError(
-                f"adapter {name!r}: weights must be a non-empty dict of "
-                f"op name -> {{'a', 'b'}}")
-        clean = {}
-        for op_name, sub in weights.items():
-            geo = self.geometry.get(op_name)
-            if geo is None:
-                raise ValueError(
-                    f"adapter {name!r} targets op {op_name!r}, which is "
-                    f"not a LoRA-targeted Linear op (targets: "
-                    f"{sorted(self.geometry)})")
-            a = np.asarray(sub["a"], np.float32)
-            b = np.asarray(sub["b"], np.float32)
-            want_a = (geo[0], self.rank)
-            want_b = (self.rank, geo[1])
-            if a.shape != want_a or b.shape != want_b:
-                raise ValueError(
-                    f"adapter {name!r} op {op_name!r}: a{a.shape}/"
-                    f"b{b.shape} do not match the pool geometry "
-                    f"a{want_a}/b{want_b} (rank is fixed per pool)")
-            clean[op_name] = {"a": a, "b": b}
-        scale = (float(alpha) if alpha is not None else float(self.rank)) \
-            / float(self.rank)
-        self.registry[name] = {"payload": clean, "scale": scale}
+                    f"adapter {name!r}: weights must be a non-empty dict of "
+                    f"op name -> {{'a', 'b'}}")
+            clean = {}
+            for op_name, sub in weights.items():
+                geo = self.geometry.get(op_name)
+                if geo is None:
+                    raise ValueError(
+                        f"adapter {name!r} targets op {op_name!r}, which is "
+                        f"not a LoRA-targeted Linear op (targets: "
+                        f"{sorted(self.geometry)})")
+                a = np.asarray(sub["a"], np.float32)
+                b = np.asarray(sub["b"], np.float32)
+                want_a = (geo[0], self.rank)
+                want_b = (self.rank, geo[1])
+                if a.shape != want_a or b.shape != want_b:
+                    raise ValueError(
+                        f"adapter {name!r} op {op_name!r}: a{a.shape}/"
+                        f"b{b.shape} do not match the pool geometry "
+                        f"a{want_a}/b{want_b} (rank is fixed per pool)")
+                clean[op_name] = {"a": a, "b": b}
+            scale = (float(alpha) if alpha is not None else float(self.rank)) \
+                / float(self.rank)
+            self.registry[name] = {"payload": clean, "scale": scale}
 
     # ---- checkout / release -------------------------------------------------
 
@@ -131,40 +139,42 @@ class LoraAdapterPool:
         caller must run the writer before dispatching the slot). Returns
         None when the pool is full of pinned pages — the caller leaves
         the request queued (KV-pool-pressure semantics)."""
-        ent = self.registry.get(name)
-        if ent is None:
-            raise KeyError(
-                f"adapter {name!r} is not registered "
-                f"(known: {sorted(self.registry)})")
-        self._tick += 1
-        self.lookups += 1
-        res = self.resident.get(name)
-        if res is not None:
-            res.ref += 1
+        with self._lock:
+            ent = self.registry.get(name)
+            if ent is None:
+                raise KeyError(
+                    f"adapter {name!r} is not registered "
+                    f"(known: {sorted(self.registry)})")
+            self._tick += 1
+            self.lookups += 1
+            res = self.resident.get(name)
+            if res is not None:
+                res.ref += 1
+                res.last_use = self._tick
+                self._live_refs += 1
+                self.hits += 1
+                return res.page, None
+            page = self._allocate()
+            if page is None:
+                self.lookups -= 1   # an un-placeable checkout retries every
+                #                     tick — it must not skew the hit rate
+                return None
+            res = _Resident(page)
+            res.ref = 1
             res.last_use = self._tick
+            self.resident[name] = res
             self._live_refs += 1
-            self.hits += 1
-            return res.page, None
-        page = self._allocate()
-        if page is None:
-            self.lookups -= 1   # an un-placeable checkout retries every
-            #                     tick — it must not skew the hit rate
-            return None
-        res = _Resident(page)
-        res.ref = 1
-        res.last_use = self._tick
-        self.resident[name] = res
-        self._live_refs += 1
-        self.faults += 1
-        return page, ent
+            self.faults += 1
+            return page, ent
 
     def release(self, name: str) -> None:
-        res = self.resident.get(name)
-        if res is None or res.ref <= 0:
-            raise AssertionError(
-                f"adapter refcount underflow on {name!r}")
-        res.ref -= 1
-        self._live_refs -= 1
+        with self._lock:
+            res = self.resident.get(name)
+            if res is None or res.ref <= 0:
+                raise AssertionError(
+                    f"adapter refcount underflow on {name!r}")
+            res.ref -= 1
+            self._live_refs -= 1
 
     def _allocate(self) -> Optional[int]:
         if self._free:

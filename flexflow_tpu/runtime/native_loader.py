@@ -18,10 +18,12 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from flexflow_tpu.runtime import locks
+
 _CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
 _LIB_PATH = os.path.join(_CSRC, "libffdl.so")
 _lib = None
-_lib_lock = threading.Lock()
+_lib_lock = locks.make_lock("native-loader")
 
 
 def load_lib():

@@ -48,7 +48,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from flexflow_tpu.logger import fflogger
-from flexflow_tpu.runtime import faultinject, telemetry
+from flexflow_tpu.runtime import faultinject, locks, telemetry
 from flexflow_tpu.runtime.resilience import retry
 
 
@@ -74,7 +74,7 @@ class PipelineLoader:
         self._cursors = cursors
         self._restore = restore
         self.depth = depth
-        self._cv = threading.Condition()
+        self._cv = locks.make_condition("pipeline-loader")
         self._buf: collections.deque = collections.deque()
         self._paused = False
         self._stopped = False

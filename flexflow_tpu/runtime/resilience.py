@@ -49,7 +49,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from flexflow_tpu.logger import fflogger
-from flexflow_tpu.runtime import faultinject, flightrec, telemetry
+from flexflow_tpu.runtime import faultinject, flightrec, locks, telemetry
 
 # process-wide resilience counters (skipped steps / restarts / retries …);
 # read via counters(), cleared via reset_counters()
@@ -172,7 +172,7 @@ class Watchdog:
         timeout_s = self.timeout_s * max(scale, 1.0)
 
         grace: List[threading.Timer] = []
-        lock = threading.Lock()
+        lock = locks.make_lock("watchdog")
         state = {"active": True}
 
         def hard_exit():

@@ -93,7 +93,7 @@ import numpy as np
 
 from flexflow_tpu.logger import fflogger
 from flexflow_tpu.ops import sampling as sampling_ops
-from flexflow_tpu.runtime import faultinject, flightrec, telemetry
+from flexflow_tpu.runtime import faultinject, flightrec, locks, telemetry
 from flexflow_tpu.runtime.serving import RadixPrefixCache
 
 
@@ -205,6 +205,10 @@ class ServingRouter:
             raise ValueError(
                 f"health_timeout_s={health_timeout_s}: must be > 0")
         cfg = model.config
+        # adopt FFConfig.sanitize before the replica engines (and
+        # this router's own lock) are created — lock proxying is
+        # decided at creation time (runtime/locks.py)
+        locks.configure(cfg)
         self.model = model
         self.n = int(replicas)
         # replica roles (ISSUE 12): default "mixed" for every replica —
@@ -264,7 +268,7 @@ class ServingRouter:
             any(t == "prefill" for t in self.roles)
             and self.engines[0].prefix_cache is not None)
 
-        self._lock = threading.RLock()
+        self._lock = locks.make_rlock("router")
         self._queue: collections.deque = collections.deque()  # FleetRequest
         # rid -> (FleetRequest, engine Request | None): None until the
         # replica's driver hands the request to its engine

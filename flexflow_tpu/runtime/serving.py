@@ -146,7 +146,7 @@ import numpy as np
 from flexflow_tpu._env import compilation_cache_entries
 from flexflow_tpu.logger import fflogger
 from flexflow_tpu.ops import sampling as sampling_ops
-from flexflow_tpu.runtime import faultinject, flightrec, telemetry
+from flexflow_tpu.runtime import faultinject, flightrec, locks, telemetry
 from flexflow_tpu.runtime.generation import Generator
 from flexflow_tpu.runtime.lora import LoraAdapterPool
 
@@ -347,7 +347,7 @@ class RadixPrefixCache:
         # _cv guards hostdata/gen/queue handoff between that thread and
         # the engine-lock holder. Structural trie mutation stays under
         # the ENGINE lock only.
-        self._cv = threading.Condition()
+        self._cv = locks.make_condition("prefix-cache")
         self._pending = collections.deque()
         self._inflight = 0
         self._publisher: Optional[threading.Thread] = None
@@ -854,6 +854,10 @@ class ServingEngine:
                  lora_rank: Optional[int] = None,
                  lora_targets: Optional[List[str]] = None):
         cfg = model.config
+        # sanitize mode is read at LOCK CREATION time: adopt
+        # FFConfig.sanitize before this engine (or its pools)
+        # creates a single lock (runtime/locks.py)
+        locks.configure(cfg)
         self.model = model
         # ---- per-request sampling defaults (ISSUE 14) ----
         # requests carry their own temperature/top_p/top_k/seed as
@@ -1180,6 +1184,10 @@ class ServingEngine:
         self._queue: List[Request] = []
         self._draining = False
         self._programs: Dict = {}
+        # ffsan retrace sentinel: warmup() closes the program set;
+        # armed + sanitize on, _compiled_call reports any further
+        # jit cache miss with the argument signature that diverged
+        self._retrace = locks.RetraceSentinel()
         self._key = jax.random.PRNGKey(seed)
         self._next_rid = 0
         # ONE engine lock around every queue/slot/counter mutation so a
@@ -1188,7 +1196,7 @@ class ServingEngine:
         # step() holds it across the whole tick (including the device
         # dispatch) and calls locked helpers underneath — cross-thread
         # callers simply serialize behind the tick.
-        self._lock = threading.RLock()
+        self._lock = locks.make_rlock("engine")
         self.recompile_count = 0
         self.decode_steps = 0
         self._occupancy_sum = 0
@@ -1254,6 +1262,7 @@ class ServingEngine:
         self._tm_on = getattr(cfg, "telemetry", "on") != "off"
         self._tm_labels = {"replica": f"engine{next(_ENGINE_IDS)}",
                            "role": "solo"}
+        self._retrace.owner = self._tm_labels["replica"]
         self._tm_ch: Dict = {}
         # flight recorder + SLO plane adopt the config's knobs
         # UNCONDITIONALLY: configure() is how telemetry="off" reaches
@@ -1607,7 +1616,11 @@ class ServingEngine:
         counter is exactly the number of XLA compiles the engine caused."""
         fn = self._programs.get(key)
         if fn is not None:
-            return fn(*args)
+            # armed sentinel: bracket the dispatch with the jitted
+            # callable's trace-cache size — growth means a WARM
+            # program silently retraced (the PR-3/7/10/11 bug class)
+            return self._retrace.call(key, fn, args)
+        self._retrace.note_miss(key, args)
         fn = self._programs[key] = build()
         self.recompile_count += 1
         cache_dir = getattr(self.model.config, "compilation_cache_dir", "")
@@ -2182,6 +2195,8 @@ class ServingEngine:
             # drill (a deadline set tighter than <ms> expires while this
             # request is in flight; the router must NOT resubmit it)
             if faultinject.active_plan().fire("slow", "serve"):
+                # ffsan: allow(lock-across-blocking) — stalling
+                # this replica's tick IS the slow() drill's point
                 time.sleep((faultinject.active_plan().last_value or 0)
                            / 1000.0)
             fresh = [self._free_pages.pop() for _ in range(need)]
@@ -2593,7 +2608,7 @@ class ServingEngine:
         ends bit-identical to where it started, with the writer program
         warm. Router/engine ``warmup()`` call this so the first real
         promotion or handoff never compiles mid-traffic."""
-        with self._lock:
+        with self._lock, self._retrace.suspended():
             if self.prefix_cache is None:
                 return False
             prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -2622,6 +2637,7 @@ class ServingEngine:
         {"programs": compiles this warmup caused, "requests", and the
         warmed program "variants"}."""
         plist = [np.asarray(p, np.int32).reshape(-1) for p in prompts]
+        self._retrace.armed = False   # re-warming reopens the set
         before = self.recompile_count
         req0 = self._submitted
         self.run(list(plist), max_new_tokens=max_new_tokens)
@@ -2663,6 +2679,7 @@ class ServingEngine:
             # breach (the bench's warm-window discipline, applied to
             # the health plane)
             flightrec.slo_monitor().rebaseline()
+        self._retrace.arm()
         return {"programs": self.recompile_count - before,
                 "requests": self._submitted - req0,
                 "variants": sorted(self._programs.keys(), key=repr)}
@@ -3075,6 +3092,10 @@ class ServingEngine:
             "tokens_generated": self._tokens_emitted,
             "decode_steps": self.decode_steps,
             "recompiles": self.recompile_count,
+            # post-warmup jit cache misses the ffsan sentinel saw
+            # (0 unless FF_SANITIZE is on and a warm program
+            # retraced — the smokes assert this stays 0)
+            "sanitizer_retraces": self._retrace.hits,
             # mean fraction of computed positions doing USEFUL work per
             # decode step (mid-chunk retirements stop counting) — the
             # engine's steady-state utilization headline. Under

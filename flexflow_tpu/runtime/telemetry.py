@@ -61,6 +61,8 @@ import time
 import weakref
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
+from flexflow_tpu.runtime import locks
+
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "Tracer",
     "registry", "tracer", "reset", "set_enabled", "enabled",
@@ -212,7 +214,7 @@ class _Family:
         self.kind = kind                    # counter | gauge | histogram
         self.labelnames = labelnames
         self.bounds = bounds
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("telemetry-family")
         self._children: Dict[Tuple[str, ...], object] = {}
 
     def labels(self, *values, **kv):
@@ -283,7 +285,7 @@ class Registry:
     when someone is looking."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("telemetry-registry")
         self._families: "collections.OrderedDict[str, _Family]" = \
             collections.OrderedDict()
         self._collectors: List[weakref.ref] = []
@@ -527,7 +529,7 @@ class Tracer:
     ``trace_id`` when the event belongs to a request."""
 
     def __init__(self, cap: int = TRACE_RING_CAP):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("telemetry-tracer")
         self._ring: collections.deque = collections.deque(maxlen=cap)
         self._open: Dict[int, Dict] = {}    # begin() handles awaiting end()
         self._next_handle = 0
@@ -691,7 +693,7 @@ def _tree_complete(root, spans) -> bool:
 
 _registry = Registry()
 _tracer = Tracer()
-_lock = threading.Lock()
+_lock = locks.make_lock("telemetry-server")
 
 
 def registry() -> Registry:

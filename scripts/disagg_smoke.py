@@ -210,6 +210,27 @@ def main():
     ff = build_model()
     fleet_phase(ff, n_requests)
     tier_phase(ff)
+
+    if os.environ.get("FF_SANITIZE"):
+        # CI sanitize tier: both phases (fleet crash drill + tiered KV
+        # migrations) ran sanitized — the global evidence rings cover
+        # every engine created above
+        from flexflow_tpu.runtime import locks
+
+        assert locks.mode() != "off", "FF_SANITIZE set but sanitizer off"
+        assert locks.violations() == [], (
+            "lock-order violations under FF_SANITIZE:\n"
+            + "\n".join(f"{v['outer']} -> {v['inner']}\n{v['inner_stack']}"
+                        for v in locks.violations()))
+        assert locks.retrace_log() == [], (
+            "post-warmup retraces under FF_SANITIZE:\n"
+            + "\n".join(f"{r['program']} {r['signature']}\n{r['stack']}"
+                        for r in locks.retrace_log()))
+        snap = locks.lock_graph_snapshot()
+        print(f"disagg_smoke[sanitize]: mode={snap['mode']}, "
+              f"{len(snap['tracked_locks'])} tracked locks, "
+              f"zero violations, zero retraces")
+
     print("disagg_smoke: PASSED")
 
 

@@ -155,6 +155,29 @@ def main():
 
     deadline_leg(router, rs, vocab, system)
     shedding_leg(ff, rs, vocab)
+
+    if os.environ.get("FF_SANITIZE"):
+        # CI sanitize tier: the whole run above executed under the
+        # order-asserting lock proxies and the armed retrace sentinel —
+        # any inversion or warm-program retrace is a hard failure here
+        from flexflow_tpu.runtime import locks
+
+        assert locks.mode() != "off", "FF_SANITIZE set but sanitizer off"
+        assert locks.violations() == [], (
+            "lock-order violations under FF_SANITIZE:\n"
+            + "\n".join(f"{v['outer']} -> {v['inner']}\n{v['inner_stack']}"
+                        for v in locks.violations()))
+        assert locks.retrace_log() == [], (
+            "post-warmup retraces under FF_SANITIZE:\n"
+            + "\n".join(f"{r['program']} {r['signature']}\n{r['stack']}"
+                        for r in locks.retrace_log()))
+        retr = [e.stats()["sanitizer_retraces"] for e in router.engines]
+        assert sum(retr) == 0, f"per-engine sentinel hits: {retr}"
+        snap = locks.lock_graph_snapshot()
+        print(f"router_smoke[sanitize]: mode={snap['mode']}, "
+              f"{len(snap['tracked_locks'])} tracked locks, "
+              f"zero violations, zero retraces")
+
     print("router_smoke: PASSED")
 
 

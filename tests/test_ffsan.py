@@ -1,0 +1,448 @@
+"""ffsan (ISSUE 16): lock-order & retrace-hazard static passes plus the
+runtime sanitizer plane.
+
+Covers both halves of the acceptance contract:
+  * HEAD is clean — `analyze_sources()` over flexflow_tpu/runtime finds
+    zero errors and zero warnings (the lock-inventory test additionally
+    pins that every runtime lock goes through the locks.py registry, so
+    a new raw ``threading.Lock()`` fails CI here).
+  * every seeded violation is caught WITH a file:line — inverted
+    acquisition (direct and transitive), a lock held across a blocking
+    call, jnp dispatch under a lock, an uncommitted device_put, and a
+    registry bypass.
+  * the runtime sanitizer catches the same two bug classes dynamically:
+    order-asserting lock proxies (named pair + both stacks, strict
+    raises) and the post-warmup retrace sentinel on a real jax.jit
+    cache.
+"""
+
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flexflow_tpu.analysis.__main__ import main as fflint_main
+from flexflow_tpu.analysis.sanitize import analyze_sources, default_paths
+from flexflow_tpu.analysis.sanitize.lockgraph import build_lockgraph
+from flexflow_tpu.runtime import locks
+from flexflow_tpu.runtime.locks import (LOCK_RANKS, LockOrderViolation,
+                                        RetraceSentinel, RetraceViolation)
+
+
+@pytest.fixture
+def san():
+    """Sanitizer 'on' with clean evidence rings; always restored."""
+    prev = locks.set_mode("on")
+    locks.reset()
+    yield locks
+    locks.set_mode(prev)
+    locks.reset()
+
+
+@pytest.fixture
+def strict():
+    prev = locks.set_mode("strict")
+    locks.reset()
+    yield locks
+    locks.set_mode(prev)
+    locks.reset()
+
+
+def _runtime_files():
+    [runtime] = default_paths()
+    return [os.path.join(runtime, n) for n in sorted(os.listdir(runtime))
+            if n.endswith(".py")]
+
+
+def _codes(report):
+    return set(report.codes())
+
+
+# ------------------------------------------------------------ clean @ HEAD
+
+
+def test_runtime_clean_at_head():
+    """The acceptance gate: both source passes clean over runtime/."""
+    report = analyze_sources()
+    assert not report.errors() and not report.warnings(), \
+        report.format_text()
+
+
+def test_lock_inventory_pins_registry():
+    """Every lock in runtime/ comes from locks.make_* with a declared
+    name — a new raw threading.Lock() (or an undeclared name) fails
+    here before it fails in review."""
+    graph = build_lockgraph(_runtime_files())
+    for mod in graph.modules.values():
+        raw = [(p, l) for kind, p, l in mod.raw_locks
+               if not graph.allowed_at("raw-lock", p, l)]
+        assert not raw, \
+            f"raw threading primitive(s) bypass the registry: {raw}"
+        assert not mod.unknown_factory, mod.unknown_factory
+    used = set()
+    for mod in graph.modules.values():
+        used.update(mod.global_locks.values())
+        for cls in mod.classes.values():
+            used.update(cls["attr_locks"].values())
+    assert used <= set(LOCK_RANKS), used - set(LOCK_RANKS)
+    # the inventory the refactor migrated (ISSUE 16's named modules)
+    for name in ("engine", "router", "prefix-cache", "adapter-pool",
+                 "pipeline-loader", "checkpoint-saver", "watchdog",
+                 "flightrec", "telemetry-registry", "telemetry-family",
+                 "telemetry-tracer", "native-loader"):
+        assert name in used, f"expected registered lock {name!r}"
+
+
+def test_ranks_strictly_ordered_and_unique():
+    ranks = list(LOCK_RANKS.values())
+    assert len(set(ranks)) == len(ranks)
+    assert LOCK_RANKS["router"] < LOCK_RANKS["engine"] \
+        < LOCK_RANKS["flightrec"] < LOCK_RANKS["telemetry-registry"]
+
+
+# ------------------------------------------------- seeded static mutations
+
+
+def _seed(tmp_path, name, src):
+    f = tmp_path / name
+    f.write_text(src)
+    return str(f)
+
+
+def _analyze(path, passes=("concurrency", "tracestability")):
+    return analyze_sources(paths=[path], passes=passes)
+
+
+def _find(report, code):
+    vs = report.by_code(code)
+    assert vs, f"expected {code!r}; got {report.codes()}"
+    for v in vs:
+        assert v.file and v.line, f"{code}: missing file:line ({v})"
+    return vs
+
+
+def test_seeded_direct_inversion(tmp_path):
+    path = _seed(tmp_path, "inv.py", """\
+from flexflow_tpu.runtime import locks
+_eng = locks.make_rlock("engine")
+_rt = locks.make_rlock("router")
+
+def tick():
+    with _eng:
+        with _rt:        # router(10) under engine(20): inverted
+            pass
+""")
+    vs = _find(_analyze(path), "lock-order-inversion")
+    assert any(v.line == 7 for v in vs), [v.line for v in vs]
+    assert "'router'" in vs[0].message and "'engine'" in vs[0].message
+
+
+def test_seeded_transitive_inversion(tmp_path):
+    path = _seed(tmp_path, "trans.py", """\
+from flexflow_tpu.runtime import locks
+_eng = locks.make_rlock("engine")
+_rt = locks.make_rlock("router")
+
+def _admit():
+    with _rt:
+        pass
+
+def tick():
+    with _eng:
+        _admit()         # acquires router(10) under engine(20)
+""")
+    vs = _find(_analyze(path), "lock-order-inversion")
+    assert any("via" in v.message for v in vs), [v.message for v in vs]
+
+
+def test_seeded_lock_across_blocking(tmp_path):
+    path = _seed(tmp_path, "blk.py", """\
+from flexflow_tpu.runtime import locks
+_rt = locks.make_rlock("router")
+
+def flush(arr):
+    with _rt:
+        arr.block_until_ready()
+""")
+    vs = _find(_analyze(path), "lock-across-blocking")
+    assert "router" in vs[0].message
+
+
+def test_engine_tick_waiver_is_structural(tmp_path):
+    """The documented serving contract: engine lock across dispatch is
+    NOT a finding — but any other lock in the same position is."""
+    path = _seed(tmp_path, "waiv.py", """\
+from flexflow_tpu.runtime import locks
+_eng = locks.make_rlock("engine")
+
+def tick(arr):
+    with _eng:
+        arr.block_until_ready()
+""")
+    assert not _analyze(path).by_code("lock-across-blocking")
+
+
+def test_seeded_jnp_under_lock(tmp_path):
+    path = _seed(tmp_path, "jnp.py", """\
+import jax.numpy as jnp
+from flexflow_tpu.runtime import locks
+_rt = locks.make_rlock("router")
+
+def score(x):
+    with _rt:
+        return jnp.sum(x)     # op-by-op dispatch under the lock
+
+def builder(x):
+    with _rt:
+        def prog(y):
+            return jnp.sum(y)  # traced-program body: NOT a finding
+        return prog
+""")
+    vs = _find(_analyze(path), "jnp-under-lock")
+    assert all(v.line == 7 for v in vs), [v.line for v in vs]
+
+
+def test_seeded_uncommitted_device_put(tmp_path):
+    path = _seed(tmp_path, "put.py", """\
+import jax
+
+def stage(x, dev):
+    a = jax.device_put(x)          # uncommitted
+    b = jax.device_put(x, dev)     # committed: clean
+    return a, b
+""")
+    vs = _find(_analyze(path), "uncommitted-device-put")
+    assert [v.line for v in vs] == [4]
+
+
+def test_seeded_raw_lock_and_pragma(tmp_path):
+    path = _seed(tmp_path, "raw.py", """\
+import threading
+_a = threading.Lock()
+_b = threading.Lock()   # ffsan: allow(raw-lock) — test waiver
+""")
+    vs = _find(_analyze(path, passes=("concurrency",)), "raw-lock")
+    assert [v.line for v in vs] == [2]   # pragma'd line 3 waived
+
+
+def test_seeded_unknown_lock_name(tmp_path):
+    path = _seed(tmp_path, "unk.py", """\
+from flexflow_tpu.runtime import locks
+_x = locks.make_lock("not-a-declared-name")
+""")
+    _find(_analyze(path, passes=("concurrency",)), "unknown-lock-name")
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def test_cli_source_passes_clean_at_head(capsys):
+    rc = fflint_main(["--passes", "concurrency,tracestability",
+                      "--tiered-exit"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "0 error(s), 0 warning(s)" in out
+
+
+def test_cli_tiered_exit_codes(tmp_path, capsys):
+    err = _seed(tmp_path, "e.py", "import threading\n_l = threading.Lock()\n")
+    warn = _seed(tmp_path, "w.py",
+                 "import jax\n\ndef f(x):\n    return jax.device_put(x)\n")
+    assert fflint_main(["--passes", "concurrency", "--path", err,
+                        "--tiered-exit"]) == 2
+    assert fflint_main(["--passes", "tracestability", "--path", warn,
+                        "--tiered-exit"]) == 1
+    # legacy exit codes stay pinned: errors -> 1, warnings alone -> 0
+    assert fflint_main(["--passes", "concurrency", "--path", err]) == 1
+    assert fflint_main(["--passes", "tracestability", "--path", warn]) == 0
+    capsys.readouterr()
+
+
+def test_cli_json_format(tmp_path, capsys):
+    err = _seed(tmp_path, "e.py", "import threading\n_l = threading.Lock()\n")
+    rc = fflint_main(["--passes", "concurrency", "--path", err,
+                      "--format", "json", "--tiered-exit"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 2 and doc["num_errors"] == 1
+    [v] = doc["violations"]
+    assert v["code"] == "raw-lock" and v["file"] == err and v["line"] == 2
+
+
+def test_cli_usage_is_64_under_tiered_exit(capsys):
+    rc = fflint_main(["--passes", "legality", "--tiered-exit"])
+    assert rc == 64      # model passes need both positionals
+    assert "positionals" in capsys.readouterr().err
+
+
+# -------------------------------------------------------- runtime: proxies
+
+
+def test_proxy_detects_inversion_with_both_stacks(san):
+    reg = locks.make_lock("telemetry-registry")
+    eng = locks.make_rlock("engine")
+    with eng:
+        with reg:            # increasing rank: legal
+            pass
+    assert locks.violations() == []
+    with reg:
+        with eng:            # engine(20) under telemetry-registry(70)
+            pass
+    [v] = locks.violations()
+    assert (v["outer"], v["inner"]) == ("telemetry-registry", "engine")
+    assert "acquire" in v["outer_stack"] and v["inner_stack"]
+    assert v["thread"] == threading.current_thread().name
+
+
+def test_proxy_dedups_pairs_but_counts(san):
+    reg = locks.make_lock("telemetry-registry")
+    eng = locks.make_rlock("engine")
+    for _ in range(3):
+        with reg:
+            with eng:
+                pass
+    assert len(locks.violations()) == 1
+    snap = locks.lock_graph_snapshot()
+    assert snap["violation_pairs"] == {"telemetry-registry->engine": 3}
+
+
+def test_reentrant_and_same_object_always_legal(san):
+    eng = locks.make_rlock("engine")
+    with eng:
+        with eng:            # RLock re-acquire
+            pass
+    assert locks.violations() == []
+
+
+def test_strict_mode_raises(strict):
+    reg = locks.make_lock("telemetry-registry")
+    eng = locks.make_rlock("engine")
+    with pytest.raises(LockOrderViolation, match="engine"):
+        with reg:
+            with eng:
+                pass
+    # the held-stack survived the raise: a clean acquisition still works
+    with eng:
+        with reg:
+            pass
+
+
+def test_condition_wait_keeps_held_stack_exact(san):
+    cv = locks.make_condition("pipeline-loader")
+    rt = locks.make_rlock("router")
+
+    def waker():
+        with cv:
+            cv.notify_all()
+
+    with cv:
+        t = threading.Timer(0.05, waker)
+        t.start()
+        cv.wait(timeout=2.0)
+        t.join()
+        # still (re-)holding pipeline-loader(45) after the wait: taking
+        # router(10) now must be flagged — proves _acquire_restore
+        # re-noted the lock
+        with rt:
+            pass
+    pairs = {(v["outer"], v["inner"]) for v in locks.violations()}
+    assert ("pipeline-loader", "router") in pairs
+
+
+def test_off_mode_returns_raw_primitives():
+    prev = locks.set_mode("off")
+    try:
+        lk = locks.make_lock("engine")
+        assert not hasattr(lk, "rank")          # raw threading.Lock
+        assert isinstance(locks.make_condition("engine"),
+                          threading.Condition)
+    finally:
+        locks.set_mode(prev)
+
+
+def test_unknown_name_rejected_at_creation():
+    with pytest.raises(ValueError, match="unknown lock name"):
+        locks.make_lock("no-such-lock")
+
+
+# ------------------------------------------------------- runtime: sentinel
+
+
+def test_retrace_sentinel_on_real_jit_cache(san):
+    jax = pytest.importorskip("jax")
+    import numpy as np
+    fn = jax.jit(lambda x: x + 1)
+    s = RetraceSentinel("test-engine")
+    x = jax.device_put(np.ones((4,), np.float32), jax.devices()[0])
+    s.call("prog", fn, (x,))          # warmup trace
+    s.arm()
+    s.call("prog", fn, (x,))          # warm hit: clean
+    assert s.hits == 0 and locks.retrace_log() == []
+    y = jax.device_put(np.ones((4,), np.float32))   # uncommitted twin
+    s.call("prog", fn, (y,))
+    assert s.hits == 1
+    [rec] = locks.retrace_log()
+    assert rec["kind"] == "retrace" and rec["owner"] == "test-engine"
+    assert any("UNCOMMITTED" in sig for sig in rec["signature"]), rec
+
+
+def test_sentinel_note_miss_and_suspended(san):
+    s = RetraceSentinel("t")
+    s.note_miss("early", ())          # pre-arm: warmup compiles are free
+    s.arm()
+    with s.suspended():               # deliberate warm-path compile
+        s.note_miss("imported-page", ())
+    assert s.hits == 0
+    s.note_miss("late-program", ())
+    assert s.hits == 1
+    [rec] = locks.retrace_log()
+    assert rec["kind"] == "new-program" and "late-program" in rec["program"]
+
+
+def test_sentinel_strict_raises(strict):
+    s = RetraceSentinel("t")
+    s.arm()
+    with pytest.raises(RetraceViolation, match="late"):
+        s.note_miss("late", ())
+
+
+def test_sentinel_off_mode_is_passthrough():
+    prev = locks.set_mode("off")
+    try:
+        s = RetraceSentinel("t")
+        s.arm()
+        s.note_miss("anything", ())
+        assert s.hits == 0
+    finally:
+        locks.set_mode(prev)
+
+
+# ------------------------------------------------------ snapshot & config
+
+
+def test_lock_graph_snapshot_shape(san):
+    eng = locks.make_rlock("engine")
+    snap = locks.lock_graph_snapshot()
+    assert snap["mode"] == "on"
+    assert snap["ranks"] == LOCK_RANKS
+    assert {"name": "engine", "rank": 20} in snap["tracked_locks"]
+    for key in ("violation_pairs", "violations", "retraces"):
+        assert key in snap
+    json.dumps(snap)                  # bundle-serializable
+
+
+def test_config_knob_validation_and_adoption():
+    from flexflow_tpu.config import FFConfig
+    with pytest.raises(ValueError, match="sanitize"):
+        FFConfig(sanitize="bogus")
+    prev = locks.mode()
+    try:
+        locks.configure(FFConfig(sanitize="strict"))
+        assert locks.mode() == "strict"
+        locks.configure(FFConfig())          # empty: leaves mode alone
+        assert locks.mode() == "strict"
+    finally:
+        locks.set_mode(prev)

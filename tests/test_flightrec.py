@@ -160,7 +160,8 @@ def test_retention_keeps_newest_k(tmp_path):
 
 BUNDLE_FILES = {"trigger.json", "trace.json", "metrics.json",
                 "logs.jsonl", "fingerprint.json", "engines.json",
-                "hbm.json", "slo.json", "ff_manifest.json"}
+                "hbm.json", "slo.json", "sanitizer.json",
+                "ff_manifest.json"}
 
 
 def test_bundle_contents_manifest_and_torn_write(tmp_path):
@@ -179,6 +180,12 @@ def test_bundle_contents_manifest_and_torn_write(tmp_path):
     assert "env" in fp
     slo = json.load(open(os.path.join(path, "slo.json")))
     assert slo["specs"] == {"ttft_p99": 5.0}
+    san = json.load(open(os.path.join(path, "sanitizer.json")))
+    assert san["mode"] in ("off", "on", "strict")
+    assert san["ranks"]["router"] < san["ranks"]["engine"]
+    for key in ("tracked_locks", "violation_pairs", "violations",
+                "retraces"):
+        assert key in san
     # torn-write drill: flip bytes mid-payload — the manifest catches it
     victim = os.path.join(path, "metrics.json")
     blob = bytearray(open(victim, "rb").read())
