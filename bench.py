@@ -102,6 +102,7 @@ TIER_COST_S = {"tiny": 90, "mid": 150, "full": 240, "full_scan": 180,
                "quantized_serving": 240,
                "tiered_prefix": 260,
                "multi_tenant": 200,
+               "rolling_deploy": 260,
                "input_overlap": 90,
                "collective_overlap": 120}
 
@@ -1592,6 +1593,175 @@ def _run_multi_tenant_tier(n_dev, backend, dev_kind):
     }
 
 
+def _run_rolling_deploy_tier(n_dev, backend, dev_kind):
+    """rolling_deploy row (ISSUE 17): the SLO-gated rolling deployment's
+    cost, measured honestly — the SAME closed-loop flood through a
+    2-replica fleet twice, once steady-state and once with a weight
+    version published mid-flood and rolled through the fleet (suspend ->
+    drain -> hot-swap -> re-warmup -> readmit, one replica at a time).
+    The claim is that a roll costs capacity (one replica out at a time),
+    never correctness or compiles: every request completes, p99 TTFT
+    degrades boundedly, zero warm-window recompiles anywhere. A third
+    window forces a canary SLO breach (FF_FAULT slow@canary under a
+    tight TTFT ceiling) and stamps the rollback-drill latency — breach
+    detected to fleet-back-on-v1 — in the config block."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.llama import llama_lm
+    from flexflow_tpu.runtime import faultinject, flightrec
+    from flexflow_tpu.runtime.deploy import (RollingDeployer,
+                                             WeightArtifactRegistry)
+
+    _phase("build_rolling_deploy")
+    vocab = 256
+    cfg = FFConfig(batch_size=2, mesh_shape={"data": 1}, serve_slots=4,
+                   kv_page_size=16, slo_window_s=1.0)
+    ff = FFModel(cfg)
+    _, logits = llama_lm(ff, 2, seq_len=16, hidden=128, layers=2, heads=4,
+                         kv_heads=2, vocab_size=vocab)
+    ff.compile(final_tensor=logits)
+
+    work = tempfile.mkdtemp(prefix="ff_bench_deploy_")
+    registry = WeightArtifactRegistry(os.path.join(work, "watch"))
+    rs = np.random.RandomState(0)
+    lens = [SERVE_PROMPT_LENS[i % len(SERVE_PROMPT_LENS)]
+            for i in range(ROUTER_REQUESTS)]
+    prompts = [rs.randint(1, vocab, (n,)).astype(np.int32) for n in lens]
+    warm = [rs.randint(1, vocab, (n,)).astype(np.int32)
+            for n in SERVE_PROMPT_LENS]
+
+    def publish(step, scale):
+        keep = ff.params
+        ff.params = ff.executor.reshard_params(jax.tree_util.tree_map(
+            lambda x: (np.asarray(x) * scale).astype(
+                np.asarray(x).dtype), keep))
+        try:
+            return registry.publish(ff, step=step)
+        finally:
+            ff.params = keep
+
+    def mk_router():
+        r = ff.make_serving_router(
+            replicas=2, max_seq_len=96, serve_slots=8, decode_chunk=2,
+            prefix_cache=False, start=False)
+        r.warmup(warm, max_new_tokens=4)
+        return r
+
+    def flood_window(name, deploy_to=None, canary_windows=1,
+                     fault=None, slo_cfg=None):
+        """Flood the fleet; optionally run a deploy mid-flood. Returns
+        (p99/p50 TTFT, tokens/s, deploy report, recompile leak)."""
+        _phase(f"time_deploy_{name}")
+        old_fault = os.environ.get("FF_FAULT")
+        if fault:
+            os.environ["FF_FAULT"] = fault
+            faultinject.reset()
+        router = mk_router()
+        # AFTER mk_router: engine/router creation re-runs
+        # flightrec.configure with the model cfg (last configure wins),
+        # so the drill's tight SLO ceiling must land on top of it
+        if slo_cfg is not None:
+            flightrec.configure(slo_cfg)
+        try:
+            warm_compiles = [e.recompile_count for e in router.engines]
+            router.start()
+            time.sleep(0.05)
+            t0 = time.perf_counter()
+            reqs = [router.submit(prompts[i % len(prompts)],
+                                  ROUTER_MAX_NEW)
+                    for i in range(ROUTER_REQUESTS)]
+            report = None
+            if deploy_to is not None:
+                dep = RollingDeployer(router, registry,
+                                      canary_windows=canary_windows)
+                report = dep.deploy(deploy_to, warmup_prompts=warm,
+                                    max_new_tokens=4)
+            router.wait(reqs, timeout=1200)
+            dt = time.perf_counter() - t0
+            assert all(r.state == "done" for r in reqs), \
+                f"{name}: a request was dropped through the roll"
+            done = sorted(r.ttft for r in reqs)
+
+            def pct(p):
+                return round(done[min(len(done) - 1,
+                                      int(p * len(done)))] * 1e3, 3)
+
+            leaked = any(e.recompile_count != c for e, c
+                         in zip(router.engines, warm_compiles))
+            tps = ROUTER_REQUESTS * ROUTER_MAX_NEW / dt
+            return {"p99_ttft_ms": pct(0.99), "p50_ttft_ms": pct(0.50),
+                    "tokens_per_s": round(tps, 2)}, report, leaked
+        finally:
+            router.close()
+            if fault:
+                if old_fault is None:
+                    os.environ.pop("FF_FAULT", None)
+                else:
+                    os.environ["FF_FAULT"] = old_fault
+                faultinject.reset()
+
+    try:
+        v1 = publish(1, 1.25)
+        steady, _, leak_steady = flood_window("steady")
+        rolling, roll_report, leak_roll = flood_window(
+            "rolling", deploy_to=v1)
+        assert roll_report["state"] == "completed", roll_report
+
+        # rollback drill: tight TTFT ceiling + slow@canary stalls ->
+        # breach in the canary's first rebaselined window -> automatic
+        # rollback; the drill latency is breach -> fleet-on-prior
+        v2 = publish(2, 1.5)
+        _, back_report, _ = flood_window(
+            "rollback_drill", deploy_to=v2, canary_windows=2,
+            fault="slow(600)@canary:1-400",
+            slo_cfg=FFConfig(
+                batch_size=2, mesh_shape={"data": 1},
+                slo_ttft_p99_s=0.25, slo_window_s=1.0,
+                flight_recorder_dir=os.path.join(work, "flight"),
+                flight_debounce_s=600.0))
+        assert back_report["state"] == "rolled_back", back_report
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    return {
+        "metric": "rolling_deploy_serving", "tier": "rolling_deploy",
+        # headline: aggregate tokens/s THROUGH the roll (the honest
+        # cost number), with steady state as the baseline ratio
+        "value": rolling["tokens_per_s"], "unit": "tokens/s",
+        "vs_baseline": round(rolling["tokens_per_s"]
+                             / steady["tokens_per_s"], 3),
+        "steady_tokens_per_s": steady["tokens_per_s"],
+        "rolling_tokens_per_s": rolling["tokens_per_s"],
+        "p99_ttft_ms_steady": steady["p99_ttft_ms"],
+        "p99_ttft_ms_rolling": rolling["p99_ttft_ms"],
+        "p50_ttft_ms_steady": steady["p50_ttft_ms"],
+        "p50_ttft_ms_rolling": rolling["p50_ttft_ms"],
+        "roll_duration_s": roll_report["duration_s"],
+        "recompiles_after_warmup": bool(leak_steady or leak_roll),
+        "backend": backend, "device_kind": dev_kind, "n_devices": n_dev,
+        "config": {"requests": ROUTER_REQUESTS,
+                   "max_new_tokens": ROUTER_MAX_NEW,
+                   "load_shape": "closed_loop_flood",
+                   "replicas": 2, "serve_slots": 8, "kv_page_size": 16,
+                   "decode_chunk": 2, "max_seq_len": 96,
+                   "hidden": 128, "layers": 2, "prefix_cache": False,
+                   "canary_windows": 1, "slo_window_s": 1.0,
+                   # the rollback-drill stamp (ISSUE 17 acceptance):
+                   # canary breach -> every replica back on the prior
+                   # version
+                   "rollback_breach_slo":
+                       (back_report["breach"] or {}).get("slo"),
+                   "rollback_latency_s": back_report["rollback_s"],
+                   "rollback_replicas": len(back_report["swapped"])},
+    }
+
+
 def _run_overlap_tier(n_dev, backend, dev_kind):
     """input_overlap tier: the synchronous fit() loop vs the host-overlap
     step engine (runtime/pipeline_loader.py prefetch + dispatch-ahead)
@@ -1900,6 +2070,14 @@ def child():
         print(json.dumps(
             _run_multi_tenant_tier(n_dev, backend, dev_kind)),
             flush=True)
+    # rolling_deploy tier (ISSUE 17): p99 TTFT + tokens/s through a live
+    # weight roll vs steady state, plus the canary-breach rollback drill
+    if "rolling_deploy" not in skip and (
+            deadline is None
+            or deadline - time.time() >= TIER_COST_S["rolling_deploy"]):
+        print(json.dumps(
+            _run_rolling_deploy_tier(n_dev, backend, dev_kind)),
+            flush=True)
     # input-overlap tier: last, pure upside — measures the host-overlap
     # step engine against the synchronous loop under a slow loader
     if "input_overlap" not in skip and (
@@ -1978,7 +2156,8 @@ def _serving_rows(results):
                                    "prefix_serving_throughput",
                                    "router_serving_throughput",
                                    "paged_attention_microbench",
-                                   "tiered_prefix_serving")]
+                                   "tiered_prefix_serving",
+                                   "rolling_deploy_serving")]
 
 
 def _attach_serving(pick, results):

@@ -102,6 +102,16 @@
 #               failover, adapter evicted + re-faulted under pool
 #               pressure, zero warm-window recompiles, per-adapter
 #               telemetry series present
+#   deploy    — rolling-deployment tier (ISSUE 17): the weight-version
+#               registry + RollingDeployer suite (drain->reopen, version-
+#               salted prefix isolation, refused corrupt artifacts, torn-
+#               swap rollback), then the 2-replica rolling-swap smoke: a
+#               version published mid-flood rolls through the fleet with
+#               every request served exactly once and zero warm-window
+#               recompiles, and a second leg forces a canary SLO breach
+#               (slow@canary) that must end in an automatic rollback —
+#               fleet back on v1, exactly one manifest-intact post-mortem
+#               bundle naming the breached SLO
 #   sanitize  — ffsan plane (ISSUE 16): static concurrency/
 #               tracestability passes clean over runtime/ (tiered exit:
 #               warnings fail too) + the seeded-violation harness, then
@@ -110,7 +120,7 @@
 #               retrace sentinels) asserting zero violations and zero
 #               post-warmup retraces
 #
-# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|kernels|quant|disagg|obs|router|tenancy|sanitize|all]
+# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|kernels|quant|disagg|obs|router|tenancy|deploy|sanitize|all]
 set -e
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -331,6 +341,19 @@ run_tenancy() {
   FF_FAULT="crash(6)@replica:0" python scripts/tenancy_smoke.py 48
 }
 
+# deploy tier (ISSUE 17): SLO-gated rolling deployment. The full suite
+# (slow tests included: drain->reopen token identity, version-salted
+# prefix isolation, the A/B mid-roll fleet, live rolling deploy), then
+# the 2-leg smoke: a rolling swap under a skewed flood (exactly-once,
+# capacity >= N-1, zero warm-window recompiles) and a forced canary
+# breach that must roll the fleet back to v1 with exactly one
+# manifest-intact bundle naming the breached SLO (the smoke arms its
+# own slow@canary plan internally).
+run_deploy() {
+  python -m pytest tests/test_deploy.py -q
+  python scripts/deploy_smoke.py 80
+}
+
 case "$TIER" in
   unit)     run_unit ;;
   sweep)    run_sweep ;;
@@ -348,8 +371,9 @@ case "$TIER" in
   obs)      run_obs ;;
   router)   run_router ;;
   tenancy)  run_tenancy ;;
+  deploy)   run_deploy ;;
   sanitize) run_sanitize ;;
-  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_kernels; run_quant; run_disagg; run_obs; run_router; run_tenancy; run_sanitize; run_native; run_docs; run_sweep ;;
+  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_kernels; run_quant; run_disagg; run_obs; run_router; run_tenancy; run_deploy; run_sanitize; run_native; run_docs; run_sweep ;;
   *) echo "unknown tier $TIER"; exit 2 ;;
 esac
 echo "ci($TIER): PASSED"

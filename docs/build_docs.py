@@ -32,7 +32,7 @@ PAGES = [("index", os.path.join(ROOT, "README.md"), "Overview"),
          ("serving", os.path.join(DOCS, "serving.md"),
           "Serving (continuous batching, prefix cache, fleet router, "
           "quantized tier, disaggregated fleet + tiered cache, "
-          "sampling + multi-tenant LoRA)"),
+          "sampling + multi-tenant LoRA, rolling deployment)"),
          ("performance", os.path.join(DOCS, "performance.md"),
           "Performance (host + in-graph overlap, Pallas kernel tier)"),
          ("observability", os.path.join(DOCS, "observability.md"),

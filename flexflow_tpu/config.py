@@ -379,6 +379,22 @@ class FFConfig:
     # process-wide coverage (what the CI sanitize tier does).
     sanitize: str = ""
     slo_trip_recorder: bool = False      # breach also trips the recorder
+    # ---- rolling deployment (runtime/deploy.py, ISSUE 17) ----
+    # watch path the weight-version registry scans: async checkpointing
+    # publishes manifest-verified artifacts here (save_checkpoint
+    # step_<N> layout; version "v<N>"), and RollingDeployer.deploy()
+    # rolls the fleet onto the newest intact one. "" = no watch path
+    # (pass one to WeightArtifactRegistry directly).
+    deploy_watch_dir: str = ""
+    # canary soak: the first swapped replica serves under its own
+    # rebaselined SLO windows for this many full slo_window_s windows;
+    # any breach attributed to it inside the soak rolls the whole
+    # deploy back. 0 = no soak (swap and move on — the drill-less path).
+    deploy_canary_windows: int = 2
+    # hard ceiling on one replica's drain-quiesce wait during a roll
+    # (seconds): a replica that cannot quiesce aborts the deploy
+    # (state "failed") instead of wedging the roll forever
+    deploy_drain_timeout_s: float = 120.0
 
     # populated at FFModel construction
     strategies: Dict[str, "ParallelConfig"] = dataclasses.field(default_factory=dict)
@@ -518,6 +534,14 @@ class FFConfig:
             raise ValueError(
                 f"slo_clear_windows={self.slo_clear_windows}: must be "
                 f">= 1 (a breach must be clearable)")
+        if self.deploy_canary_windows < 0:
+            raise ValueError(
+                f"deploy_canary_windows={self.deploy_canary_windows}: "
+                f"must be >= 0 (0 = no canary soak)")
+        if self.deploy_drain_timeout_s <= 0:
+            raise ValueError(
+                f"deploy_drain_timeout_s={self.deploy_drain_timeout_s}: "
+                f"must be > 0")
         if self.paged_attention_impl not in ("auto", "pallas", "einsum"):
             raise ValueError(
                 f"paged_attention_impl={self.paged_attention_impl!r}: "

@@ -57,7 +57,21 @@ Consumers:
     page dies exactly as it would without a host tier) and
     ``h2d_fail@promote:<n>`` on the n-th host->HBM promotion (the host
     copy is killed and admission falls back to cold prefill) — neither
-    may stall the scheduler or mount a corrupt page.
+    may stall the scheduler or mount a corrupt page;
+  * the rolling-deploy plane (ISSUE 17) drives three drills:
+    ``runtime/deploy.py WeightArtifactRegistry.publish`` checks
+    ``corrupt_ckpt@publish:<n>`` AFTER the n-th artifact lands in the
+    watch path and flips bytes in it (the torn-artifact drill — the
+    deployer's manifest verify must refuse the roll before any replica
+    is touched); ``ServingEngine.swap_weights`` checks
+    ``swap_fail@deploy:<n>`` via ``maybe_fail`` AFTER installing the new
+    weights (the torn mid-swap drill — the engine restores the prior
+    version and the deployer rolls the whole deploy back); and
+    ``ServingEngine._admit`` checks ``slow(<ms>)@canary:<n>`` ONLY while
+    the engine is the deploy canary, stalling its admissions by ``<ms>``
+    — the deterministic canary SLO-breach drill that must end in an
+    automatic rollback plus a post-mortem bundle naming the breached
+    SLO.
 
 The active plan is parsed lazily from ``FF_FAULT`` and re-parsed (with
 occurrence counters reset) whenever the env value changes; tests that

@@ -1083,6 +1083,10 @@ def health_rollup() -> Dict:
             degraded.append(f"{row['fenced']} replica(s) fenced")
         if row.get("status") in ("dead", "draining"):
             degraded.append(f"fleet status {row['status']}")
+        if row.get("deploying"):
+            # a rolling deploy is a PLANNED capacity dip: degraded
+            # (operators see it), never a breach (nothing is wrong)
+            degraded.append("rolling deploy in progress")
         alive, total = row.get("alive"), row.get("replicas")
         if alive is not None and total is not None and alive < total:
             degraded.append(f"{total - alive}/{total} replicas down")

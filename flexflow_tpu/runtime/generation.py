@@ -101,6 +101,12 @@ class Generator:
         self._qparams = None
         self._qparams_key = None
         self._q_refs = None
+        # per-generator weight override (rolling deploy hot-swap): when
+        # set, decode programs read these params instead of model.params
+        # — same tree structure/shapes/dtypes, so warm programs never
+        # retrace. None = serve the shared model weights.
+        self._params_override = None
+        self._override_version = 0
         # compiled decode programs, LRU-bounded (FF_GEN_PROGRAM_CACHE,
         # default 8): a long-lived serving process sweeping
         # max_new_tokens/prompt shapes must not accumulate XLA programs
@@ -185,7 +191,8 @@ class Generator:
         # mutation) AND liveness of the recorded leaves (a dead weakref
         # means an id could have been recycled, so ids stop being
         # authoritative — rebuild)
-        leaves = jax.tree_util.tree_leaves(self.model.params)
+        src = self._source_params()
+        leaves = jax.tree_util.tree_leaves(src)
         try:
             refs = tuple(weakref.ref(w) for w in leaves)
         except TypeError:
@@ -193,7 +200,8 @@ class Generator:
             # never authoritative — disable caching rather than risk a
             # recycled-id stale hit
             refs = None
-        key = (self.model._params_version, tuple(map(id, leaves)))
+        key = (self.model._params_version, self._override_version,
+               tuple(map(id, leaves)))
         if (self._qparams is not None and self._qparams_key == key
                 and self._q_refs is not None
                 and all(r() is not None for r in self._q_refs)):
@@ -204,7 +212,7 @@ class Generator:
         else:
             qdtype, qmax = jnp.int8, 127.0
         out = {}
-        for op_name, ws in self.model.params.items():
+        for op_name, ws in src.items():
             q_ws = {}
             for w_name, w in ws.items():
                 if w.ndim >= 2 and jnp.issubdtype(w.dtype, jnp.floating):
@@ -641,9 +649,49 @@ class Generator:
 
         return jax.jit(gen)
 
+    def _source_params(self):
+        """The weight tree decode programs read: the per-generator
+        override when one is installed (rolling deploy), else the shared
+        model params."""
+        if self._params_override is not None:
+            return self._params_override
+        return self.model.params
+
+    def set_params(self, tree):
+        """Install (or, with ``tree=None``, clear) a per-generator weight
+        override. The tree must match ``model.params`` in structure,
+        shapes and dtypes — same geometry, so every warm decode program
+        stays valid and nothing retraces. Invalidate the quantized-weight
+        cache so the next program pull re-quantizes from the new source
+        exactly once."""
+        if tree is not None:
+            ref_leaves, ref_def = jax.tree_util.tree_flatten(
+                self.model.params)
+            new_leaves, new_def = jax.tree_util.tree_flatten(tree)
+            if new_def != ref_def:
+                raise ValueError(
+                    "set_params: tree structure differs from model.params "
+                    "— a weight swap must be same-geometry")
+            for ref, new in zip(ref_leaves, new_leaves):
+                if (getattr(ref, "shape", None) != getattr(new, "shape",
+                                                           None)
+                        or getattr(ref, "dtype", None)
+                        != getattr(new, "dtype", None)):
+                    raise ValueError(
+                        f"set_params: leaf geometry mismatch "
+                        f"{getattr(ref, 'shape', None)}/"
+                        f"{getattr(ref, 'dtype', None)} vs "
+                        f"{getattr(new, 'shape', None)}/"
+                        f"{getattr(new, 'dtype', None)}")
+        self._params_override = tree
+        self._override_version += 1
+        self._qparams = None
+        self._qparams_key = None
+        self._q_refs = None
+
     def _params(self):
         return (self._quantized_params() if self.quantize
-                else self.model.params)
+                else self._source_params())
 
     def _cached_program(self, key, build):
         """LRU lookup/insert for compiled decode programs."""

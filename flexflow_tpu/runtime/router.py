@@ -94,7 +94,7 @@ import numpy as np
 from flexflow_tpu.logger import fflogger
 from flexflow_tpu.ops import sampling as sampling_ops
 from flexflow_tpu.runtime import faultinject, flightrec, locks, telemetry
-from flexflow_tpu.runtime.serving import RadixPrefixCache
+from flexflow_tpu.runtime.serving import RadixPrefixCache, version_ns
 
 
 class ReplicaCrash(RuntimeError):
@@ -285,6 +285,15 @@ class ServingRouter:
         self._busy_ticks = [0] * self.n
         self._stop = threading.Event()
         self._draining = False
+        # rolling deploy (ISSUE 17): a SUSPENDED replica is alive (its
+        # driver keeps ticking, it is never fenced) but receives no new
+        # dispatches — the deployer's drain-swap-warmup window. The
+        # deploying flag degrades (not breaches) the /healthz rollup
+        # while a roll is in progress.
+        self._suspended = [False] * self.n
+        self._deploying = False
+        self._swaps_completed = 0
+        self._rollbacks = 0
         self._next_rid = 0
         # router counters (stats()): the fleet-level ledger
         self._submitted = 0
@@ -573,6 +582,55 @@ class ServingRouter:
         for t in self._threads:
             t.join(timeout=30)
 
+    # ---- rolling deploy hooks (runtime/deploy.py drives these) --------------
+
+    def suspend_replica(self, r: int):
+        """Stop dispatching NEW work to replica r; its driver keeps
+        ticking so in-flight work drains naturally, and the hang sweep
+        never fences it (an idle replica has no outstanding work). The
+        deployer's drain-swap-warmup window."""
+        with self._lock:
+            self._suspended[r] = True
+            self.engines[r].deploy_state = "draining"
+
+    def resume_replica(self, r: int):
+        """Readmit replica r to dispatch after a swap (or an aborted
+        one). Affinity entries recorded for it under its PREVIOUS
+        version are dropped — the swap flushed those pages."""
+        with self._lock:
+            self._suspended[r] = False
+            self._drop_affinity_locked(r)
+            # only the drain gate resets here: "canary" belongs to the
+            # deployer, which resumes the canary so it RECEIVES soak
+            # traffic while still being judged (and drilled) as canary
+            if self.engines[r].deploy_state == "draining":
+                self.engines[r].deploy_state = "serving"
+
+    def replica_quiesced(self, r: int) -> bool:
+        """True when replica r owes the router nothing: no outstanding
+        engine work and nothing assigned-but-not-submitted."""
+        with self._lock:
+            return (not self._outstanding[r]
+                    and not self._to_submit[r])
+
+    def _drop_affinity_locked(self, r: int):
+        for key in [k for k, v in self._affinity.items() if v[0] == r]:
+            del self._affinity[key]
+
+    def set_deploying(self, on: bool):
+        """Mark a roll in progress: /healthz degrades (never breaches)
+        while this is set (flightrec.health_rollup)."""
+        with self._lock:
+            self._deploying = bool(on)
+
+    def note_swap(self):
+        with self._lock:
+            self._swaps_completed += 1
+
+    def note_rollback(self):
+        with self._lock:
+            self._rollbacks += 1
+
     # ---- dispatch (router lock held) ----------------------------------------
 
     def _alive(self) -> List[int]:
@@ -591,7 +649,7 @@ class ServingRouter:
         prefill replicas decode (the fleet degrades to mixed); with the
         prefill side gone, _classify_locked already downgraded the work
         to the cold path."""
-        alive = self._alive()
+        alive = [r for r in self._alive() if not self._suspended[r]]
         if phase == "prefill":
             return [r for r in alive if self.roles[r] == "prefill"]
         cands = [r for r in alive if self.roles[r] != "prefill"]
@@ -622,19 +680,56 @@ class ServingRouter:
                 # fallback on the decode side, never stranded
                 self._handoff_fallbacks += 1
             return
-        entry = (self._affinity.get(req.affinity)
-                 if req.affinity is not None else None)
-        if entry is not None and not self._fenced[entry[0]] \
-                and self.roles[entry[0]] != "prefill":
+        entry = self._home_locked(req)
+        if entry is not None and self.roles[entry[0]] != "prefill":
             return                  # warm home: direct hit beats handoff
         req.phase = "prefill"
+
+    def _affinity_key(self, req: FleetRequest, version: str):
+        """The affinity-map key for this request under weight
+        ``version``: exactly the trie's version-salted first edge
+        (serving.version_ns), so equal key still guarantees a trie hit
+        on the home replica. At the default version this is bit-
+        identical to the pre-deploy adapter-namespaced key."""
+        if req.affinity is None:
+            return None
+        ns = version_ns(version, req.adapter)
+        if ns == req.adapter:
+            return req.affinity     # default version: the precomputed key
+        return RadixPrefixCache.first_chunk(
+            req.prompt[:self.page_size], ns)
+
+    def _home_locked(self, req: FleetRequest):
+        """The live (replica, tier) whose trie is guaranteed to hold
+        this request's first-page prefix. Affinity entries are keyed by
+        the VERSION-SALTED trie edge, so mid-roll the lookup tries each
+        live weight version (<= 2 during a roll, 1 otherwise) and only
+        trusts an entry whose replica still serves the version it was
+        recorded under — a swapped replica's old-version pages are
+        flushed, so its stale entries must not steer."""
+        if req.affinity is None:
+            return None
+        seen = set()
+        for r0 in self._alive():
+            v = self.engines[r0].weight_version
+            if v in seen:
+                continue
+            seen.add(v)
+            entry = self._affinity.get(self._affinity_key(req, v))
+            if entry is None:
+                continue
+            home = entry[0]
+            if (not self._fenced[home]
+                    and self.engines[home].weight_version == v):
+                return entry
+        return None
 
     def _pick_replica_locked(self, req: FleetRequest) -> Optional[int]:
         cands = self._eligible_locked(req.phase)
         if not cands:
             return None
         if req.affinity is not None and req.phase != "prefill":
-            entry = self._affinity.get(req.affinity)
+            entry = self._home_locked(req)
             if entry is not None:
                 home, _tier = entry
                 if home in cands and self._load(home) < self._cap:
@@ -698,9 +793,13 @@ class ServingRouter:
                 # the affinity home is where the prefix DECODES (and
                 # therefore publishes); a prefill dispatch must not
                 # steal the key from the decode side. Tier starts hbm;
-                # the replica's tier events keep it current.
-                self._affinity[req.affinity] = (r, "hbm")
-                self._affinity.move_to_end(req.affinity)
+                # the replica's tier events keep it current. The key is
+                # salted with the DISPATCHED replica's weight version —
+                # the namespace its trie will file the prefix under.
+                key = self._affinity_key(
+                    req, self.engines[r].weight_version)
+                self._affinity[key] = (r, "hbm")
+                self._affinity.move_to_end(key)
                 while len(self._affinity) > self._affinity_cap:
                     self._affinity.popitem(last=False)
             self._outstanding[r][req.rid] = (req, None)
@@ -1108,6 +1207,9 @@ class ServingRouter:
                        "fenced": self._fenced[r],
                        "fence_reason": self._fence_reason[r],
                        "outstanding": self._load(r),
+                       "weight_version": eng.weight_version,
+                       "deploy_state": eng.deploy_state,
+                       "suspended": self._suspended[r],
                        **eng.load()}
                 per_replica.append(row)
             return {
@@ -1124,6 +1226,12 @@ class ServingRouter:
                 "resubmitted": self._resubmitted,
                 "handoffs": self._handoffs,
                 "handoff_fallbacks": self._handoff_fallbacks,
+                # rolling-deploy ledger (ISSUE 17, keys pinned):
+                # completed per-replica swaps, automatic rollbacks, and
+                # whether a roll is in progress right now
+                "swaps_completed": self._swaps_completed,
+                "rollbacks": self._rollbacks,
+                "deploying": self._deploying,
                 "queued": len(self._queue),
                 "max_queue": self.max_queue,
                 "ttft_p50_ms": round(pct(0.50) * 1e3, 3),
@@ -1201,4 +1309,12 @@ class ServingRouter:
                                    if not self._fenced[r]),
                 "fenced": self._fenced_count,
                 "max_queue": self.max_queue,
+                # rolling deploy (ISSUE 17): /healthz reports every
+                # replica's weight version, and `deploying` degrades
+                # (never breaches) the rollup while a roll is live
+                "deploying": self._deploying,
+                "weight_versions": [eng.weight_version
+                                    for eng in self.engines],
+                "deploy_states": [eng.deploy_state
+                                  for eng in self.engines],
             }

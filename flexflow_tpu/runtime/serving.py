@@ -154,6 +154,27 @@ from flexflow_tpu.runtime.lora import LoraAdapterPool
 # router assigns a fleet identity (set_telemetry_identity)
 _ENGINE_IDS = iter(range(1 << 30))
 
+# the weight version every engine serves until a rolling deploy swaps it
+# (runtime/deploy.py). The default version salts NOTHING — version_ns
+# returns the bare adapter namespace, so pre-deploy behavior (cache keys,
+# affinity hashes, slab namespaces) is bit-identical to builds without
+# versioning.
+DEFAULT_WEIGHT_VERSION = "v0"
+
+
+def version_ns(version, adapter=None):
+    """The prefix-cache namespace for (weight version, LoRA adapter) —
+    the ISSUE-14 ``("ns", adapter)`` salt extended to versions (ISSUE
+    17): KV depends on the weights that produced it, so cached prefixes
+    must never cross weight versions during an A/B roll. Kept next to
+    RadixPrefixCache.first_chunk so the engine, router affinity, and
+    slab import/export derive the SAME key and cannot drift. The default
+    version maps to the bare adapter (None for no adapter): zero change
+    to any pre-deploy trie or affinity key."""
+    if version in (None, "", DEFAULT_WEIGHT_VERSION):
+        return adapter
+    return (version, adapter)
+
 
 def _ktune_stats():
     from flexflow_tpu.search import kernel_tune
@@ -1183,6 +1204,14 @@ class ServingEngine:
 
         self._queue: List[Request] = []
         self._draining = False
+        # rolling-deploy identity (ISSUE 17): the weight version this
+        # engine serves (salts cache namespaces + affinity keys via
+        # version_ns) and where it stands in a roll —
+        # "serving" | "draining" | "swapping" | "canary". Both ride
+        # stats()/health()/telemetry.
+        self.weight_version = DEFAULT_WEIGHT_VERSION
+        self.deploy_state = "serving"
+        self._weight_swaps = 0
         self._programs: Dict = {}
         # ffsan retrace sentinel: warmup() closes the program set;
         # armed + sanitize on, _compiled_call reports any further
@@ -1367,6 +1396,14 @@ class ServingEngine:
                           "weight_dtype", "impl")).labels(
             *lab, st["kv_cache_dtype"], st["weight_dtype"],
             st["paged_attention_impl"]).set(1)
+        # rolling-deploy identity (ISSUE 17): the string-valued version
+        # and deploy state ride a labeled info gauge (value always 1) —
+        # the numeric loop above only exports numbers
+        reg.gauge("ff_replica_weight_version",
+                  "weight version + deploy state per replica "
+                  "(value is always 1)",
+                  labels=("replica", "role", "version", "state")).labels(
+            *lab, st["weight_version"], st["deploy_state"]).set(1)
         # per-adapter speculation accept rate (ISSUE 14): one labeled
         # series per adapter that has seen speculative traffic
         if self._adapter_spec:
@@ -1440,6 +1477,8 @@ class ServingEngine:
                 "replica": self._tm_labels["replica"],
                 "role": self._tm_labels["role"],
                 "status": "draining" if self._draining else "up",
+                "weight_version": self.weight_version,
+                "deploy_state": self.deploy_state,
                 **self.load()}
 
     # ---- request lifecycle --------------------------------------------------
@@ -2105,6 +2144,13 @@ class ServingEngine:
                 kept.append(req)
         self._queue = kept
 
+    def _cache_ns(self, adapter):
+        """The trie namespace this engine files prefixes under: the
+        adapter salt (ISSUE 14) plus this engine's weight-version salt
+        (ISSUE 17, rolling deploy) — ``version_ns`` keeps the default
+        version bit-identical to the bare adapter key."""
+        return version_ns(self.weight_version, adapter)
+
     def _admit(self):
         """Move queued requests into free slots: look up the longest
         cached prompt prefix, allocate fresh pages for everything past it
@@ -2127,10 +2173,12 @@ class ServingEngine:
             matched: List[_TrieNode] = []
             if self.prefix_cache is not None:
                 cap = (req.prompt.size - 1) // self.page_size
-                # the trie is namespaced per adapter (KV depends on the
-                # adapter's deltas): tenants never share prefix pages
-                matched = self.prefix_cache.match(req.prompt, cap,
-                                                  ns=req.adapter)
+                # the trie is namespaced per (weight version, adapter):
+                # KV depends on both the adapter's deltas and the
+                # weights that produced it — tenants never share prefix
+                # pages, and neither do weight versions mid-roll
+                matched = self.prefix_cache.match(
+                    req.prompt, cap, ns=self._cache_ns(req.adapter))
             full = len(matched)
             # host-resident matched pages each need a fresh HBM page to
             # promote into before they can be mounted read-only
@@ -2197,6 +2245,18 @@ class ServingEngine:
             if faultinject.active_plan().fire("slow", "serve"):
                 # ffsan: allow(lock-across-blocking) — stalling
                 # this replica's tick IS the slow() drill's point
+                time.sleep((faultinject.active_plan().last_value or 0)
+                           / 1000.0)
+            # FF_FAULT=slow(<ms>)@canary:<n> — the deterministic canary
+            # SLO-breach drill (ISSUE 17): stall admissions ONLY while
+            # this engine is the deploy canary, inflating its TTFT past
+            # the slo_ttft_p99_s bound so the RollingDeployer's soak
+            # judges a breach and rolls back. Non-canary replicas never
+            # consume from the plan (fire() checks deploy_state first).
+            if (self.deploy_state == "canary"
+                    and faultinject.active_plan().fire("slow", "canary")):
+                # ffsan: allow(lock-across-blocking) — the stall is
+                # the injected breach itself
                 time.sleep((faultinject.active_plan().last_value or 0)
                            / 1000.0)
             fresh = [self._free_pages.pop() for _ in range(need)]
@@ -2310,7 +2370,7 @@ class ServingEngine:
                 if last > full:
                     created = self.prefix_cache.insert(
                         req.prompt, matched, full, req.pages[full:last],
-                        ns=req.adapter)
+                        ns=self._cache_ns(req.adapter))
                     if created:
                         adopted = {n.page for n in created}
                         req.trie_nodes.extend(created)
@@ -2381,7 +2441,8 @@ class ServingEngine:
             ps_sz = self.page_size
             last = prompt.size // ps_sz     # publishable full pages
             cap = (prompt.size - 1) // ps_sz
-            matched = self.prefix_cache.match(prompt, cap, ns=adapter)
+            matched = self.prefix_cache.match(
+                prompt, cap, ns=self._cache_ns(adapter))
             full = len(matched)
             if last <= full:
                 return last                 # already fully published
@@ -2457,7 +2518,8 @@ class ServingEngine:
                 return None
             pages = [n.page for n in matched] + fresh
             created = self.prefix_cache.insert(
-                prompt, matched, full, pages[full:last], ns=adapter)
+                prompt, matched, full, pages[full:last],
+                ns=self._cache_ns(adapter))
             # the publisher holds no mount: published pages sit warm at
             # refcount 0, exportable and evictable like any cached page
             self.prefix_cache.release(created)
@@ -2482,7 +2544,8 @@ class ServingEngine:
             last = prompt.size // self.page_size
             if last < 1:
                 return None
-            path = self.prefix_cache.match(prompt, last, ns=adapter)
+            path = self.prefix_cache.match(
+                prompt, last, ns=self._cache_ns(adapter))
             if len(path) < last:
                 return None
             # host-tier pages export from their pinned payloads; the
@@ -2501,9 +2564,13 @@ class ServingEngine:
                     payload = by_node[id(node)]
                 payloads.append(payload)
             self._slab_exports += 1
+            # the slab carries the exporter's SALTED namespace: an
+            # importer on a different weight version files it under the
+            # exporter's version key, so its own traffic can never hit
+            # cross-version KV (zero stale hits by construction)
             return {"page_size": self.page_size,
                     "tokens": prompt[:last * self.page_size].copy(),
-                    "ns": adapter,
+                    "ns": self._cache_ns(adapter),
                     "payload": payloads}
 
     def import_prefix_slab(self, slab) -> int:
@@ -3013,6 +3080,71 @@ class ServingEngine:
             snap["queued"], snap["occupancy"], snap["recompiles"])
         return snap
 
+    def reopen(self):
+        """Readmit after a drain() (ISSUE 17 satellite: drain used to be
+        terminal). The drained engine's slots are all free and its
+        counters/pages consistent — reopening is just lifting the
+        admission gate; queued requests (if any survived the drain
+        untouched) admit on the next tick, and ``submit()`` works again.
+        Idempotent; a no-op on an engine that was never drained."""
+        with self._lock:
+            self._draining = False
+            if self.deploy_state == "draining":
+                self.deploy_state = "serving"
+        fflogger.info("serving: reopened — admitting again (version %s)",
+                      self.weight_version)
+
+    def swap_weights(self, params, version: str) -> Dict:
+        """Hot-swap this engine's serving weights in place (ISSUE 17):
+        install ``params`` (a device tree matching ``model.params`` in
+        structure/shape/dtype — same geometry, so every warm fixed-shape
+        program stays valid and nothing retraces) as the generator's
+        per-engine override, re-quantize ONCE if this is a quantized
+        tier, and flush the prefix cache (a drained engine holds every
+        cached page at refcount 0, so the flush is total; stale-KV
+        safety does not depend on it — the version salt already
+        partitions the trie). ``params=None`` reverts to the shared
+        ``model.params`` (rollback to the construction-time weights).
+
+        The engine must be DRAINED: swapping under live slots would
+        hand in-flight decodes a mid-stream weight change.
+
+        FF_FAULT=swap_fail@deploy:<n> dies AFTER the install — the torn
+        mid-swap drill; the deployer catches it, restores the prior
+        version and rolls the whole deploy back."""
+        with self._lock:
+            if self.active.any():
+                raise RuntimeError(
+                    "swap_weights: engine has live slots — drain() first "
+                    "(a mid-stream weight change corrupts in-flight "
+                    "decodes)")
+            prev = (self.gen._params_override, self.weight_version)
+            self.deploy_state = "swapping"
+            try:
+                self.gen.set_params(params)
+                if self.gen.quantize:
+                    # re-quantize once, now, under the swap — admission
+                    # and decode never pay the quantization pass
+                    self.gen._quantized_params()
+                faultinject.maybe_fail("swap_fail", "deploy")
+            except BaseException:
+                # restore the prior weights before re-raising: a failed
+                # swap must leave the engine serving what it served
+                self.gen.set_params(prev[0])
+                if self.gen.quantize:
+                    self.gen._quantized_params()
+                self.deploy_state = "serving"
+                raise
+            self.weight_version = str(version)
+            self._weight_swaps += 1
+            flushed = self.flush_prefix_cache()
+            self.deploy_state = "serving"
+        fflogger.info(
+            "serving: weight swap -> %s (%d cached pages flushed, "
+            "swap #%d)", self.weight_version, flushed, self._weight_swaps)
+        return {"version": self.weight_version, "flushed_pages": flushed,
+                "swaps": self._weight_swaps}
+
     def health(self) -> Dict:
         """Cheap liveness/readiness probe for a router: admission status
         plus the load counters a balancer steers by, sliced from the one
@@ -3036,6 +3168,8 @@ class ServingEngine:
                 "admitting": not self._draining,
                 "active_slots": active,
                 "queued": len(self._queue),
+                "weight_version": self.weight_version,
+                "deploy_state": self.deploy_state,
                 **{k: snap[k] for k in ("serve_slots", "free_pages",
                                         "completed", "failed", "timeouts",
                                         "occupancy", "recompiles",
@@ -3089,6 +3223,12 @@ class ServingEngine:
             "completed": self._completed,
             "failed": self._failed,
             "timeouts": self._timeouts,
+            # rolling-deploy identity (ISSUE 17): the weight version this
+            # engine serves, where it stands in a roll, and how many
+            # in-place swaps it has taken (keys pinned)
+            "weight_version": self.weight_version,
+            "deploy_state": self.deploy_state,
+            "weight_swaps": self._weight_swaps,
             "tokens_generated": self._tokens_emitted,
             "decode_steps": self.decode_steps,
             "recompiles": self.recompile_count,

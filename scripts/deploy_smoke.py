@@ -1,0 +1,330 @@
+#!/usr/bin/env python
+"""CI rolling-deployment smoke (ci/run_ci.sh `deploy` tier): a 2-replica
+fleet under a skewed closed-loop flood, a new weight version published
+mid-flood, and a RollingDeployer rolling the fleet onto it one replica
+at a time. Proves the ISSUE-17 acceptance end to end on CPU:
+
+  leg 1 — rolling swap under load:
+  * every flood request is served EXACTLY ONCE through the roll — none
+    dropped, none duplicated (router ledger == per-engine completions);
+  * the fleet never falls below N-1 capacity (at most one replica
+    suspended at any sampled instant, zero fenced);
+  * ZERO recompiles anywhere in the warm window: the same-geometry swap
+    keeps every fixed-shape program valid on the swapped replica, and
+    the survivor never compiles under the rerouted load;
+  * post-roll traffic is token-identical to a reference model holding
+    the NEW weights (and the fleet reports the new version everywhere).
+
+  leg 2 — canary breach -> automatic rollback:
+  * FF_FAULT ``slow(<ms>)@canary`` stalls the freshly-swapped canary's
+    admissions, deterministically breaching its rebaselined TTFT SLO;
+  * the deployer rolls the fleet BACK — every replica ends on the prior
+    version, traffic still exactly-once and token-identical to it;
+  * exactly ONE manifest-intact flight-recorder bundle lands, its
+    trigger naming the breached SLO.
+
+Usage: python scripts/deploy_smoke.py [N_per_leg]
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flexflow_tpu._env import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(1)
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+from flexflow_tpu import FFConfig, FFModel  # noqa: E402
+from flexflow_tpu.models.llama import llama_lm  # noqa: E402
+from flexflow_tpu.runtime import faultinject, flightrec  # noqa: E402
+from flexflow_tpu.runtime.deploy import (RollingDeployer,  # noqa: E402
+                                         WeightArtifactRegistry)
+
+VOCAB = 128
+MAX_NEW = 12
+
+
+def build_model():
+    cfg = FFConfig(batch_size=2, mesh_shape={"data": 1}, serve_slots=4,
+                   kv_page_size=8, slo_window_s=1.0)
+    ff = FFModel(cfg)
+    _, logits = llama_lm(ff, 2, seq_len=16, hidden=64, layers=1, heads=4,
+                         kv_heads=2, vocab_size=VOCAB)
+    ff.compile(final_tensor=logits)
+    return ff
+
+
+def publish_bumped(ff, registry, step, scale):
+    """Publish a same-geometry, visibly-different weight tree as
+    v<step> — the 'new training run' — leaving the model untouched."""
+    keep = ff.params
+    bumped = jax.tree_util.tree_map(
+        lambda x: (np.asarray(x) * scale).astype(np.asarray(x).dtype),
+        keep)
+    ff.params = ff.executor.reshard_params(bumped)
+    try:
+        return registry.publish(ff, step=step)
+    finally:
+        ff.params = keep
+
+
+class Feeder(threading.Thread):
+    """Closed-loop skewed flood: keeps up to ``max_inflight`` requests
+    open (80% share a 64-token system prompt) until stopped, sampling
+    the fleet's suspension count every iteration — the capacity>=N-1
+    witness."""
+
+    def __init__(self, router, rs, system, max_inflight=12):
+        super().__init__(daemon=True)
+        self.router, self.rs, self.system = router, rs, system
+        self.max_inflight = max_inflight
+        self.reqs, self.max_suspended = [], 0
+        self._halt = threading.Event()
+
+    def _prompt(self):
+        if self.rs.randint(5) < 4:
+            tail = self.rs.randint(
+                1, VOCAB, (int(self.rs.randint(1, 8)),)).astype(np.int32)
+            return np.concatenate([self.system, tail])
+        return self.rs.randint(
+            1, VOCAB, (int(self.rs.randint(3, 25)),)).astype(np.int32)
+
+    def run(self):
+        while not self._halt.is_set():
+            self.max_suspended = max(
+                self.max_suspended, sum(self.router._suspended))
+            if sum(1 for r in self.reqs
+                   if not r.settled) >= self.max_inflight:
+                time.sleep(0.004)
+                continue
+            self.reqs.append(self.router.submit(self._prompt(), MAX_NEW))
+
+    def stop(self):
+        self._halt.set()
+        self.join(timeout=60)
+
+
+def ref_tokens(ff, tree, prompt):
+    """Solo greedy reference under ``tree`` (the fleet must match it)."""
+    keep = ff.params
+    ff.params = tree
+    try:
+        out = ff.generate(prompt[None, :], max_new_tokens=MAX_NEW)
+    finally:
+        ff.params = keep
+    return out[0, prompt.size:]
+
+
+def settle(router, feeder, engines_before, warmups_since):
+    """Stop the flood, wait everything out, and assert the exactly-once
+    ledger: router completions == flood size, per-engine completions ==
+    flood + the deploy warmups that ran engine-side."""
+    feeder.stop()
+    router.wait(feeder.reqs, timeout=1200)
+    n = len(feeder.reqs)
+    assert all(r.settled for r in feeder.reqs), "requests lost"
+    assert [r.state for r in feeder.reqs] == ["done"] * n, \
+        f"{sum(1 for r in feeder.reqs if r.state != 'done')} of {n} " \
+        f"requests did not complete through the roll"
+    engine_done = sum(e.stats()["completed"] for e in router.engines) \
+        - engines_before
+    assert engine_done == n + warmups_since, (
+        f"engines completed {engine_done} != {n} flood + "
+        f"{warmups_since} warmup: duplicated or dropped work")
+    assert all(r.attempts == 1 for r in feeder.reqs), \
+        "no fault was armed that justifies a resubmission"
+    return n
+
+
+def main():
+    n_target = int(sys.argv[1]) if len(sys.argv) > 1 else 80
+    work = tempfile.mkdtemp(prefix="ff_deploy_smoke_")
+    watch = os.path.join(work, "watch")
+    flight = os.path.join(work, "flight")
+    os.makedirs(flight)
+    ff = build_model()
+    registry = WeightArtifactRegistry(watch)
+    rs = np.random.RandomState(0)
+    system = rs.randint(1, VOCAB, (64,)).astype(np.int32)  # 8 full pages
+
+    router = ff.make_serving_router(
+        replicas=2, max_seq_len=112, decode_buckets=[32, 96], start=False)
+    warm_tail = rs.randint(1, VOCAB, (3,)).astype(np.int32)
+    warm_prompts = [rs.randint(1, VOCAB, (10,)).astype(np.int32),
+                    np.concatenate([system, warm_tail]),
+                    np.concatenate([system, warm_tail + 1])]
+    router.warmup(warm_prompts, max_new_tokens=4)
+    warm_compiles = [e.recompile_count for e in router.engines]
+    router.start()
+    deployer = RollingDeployer(router, registry, canary_windows=2)
+
+    try:
+        leg1(ff, router, registry, deployer, rs, system, warm_prompts,
+             warm_compiles, n_target)
+        leg2(ff, router, registry, deployer, rs, system, warm_prompts,
+             flight)
+        sanitize_check(router)
+    finally:
+        router.close()
+        shutil.rmtree(work, ignore_errors=True)
+    print("deploy_smoke: PASSED")
+
+
+def leg1(ff, router, registry, deployer, rs, system, warm_prompts,
+         warm_compiles, n_target):
+    v1 = publish_bumped(ff, registry, step=1, scale=1.25)
+    tree1 = ff.executor.reshard_params(registry.load_params(v1))
+
+    base_done = sum(e.stats()["completed"] for e in router.engines)
+    feeder = Feeder(router, rs, system)
+    feeder.start()
+    while len(feeder.reqs) < max(8, n_target // 10):  # flood is live
+        time.sleep(0.01)
+
+    t0 = time.perf_counter()
+    report = deployer.deploy(v1, warmup_prompts=warm_prompts,
+                             max_new_tokens=4)
+    dt = time.perf_counter() - t0
+    while len(feeder.reqs) < n_target:  # post-roll traffic too
+        time.sleep(0.01)
+    # each swapped engine's warmup drives 2 passes over the prompt set
+    # (cold + hit variants) — those requests are engine-side, not router
+    n = settle(router, feeder, base_done,
+               warmups_since=2 * 2 * len(warm_prompts))
+
+    assert report["state"] == "completed", report
+    assert report["swapped"] == [0, 1] and report["canary"] == 0
+    st = router.stats()
+    assert st["fenced"] == 0, "a healthy roll must not fence anyone"
+    assert feeder.max_suspended <= 1, (
+        f"{feeder.max_suspended} replicas suspended at once — the fleet "
+        f"dropped below N-1 capacity")
+    assert [e.weight_version for e in router.engines] == [v1, v1]
+    assert st["swaps_completed"] == 2 and st["rollbacks"] == 0
+    assert not st["deploying"]
+    assert [row["weight_version"] for row in st["per_replica"]] \
+        == [v1, v1]
+    assert router.health()["weight_versions"] == [v1, v1]
+    for r, eng in enumerate(router.engines):
+        assert eng._cache_ns(None) == (v1, None), \
+            f"replica {r} trie not salted with {v1}"
+        assert eng.recompile_count == warm_compiles[r], (
+            f"replica {r} compiled "
+            f"{eng.recompile_count - warm_compiles[r]} programs during "
+            f"the roll — the swap must not retrace")
+    # post-roll traffic serves the NEW weights, token-identically
+    for probe in [np.concatenate(
+            [system, rs.randint(1, VOCAB, (4,)).astype(np.int32)]),
+            rs.randint(1, VOCAB, (9,)).astype(np.int32)]:
+        got = router.run([probe], max_new_tokens=MAX_NEW,
+                         timeout=600)[0]
+        np.testing.assert_array_equal(
+            np.asarray(got.tokens, np.int32), ref_tokens(ff, tree1, probe),
+            err_msg="post-roll stream diverged from the v1 reference")
+    print(f"deploy_smoke[roll]: {n} requests exactly-once through the "
+          f"{dt:.1f}s roll to {v1} (canary replica "
+          f"{report['canary']} held {deployer.canary_windows} windows), "
+          f"0 recompiles, max {feeder.max_suspended} replica out")
+
+
+def leg2(ff, router, registry, deployer, rs, system, warm_prompts,
+         flight_dir):
+    v1 = router.engines[0].weight_version
+    v2 = publish_bumped(ff, registry, step=2, scale=1.5)
+    tree1 = ff.executor.reshard_params(registry.load_params(v1))
+
+    # arm the SLO plane: a tight TTFT ceiling over 1 s windows, bundles
+    # into a fresh dir (debounce parked high so the ONLY bundle written
+    # is the rollback's own synchronous dump — fault trips merge into it)
+    flightrec.configure(FFConfig(
+        batch_size=2, mesh_shape={"data": 1}, slo_ttft_p99_s=0.25,
+        slo_window_s=1.0, flight_recorder_dir=flight_dir,
+        flight_debounce_s=600.0))
+    os.environ["FF_FAULT"] = "slow(600)@canary:1-400"
+    faultinject.reset()
+
+    base_done = sum(e.stats()["completed"] for e in router.engines)
+    feeder = Feeder(router, rs, system)
+    feeder.start()
+    while len(feeder.reqs) < 8:
+        time.sleep(0.01)
+    try:
+        report = deployer.deploy(v2, warmup_prompts=warm_prompts,
+                                 max_new_tokens=4)
+    finally:
+        os.environ.pop("FF_FAULT", None)
+        faultinject.reset()
+    # only the canary's warmup ran engine-side (2 passes); the rollback
+    # swap rebaselines without re-warming
+    n = settle(router, feeder, base_done,
+               warmups_since=2 * len(warm_prompts))
+
+    assert report["state"] == "rolled_back", report
+    assert report["breach"] is not None, \
+        "rollback without a recorded canary breach"
+    assert report["breach"]["slo"] == "ttft_p99", report["breach"]
+    assert str(report["breach"]["replica"]) == str(report["canary"])
+    assert report["rollback_s"] > 0
+    assert [e.weight_version for e in router.engines] == [v1, v1], \
+        "the fleet must end back on the prior version"
+    st = router.stats()
+    assert st["rollbacks"] == 1 and st["fenced"] == 0
+    # exactly one manifest-intact bundle, naming the breached SLO
+    bundles = [os.path.join(flight_dir, d)
+               for d in os.listdir(flight_dir)]
+    assert len(bundles) == 1, f"expected exactly 1 bundle: {bundles}"
+    assert report["bundle"] == bundles[0]
+    flightrec.verify_bundle(bundles[0])
+    trigger = json.load(open(os.path.join(bundles[0], "trigger.json")))
+    blob = json.dumps(trigger)
+    assert "canary_rollback" in blob and "ttft_p99" in blob, \
+        "the bundle's trigger must name the breached SLO"
+    # rolled-back fleet serves the PRIOR weights, token-identically
+    probe = np.concatenate(
+        [system, rs.randint(1, VOCAB, (5,)).astype(np.int32)])
+    got = router.run([probe], max_new_tokens=MAX_NEW, timeout=600)[0]
+    np.testing.assert_array_equal(
+        np.asarray(got.tokens, np.int32), ref_tokens(ff, tree1, probe),
+        err_msg="post-rollback stream diverged from the v1 reference")
+    print(f"deploy_smoke[rollback]: canary breached "
+          f"{report['breach']['slo']} "
+          f"({report['breach']['value']:.3f}s vs "
+          f"{report['breach']['bound']:.3f}s), fleet back on {v1} in "
+          f"{report['rollback_s']:.2f}s, {n} requests exactly-once, "
+          f"bundle {os.path.basename(bundles[0])} intact")
+
+
+def sanitize_check(router):
+    if not os.environ.get("FF_SANITIZE"):
+        return
+    from flexflow_tpu.runtime import locks
+
+    assert locks.mode() != "off", "FF_SANITIZE set but sanitizer off"
+    assert locks.violations() == [], (
+        "lock-order violations under FF_SANITIZE:\n"
+        + "\n".join(f"{v['outer']} -> {v['inner']}\n{v['inner_stack']}"
+                    for v in locks.violations()))
+    # the injected canary stall is the ONLY tolerated warm-window delay;
+    # it must never have manifested as a retrace
+    assert locks.retrace_log() == [], (
+        "post-warmup retraces under FF_SANITIZE:\n"
+        + "\n".join(f"{r['program']} {r['signature']}\n{r['stack']}"
+                    for r in locks.retrace_log()))
+    retr = [e.stats()["sanitizer_retraces"] for e in router.engines]
+    assert sum(retr) == 0, f"per-engine sentinel hits: {retr}"
+    print(f"deploy_smoke[sanitize]: zero violations, zero retraces "
+          f"across both legs")
+
+
+if __name__ == "__main__":
+    main()

@@ -160,6 +160,40 @@ def test_fault_parser_tier_migration_kinds():
     assert ("d2h_fail", "migrate") not in q._counts
 
 
+def test_fault_parser_deploy_kinds():
+    """The rolling-deploy drill grammar (ISSUE 17):
+    corrupt_ckpt@publish:<n> tears the n-th artifact landing in the
+    watch path, swap_fail@deploy:<n> dies after the n-th weight install,
+    slow(<ms>)@canary:<n> stalls the n-th CANARY admission by <ms>. All
+    three ride the occurrence-counted site machinery; publish, deploy
+    and canary counters are independent of each other AND of the save /
+    serve sites the same kinds fire on elsewhere."""
+    p = FaultPlan.parse(
+        "corrupt_ckpt@publish:2,swap_fail@deploy:1,slow(400)@canary:3")
+    assert ("corrupt_ckpt", "publish", 2) in p.events
+    assert ("swap_fail", "deploy", 1) in p.events
+    assert ("slow", "canary", 3) in p.events
+    # publish counter: artifact 1 lands clean, artifact 2 is torn
+    assert not p.fire("corrupt_ckpt", "publish")
+    assert p.fire("corrupt_ckpt", "publish")
+    # the save-site counter for the SAME kind never advanced
+    assert ("corrupt_ckpt", "save") not in p._counts
+    # deploy counter: the very first swap dies
+    assert p.fire("swap_fail", "deploy") and p.last_value is None
+    assert not p.fire("swap_fail", "deploy"), "occurrence 2 clean"
+    # canary counter carries the stall milliseconds, independent of the
+    # serve-site slow counter
+    assert not p.fire("slow", "canary")
+    assert not p.fire("slow", "canary")
+    assert p.fire("slow", "canary") and p.last_value == 400
+    assert ("slow", "serve") not in p._counts
+    # a sustained-breach drill stalls a RANGE of canary admissions
+    r = FaultPlan.parse("slow(300)@canary:1-4")
+    assert [r.fire("slow", "canary") for _ in range(5)] \
+        == [True, True, True, True, False]
+    assert r.last_value == 300
+
+
 # ------------------------------------------------- integrity manifest
 
 
