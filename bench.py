@@ -103,6 +103,7 @@ TIER_COST_S = {"tiny": 90, "mid": 150, "full": 240, "full_scan": 180,
                "tiered_prefix": 260,
                "multi_tenant": 200,
                "rolling_deploy": 260,
+               "long_context": 240,
                "input_overlap": 90,
                "collective_overlap": 120}
 
@@ -1762,6 +1763,178 @@ def _run_rolling_deploy_tier(n_dev, backend, dev_kind):
     }
 
 
+
+def _run_long_context_tier(n_dev, backend, dev_kind):
+    """long_context tier (ISSUE 18): the two long-context serving
+    claims, measured.
+
+    (1) INTERLEAVE — a live decode stream's inter-token gaps while a
+        MAXIMAL (500-token, 32-chunk) prompt admits mid-stream,
+        interleave off (run-to-completion admission: the stream eats
+        the whole prefill as ONE gap) vs on (one chunk quantum per
+        tick). Both engines warmed by an identical cold round (prefix
+        cache off so timed rounds replay the warm round's programs);
+        acceptance: interleaved p99 gap measurably LOWER, identical
+        tokens both arms, zero timed-window recompiles.
+    (2) SEQ-PARALLEL — TTFT vs prompt length at 3 lengths, a
+        single-replica engine vs a 2-prefill/1-decode fleet with
+        ``seq_parallel_shards=2``. On the CPU smoke box the shards run
+        serially on shared cores (the router executes them from one
+        driver thread), so ~1x is the honest expectation — the curve is
+        about hardware that gives each prefill replica its own chips;
+        the row also pins the sharded streams token-identical to the
+        single engine and the seq_parallel/partial-import counters."""
+    import numpy as np
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.llama import llama_lm
+
+    _phase("build_long_context")
+    vocab = 128
+    ps, chunk, monster_len = 8, 16, 500     # monster buckets to 512
+    flood_new, monster_new = 40, 4
+    cfg = FFConfig(batch_size=2, mesh_shape={"data": 1})
+    ff = FFModel(cfg)
+    # heavy enough that the 32-chunk admission stall dwarfs one decode
+    # tick (the head-of-line effect the interleave arm measures)
+    _, logits = llama_lm(ff, 2, seq_len=16, hidden=128, layers=2,
+                         heads=4, kv_heads=2, vocab_size=vocab)
+    ff.compile(final_tensor=logits)
+    rs = np.random.RandomState(0)
+    flood = rs.randint(1, vocab, (12,)).astype(np.int32)
+    monster = rs.randint(1, vocab, (monster_len,)).astype(np.int32)
+
+    def flood_round(eng):
+        """One cold round: flood stream decoding, monster dropped on it
+        mid-stream; returns (inter-token gaps, flood toks, monster
+        toks)."""
+        fr = eng.submit(flood, max_new_tokens=flood_new)
+        while len(fr.tokens) < 4:
+            eng.step()
+        mr = eng.submit(monster, max_new_tokens=monster_new)
+        gaps, last, prev = [], len(fr.tokens), time.perf_counter()
+        while fr.state not in ("done", "failed") \
+                or mr.state not in ("done", "failed"):
+            eng.step()
+            now = time.perf_counter()
+            if len(fr.tokens) > last:
+                gaps.append((now - prev) / (len(fr.tokens) - last))
+                last, prev = len(fr.tokens), now
+        assert fr.state == "done" and mr.state == "done"
+        return gaps, list(fr.tokens), list(mr.tokens)
+
+    arms = {}
+    for budget in (0, 1):
+        _phase(f"time_long_context_interleave_{budget}")
+        eng = ff.make_serving_engine(
+            serve_slots=2, kv_page_size=ps, max_seq_len=520,
+            decode_buckets=[16, 512], prefill_chunk=chunk,
+            prefill_interleave_chunks=budget, prefix_cache=False)
+        flood_round(eng)                        # warm
+        rc = eng.recompile_count
+        gaps, ftoks, mtoks = [], None, None
+        for _ in range(3):
+            g, ftoks, mtoks = flood_round(eng)
+            gaps.extend(g)
+        gaps.sort()
+
+        def _pct(q, g=gaps):
+            return round(g[min(len(g) - 1, int(q * len(g)))] * 1e3, 3)
+
+        arms[budget] = {
+            "intertoken_p50_ms": _pct(0.50),
+            "intertoken_p99_ms": _pct(0.99),
+            "intertoken_max_ms": round(gaps[-1] * 1e3, 3),
+            "recompiles": eng.recompile_count - rc,
+            "chunks_interleaved":
+                eng.stats()["prefill_chunks_interleaved"],
+            "preempted_ticks": eng.stats()["prefill_preempted_ticks"],
+            "streams": (ftoks, mtoks),
+        }
+    off, on = arms[0], arms[1]
+    interleave_identity = off.pop("streams") == on.pop("streams")
+
+    # ---- TTFT vs prompt length, single vs 2-shard fleet ----
+    _phase("time_long_context_seq_parallel")
+    lengths = [120, 248, 500]                   # 15 / 31 / 62 pages
+    sp_kw = dict(serve_slots=2, kv_page_size=ps, max_seq_len=520,
+                 decode_buckets=[16, 128, 256, 512])
+    single = ff.make_serving_engine(**sp_kw)
+    router = ff.make_serving_router(
+        replicas=3, roles=["prefill", "prefill", "decode"],
+        seq_parallel_shards=2, handoff_min_pages=2, **sp_kw)
+    curve, identity_sharded = [], True
+    try:
+        # warm pass: fresh prompts per length drive every cold program
+        # both paths reach (timed prompts are fresh too, so they replay
+        # exactly these)
+        for L in lengths:
+            warm = rs.randint(1, vocab, (L,)).astype(np.int32)
+            single.run([warm], max_new_tokens=2)
+            router.run([warm], max_new_tokens=2, timeout=600)
+        rc_single = single.recompile_count
+        rc_fleet = [e.recompile_count for e in router.engines]
+        for L in lengths:
+            prompt = rs.randint(1, vocab, (L,)).astype(np.int32)
+            t0 = time.perf_counter()
+            sreq = single.run([prompt], max_new_tokens=2)[0]
+            dt_single = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            freq = router.run([prompt], max_new_tokens=2,
+                              timeout=600)[0]
+            dt_fleet = time.perf_counter() - t0
+            identity_sharded &= (freq.state == "done"
+                                 and list(freq.tokens)
+                                 == list(sreq.tokens))
+            curve.append({
+                "prompt_tokens": L,
+                "prompt_pages": L // ps,
+                "single_ttft_ms": round(dt_single * 1e3, 1),
+                "sharded_ttft_ms": round(dt_fleet * 1e3, 1),
+            })
+        fleet = router.stats()["fleet"]
+        seq_parallel_prefills = fleet["seq_parallel_prefills"]
+        partial_slab_imports = fleet["partial_slab_imports"]
+        recompiles_sp = (single.recompile_count - rc_single) + sum(
+            e.recompile_count - c
+            for e, c in zip(router.engines, rc_fleet))
+    finally:
+        router.close()
+
+    return {
+        "metric": "long_context_serving", "tier": "long_context",
+        # headline: how much interleaving flattens the decode stream's
+        # worst-case stall while the maximal prompt admits
+        "value": on["intertoken_p99_ms"], "unit": "intertoken_p99_ms",
+        "vs_baseline": round(
+            on["intertoken_p99_ms"]
+            / max(1e-3, off["intertoken_p99_ms"]), 3),
+        "intertoken_p99_ms_interleave_off": off["intertoken_p99_ms"],
+        "intertoken_p99_lower": bool(
+            on["intertoken_p99_ms"] < off["intertoken_p99_ms"]),
+        "token_identity_interleave": bool(interleave_identity),
+        "ttft_vs_length": curve,
+        "token_identity_sharded_vs_single": bool(identity_sharded),
+        "seq_parallel_prefills": seq_parallel_prefills,
+        "partial_slab_imports": partial_slab_imports,
+        "recompiles_after_warmup": off["recompiles"] + on["recompiles"]
+        + recompiles_sp,
+        "arms": {"interleave_off": off, "interleave_on": on},
+        "backend": backend, "device_kind": dev_kind, "n_devices": n_dev,
+        "config": {"monster_tokens": monster_len,
+                   "prefill_chunk": chunk,
+                   "monster_chunks": 512 // chunk,
+                   "flood_max_new_tokens": flood_new,
+                   "interleave_rounds_timed": 3,
+                   "curve_lengths": lengths,
+                   "seq_parallel_shards": 2,
+                   "fleet_roles": ["prefill", "prefill", "decode"],
+                   "serve_slots": 2, "kv_page_size": ps,
+                   "max_seq_len": 520, "hidden": 128, "layers": 2,
+                   "dispatch_ahead": 0, "host_wait_fraction": 0.0},
+    }
+
+
 def _run_overlap_tier(n_dev, backend, dev_kind):
     """input_overlap tier: the synchronous fit() loop vs the host-overlap
     step engine (runtime/pipeline_loader.py prefetch + dispatch-ahead)
@@ -2078,6 +2251,15 @@ def child():
         print(json.dumps(
             _run_rolling_deploy_tier(n_dev, backend, dev_kind)),
             flush=True)
+    # long_context tier (ISSUE 18): decode inter-token p99 while a
+    # maximal prompt admits (interleave on vs off) + the TTFT-vs-length
+    # curve, single replica vs the 2-shard sequence-parallel fleet
+    if "long_context" not in skip and (
+            deadline is None
+            or deadline - time.time() >= TIER_COST_S["long_context"]):
+        print(json.dumps(
+            _run_long_context_tier(n_dev, backend, dev_kind)),
+            flush=True)
     # input-overlap tier: last, pure upside — measures the host-overlap
     # step engine against the synchronous loop under a slow loader
     if "input_overlap" not in skip and (
@@ -2157,7 +2339,8 @@ def _serving_rows(results):
                                    "router_serving_throughput",
                                    "paged_attention_microbench",
                                    "tiered_prefix_serving",
-                                   "rolling_deploy_serving")]
+                                   "rolling_deploy_serving",
+                                   "long_context_serving")]
 
 
 def _attach_serving(pick, results):

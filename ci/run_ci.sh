@@ -112,6 +112,15 @@
 #               (slow@canary) that must end in an automatic rollback —
 #               fleet back on v1, exactly one manifest-intact post-mortem
 #               bundle naming the breached SLO
+#   longctx   — long-context serving tier (ISSUE 18): chunk-interleaved
+#               admission + sequence-parallel prefill suites (token
+#               identity interleaved vs run-to-completion, 2/3-shard
+#               partial-slab merges bitwise, mid-prefill fault/deadline/
+#               drain legs), then the smoke twice — plain and under
+#               FF_SANITIZE=1: a maximal prompt admitted mid-decode-
+#               flood must shrink the flood's worst inter-token gap
+#               under interleave with zero timed-window recompiles, and
+#               the 2-shard fleet merge stays bitwise + token-identical
 #   sanitize  — ffsan plane (ISSUE 16): static concurrency/
 #               tracestability passes clean over runtime/ (tiered exit:
 #               warnings fail too) + the seeded-violation harness, then
@@ -120,7 +129,7 @@
 #               retrace sentinels) asserting zero violations and zero
 #               post-warmup retraces
 #
-# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|kernels|quant|disagg|obs|router|tenancy|deploy|sanitize|all]
+# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|kernels|quant|disagg|obs|router|tenancy|deploy|longctx|sanitize|all]
 set -e
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -354,6 +363,18 @@ run_deploy() {
   python scripts/deploy_smoke.py 80
 }
 
+# longctx tier (ISSUE 18): long-context serving. The interleave/
+# seq-parallel suites (slow tests included: interleaved-vs-run-to-
+# completion token identity, the router's sharded handoff, the warmup
+# variant sweep), then the smoke — once plain and once sanitized (the
+# FF_SANITIZE leg also proves the new admission paths take the engine
+# lock in order and never retrace warm programs).
+run_longctx() {
+  python -m pytest tests/test_longctx_serving.py tests/test_seq_parallel.py -q
+  python scripts/longctx_smoke.py
+  FF_SANITIZE=1 python scripts/longctx_smoke.py 24
+}
+
 case "$TIER" in
   unit)     run_unit ;;
   sweep)    run_sweep ;;
@@ -372,8 +393,9 @@ case "$TIER" in
   router)   run_router ;;
   tenancy)  run_tenancy ;;
   deploy)   run_deploy ;;
+  longctx)  run_longctx ;;
   sanitize) run_sanitize ;;
-  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_kernels; run_quant; run_disagg; run_obs; run_router; run_tenancy; run_deploy; run_sanitize; run_native; run_docs; run_sweep ;;
+  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_kernels; run_quant; run_disagg; run_obs; run_router; run_tenancy; run_deploy; run_longctx; run_sanitize; run_native; run_docs; run_sweep ;;
   *) echo "unknown tier $TIER"; exit 2 ;;
 esac
 echo "ci($TIER): PASSED"

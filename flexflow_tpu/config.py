@@ -200,7 +200,12 @@ class FFConfig:
     serve_slots: int = 4
     # paged KV cache: pool of (kv_pages, kv_page_size, KVH, Dh) blocks
     # shared by all slots through per-slot page tables. kv_pages = 0
-    # derives 1 + serve_slots * ceil(max_seq_len / kv_page_size)
+    # derives 1 (scratch) + serve_slots * ceil(max_seq_len /
+    # kv_page_size) + prefix-cache slack (half the slot pages, at least
+    # one slot's worth) when serve_prefix_cache is on — without the
+    # slack the derived pool has zero free pages for refcount-0 cached
+    # prefixes and the radix cache silently goes cold (ISSUE 18). The
+    # engine logs the derived split at init.
     kv_page_size: int = 128
     kv_pages: int = 0
     # prompt-length admission buckets (ascending ints); None = powers of
@@ -293,6 +298,27 @@ class FFConfig:
     # traffic. Roles are placement preferences, never constraints — a
     # dead tier degrades to the mixed-fleet path.
     serve_replica_roles: str = ""
+    # ---- long-context serving (ISSUE 18) ----
+    # chunk-interleaved admission (runtime/serving.py): > 0 turns an
+    # admitted cold prompt's prefill chunks into schedulable quanta —
+    # the scheduler runs at most this many prefill chunks per step()
+    # between decode ticks, so a 100k-token prompt admits without
+    # head-of-line-blocking the replica's decode streams. Partial
+    # prefill state is slot-resident (the slot is held, inactive, until
+    # the last chunk lands); greedy/sampled streams are token-identical
+    # to run-to-completion admission. 0 = off (prefill completes at
+    # admission, the pre-18 behavior).
+    prefill_interleave_chunks: int = 0
+    # sequence-parallel prefill (runtime/router.py): >= 2 splits a
+    # long prompt's page-aligned prefix into that many contiguous
+    # sequence shards fanned out across the prefill tier; each shard
+    # exports its KV pages as a partial-prefix slab
+    # (export_prefix_slab(start_page=...)) and the decode replica
+    # merges them in order through import_prefix_slab. Bitwise the
+    # single-replica prefill (tests/test_seq_parallel.py pins page and
+    # pool equality). Requires a handoff-capable fleet
+    # (serve_replica_roles); 0/1 = off.
+    seq_parallel_shards: int = 0
     # ---- multi-tenant serving (ISSUE 14) ----
     # per-request sampling DEFAULTS (submit() overrides per request;
     # the values ride the one fixed-shape slot program as per-slot
@@ -459,6 +485,15 @@ class FFConfig:
             raise ValueError(
                 f"serve_speculate_k={self.serve_speculate_k}: must be "
                 f">= 0 (0 = speculative decoding off)")
+        if self.prefill_interleave_chunks < 0:
+            raise ValueError(
+                f"prefill_interleave_chunks="
+                f"{self.prefill_interleave_chunks}: must be >= 0 "
+                f"(0 = run-to-completion prefill at admission)")
+        if self.seq_parallel_shards < 0 or self.seq_parallel_shards == 1:
+            raise ValueError(
+                f"seq_parallel_shards={self.seq_parallel_shards}: must "
+                f"be 0 (off) or >= 2 (shard count)")
         if self.serve_max_queue < 0:
             raise ValueError(
                 f"serve_max_queue={self.serve_max_queue}: must be >= 0 "
@@ -683,6 +718,16 @@ class FFConfig:
                             "prefill|decode|mixed, one per replica "
                             "('' = all mixed); prefill replicas hand "
                             "finished KV pages off to decode replicas")
+        p.add_argument("--prefill-interleave-chunks", type=int, default=0,
+                       help="chunk-interleaved admission: max prefill "
+                            "chunks the scheduler runs per step between "
+                            "decode ticks (0 = run-to-completion "
+                            "prefill at admission)")
+        p.add_argument("--seq-parallel-shards", type=int, default=0,
+                       help="sequence-parallel prefill: split a long "
+                            "prompt's prefix into this many contiguous "
+                            "shards across the prefill tier (0 = off, "
+                            ">= 2 = shard count)")
         p.add_argument("--paged-attention-impl", type=str, default="auto",
                        choices=("auto", "pallas", "einsum"),
                        help="decode attention over the paged pool: "
@@ -812,6 +857,8 @@ class FFConfig:
             serve_adapter_pool_pages=args.serve_adapter_pool_pages,
             serve_lora_rank=args.serve_lora_rank,
             serve_replica_roles=args.serve_replica_roles,
+            prefill_interleave_chunks=args.prefill_interleave_chunks,
+            seq_parallel_shards=args.seq_parallel_shards,
             paged_attention_impl=args.paged_attention_impl,
             kv_cache_dtype=args.kv_cache_dtype,
             serve_weight_dtype=args.serve_weight_dtype,

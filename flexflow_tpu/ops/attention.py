@@ -471,7 +471,7 @@ class MultiHeadAttention(Op):
                 (num_pages, self.num_kv_heads), jnp.float32)
         return pool
 
-    def paged_prefill_write(self, cache, kh, vh, pages):
+    def paged_prefill_write(self, cache, kh, vh, pages, impl="einsum"):
         """Scatter a slot's contiguous prefill k/v (1, L, KVH, Dh) into
         pool pages `pages` ((n_pages,) int32, n_pages = ceil(L /
         page_size)). The tail of the last page beyond L holds junk; it is
@@ -480,7 +480,16 @@ class MultiHeadAttention(Op):
         per-(page, head) scale over the whole just-written page — the
         zero pad tail never inflates an amax — and replace scale AND
         payload (prefill only ever targets the request's own fresh
-        pages, so a wholesale replace can never touch shared state)."""
+        pages, so a wholesale replace can never touch shared state).
+
+        ``impl``: 'einsum' is the big-scatter parity oracle below;
+        'pallas' routes to pallas_kernels.paged_prefill_write_pallas,
+        which scatters page-at-a-time from VMEM (ISSUE 18) and is
+        bitwise against the oracle (tests/test_pallas_paged.py)."""
+        if impl == "pallas":
+            from flexflow_tpu.ops.pallas_kernels import \
+                paged_prefill_write_pallas
+            return paged_prefill_write_pallas(cache, kh, vh, pages)
         page_size = cache["k"].shape[1]
         n_pages = pages.shape[0]
         pad = n_pages * page_size - kh.shape[1]

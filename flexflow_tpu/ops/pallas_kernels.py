@@ -774,3 +774,130 @@ def paged_attention_fwd_pallas(q, k_pages, v_pages, page_table, write_pos,
         compiler_params=_compiler_params(("parallel", "arbitrary")),
         interpret=_interpret() if interpret is None else interpret,
     )(*prefetch, q, k_pages, v_pages)
+
+
+def paged_prefill_write_pallas(cache, kh, vh, pages,
+                               interpret: Optional[bool] = None):
+    """Prefill/append page scatter: write a (1, S, KVH, D) KV slab into
+    the paged pool page-at-a-time from VMEM (ISSUE 18 tentpole (c)).
+
+    The einsum oracle (attention.paged_prefill_write) materializes the
+    page-reshaped slab and issues one big ``pool.at[pages].set`` —
+    XLA's scatter lowering stages the whole slab through HBM. Here the
+    grid is (n_pages,): each step DMAs ONE page-sized slab tile into
+    VMEM and writes it (quantizing in-register when the pool is
+    int8/fp8) to its pool page, so peak on-chip footprint is one page
+    regardless of prompt length. ``pages`` rides the scalar-prefetch
+    stream and drives the output index map — the paged-pool idiom of
+    paged_attention_fwd_pallas, pointed at the write path.
+
+    The pool (and, when quantized, the per-page scale planes) are
+    aliased input->output so untouched pages survive: the grid only
+    visits the scatter list, and every non-visited output block must
+    retain the incoming pool bytes. Alias indices count the scalar-
+    prefetch operand (pallas initializes outputs from the FULL operand
+    list, prefetch included).
+
+    Quantized pools recompute attention.page_scale / page_quantize
+    inside the kernel via the imported helpers themselves — elementwise
+    f32 ops, so interpret mode is BITWISE against the einsum oracle and
+    the PR 11 published-state contract (scales + payload) holds.
+
+    `interpret` defaults to the module rule (interpret off-TPU), which
+    is how FFConfig.paged_attention_impl='pallas' executes the real
+    kernel code path in every CPU CI tier. Returns a new cache dict
+    with the k/v pools (and scales) replaced."""
+    from flexflow_tpu.ops.attention import (page_quantize, page_scale,
+                                            storage_qmax)
+
+    pool_k, pool_v = cache["k"], cache["v"]
+    ps, kvh = pool_k.shape[1], pool_k.shape[2]
+    dk, dv = pool_k.shape[3], pool_v.shape[3]
+    n_pages = len(pages)
+    quantized = "k_scale" in cache
+    qmax = storage_qmax(pool_k.dtype) if quantized else 0.0
+
+    def paged(x, d):
+        # identical host-side prep to the einsum oracle: pad the slab
+        # tail to a page boundary, reshape to page-major tiles
+        s = x.shape[1]
+        pad = n_pages * ps - s
+        x = x[0]
+        if pad:
+            x = jnp.pad(x, ((0, pad), (0, 0), (0, 0)))
+        return x.reshape(n_pages, ps, kvh, d)
+
+    kp = paged(kh, dk)
+    vp = paged(vh, dv)
+    pages = jnp.asarray(pages, jnp.int32)
+
+    def slab_map(t, pages_ref):
+        return (t, 0, 0, 0)
+
+    def pool_map(t, pages_ref):
+        return (pages_ref[t], 0, 0, 0)
+
+    def scale_map(t, pages_ref):
+        return (pages_ref[t], 0)
+
+    def kernel(pages_ref, *refs):
+        if quantized:
+            (kp_ref, vp_ref, _pk, _pv, _ks, _vs,
+             pk_out, pv_out, ks_out, vs_out) = refs
+            for x_ref, p_out, s_out in ((kp_ref, pk_out, ks_out),
+                                        (vp_ref, pv_out, vs_out)):
+                pf = x_ref[...].astype(jnp.float32)   # (1, ps, kvh, d)
+                scale = page_scale(pf, qmax)          # (1, kvh)
+                p_out[...] = page_quantize(pf, scale, qmax, p_out.dtype)
+                s_out[...] = scale
+        else:
+            kp_ref, vp_ref, _pk, _pv, pk_out, pv_out = refs
+            pk_out[...] = kp_ref[...].astype(pk_out.dtype)
+            pv_out[...] = vp_ref[...].astype(pv_out.dtype)
+
+    in_specs = [
+        pl.BlockSpec((1, ps, kvh, dk), slab_map),
+        pl.BlockSpec((1, ps, kvh, dv), slab_map),
+        pl.BlockSpec((1, ps, kvh, dk), pool_map),
+        pl.BlockSpec((1, ps, kvh, dv), pool_map),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, ps, kvh, dk), pool_map),
+        pl.BlockSpec((1, ps, kvh, dv), pool_map),
+    ]
+    out_shape = [jax.ShapeDtypeStruct(pool_k.shape, pool_k.dtype),
+                 jax.ShapeDtypeStruct(pool_v.shape, pool_v.dtype)]
+    inputs = [kp, vp, pool_k, pool_v]
+    # alias index = position in (prefetch + inputs); output index is
+    # positional in out_shape
+    aliases = {3: 0, 4: 1}
+    if quantized:
+        ksc, vsc = cache["k_scale"], cache["v_scale"]
+        in_specs += [pl.BlockSpec((1, kvh), scale_map),
+                     pl.BlockSpec((1, kvh), scale_map)]
+        out_specs += [pl.BlockSpec((1, kvh), scale_map),
+                      pl.BlockSpec((1, kvh), scale_map)]
+        out_shape += [jax.ShapeDtypeStruct(ksc.shape, ksc.dtype),
+                      jax.ShapeDtypeStruct(vsc.shape, vsc.dtype)]
+        inputs += [ksc, vsc]
+        aliases = {3: 0, 4: 1, 5: 2, 6: 3}
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_pages,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        compiler_params=_compiler_params(("arbitrary",)),
+        interpret=_interpret() if interpret is None else interpret,
+    )(pages, *inputs)
+    out = dict(cache)
+    out["k"], out["v"] = outs[0], outs[1]
+    if quantized:
+        out["k_scale"], out["v_scale"] = outs[2], outs[3]
+    return out

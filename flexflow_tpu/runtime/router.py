@@ -196,6 +196,7 @@ class ServingRouter:
                  health_timeout_s: Optional[float] = None,
                  dispatch_backlog: Optional[int] = None,
                  roles=None, handoff_min_pages: int = 1,
+                 seq_parallel_shards: Optional[int] = None,
                  start: bool = True, **engine_kwargs):
         if health_timeout_s is None:
             health_timeout_s = self.DEFAULT_HEALTH_TIMEOUT_S
@@ -243,6 +244,17 @@ class ServingRouter:
         if self.handoff_min_pages < 1:
             raise ValueError(
                 f"handoff_min_pages={handoff_min_pages}: must be >= 1")
+        # sequence-parallel prefill (ISSUE 18): split a monster prompt's
+        # page-aligned prefix into contiguous shards across the prefill
+        # tier; the decode replica merges the shard slabs through
+        # partial-prefix import_prefix_slab. 0/1 = off.
+        self.seq_parallel_shards = int(
+            seq_parallel_shards if seq_parallel_shards is not None
+            else getattr(cfg, "seq_parallel_shards", 0) or 0)
+        if self.seq_parallel_shards < 0 or self.seq_parallel_shards == 1:
+            raise ValueError(
+                f"seq_parallel_shards={self.seq_parallel_shards}: must "
+                f"be 0 (off) or >= 2 (shard count)")
         self.max_queue = int(max_queue if max_queue is not None
                              else getattr(cfg, "serve_max_queue", 0))
         if self.max_queue < 0:
@@ -310,6 +322,9 @@ class ServingRouter:
         # imports that fell back cold on the decode side
         self._handoffs = 0
         self._handoff_fallbacks = 0
+        # sequence-parallel prefills completed (every shard exported and
+        # the request queued for decode with its slab LIST)
+        self._seq_parallel = 0
         self._ttfts = collections.deque(maxlen=4096)
         # unified telemetry plane (ISSUE 13): fleet identity on every
         # replica's metric labels + trace track, the fleet TTFT
@@ -981,16 +996,23 @@ class ServingRouter:
                     if req.slab is not None:
                         # decode-side ingestion: page scatter + trie
                         # publish; the submit below then admits as a
-                        # prefix HIT. Any import problem falls back to
-                        # the cold path — always correct, never lost.
+                        # prefix HIT. A sequence-parallel handoff
+                        # carries a LIST of shard slabs, merged in
+                        # order through partial-prefix import (ISSUE
+                        # 18). Any import problem falls back to the
+                        # cold path — always correct, never lost.
+                        slabs = (req.slab if isinstance(req.slab, list)
+                                 else [req.slab])
                         try:
                             with telemetry.tracer().span(
                                     "handoff_import",
                                     trace_id=req.trace_id,
                                     track=f"replica{r}",
-                                    pages=len(req.slab.get(
-                                        "payload", []))):
-                                eng.import_prefix_slab(req.slab)
+                                    shards=len(slabs),
+                                    pages=sum(len(s.get("payload", []))
+                                              for s in slabs)):
+                                for sl in slabs:
+                                    eng.import_prefix_slab(sl)
                         except Exception as e:  # noqa: BLE001
                             fflogger.warning(
                                 "router: slab import on replica %d "
@@ -1038,14 +1060,22 @@ class ServingRouter:
         exactly-once requeue re-classifies the request at its next
         dispatch."""
         slab = None
-        with telemetry.tracer().span("handoff_export",
-                                     trace_id=req.trace_id,
-                                     track=f"replica{r}") as sp:
-            if eng.prefill_into_cache(req.prompt,
-                                      adapter=req.adapter) is not None:
-                slab = eng.export_prefix_slab(req.prompt,
-                                              adapter=req.adapter)
-            sp.annotate(exported=slab is not None)
+        sharded = False
+        if self.seq_parallel_shards >= 2:
+            # monster-prompt path: fan the prefix out across the prefill
+            # tier; any problem (too small, lone replica, pressure,
+            # export miss) falls through to the single-replica export
+            slab = self._seq_parallel_prefill(r, eng, req)
+            sharded = slab is not None
+        if slab is None:
+            with telemetry.tracer().span("handoff_export",
+                                         trace_id=req.trace_id,
+                                         track=f"replica{r}") as sp:
+                if eng.prefill_into_cache(req.prompt,
+                                          adapter=req.adapter) is not None:
+                    slab = eng.export_prefix_slab(req.prompt,
+                                                  adapter=req.adapter)
+                sp.annotate(exported=slab is not None)
         with self._lock:
             if self._fenced[r]:
                 return          # the fence already requeued this request
@@ -1059,9 +1089,79 @@ class ServingRouter:
             if slab is not None:
                 req.handoff = True
                 self._handoffs += 1
+                if sharded:
+                    self._seq_parallel += 1
             else:
                 self._handoff_fallbacks += 1
             self._queue.appendleft(req)
+
+    def _seq_parallel_prefill(self, r: int, eng, req: FleetRequest):
+        """Sequence-parallel prefill (ISSUE 18): split the prompt's
+        page-aligned prefix into ``seq_parallel_shards`` contiguous
+        page ranges and compute each on a prefill-capable replica —
+        shard 0 on THIS replica, later shards on round-robin peers that
+        first import the earlier shards' slabs (their shard is then a
+        prefix-HIT tail compute, attending real KV for everything
+        before it — the causal dependency sequence sharding must
+        honor). Each shard exports a partial-prefix slab
+        (``export_prefix_slab(start_page=shard start)``); the decode
+        replica merges the LIST in order through partial-prefix
+        ``import_prefix_slab``, bitwise the single-replica pages
+        (tests/test_seq_parallel.py). Returns the slab list, or None —
+        prompt too small (< shards * handoff_min_pages full pages),
+        no peer alive, pool pressure anywhere, or any shard error —
+        and the caller falls back to the single-replica export."""
+        shards = self.seq_parallel_shards
+        prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+        last = prompt.size // self.page_size
+        if last < shards * self.handoff_min_pages:
+            return None
+        with self._lock:
+            cands = [i for i in range(self.n)
+                     if not self._fenced[i] and not self._suspended[i]
+                     and self.roles[i] in ("prefill", "mixed")]
+        if r not in cands or len(cands) < 2:
+            return None         # sharding needs a live peer to pay off
+        cands.remove(r)
+        cands.insert(0, r)      # shard 0 stays home (its KV is local)
+        # contiguous page ranges, remainder spread over the front shards
+        base, rem = divmod(last, shards)
+        bounds = [0]
+        for i in range(shards):
+            bounds.append(bounds[-1] + base + (1 if i < rem else 0))
+        slabs = []
+        try:
+            with telemetry.tracer().span("seq_parallel_prefill",
+                                         trace_id=req.trace_id,
+                                         track=f"replica{r}",
+                                         shards=shards,
+                                         pages=last) as sp:
+                for i in range(shards):
+                    s_pg, e_pg = bounds[i], bounds[i + 1]
+                    eng_i = self.engines[cands[i % len(cands)]]
+                    for sl in slabs:
+                        # cumulative merge: already-cached chunks are
+                        # skipped, so re-imports on a reused replica
+                        # are cheap no-ops
+                        eng_i.import_prefix_slab(sl)
+                    sub = prompt[:e_pg * self.page_size]
+                    if eng_i.prefill_into_cache(
+                            sub, adapter=req.adapter) is None:
+                        sp.annotate(aborted=f"shard{i}_pressure")
+                        return None
+                    sl = eng_i.export_prefix_slab(
+                        sub, adapter=req.adapter, start_page=s_pg)
+                    if sl is None:
+                        sp.annotate(aborted=f"shard{i}_export")
+                        return None
+                    slabs.append(sl)
+        except Exception as e:  # noqa: BLE001 — any shard failure
+            #   downgrades; the single-replica path is always correct
+            fflogger.warning(
+                "router: sequence-parallel prefill failed (%s) — "
+                "single-replica fallback", e)
+            return None
+        return slabs
 
     def _collect_tier_events(self, r: int):
         """Fold the replica's depth-1 tier transitions into the affinity
@@ -1256,6 +1356,9 @@ class ServingRouter:
                          "tier_host_evictions", "tier_pending_migrations",
                          "prefill_only_requests", "prefix_slab_exports",
                          "prefix_slab_imports", "prefix_pages_imported",
+                         "partial_slab_imports",
+                         "prefill_chunks_interleaved",
+                         "prefill_preempted_ticks",
                          "spec_proposed", "spec_accepted",
                          "sampled_requests", "adapter_faults",
                          "adapter_evictions", "adapter_pages_in_use",
@@ -1268,6 +1371,7 @@ class ServingRouter:
                                 "host": agg.pop("kv_pages_host")}
         agg["handoffs"] = self._handoffs
         agg["handoff_fallbacks"] = self._handoff_fallbacks
+        agg["seq_parallel_prefills"] = self._seq_parallel
         per_role: Dict[str, Dict] = {}
         for r, role in enumerate(self.roles):
             row = per_role.setdefault(role, {

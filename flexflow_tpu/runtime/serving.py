@@ -873,7 +873,8 @@ class ServingEngine:
                  weight_dtype: Optional[str] = None,
                  adapter_pool_pages: Optional[int] = None,
                  lora_rank: Optional[int] = None,
-                 lora_targets: Optional[List[str]] = None):
+                 lora_targets: Optional[List[str]] = None,
+                 prefill_interleave_chunks: Optional[int] = None):
         cfg = model.config
         # sanitize mode is read at LOCK CREATION time: adopt
         # FFConfig.sanitize before this engine (or its pools)
@@ -917,15 +918,56 @@ class ServingEngine:
         self.buckets = sorted(int(b) for b in buckets) if buckets else None
         self.max_seq_len = int(max_seq_len)
         self.prefill_chunk = int(prefill_chunk)
+        # chunk-interleaved admission (ISSUE 18): > 0 makes each cold
+        # prompt's prefill chunks schedulable quanta — step() runs at
+        # most this many chunks per tick between decode dispatches, so
+        # a maximal prompt admits without stalling live decode streams.
+        # Needs prefill_chunk > 0 (the chunk IS the quantum).
+        self.prefill_interleave_chunks = int(
+            prefill_interleave_chunks
+            if prefill_interleave_chunks is not None
+            else getattr(cfg, "prefill_interleave_chunks", 0))
+        if self.prefill_interleave_chunks < 0:
+            raise ValueError(
+                f"prefill_interleave_chunks="
+                f"{self.prefill_interleave_chunks}: must be >= 0")
+        if self.prefill_interleave_chunks and self.prefill_chunk <= 0:
+            raise ValueError(
+                "prefill_interleave_chunks > 0 needs prefill_chunk > 0: "
+                "the chunk is the interleave quantum")
         if self.slots < 1 or self.page_size < 1 or self.max_seq_len < 2:
             raise ValueError(
                 f"serve_slots={self.slots}, kv_page_size={self.page_size},"
                 f" max_seq_len={self.max_seq_len}: all must be positive "
                 f"(max_seq_len >= 2)")
         self.pages_per_slot = math.ceil(self.max_seq_len / self.page_size)
-        want_pages = 1 + self.slots * self.pages_per_slot  # +1: scratch
-        self.num_pages = int(kv_pages or getattr(cfg, "kv_pages", 0)
-                             or want_pages)
+        # prefix-cache membership decides the derived pool size below, so
+        # resolve it before the derive (the trie itself is built later)
+        enable_prefix = (prefix_cache if prefix_cache is not None
+                         else getattr(cfg, "serve_prefix_cache", True))
+        # kv_pages = 0 derive: scratch page + one slot's worth of pages
+        # per slot + prefix-cache slack. The slack matters: with exactly
+        # slots*pages_per_slot pages, a full house leaves ZERO free pages
+        # for refcount-0 cached prefixes, so every retirement's pages are
+        # immediately reclaimed by the next admission and the radix cache
+        # silently goes cold (ISSUE 18; found as PR 11's derive bug).
+        # Half the slot pages — at least one slot's worth — keeps a warm
+        # working set of shared prefixes alive at full occupancy. Page
+        # ids are allocated pool-size-independently (pop from the low
+        # end), so growing the pool never changes which pages a request
+        # gets — streams are bitwise unaffected.
+        slot_pages = self.slots * self.pages_per_slot
+        cache_slack = (max(self.pages_per_slot, slot_pages // 2)
+                       if enable_prefix else 0)
+        want_pages = 1 + slot_pages + cache_slack  # +1: scratch
+        explicit_pages = int(kv_pages or getattr(cfg, "kv_pages", 0) or 0)
+        self.num_pages = explicit_pages or want_pages
+        if not explicit_pages:
+            fflogger.info(
+                "serving: derived kv_pages=%d (scratch 1 + slots %d x "
+                "pages_per_slot %d = %d + prefix-cache slack %d)",
+                self.num_pages, self.slots, self.pages_per_slot,
+                slot_pages, cache_slack)
         if self.num_pages < 1 + self.pages_per_slot:
             raise ValueError(
                 f"kv_pages={self.num_pages} cannot hold even one "
@@ -1054,18 +1096,39 @@ class ServingEngine:
                 batch=self.slots, heads=op0.num_heads)
             if tuned is not None:
                 self.paged_attention_impl = tuned
+        # prefill/append page-scatter impl (ISSUE 18): the same knob
+        # routes the KV WRITE path — "pallas" scatters pages to the pool
+        # from VMEM one page at a time (ops/pallas_kernels.py
+        # paged_prefill_write_pallas), "einsum" is the whole-slab
+        # dynamic-update scatter and stays the parity oracle (prefill
+        # writes are bitwise identical either way; tests pin it). Under
+        # "auto" a measured tune_paged_prefill winner for this engine's
+        # shape overrides the backend default, same as decode above.
+        self.paged_prefill_impl = resolve_paged_attention_impl(
+            requested, cfg)
+        if requested == "auto":
+            op0 = self.gen.attn_ops[0]
+            tuned_pf = kernel_tune.lookup_paged_prefill_impl(
+                page_size=self.page_size,
+                pages_per_slot=self.pages_per_slot,
+                head_dim=op0.qk_head_dim,
+                dtype=self.pool[op0.name]["k"].dtype,
+                batch=self.slots, heads=op0.num_heads)
+            if tuned_pf is not None:
+                self.paged_prefill_impl = tuned_pf
         fflogger.info(
-            "serving: paged decode attention impl=%s kv_cache_dtype=%s "
+            "serving: paged decode attention impl=%s prefill impl=%s "
+            "kv_cache_dtype=%s "
             "weight_dtype=%s (%.1f KV bytes/token, %.2fx bf16 capacity)",
-            self.paged_attention_impl, self.kv_cache_dtype,
+            self.paged_attention_impl, self.paged_prefill_impl,
+            self.kv_cache_dtype,
             self.weight_dtype, self._kv_bytes_per_token,
             self._bf16_bytes_per_token / self._kv_bytes_per_token)
 
         # radix prefix cache: page-granular prompt-prefix sharing with
         # copy-on-write allocation (shared pages are read-only; every
-        # tail/decode write goes to the request's own fresh pages)
-        enable_prefix = (prefix_cache if prefix_cache is not None
-                         else getattr(cfg, "serve_prefix_cache", True))
+        # tail/decode write goes to the request's own fresh pages).
+        # enable_prefix was resolved above, before the kv_pages derive.
         # tiered prefix cache (ISSUE 12): host_kv_pages > 0 gives the
         # trie a pinned host-memory second tier — ref-0 pages evicted
         # under pool pressure demote (async ordered D2H) instead of
@@ -1204,6 +1267,15 @@ class ServingEngine:
 
         self._queue: List[Request] = []
         self._draining = False
+        # mid-prefill slots (ISSUE 18): slot -> partial-prefill state
+        # (request, chunked caches so far, next chunk start, padded
+        # tokens). The slot is HELD (slot_req set) but inactive, so
+        # decode dispatches clamp its writes to scratch page 0; the
+        # state survives the scheduler loop until _finish_prefill flips
+        # the slot active. _prefill_rr round-robins chunk budget across
+        # mid-prefill slots so two long prompts make equal progress.
+        self._partial: Dict[int, dict] = {}
+        self._prefill_rr = 0
         # rolling-deploy identity (ISSUE 17): the weight version this
         # engine serves (salts cache namespaces + affinity keys via
         # version_ns) and where it stands in a roll —
@@ -1249,6 +1321,14 @@ class ServingEngine:
         self._slab_exports = 0
         self._slab_imports = 0
         self._import_pages = 0
+        # long-context counters (ISSUE 18): prefill chunks run
+        # interleaved with decode ticks, ticks where a mid-prefill slot
+        # still had chunks left when the per-tick budget ran out, and
+        # partial-prefix slab imports (start_page > 0 merges from
+        # sequence-parallel prefill shards)
+        self._prefill_chunks_interleaved = 0
+        self._prefill_preempted_ticks = 0
+        self._partial_slab_imports = 0
         # decode-attention observability (ISSUE 7 satellite): pool pages
         # the attention body READS per dispatch (sum over active slots
         # of the final-step frontier's page count — what the pallas
@@ -1570,7 +1650,8 @@ class ServingEngine:
 
     def pending(self) -> bool:
         with self._lock:
-            return bool(self._queue) or bool(self.active.any())
+            return bool(self._queue) or bool(self.active.any()) \
+                or bool(self._partial)
 
     def _retire(self, slot: int, state: str, error: str = ""):
         req = self.slot_req[slot]
@@ -1579,8 +1660,16 @@ class ServingEngine:
         req.t_done = time.perf_counter()
         if state == "done":
             self._completed += 1
+        elif state == "timeout":
+            # a mid-prefill slot whose deadline expired before its last
+            # chunk ran (ISSUE 18) — never decoded, same bucket as
+            # queue-expiry
+            self._timeouts += 1
         else:
             self._failed += 1
+        # drop any partial-prefill state (mid-prefill abort: the chunked
+        # caches are device arrays — releasing the reference frees them)
+        self._partial.pop(slot, None)
         if req.ttft:
             self._ttfts.append(req.ttft)
         # close the cross-thread decode span (0-handle = telemetry off)
@@ -1696,15 +1785,20 @@ class ServingEngine:
                 for name in ("k", "v")}
         return caches
 
-    @staticmethod
-    def _scatter_tail(gen, pool, caches, pages, p0: int = 0):
+    def _scatter_tail(self, gen, pool, caches, pages, p0: int = 0):
         """COW scatter: write the contiguous cache's positions past
         ``p0`` into ``pages`` — the request's own fresh pages, never the
-        shared ones. ``p0=0`` is the cold (whole-bucket) case."""
+        shared ones. ``p0=0`` is the cold (whole-bucket) case. Routed
+        through the engine's resolved prefill impl: 'einsum' is the
+        big-scatter oracle, 'pallas' the page-at-a-time VMEM kernel
+        (ISSUE 18); both are bitwise-identical so the choice is purely
+        a perf knob — resolution happens at TRACE time inside the
+        prefill builders, warm programs pay nothing."""
+        impl = getattr(self, "paged_prefill_impl", "einsum")
         return {
             op.name: op.paged_prefill_write(
                 pool[op.name], caches[op.name]["k"][:, p0:],
-                caches[op.name]["v"][:, p0:], pages)
+                caches[op.name]["v"][:, p0:], pages, impl=impl)
             for op in gen.attn_ops}
 
     # ---- page migration primitives (tier + fleet handoff, ISSUE 12) ------
@@ -1923,6 +2017,81 @@ class ServingEngine:
             return self._scatter_tail(gen, pool, caches, tail_pages, p0)
 
         return jax.jit(prefill, donate_argnums=(3,))
+
+    # ---- chunk-interleaved admission programs (ISSUE 18) ------------------
+
+    def _build_prefill_ichunk(self, bucket: int, st: int):
+        """ONE schedulable prefill chunk of a cold bucket-shaped prompt:
+        positions [st, st+prefill_chunk) write their k/v into the
+        contiguous per-request cache, cache-only (skip_tail) — exactly
+        iteration ``st`` of Generator._prefill's ragged chunked loop, so
+        the chunk sequence is bitwise the run-to-completion prefill. The
+        FULL padded (1, bucket) prompt is the input and the chunk slice
+        is static, so every chunk of a bucket shares one argument
+        signature; st=0 creates the caches, later chunks take + donate
+        them (the cursor state the scheduler carries between ticks)."""
+        gen = self.gen
+        cdtype = gen._compute_dtype()
+        has_lora = self.lora_pool is not None
+        chunk = self.prefill_chunk
+
+        if st == 0:
+            def chunk0(params, state, tokens, lora_pool, lora_pages):
+                caches = {op.name: op.init_cache(1, bucket, cdtype)
+                          for op in gen.attn_ops}
+                lora = ({"pool": lora_pool, "pages": lora_pages}
+                        if has_lora else None)
+                _, caches = gen._walk(
+                    params, state, tokens[:, :chunk], caches, None,
+                    chunk_start=0, skip_tail=True, lora=lora)
+                return caches
+
+            return jax.jit(chunk0)
+
+        def chunk_fn(params, state, tokens, caches, lora_pool,
+                     lora_pages):
+            lora = ({"pool": lora_pool, "pages": lora_pages}
+                    if has_lora else None)
+            _, caches = gen._walk(
+                params, state, tokens[:, st:st + chunk], caches, None,
+                chunk_start=st, skip_tail=True, lora=lora)
+            return caches
+
+        return jax.jit(chunk_fn, donate_argnums=(3,))
+
+    def _build_prefill_ifinal(self, bucket: int, n_pages: int):
+        """The last quantum of an interleaved prefill: the ragged
+        gather-last pass over the filled chunk caches (the prompt's true
+        last position scores the first emitted token), then the COW
+        scatter of the whole bucket's k/v into the request's pages —
+        Generator._prefill's final _walk plus _build_prefill's sampling
+        tail, so (tok, ok, pool) match run-to-completion admission
+        bitwise."""
+        gen = self.gen
+        has_lora = self.lora_pool is not None
+
+        def final(params, state, tokens, length, caches, pool, pages,
+                  poison, temps, top_ps, top_ks, seeds, lora_pool,
+                  lora_pages):
+            lora = ({"pool": lora_pool, "pages": lora_pages}
+                    if has_lora else None)
+            tok_last = jnp.take_along_axis(
+                tokens, (length - 1)[:, None], axis=1)       # (1, 1)
+            logits, caches = gen._walk(params, state, tok_last, caches,
+                                       None, last_only=True,
+                                       row_lengths=length,
+                                       gather_last=True, lora=lora)
+            logits = logits[:, -1] + poison                  # (1, V)
+            ok = jnp.isfinite(logits).all(axis=-1)
+            tok = sampling_ops.sample_tokens(
+                logits, temps, top_ps, top_ks, seeds,
+                jnp.zeros_like(seeds))
+            return tok, ok, self._scatter_tail(gen, pool, caches, pages)
+
+        # donate the pool only: the chunk caches feed the scatter but
+        # back no output (tok/ok are tiny, pool aliases the pool input),
+        # so donating them just trips jax's unusable-donation warning
+        return jax.jit(final, donate_argnums=(5,))
 
     def _build_verify(self, k: int):
         """Speculative verify: ONE dispatch scores all K+1 candidate
@@ -2159,8 +2328,10 @@ class ServingEngine:
         self._expire_queued()
         while self._queue:
             try:
+                # a mid-prefill slot is inactive but HELD (slot_req set)
                 slot = next(i for i in range(self.slots)
-                            if not self.active[i])
+                            if not self.active[i]
+                            and self.slot_req[i] is None)
             except StopIteration:
                 return
             req = self._queue[0]
@@ -2272,6 +2443,38 @@ class ServingEngine:
             req.state = "running"
             req.adapter_page = adapter_page
             self.slot_req[slot] = req
+            n_prefill = math.ceil(req.bucket / self.page_size)
+            # fault injection: FF_FAULT=nan_loss@serve:<n> poisons the
+            # n-th ADMITTED request in-graph (NaN added to its logits), so
+            # the detect-and-retire path runs end to end, not a host
+            # stub. Consumed HERE — in admission order — so the drill's
+            # index is independent of how the prefill is scheduled; an
+            # interleaved admission carries the poison in its partial
+            # state until the final chunk's program applies it.
+            poison = (np.float32(np.nan)
+                      if faultinject.active_plan().fire("nan_loss",
+                                                        "serve")
+                      else np.float32(0.0))
+            if (self.prefill_interleave_chunks > 0 and full == 0
+                    and req.bucket > self.prefill_chunk):
+                # chunk-interleaved admission (ISSUE 18): don't run the
+                # prefill here — park the slot mid-prefill and let
+                # _prefill_tick spend the per-tick chunk budget on it
+                # between decode dispatches. The slot's decode-state
+                # arrays stay ZEROED (decode writes clamp to scratch
+                # page 0, budget stays 1 — indistinguishable from an
+                # idle slot to the fixed-shape programs) until
+                # _finish_prefill seeds and activates it. Prefix HITS
+                # keep the run-to-completion path: the hit already
+                # removed the long prefill this knob exists to split.
+                padded = np.full((1, req.bucket), self.pad_id, np.int32)
+                padded[0, :req.prompt.size] = req.prompt
+                self._partial[slot] = {
+                    "req": req, "caches": None, "next": 0,
+                    "padded": padded, "n_prefill": n_prefill,
+                    "t_adm": t_adm, "tm": tm, "poison": poison,
+                    "adapter_page": adapter_page}
+                continue
             # slot-resident sampling + adapter state: the fixed-shape
             # programs read these arrays every dispatch
             self.temps[slot] = req.temperature
@@ -2279,13 +2482,7 @@ class ServingEngine:
             self.top_ks[slot] = req.top_k
             self.seeds[slot] = req.seed
             self.lora_pages[slot] = adapter_page
-
-            n_prefill = math.ceil(req.bucket / self.page_size)
-            # fault injection: FF_FAULT=nan_loss@serve:<n> poisons the
-            # n-th ADMITTED request in-graph (NaN added to its logits), so
-            # the detect-and-retire path runs end to end, not a host stub
-            if faultinject.active_plan().fire("nan_loss", "serve"):
-                self.poison[slot] = np.float32(np.nan)
+            self.poison[slot] = poison
             table = np.zeros((self.pages_per_slot,), np.int32)
             table[:n_total] = req.pages
             self.page_tables[slot] = table
@@ -2378,6 +2575,143 @@ class ServingEngine:
                                              if p not in adopted]
             self.active[slot] = True
             self._record_token(slot, int(np.asarray(tok)[0]), ok_host)
+
+    # ---- chunk-interleaved prefill scheduling (ISSUE 18) ------------------
+
+    def _prefill_tick(self):
+        """Spend up to ``prefill_interleave_chunks`` prefill chunks this
+        tick, round-robined across mid-prefill slots so concurrent long
+        prompts make equal progress; a slot whose last chunk lands is
+        finished (sampled + activated) inline, mid-tick. Deadlines are
+        swept FIRST so an expired mid-prefill request costs no further
+        dispatches — it retires as "timeout" and frees its pages without
+        ever decoding."""
+        if not self._partial:
+            return
+        now = time.perf_counter()
+        for slot in sorted(self._partial):
+            req = self._partial[slot]["req"]
+            if req.deadline is not None and now >= req.deadline:
+                self._abort_partial(slot, "timeout",
+                                    "deadline expired mid-prefill")
+        budget = self.prefill_interleave_chunks
+        while budget > 0 and self._partial:
+            slots = sorted(self._partial)
+            slot = slots[self._prefill_rr % len(slots)]
+            self._prefill_rr += 1
+            self._run_prefill_chunk(slot)
+            budget -= 1
+        if self._partial:
+            # chunks remained when the tick's budget ran out — the
+            # decode streams get the device back; this counter is the
+            # proof the knob actually preempted a long prefill
+            self._prefill_preempted_ticks += 1
+
+    def _run_prefill_chunk(self, slot: int):
+        """One prefill quantum: run the slot's next chunk program,
+        advancing the slot-resident cache cursor."""
+        ps = self._partial[slot]
+        req = ps["req"]
+        st = ps["next"]
+        if st == 0:
+            ps["caches"] = self._compiled_call(
+                ("prefill_ichunk", req.bucket, 0),
+                lambda: self._build_prefill_ichunk(req.bucket, 0),
+                self.gen._params(), self.model.bn_state, ps["padded"],
+                *self._lora_args_1(ps["adapter_page"]))
+        else:
+            ps["caches"] = self._compiled_call(
+                ("prefill_ichunk", req.bucket, st),
+                lambda: self._build_prefill_ichunk(req.bucket, st),
+                self.gen._params(), self.model.bn_state, ps["padded"],
+                ps["caches"], *self._lora_args_1(ps["adapter_page"]))
+        ps["next"] = st + self.prefill_chunk
+        self._prefill_chunks_interleaved += 1
+        if ps["next"] >= req.bucket:
+            self._finish_prefill(slot)
+
+    def _finish_prefill(self, slot: int):
+        """The last interleaved quantum: run the gather-last + COW
+        scatter program, seed the slot's decode-state arrays and
+        activate it — from here on the request is indistinguishable
+        from a run-to-completion admission (same pages, same sampled
+        first token, same published prefix)."""
+        ps = self._partial.pop(slot)
+        req = ps["req"]
+        n_prefill = ps["n_prefill"]
+        tok, ok, self.pool = self._compiled_call(
+            ("prefill_ifinal", req.bucket, n_prefill),
+            lambda: self._build_prefill_ifinal(req.bucket, n_prefill),
+            self.gen._params(), self.model.bn_state, ps["padded"],
+            np.asarray([req.prompt.size], np.int32), ps["caches"],
+            self.pool, np.asarray(req.pages[:n_prefill], np.int32),
+            ps["poison"], *self._sampling_args_1(req),
+            *self._lora_args_1(ps["adapter_page"]))
+        if self.draft_gen is not None:
+            # the draft pool rides the same page ids; its cold prefill
+            # program (shared with run-to-completion admission) fills
+            # them in one pass — the TARGET's prefill is the
+            # head-of-line blocker this path splits, not the draft's
+            self.draft_pool = self._compiled_call(
+                ("draft_prefill", req.bucket, n_prefill),
+                lambda: self._build_draft_prefill(req.bucket, n_prefill),
+                self.draft_gen._params(), self.draft_model.bn_state,
+                ps["padded"], self.draft_pool,
+                np.asarray(req.pages[:n_prefill], np.int32))
+        ok_host = bool(np.asarray(ok)[0])
+        # decode-state arrays applied only NOW: until this instant every
+        # decode dispatch saw this slot as idle
+        self.temps[slot] = req.temperature
+        self.top_ps[slot] = req.top_p
+        self.top_ks[slot] = req.top_k
+        self.seeds[slot] = req.seed
+        self.lora_pages[slot] = ps["adapter_page"]
+        self.poison[slot] = ps["poison"]
+        n_total = math.ceil((req.bucket + req.max_new_tokens)
+                            / self.page_size)
+        table = np.zeros((self.pages_per_slot,), np.int32)
+        table[:n_total] = req.pages
+        self.page_tables[slot] = table
+        self.row_len[slot] = req.prompt.size
+        self.prompt_pad[slot] = req.bucket
+        self.emitted[slot] = 0
+        if ps["tm"]:
+            telemetry.tracer().complete(
+                "prefill", ps["t_adm"],
+                time.perf_counter() - ps["t_adm"],
+                trace_id=req.trace_id, track=self._tm_track,
+                kind="interleaved", bucket=req.bucket,
+                matched_pages=0, ok=ok_host)
+            req.decode_span = telemetry.tracer().begin(
+                "decode", trace_id=req.trace_id, track=self._tm_track)
+        if self.prefix_cache is not None and ok_host:
+            # publish the prompt's full pages for future sharing —
+            # the same rule (and the same insert) as _admit's cold leg
+            last = req.prompt.size // self.page_size
+            if last > 0:
+                created = self.prefix_cache.insert(
+                    req.prompt, [], 0, req.pages[:last],
+                    ns=self._cache_ns(req.adapter))
+                if created:
+                    adopted = {n.page for n in created}
+                    req.trie_nodes.extend(created)
+                    req.private_pages = [p for p in req.private_pages
+                                         if p not in adopted]
+        self.active[slot] = True
+        self._record_token(slot, int(np.asarray(tok)[0]), ok_host)
+
+    def _abort_partial(self, slot: int, state: str, error: str):
+        """Retire a mid-prefill slot (deadline/poison/fault paths): the
+        chunked caches are dropped, pages freed, and the request retires
+        without ever decoding. _retire clears the partial state."""
+        ps = self._partial[slot]
+        if ps["tm"]:
+            telemetry.tracer().complete(
+                "prefill", ps["t_adm"],
+                time.perf_counter() - ps["t_adm"],
+                trace_id=ps["req"].trace_id, track=self._tm_track,
+                kind="interleaved", aborted=state)
+        self._retire(slot, state, error)
 
     # ---- disaggregated fleet: prefill-only + page-slab handoff -----------
 
@@ -2529,33 +2863,48 @@ class ServingEngine:
             return last
 
     def export_prefix_slab(self, prompt,
-                           adapter: Optional[str] = None) -> Optional[Dict]:
+                           adapter: Optional[str] = None,
+                           start_page: int = 0) -> Optional[Dict]:
         """Serialize the prompt's cached full-page prefix as a
         host-memory page slab — the bytes a prefill->decode handoff
         moves: per page, every attention op's pool storage (target and
         draft pools) plus quantized scales, verbatim. Host-tier pages
         export straight from their pinned host payload (no promotion);
         HBM pages D2H on the spot. None when the prefix is not fully
-        cached — the caller falls back cold."""
+        cached — the caller falls back cold.
+
+        ``start_page`` > 0 exports a PARTIAL-PREFIX slab (ISSUE 18,
+        sequence-parallel prefill): only pages [start_page, last) ride
+        the payload — the shard this replica computed — while
+        ``tokens`` still names the whole prefix, so the importer can
+        verify the pages extend an already-merged path. The whole
+        prefix must still be cached HERE (shards import their
+        predecessors' slabs before prefilling), so the exported pages'
+        KV attends the true full prefix."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         with self._lock:
             if self.prefix_cache is None:
                 return None
             last = prompt.size // self.page_size
-            if last < 1:
-                return None
+            if start_page < 0 or start_page >= last:
+                if start_page == 0:
+                    return None     # last < 1: nothing page-aligned
+                raise ValueError(
+                    f"start_page={start_page}: must be in [0, {last}) "
+                    f"for this prompt's {last} full prefix pages")
             path = self.prefix_cache.match(
                 prompt, last, ns=self._cache_ns(adapter))
             if len(path) < last:
                 return None
+            tail = path[start_page:]
             # host-tier pages export from their pinned payloads; the
             # HBM part D2Hs in ONE batched gather
-            hbm = [n for n in path if n.tier == "hbm"]
+            hbm = [n for n in tail if n.tier == "hbm"]
             hbm_payloads = (self._page_d2h([n.page for n in hbm])()
                             if hbm else [])
             by_node = {id(n): p for n, p in zip(hbm, hbm_payloads)}
             payloads = []
-            for node in path:
+            for node in tail:
                 if node.tier == "host":
                     payload = self.prefix_cache.host_payload(node)
                     if payload is None:
@@ -2571,6 +2920,7 @@ class ServingEngine:
             return {"page_size": self.page_size,
                     "tokens": prompt[:last * self.page_size].copy(),
                     "ns": self._cache_ns(adapter),
+                    "start_page": int(start_page),
                     "payload": payloads}
 
     def import_prefix_slab(self, slab) -> int:
@@ -2580,7 +2930,14 @@ class ServingEngine:
         at refcount 0, so the subsequent ``submit()`` of the same prompt
         admits as a prefix HIT. Chunks already cached are skipped;
         returns the number of pages written. Partial imports are safe
-        (the trie path stays a valid prefix)."""
+        (the trie path stays a valid prefix).
+
+        Partial-prefix slabs (``start_page`` > 0, ISSUE 18) compose
+        MID-prefix: the slab's pages extend an already-imported path —
+        sequence-parallel prefill merges its shards by importing them
+        in order. A slab whose predecessors have not merged yet is
+        refused (return 0, no pages written): publishing pages past a
+        gap would cache a prefix whose middle was never written."""
         with self._lock:
             if self.prefix_cache is None:
                 return 0
@@ -2626,8 +2983,14 @@ class ServingEngine:
                         f" pages")
             tokens = np.asarray(slab["tokens"], np.int32).reshape(-1)
             ns = slab.get("ns")
-            n = len(slab["payload"])
+            sp = int(slab.get("start_page", 0))
+            n = sp + len(slab["payload"])
             path = self.prefix_cache.match(tokens, n, ns=ns)
+            if len(path) < sp:
+                # a partial slab landing before its predecessors: pages
+                # [len(path), sp) are neither cached here nor in this
+                # payload — importing would publish a gapped prefix
+                return 0
             # only extend under a fully HBM-resident prefix: inserting
             # fresh hbm nodes below a host-tier tail would break the
             # hbm*-then-host* path invariant that promotion truncation
@@ -2648,8 +3011,10 @@ class ServingEngine:
                 return 0
             pages = [self._free_pages.pop() for _ in range(take)]
             # ONE batched writer dispatch (padded to pages_per_slot
-            # chunks) scatters the whole slab in
-            self._page_h2d(pages, slab["payload"][start:start + take])
+            # chunks) scatters the whole slab in; a partial slab's
+            # payload list starts at page ``sp``, so index relative
+            self._page_h2d(pages,
+                           slab["payload"][start - sp:start - sp + take])
             imported = 0
             node_path = list(path)
             for j, page in enumerate(pages, start=start):
@@ -2666,6 +3031,8 @@ class ServingEngine:
             if imported:
                 self._slab_imports += 1
                 self._import_pages += imported
+                if sp > 0:
+                    self._partial_slab_imports += 1
             return imported
 
     def warm_page_import(self, prompt) -> bool:
@@ -2774,7 +3141,10 @@ class ServingEngine:
         budget = np.ones((self.slots,), np.int32)
         for slot in range(self.slots):
             req = self.slot_req[slot]
-            if req is not None:
+            # mid-prefill slots (slot_req set, inactive) keep budget 1:
+            # their state arrays are still zeroed, so decode writes
+            # clamp to scratch page 0 exactly like an idle slot's
+            if req is not None and self.active[slot]:
                 budget[slot] = req.bucket + req.max_new_tokens
         return write_pos, rope_pos, budget
 
@@ -3003,10 +3373,15 @@ class ServingEngine:
             with self._lock:
                 if not self._draining:
                     self._admit()
+                # mid-prefill slots spend their per-tick chunk budget
+                # between admit and the decode dispatch — draining
+                # included (an admitted request is never cancelled, so
+                # a drain must finish its prefill to retire it)
+                self._prefill_tick()
                 if self.active.any():
                     self._decode_tick()
                 if self._draining:
-                    out = bool(self.active.any())
+                    out = bool(self.active.any()) or bool(self._partial)
                 else:
                     out = self.pending()
         except Exception as e:  # noqa: BLE001 — an uncaught engine
@@ -3061,9 +3436,14 @@ class ServingEngine:
             # lock per tick, not across the drain: submit() callers get a
             # prompt RuntimeError instead of blocking on the whole drain
             with self._lock:
-                if not self.active.any():
+                if not self.active.any() and not self._partial:
                     break
-                self._decode_tick()
+                # a mid-prefill slot is in-flight work too: finish its
+                # chunks (deadline sweep included) so it can decode and
+                # retire — drain never strands a half-prefilled request
+                self._prefill_tick()
+                if self.active.any():
+                    self._decode_tick()
         if self.prefix_cache is not None:
             # quiesce the ordered tier publisher: a drained engine owes
             # no in-flight D2H migrations (and the leak check below must
@@ -3302,6 +3682,17 @@ class ServingEngine:
             "prefix_slab_exports": self._slab_exports,
             "prefix_slab_imports": self._slab_imports,
             "prefix_pages_imported": self._import_pages,
+            # long-context serving (ISSUE 18): interleaved-admission
+            # progress (chunks run between decode ticks, ticks where a
+            # long prefill was preempted by the budget, slots currently
+            # mid-prefill) and partial-prefix merges (start_page > 0
+            # slab imports from sequence-parallel prefill shards)
+            "prefill_interleave_chunks": self.prefill_interleave_chunks,
+            "prefill_chunks_interleaved":
+                self._prefill_chunks_interleaved,
+            "prefill_preempted_ticks": self._prefill_preempted_ticks,
+            "prefill_partial_slots": len(self._partial),
+            "partial_slab_imports": self._partial_slab_imports,
             "prefix_cache": pc is not None,
             "prefix_lookups": pc.lookups if pc else 0,
             "prefix_hits": pc.hits if pc else 0,

@@ -362,6 +362,127 @@ def tune_paged_attention(*, page_size: int = 16, pages_per_slot: int = 8,
     return rec
 
 
+def lookup_paged_prefill_impl(*, page_size: int, pages_per_slot: int,
+                              head_dim: int, dtype, batch: int,
+                              heads: int, path: Optional[str] = None) \
+        -> Optional[str]:
+    """Measured paged prefill/append WRITE impl ('pallas' | 'einsum')
+    for one serving geometry on THIS device/jax version, or None (the
+    caller's backend heuristic applies). Mirrors lookup_paged_impl but
+    keys the 'paged_prefill' kernel: ``dtype`` is the POOL STORAGE
+    dtype (a quantized write adds an in-kernel quantize but streams
+    half the bytes, so winners can't be shared across widths), and the
+    signature's seq_k is the slot capacity — the slab length the write
+    path scatters at its long-context worst case. Consulted by
+    ServingEngine under paged_attention_impl='auto' at construction
+    time only (ISSUE 18)."""
+    entries = load_table(path)
+    sig = shape_sig(seq_q=page_size, seq_k=pages_per_slot * page_size,
+                    head_dim=head_dim, dtype=dtype, batch=batch,
+                    heads=heads, causal=False)
+    e = entries.get(_entry_key("paged_prefill", sig))
+    if e and e.get("impl") in ("pallas", "einsum"):
+        _STATS["hits"] += 1
+        return e["impl"]
+    _STATS["misses"] += 1
+    return None
+
+
+def tune_paged_prefill(*, page_size: int = 16, pages_per_slot: int = 8,
+                       head_dim: int = 64, kv_heads: int = 2,
+                       heads: int = 4, slots: int = 4,
+                       dtype="float32", kv_dtype: Optional[str] = None,
+                       warmup: int = 1, iters: int = 3,
+                       path: Optional[str] = None,
+                       verbose: bool = False) -> Dict:
+    """Measure the Pallas page-at-a-time prefill/append write kernel
+    against the einsum big-scatter oracle at ONE serving geometry —
+    the slab is the slot's FULL capacity (pages_per_slot * page_size),
+    the long-context worst case ISSUE 18 targets — optionally on a
+    QUANTIZED pool, and persist the winning impl under the
+    'paged_prefill' kernel key. ServingEngine consults the entry under
+    paged_attention_impl='auto' (lookup_paged_prefill_impl). Off-TPU
+    the kernel runs in interpret mode: the sweep exercises the full
+    tune->persist->consume path, it just measures the interpreter —
+    einsum wins there by construction, the right 'auto' answer for a
+    CPU backend."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from flexflow_tpu.ops.attention import (kv_storage_dtype,
+                                            page_quantize, page_scale)
+    from flexflow_tpu.ops.pallas_kernels import paged_prefill_write_pallas
+    from flexflow_tpu.search import measure
+
+    sdtype, qmax = kv_storage_dtype(kv_dtype)
+    store = sdtype if sdtype is not None else jnp.dtype(dtype)
+    rs = np.random.RandomState(0)
+    n_pages = pages_per_slot
+    pool_pages = 1 + slots * pages_per_slot
+    slab_len = n_pages * page_size
+
+    kh = jnp.asarray(rs.randn(1, slab_len, kv_heads, head_dim), dtype)
+    vh = jnp.asarray(rs.randn(1, slab_len, kv_heads, head_dim), dtype)
+    pool_k = jnp.zeros((pool_pages, page_size, kv_heads, head_dim), store)
+    pool_v = jnp.zeros_like(pool_k)
+    cache = {"k": pool_k, "v": pool_v}
+    if qmax is not None:
+        cache["k_scale"] = jnp.zeros((pool_pages, kv_heads), jnp.float32)
+        cache["v_scale"] = jnp.zeros((pool_pages, kv_heads), jnp.float32)
+    pages = jnp.asarray(1 + np.arange(n_pages), jnp.int32)
+
+    def pallas_step(kh_, vh_, pk, pv):
+        out = paged_prefill_write_pallas(
+            dict(cache, k=pk, v=pv), kh_, vh_, pages)
+        return jnp.sum(out["k"].astype(jnp.float32)) \
+            + jnp.sum(out["v"].astype(jnp.float32))
+
+    def einsum_step(kh_, vh_, pk, pv):
+        # standalone mirror of MultiHeadAttention.paged_prefill_write's
+        # einsum branch (the tuner is model-free); drift is caught by
+        # the kernel-vs-oracle parity tests (test_pallas_paged)
+        total = jnp.float32(0.0)
+        for x, pool in ((kh_, pk), (vh_, pv)):
+            pf = x[0].reshape(n_pages, page_size, kv_heads, head_dim)
+            if qmax is None:
+                out = pool.at[pages].set(pf.astype(pool.dtype))
+            else:
+                pf = pf.astype(jnp.float32)
+                sc = page_scale(pf, qmax)
+                out = pool.at[pages].set(
+                    page_quantize(pf, sc, qmax, pool.dtype))
+            total = total + jnp.sum(out.astype(jnp.float32))
+        return total
+
+    timed = {}
+    for impl, step in (("einsum", einsum_step), ("pallas", pallas_step)):
+        timed[impl] = measure.time_scalar_program(
+            jax.jit(step), kh, vh, pool_k, pool_v,
+            warmup=warmup, iters=iters)
+        if verbose:
+            print(f"[kernel_tune] paged_prefill ps{page_size} "
+                  f"pps{pages_per_slot} d{head_dim} "
+                  f"{np.dtype(store).name} {impl}: "
+                  f"{timed[impl] * 1e3:.3f} ms")
+    best = min(timed, key=timed.get)
+    sig = shape_sig(seq_q=page_size, seq_k=slab_len, head_dim=head_dim,
+                    dtype=store, batch=slots, heads=heads, causal=False)
+    record("paged_prefill", sig, None, timed[best],
+           candidates=None, path=path, impl=best,
+           extra={f"{k}_seconds": float(v) for k, v in timed.items()})
+    rec = {
+        "kernel": "paged_prefill", "sig": sig, "device": device_key(),
+        "impl": best, "kv_dtype": np.dtype(store).name,
+        "seconds": timed[best],
+        "candidates": {k: float(v) for k, v in timed.items()},
+    }
+    if verbose:
+        print(f"[kernel_tune] paged_prefill winner {best} -> "
+              f"{path or default_table_path()}")
+    return rec
+
+
 def static_blocks(seq_q: int, seq_k: int) -> Tuple[int, int]:
     """What the cold fallback would pick — recorded next to tuned picks
     so benches/tests can state whether tuning CHANGED the decision."""
@@ -459,6 +580,10 @@ def main(argv=None):
     p.add_argument("--paged", action="store_true",
                    help="tune the paged-attention kernel-vs-einsum "
                         "choice instead of flash blocks")
+    p.add_argument("--paged-prefill", action="store_true",
+                   help="tune the paged prefill/append WRITE "
+                        "kernel-vs-einsum choice (ISSUE 18) instead of "
+                        "flash blocks")
     p.add_argument("--page-size", type=int, default=16)
     p.add_argument("--pages-per-slot", type=int, default=8)
     p.add_argument("--kv-heads", type=int, default=2)
@@ -487,6 +612,16 @@ def main(argv=None):
                    help="table path (default FF_KERNEL_TUNE_TABLE or "
                         "~/.cache/flexflow_tpu/kernel_tune.json)")
     args = p.parse_args(argv)
+    if args.paged_prefill:
+        rec = tune_paged_prefill(
+            page_size=args.page_size, pages_per_slot=args.pages_per_slot,
+            head_dim=args.head_dim, kv_heads=args.kv_heads,
+            heads=args.heads, slots=args.slots, dtype=args.dtype,
+            kv_dtype=(None if args.kv_dtype == "native"
+                      else args.kv_dtype),
+            iters=args.iters, path=args.table or None, verbose=True)
+        print(json.dumps(rec))
+        return 0
     if args.paged:
         rec = tune_paged_attention(
             page_size=args.page_size, pages_per_slot=args.pages_per_slot,

@@ -436,3 +436,26 @@ def test_warmup_drives_every_variant_zero_recompiles_after(ff):
     assert eng.recompile_count == rc, (
         f"{eng.recompile_count - rc} programs compiled after warmup — "
         f"the (bucket, matched_pages) variant sweep missed one")
+
+
+@pytest.mark.slow  # ~25 s; disagg CI tier runs the full file
+def test_warmup_learns_interleaved_prefill_variants(ff):
+    """ISSUE 18: chunk-interleaved admission adds the prefill_ichunk /
+    prefill_ifinal program families. warmup() must drive them too — an
+    interleave-enabled engine's timed window compiles nothing."""
+    prompts = _mixed_prompts(37, n=8)
+    eng = ff.make_serving_engine(serve_slots=2, kv_page_size=PS,
+                                 max_seq_len=48, kv_pages=48,
+                                 prefill_chunk=PS,
+                                 prefill_interleave_chunks=1)
+    info = eng.warmup(prompts, max_new_tokens=6)
+    fams = {v[0] for v in info["variants"] if isinstance(v, tuple)}
+    assert "prefill_ichunk" in fams and "prefill_ifinal" in fams, \
+        f"warmup missed the interleaved prefill programs: {sorted(fams)}"
+    rc = eng.recompile_count
+    for _ in range(3):
+        reqs = eng.run(prompts, max_new_tokens=6)
+        assert all(r.state == "done" for r in reqs)
+    assert eng.recompile_count == rc, (
+        f"{eng.recompile_count - rc} programs compiled after warmup — "
+        f"the interleaved chunk sweep missed a (bucket, start) variant")

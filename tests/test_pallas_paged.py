@@ -296,3 +296,131 @@ def test_recompile_flat_with_pallas_impl(ff):
     st = eng.stats()
     assert st["paged_attention_impl"] == "pallas"
     assert st["pages_touched"] > 0
+
+
+# ---- paged prefill/append write kernel (ISSUE 18) -------------------------
+
+
+def _quant_pool(rs, attn, n_pages=10, page=4):
+    from flexflow_tpu.ops.attention import page_quantize, page_scale
+
+    kf = jnp.asarray(rs.randn(n_pages, page, attn.num_kv_heads,
+                              attn.qk_head_dim), jnp.float32)
+    vf = jnp.asarray(rs.randn(n_pages, page, attn.num_kv_heads,
+                              attn.v_head_dim), jnp.float32)
+    ks, vs = page_scale(kf, 127.0), page_scale(vf, 127.0)
+    return {
+        "k": page_quantize(kf, ks, 127.0, jnp.int8),
+        "v": page_quantize(vf, vs, 127.0, jnp.int8),
+        "k_scale": ks, "v_scale": vs,
+    }
+
+
+@pytest.mark.slow  # interpret-mode kernel; kernels CI tier
+@pytest.mark.parametrize("length", [5, 13, 16])
+def test_prefill_write_kernel_bitwise_full_width(ff, attn, length):
+    """The page-at-a-time VMEM scatter vs the einsum big-scatter oracle:
+    BITWISE pool equality on every page — the written scatter list AND
+    the untouched pages (the aliasing contract: a grid that only visits
+    the scatter list must leave every other pool page's bytes alone).
+    Ragged tails (length not a page multiple) pad exactly like the
+    oracle."""
+    rs = np.random.RandomState(11)
+    pool = _pool(rs, attn)
+    n_pages = -(-length // 4)
+    kh = jnp.asarray(rs.randn(1, length, attn.num_kv_heads,
+                              attn.qk_head_dim), jnp.float32)
+    vh = jnp.asarray(rs.randn(1, length, attn.num_kv_heads,
+                              attn.v_head_dim), jnp.float32)
+    pages = np.asarray([7, 2, 9, 4][:n_pages], np.int32)
+    # both arms jitted: that is how the serving prefill programs run
+    # them, and what the bitwise contract is stated over
+    out_e = jax.jit(lambda c, k, v: attn.paged_prefill_write(
+        c, k, v, pages, impl="einsum"))(pool, kh, vh)
+    out_p = jax.jit(lambda c, k, v: attn.paged_prefill_write(
+        c, k, v, pages, impl="pallas"))(pool, kh, vh)
+    for n in ("k", "v"):
+        assert out_p[n].dtype == pool[n].dtype
+        np.testing.assert_array_equal(np.asarray(out_e[n]),
+                                      np.asarray(out_p[n]))
+    # untouched pages kept the incoming pool bytes
+    untouched = [p for p in range(10) if p not in pages.tolist()]
+    np.testing.assert_array_equal(
+        np.asarray(out_p["k"][np.asarray(untouched)]),
+        np.asarray(pool["k"][np.asarray(untouched)]))
+
+
+@pytest.mark.slow  # interpret-mode kernel; kernels CI tier
+@pytest.mark.parametrize("length", [6, 16])
+def test_prefill_write_kernel_bitwise_quantized(ff, attn, length):
+    """Quantized pools: the kernel computes page_scale/page_quantize
+    in-register (per-page amax over the slab tile) — payload AND scale
+    planes must equal the oracle bitwise, scatter list and untouched
+    pages alike (PR 11 published-state contract)."""
+    rs = np.random.RandomState(13)
+    pool = _quant_pool(rs, attn)
+    n_pages = -(-length // 4)
+    kh = jnp.asarray(rs.randn(1, length, attn.num_kv_heads,
+                              attn.qk_head_dim), jnp.float32)
+    vh = jnp.asarray(rs.randn(1, length, attn.num_kv_heads,
+                              attn.v_head_dim), jnp.float32)
+    pages = np.asarray([3, 8, 1, 6][:n_pages], np.int32)
+    out_e = jax.jit(lambda c, k, v: attn.paged_prefill_write(
+        c, k, v, pages, impl="einsum"))(pool, kh, vh)
+    out_p = jax.jit(lambda c, k, v: attn.paged_prefill_write(
+        c, k, v, pages, impl="pallas"))(pool, kh, vh)
+    for n in ("k", "v", "k_scale", "v_scale"):
+        assert out_p[n].dtype == pool[n].dtype
+        np.testing.assert_array_equal(np.asarray(out_e[n]),
+                                      np.asarray(out_p[n]))
+
+
+@pytest.mark.slow  # builds engines; kernels CI tier
+def test_prefill_tune_table_roundtrip(tmp_path, ff):
+    """tune_paged_prefill persists a measured write-impl winner under
+    the 'paged_prefill' kernel key; an 'auto' engine consults it at
+    construction (lookup_paged_prefill_impl), keyed by the pool
+    STORAGE dtype so int8 and full-width entries never shadow each
+    other."""
+    import os
+
+    from flexflow_tpu.search import kernel_tune
+
+    table = str(tmp_path / "ktune.json")
+    eng = ff.make_serving_engine(serve_slots=2, kv_page_size=4,
+                                 max_seq_len=32)
+    op0 = eng.gen.attn_ops[0]
+    rec = kernel_tune.tune_paged_prefill(
+        page_size=eng.page_size, pages_per_slot=eng.pages_per_slot,
+        head_dim=op0.qk_head_dim, kv_heads=op0.num_kv_heads,
+        heads=op0.num_heads, slots=eng.slots, iters=1, path=table)
+    assert rec["kernel"] == "paged_prefill"
+    assert rec["impl"] in ("pallas", "einsum")
+    got = kernel_tune.lookup_paged_prefill_impl(
+        page_size=eng.page_size, pages_per_slot=eng.pages_per_slot,
+        head_dim=op0.qk_head_dim, dtype=jnp.float32, batch=eng.slots,
+        heads=op0.num_heads, path=table)
+    assert got == rec["impl"]
+    # dtype is in the key: the full-width entry must MISS for int8
+    assert kernel_tune.lookup_paged_prefill_impl(
+        page_size=eng.page_size, pages_per_slot=eng.pages_per_slot,
+        head_dim=op0.qk_head_dim, dtype=jnp.int8, batch=eng.slots,
+        heads=op0.num_heads, path=table) is None
+    old = os.environ.get("FF_KERNEL_TUNE_TABLE")
+    os.environ["FF_KERNEL_TUNE_TABLE"] = table
+    try:
+        kernel_tune.reload(table)
+        eng2 = ff.make_serving_engine(serve_slots=2, kv_page_size=4,
+                                      max_seq_len=32,
+                                      paged_attention_impl="auto")
+        assert eng2.paged_prefill_impl == rec["impl"]
+        # an explicit impl request bypasses the table
+        eng3 = ff.make_serving_engine(serve_slots=2, kv_page_size=4,
+                                      max_seq_len=32,
+                                      paged_attention_impl="pallas")
+        assert eng3.paged_prefill_impl == "pallas"
+    finally:
+        if old is None:
+            os.environ.pop("FF_KERNEL_TUNE_TABLE", None)
+        else:
+            os.environ["FF_KERNEL_TUNE_TABLE"] = old
