@@ -121,6 +121,17 @@
 #               flood must shrink the flood's worst inter-token gap
 #               under interleave with zero timed-window recompiles, and
 #               the 2-shard fleet merge stays bitwise + token-identical
+#   search    — search v2 (ISSUE 19): persistent op-cost DB + multi-
+#               objective (time x HBM) strategy search. The cost-DB /
+#               warm-start / mem-mode / expert-axis suite, then the
+#               smoke: a cold search persists one entry per op signature,
+#               a warm re-run across a simulated process boundary
+#               re-measures ZERO keyed ops (100% hit rate), a tight HBM
+#               cap makes the multi-objective search choose remat/ZeRO/
+#               offload relief that lints UNDER cap where the time-only
+#               strategy lints over (escalated to error), and
+#               calibration gauges (ff_csim_error_ratio et al.) land in
+#               a telemetry scrape + a calib entry in the DB
 #   sanitize  — ffsan plane (ISSUE 16): static concurrency/
 #               tracestability passes clean over runtime/ (tiered exit:
 #               warnings fail too) + the seeded-violation harness, then
@@ -129,7 +140,7 @@
 #               retrace sentinels) asserting zero violations and zero
 #               post-warmup retraces
 #
-# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|kernels|quant|disagg|obs|router|tenancy|deploy|longctx|sanitize|all]
+# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|kernels|quant|disagg|obs|router|tenancy|deploy|longctx|search|sanitize|all]
 set -e
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -375,6 +386,14 @@ run_longctx() {
   FF_SANITIZE=1 python scripts/longctx_smoke.py 24
 }
 
+# search tier (ISSUE 19): the persistent cost-DB / warm-start /
+# multi-objective suite, then the cold->warm->drill->calibration smoke
+# against a real DB file across a simulated process boundary.
+run_search() {
+  python -m pytest tests/test_cost_db.py -q
+  python scripts/search_smoke.py
+}
+
 case "$TIER" in
   unit)     run_unit ;;
   sweep)    run_sweep ;;
@@ -394,8 +413,9 @@ case "$TIER" in
   tenancy)  run_tenancy ;;
   deploy)   run_deploy ;;
   longctx)  run_longctx ;;
+  search)   run_search ;;
   sanitize) run_sanitize ;;
-  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_kernels; run_quant; run_disagg; run_obs; run_router; run_tenancy; run_deploy; run_longctx; run_sanitize; run_native; run_docs; run_sweep ;;
+  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_kernels; run_quant; run_disagg; run_obs; run_router; run_tenancy; run_deploy; run_longctx; run_search; run_sanitize; run_native; run_docs; run_sweep ;;
   *) echo "unknown tier $TIER"; exit 2 ;;
 esac
 echo "ci($TIER): PASSED"

@@ -34,7 +34,8 @@ PAGES = [("index", os.path.join(ROOT, "README.md"), "Overview"),
           "quantized tier, disaggregated fleet + tiered cache, "
           "sampling + multi-tenant LoRA, rolling deployment)"),
          ("performance", os.path.join(DOCS, "performance.md"),
-          "Performance (host + in-graph overlap, Pallas kernel tier)"),
+          "Performance (host + in-graph overlap, Pallas kernel tier, "
+          "search v2: persistent cost DB + multi-objective search)"),
          ("observability", os.path.join(DOCS, "observability.md"),
           "Observability (metrics registry, per-request tracing, "
           "Prometheus/JSON export)"),

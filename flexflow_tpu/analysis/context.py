@@ -16,7 +16,8 @@ from typing import Dict, List, Optional, Tuple
 
 from flexflow_tpu.analysis.report import Violation
 from flexflow_tpu.ops.base import InputOp, Op
-from flexflow_tpu.parallel.pconfig import CONTRACT, STAGE, ParallelConfig
+from flexflow_tpu.parallel.pconfig import (CONTRACT, EXPERT, STAGE,
+                                           ParallelConfig)
 
 AxisMap = Dict[str, Optional[int]]
 
@@ -107,16 +108,17 @@ class AnalysisContext:
                              f"strategy was produced for a different mesh; "
                              f"regenerate it or rename the mesh axes")))
                 continue
-            if d is not None and d not in (CONTRACT, STAGE) \
+            if d is not None and d not in (CONTRACT, STAGE, EXPERT) \
                     and not (0 <= d < ndims):
                 self.violations.append(Violation(
                     code="dim-out-of-range", pass_name="legality",
                     severity="error", op_name=op.name,
                     message=(f"axis_map maps mesh axis {ax!r} to tensor dim "
                              f"{d}, outside this op's output rank {ndims} "
-                             f"(valid: 0..{ndims - 1} or the CONTRACT/STAGE "
-                             f"sentinels) — the @axismap record is corrupt "
-                             f"or was written for a different operator")))
+                             f"(valid: 0..{ndims - 1} or the "
+                             f"CONTRACT/STAGE/EXPERT sentinels) — the "
+                             f"@axismap record is corrupt or was written "
+                             f"for a different operator")))
                 continue
             am[ax] = d
         return am

@@ -71,6 +71,7 @@ def check_perf(ctx: AnalysisContext, machine=None) -> List[Violation]:
     out.extend(_check_hbm(ctx, cost))
     out.extend(_check_pipeline(ctx))
     out.extend(_check_dcn(ctx, cost))
+    out.extend(_check_calibration(ctx))
     return out
 
 
@@ -205,16 +206,30 @@ def _check_replicated_weights(ctx: AnalysisContext, cost) -> List[Violation]:
 def _check_hbm(ctx: AnalysisContext, cost) -> List[Violation]:
     """Per-chip footprint under the cost model's per-shard accounting,
     accumulated over the device blocks the placement lowering would use
-    (cost_model.iteration_time's memory bookkeeping, minus the schedule)."""
+    (cost_model.iteration_time's memory bookkeeping, minus the schedule).
+    Each op is priced under ITS chosen memory-relief mode
+    (ParallelConfig.mem_mode, set by the multi-objective search) so the
+    lint audits what will actually run. When the footprint exceeds
+    capacity but the relief modes COULD have brought it under cap, the
+    over-capacity finding escalates to an error: the search had a legal
+    under-cap alternative (remat/ZeRO/offload) it wasn't allowed to take
+    — run the multi-objective search instead of the time-only one."""
+    from flexflow_tpu.search.cost_model import MEM_MODES
+
     D = ctx.num_devices
     dev_mem = [0.0] * max(D, 1)
+    relieved_mem = [0.0] * max(D, 1)  # per-op BEST mode: the relief floor
     for op in ctx.ops:
         res = ctx.resolutions[op.name]
-        m = cost.op_mem_bytes(op, res.axis_map)
+        mode = getattr(res.pc, "mem_mode", "none") or "none"
+        m = cost.op_mem_bytes(op, res.axis_map, mem_mode=mode)
+        floor = min(cost.op_mem_bytes(op, res.axis_map, mem_mode=mm)
+                    for mm in MEM_MODES)
         blk = ctx.op_block(res) or (0, max(D, 1))
         place, ndev = blk
         for d in range(place, min(place + ndev, len(dev_mem))):
             dev_mem[d] += m
+            relieved_mem[d] += floor
     peak = max(dev_mem) if dev_mem else 0.0
     cap = cost.machine.hbm_bytes
     out = [Violation(
@@ -225,14 +240,52 @@ def _check_hbm(ctx: AnalysisContext, cost) -> List[Violation]:
                  f"({100 * peak / cap:.1f}%)"))]
     if peak > cap:
         worst = max(range(len(dev_mem)), key=lambda d: dev_mem[d])
+        relieved_peak = max(relieved_mem) if relieved_mem else 0.0
+        fixable = relieved_peak <= cap
         out.append(Violation(
-            code="hbm-over-capacity", pass_name="perf", severity="warning",
+            code="hbm-over-capacity", pass_name="perf",
+            severity="error" if fixable else "warning",
             est_bytes=peak,
             message=(f"estimated per-chip HBM footprint {_fmt_bytes(peak)} "
                      f"exceeds capacity {_fmt_bytes(cap)} (worst chip "
-                     f"{worst}) — the strategy would OOM or thrash; shard "
-                     f"more weights/activations or grow the mesh")))
+                     f"{worst}) — the strategy would OOM or thrash; "
+                     + (f"memory-relief modes (remat/ZeRO/offload) could "
+                        f"bring it to {_fmt_bytes(relieved_peak)}, UNDER "
+                        f"cap: use the multi-objective search "
+                        f"(optimize_strategies_multi)" if fixable else
+                        f"shard more weights/activations or grow the "
+                        f"mesh"))))
     return out
+
+
+# ---- simulator calibration -------------------------------------------------
+
+def _check_calibration(ctx: AnalysisContext) -> List[Violation]:
+    """Predicted-vs-observed step time (info): when the search stashed a
+    predicted step time AND telemetry has observed real steps, report the
+    ratio — the same drift signal cost_db.export_calibration publishes as
+    the ff_csim_error_ratio gauge, surfaced in the lint report so a stale
+    or miscalibrated cost DB is visible at compile time."""
+    try:
+        from flexflow_tpu.search.cost_db import _observed_step_p50
+    except Exception:
+        return []
+    predicted = getattr(ctx.model, "_predicted_step_time", None)
+    if not predicted:
+        return []
+    observed = _observed_step_p50()
+    if not observed:
+        return []
+    ratio = float(predicted) / float(observed)
+    return [Violation(
+        code="csim-calibration", pass_name="perf", severity="info",
+        est_seconds=float(predicted),
+        message=(f"cost-model predicted step time {predicted * 1e3:.3f} ms "
+                 f"vs telemetry-observed p50 {observed * 1e3:.3f} ms — "
+                 f"ratio {ratio:.2f}x "
+                 f"(1.0 = calibrated; persistent drift means the cost DB "
+                 f"entries no longer match this machine — wipe or "
+                 f"re-measure)"))]
 
 
 # ---- pipeline --------------------------------------------------------------

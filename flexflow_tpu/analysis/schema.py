@@ -19,9 +19,10 @@ from typing import Dict, List, Optional, Tuple
 
 from flexflow_tpu.analysis.report import Violation
 from flexflow_tpu.config import MAX_TENSOR_DIM
-from flexflow_tpu.parallel.pconfig import CONTRACT, STAGE, ParallelConfig
+from flexflow_tpu.parallel.pconfig import (CONTRACT, EXPERT, STAGE,
+                                           ParallelConfig)
 
-_SENTINELS = (-1, CONTRACT, STAGE)
+_SENTINELS = (-1, CONTRACT, STAGE, EXPERT)
 
 
 def _v(code: str, message: str, op_name: Optional[str] = None,
@@ -191,7 +192,8 @@ def _parse_record(cur: _Cursor, name: str, out: List[Violation]) -> bool:
                 out.append(_v("schema-axismap-dim",
                               f"@axismap maps axis {ax!r} to {d}; negative "
                               f"values must be -1 (replicated), "
-                              f"{CONTRACT} (CONTRACT) or {STAGE} (STAGE)",
+                              f"{CONTRACT} (CONTRACT), {STAGE} (STAGE) or "
+                              f"{EXPERT} (EXPERT)",
                               op_name=name))
     # STAGE strategies occupy stage_size x num_parts devices while the
     # degree list (reference schema) excludes the stage axis, so a

@@ -49,6 +49,12 @@ class FFConfig:
     # True/"measure" = real on-device fwd+bwd timing (reference:
     # measure_operator_cost, simulator.cc:296-316)
     measure_search_costs: object = False
+    # persistent op-cost DB (search/cost_db.py): measured/analyzed entries
+    # keyed by op signature + environment survive the process, so a
+    # warm-started search re-measures zero already-keyed ops. "" = off
+    # (hermetic in-process caches only); the FF_COST_DB env var also
+    # activates it when this field is unset
+    cost_db_path: str = ""
 
     # dataloader (native threaded gather/prefetch; reference's dataloader is
     # native too — flexflow_dataloader.cc)
@@ -643,6 +649,11 @@ class FFConfig:
         p.add_argument("--enable-attribute-parallel", action="store_true")
         p.add_argument("--measure-costs", action="store_true")
         p.add_argument("--analyze-costs", action="store_true")
+        p.add_argument("--cost-db", dest="cost_db", type=str, default="",
+                       help="path to the persistent op-cost database "
+                            "(JSON); measured/analyzed search costs are "
+                            "read and written there so later searches "
+                            "warm-start (also: FF_COST_DB env var)")
         p.add_argument("--taskgraph", dest="taskgraph", type=str, default="")
         p.add_argument("--profiling", action="store_true")
         p.add_argument("--fusion", action="store_true")
@@ -831,6 +842,7 @@ class FFConfig:
             enable_attribute_parallel=args.enable_attribute_parallel,
             measure_search_costs=("measure" if args.measure_costs else
                                   "analyze" if args.analyze_costs else False),
+            cost_db_path=args.cost_db,
             taskgraph_file=args.taskgraph,
             profiling=args.profiling,
             perform_fusion=args.fusion,

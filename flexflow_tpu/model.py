@@ -507,8 +507,11 @@ class FFModel:
         self.mesh = make_mesh(cfg.mesh_shape)
 
         if cfg.search_budget > 0:
-            from flexflow_tpu.search.driver import optimize_strategies
+            from flexflow_tpu.search.driver import optimize_strategies_multi
 
+            # persistent cost DB: already-keyed op signatures load from
+            # disk instead of re-measuring/re-compiling (search/cost_db.py)
+            db_path = getattr(cfg, "cost_db_path", "") or None
             measured = None
             if cfg.measure_search_costs == "analyze":
                 from flexflow_tpu.search.measure import analyze_op_costs
@@ -517,7 +520,7 @@ class FFModel:
                     self, cfg.mesh_shape,
                     enable_parameter_parallel=cfg.enable_parameter_parallel,
                     enable_attribute_parallel=cfg.enable_attribute_parallel,
-                    verbose=cfg.profiling)
+                    verbose=cfg.profiling, db_path=db_path)
             elif cfg.measure_search_costs:
                 from flexflow_tpu.search.measure import measure_op_costs
 
@@ -525,7 +528,7 @@ class FFModel:
                     self, cfg.mesh_shape,
                     cfg.enable_parameter_parallel,
                     cfg.enable_attribute_parallel,
-                    verbose=cfg.profiling)
+                    verbose=cfg.profiling, db_path=db_path)
             machine = None
             if cfg.dcn_mesh_shape:
                 # two-tier topology: axes listed in dcn_mesh_shape span that
@@ -533,10 +536,13 @@ class FFModel:
                 from flexflow_tpu.search.machine import MachineModel
 
                 machine = MachineModel(dcn_axes=dict(cfg.dcn_mesh_shape))
-            best = optimize_strategies(self, budget=cfg.search_budget,
-                                       alpha=cfg.search_alpha,
-                                       machine=machine,
-                                       measured=measured)
+            # multi-objective: time subject to the per-chip HBM cap — when
+            # the time-optimal strategy fits (the common case) the relief
+            # loop is a no-op and this is exactly the old time-only search
+            best = optimize_strategies_multi(self, budget=cfg.search_budget,
+                                             alpha=cfg.search_alpha,
+                                             machine=machine,
+                                             measured=measured)
             cfg.strategies.update(best)
             if cfg.export_strategy_file:
                 save_strategies_to_file(cfg.export_strategy_file, cfg.strategies)
@@ -1261,6 +1267,19 @@ class FFModel:
         if total and elapsed > 0 and verbose:
             print(f"epochs {epochs}, ELAPSED TIME = {elapsed:.4f}s, "
                   f"THROUGHPUT = {total / elapsed:.2f} samples/s")
+        if tm_on:
+            # close the simulator feedback loop (ISSUE 19b): compare the
+            # search's predicted step time against the observed histogram,
+            # publish the ff_csim_* drift gauges, and fold the observation
+            # into the cost DB as a telemetry-tagged calib entry
+            try:
+                from flexflow_tpu.search import cost_db as _cost_db
+
+                _cost_db.export_calibration(
+                    self, path=getattr(self.config, "cost_db_path", "")
+                    or None)
+            except Exception:
+                pass  # calibration must never fail a completed fit
         for cb in callbacks:
             cb.on_train_end()
         return self._perf

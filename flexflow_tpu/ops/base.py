@@ -107,6 +107,14 @@ class Op:
         machinery (linear.cu:171-192,774-835)."""
         return None
 
+    def expert_parallel_size(self) -> Optional[int]:
+        """Number of independently-shardable experts, if the op supports
+        EXPERT (MoE expert-parallel) sharding: expert-indexed weights shard
+        on their expert dim, tokens all-to-all to their experts and back,
+        output replicated over the axis. None = not expert-parallelizable.
+        The search proposes {axis: EXPERT} when the axis size divides it."""
+        return None
+
     def pipeline_stages(self) -> int:
         """Number of identical stacked layers this op can split into pipeline
         stages (STAGE axis_map proposals): 0 = not pipelineable. Ops with a

@@ -190,15 +190,26 @@ class MoE(Op):
     def partitionable_output_dims(self):
         return list(range(self.outputs[0].num_dims - 1))
 
+    def expert_parallel_size(self):
+        return self.num_experts
+
     def weight_partition(self, axis_map):
-        # expert weights shard on the expert dim over the 'expert' axis if
-        # present in the mesh, regardless of activation sharding
-        mesh_axes = getattr(self.model, "mesh", None)
-        use_expert = (mesh_axes is not None
-                      and "expert" in getattr(mesh_axes, "axis_names", ())
-                      and mesh_axes.shape["expert"] > 1
-                      and self.num_experts % mesh_axes.shape["expert"] == 0)
-        e = "expert" if use_expert else None
+        from flexflow_tpu.parallel.pconfig import EXPERT
+
+        # searched expert parallelism: any axis the strategy mapped to the
+        # EXPERT sentinel shards the expert dim of w_in/w_out
+        eaxes = [ax for ax, d in (axis_map or {}).items() if d == EXPERT]
+        if not eaxes:
+            # legacy convention: shard over a literal 'expert' mesh axis if
+            # present, regardless of activation sharding
+            mesh_axes = getattr(self.model, "mesh", None)
+            if (mesh_axes is not None
+                    and "expert" in getattr(mesh_axes, "axis_names", ())
+                    and mesh_axes.shape["expert"] > 1
+                    and self.num_experts % mesh_axes.shape["expert"] == 0):
+                eaxes = ["expert"]
+        e = None if not eaxes else (eaxes[0] if len(eaxes) == 1
+                                    else tuple(eaxes))
         return {
             "router": P(None, None),
             "w_in": P(e, None, None),
@@ -210,6 +221,8 @@ class MoE(Op):
         return 2 * 2 * ntokens * self.k * self.dim * self.hidden_dim
 
     def input_axis_map(self, axis_map, input_idx):
+        # negative sentinels (CONTRACT/STAGE/EXPERT) must not leak into the
+        # input map: the input arrives replicated over those axes
         ndims = self.inputs[input_idx].num_dims
-        return {ax: (d if d is not None and d < ndims - 1 else None)
+        return {ax: (d if d is not None and 0 <= d < ndims - 1 else None)
                 for ax, d in (axis_map or {}).items()}

@@ -174,7 +174,15 @@ class TransformerPipelineStack(Op):
                 for w in self.weight_specs()}
 
     def partitionable_output_dims(self):
-        return [0]
+        # dim 1 = sequence: exposing it gives the search a sequence-parallel
+        # candidate (activations shard over seq between blocks; attention's
+        # internal all-gather is priced by the cost model's resharding pass)
+        return [0, 1]
+
+    def single_axis_dims(self):
+        # the seq dim lowers through a single named axis (ring attention /
+        # all-gather lowering) — no multi-axis products
+        return [1]
 
     def flops(self):
         B, S, D = self.inputs[0].dims

@@ -40,6 +40,16 @@ CONTRACT = -2
 # first-class strategy-search axis.
 STAGE = -3
 
+# axis_map value meaning "shard this op's EXPERTS over this mesh axis"
+# (MoE expert parallelism): the expert-indexed weights (w_in/w_out) shard
+# on their expert dim, tokens all-to-all to their experts and back, and
+# the output is delivered replicated over the axis — like CONTRACT/STAGE
+# it never appears in output PartitionSpecs. Only ops exposing
+# expert_parallel_size() accept it; before ISSUE 19 expert parallelism
+# existed solely as the literal 'expert' mesh-axis convention, invisible
+# to legal_axis_maps and hence to the search.
+EXPERT = -4
+
 
 @dataclasses.dataclass
 class ParallelConfig:
@@ -48,6 +58,11 @@ class ParallelConfig:
     device_ids: Tuple[int, ...] = ()
     # mesh-axis name -> logical tensor dim it partitions (None = unused/replicated)
     axis_map: Optional[Dict[str, Optional[int]]] = None
+    # per-op memory-relief mode the multi-objective search chose
+    # (cost_model.MEM_MODES: none | remat | zero1 | zero3 | offload);
+    # "none" for strategies from files/earlier searches — field default
+    # keeps old pickles/records loading unchanged
+    mem_mode: str = "none"
 
     # ---- constructors -----------------------------------------------------
 
@@ -82,6 +97,7 @@ class ParallelConfig:
         dims = [1] * ndims
         contract_deg = 1
         stage_deg = 1
+        expert_deg = 1
         for ax, d in axis_map.items():
             if d == CONTRACT:
                 contract_deg *= mesh_shape[ax]
@@ -91,6 +107,10 @@ class ParallelConfig:
                 # reference file schema, which has no PP concept), but the
                 # op still OCCUPIES the stage devices
                 stage_deg *= mesh_shape[ax]
+            elif d == EXPERT:
+                # like STAGE: shards the expert (weight) dim, not an output
+                # dim — lives only in the axis_map, but occupies the devices
+                expert_deg *= mesh_shape[ax]
             elif d is not None:
                 dims[d] *= mesh_shape[ax]
         if contract_deg > 1:
@@ -107,7 +127,8 @@ class ParallelConfig:
         # the schema's degree product, so for STAGE strategies
         # len(device_ids) is a stage-size multiple of num_parts()
         return ParallelConfig(dims=tuple(dims),
-                              device_ids=tuple(range(n * stage_deg)),
+                              device_ids=tuple(range(n * stage_deg
+                                                     * expert_deg)),
                               axis_map=dict(axis_map))
 
     # ---- queries ----------------------------------------------------------

@@ -323,7 +323,13 @@ def apply_elastic_policy(model) -> Optional[ElasticDecision]:
     if cfg.on_topology_change == "research":
         from flexflow_tpu.search.driver import research_strategies
 
-        cfg.strategies.update(research_strategies(model, new_mesh))
+        # warm-start the M-chip re-search from the N-chip strategy (ISSUE
+        # 19d): the saved table seeds the anneal (and, with a cost DB
+        # configured, its op measurements are already keyed on disk)
+        if saved is None:
+            saved = _saved_strategies(model, directory, step)
+        cfg.strategies.update(research_strategies(model, new_mesh,
+                                                  warm_start=saved))
         decision.strategy_source = "research"
     else:  # resume_resharded: re-derive the saved table on the new mesh
         if saved is None:

@@ -35,7 +35,7 @@ def resolve_axis_map(pc: ParallelConfig, mesh_shape: Dict[str, int],
     """Fill in pc.axis_map from degrees when a strategy came from a file
     (degrees only). Greedy: each partitioned dim takes unused mesh axes whose
     sizes multiply to its degree; sample dim prefers 'data'."""
-    from flexflow_tpu.parallel.pconfig import CONTRACT, STAGE
+    from flexflow_tpu.parallel.pconfig import CONTRACT, EXPERT, STAGE
 
     if pc.axis_map is not None:
         # explicit axis_map (search output, or a file's @axismap record):
@@ -55,14 +55,14 @@ def resolve_axis_map(pc: ParallelConfig, mesh_shape: Dict[str, int],
         # corrupt @axismap record would otherwise surface as a bare
         # IndexError inside from_axis_map rather than a diagnosis
         bad = {ax: d for ax, d in pc.axis_map.items()
-               if d is not None and d not in (CONTRACT, STAGE)
+               if d is not None and d not in (CONTRACT, STAGE, EXPERT)
                and not (0 <= d < ndims)}
         if bad:
             raise ValueError(
                 f"strategy axis_map entries {bad} map mesh axes to tensor "
                 f"dims outside this op's rank {ndims} (valid: 0..{ndims - 1} "
-                f"or the CONTRACT/STAGE sentinels) — the @axismap record is "
-                f"corrupt or was written for a different operator")
+                f"or the CONTRACT/STAGE/EXPERT sentinels) — the @axismap "
+                f"record is corrupt or was written for a different operator")
         if pc.dims:
             # re-derive degrees exactly the way the serializer did
             # (from_axis_map: CONTRACT appends a trailing degree, STAGE
@@ -223,7 +223,12 @@ class GraphExecutor:
     def init_params(self, rng_key) -> Dict[str, Dict[str, jnp.ndarray]]:
         """Sharded param init: each weight's init runs jitted with its target
         sharding as out_sharding, so a vocab-sharded embedding table never
-        materializes replicated."""
+        materializes replicated. Deliberately one tiny jit per weight (NOT
+        one batched program per model): the key is a traced argument, so
+        same-shape inits share a jaxpr and jax's lowering/compilation
+        caches dedupe them across ops, models, and tests in a process — a
+        per-model batched program bakes the per-op key constants into a
+        unique HLO and recompiles for every model built."""
         shardings = self.param_shardings()
         params: Dict[str, Dict[str, jnp.ndarray]] = {}
         for op in self.model.ops:

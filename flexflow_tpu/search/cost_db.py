@@ -1,0 +1,260 @@
+"""Persistent op-cost database: the cross-session half of the search.
+
+The paper's core loop anneals over MEASURED op costs; until ISSUE 19
+every session re-measured (or re-compiled, for the "analyze" tier) each
+op signature from scratch. This module promotes `measure.py`'s
+in-process `_SIGNATURE_CACHE` to an on-disk store using the SAME atomic
+publish + (mtime,size) invalidation machinery as the kernel-tune table
+(`search/table_store.py` — one persistence implementation, not two).
+
+Keys. Every entry is keyed by ``kind | env | signature``:
+
+  * ``kind`` — ``measure`` (real fwd+bwd timing, seconds), ``analyze``
+    (compile-only XLA cost_analysis: flops + bytes) or ``calib``
+    (telemetry-observed whole-step entries). Measured and analyzed rows
+    for one op signature can therefore never collide or shadow each
+    other — the historical ``("analyze",) + sig`` tuple-prefix trick is
+    replaced by structurally distinct key kinds and value schemas.
+  * ``env`` — ``table_store.env_key()``: backend | device kind |
+    jax version. A jax bump or backend change invalidates by mismatch.
+  * ``signature`` — ``repr`` of ``measure._op_signature`` minus its
+    trailing env tuple (op class, attrs, per-shard in/weight shapes,
+    input dtypes): a bf16 entry can never serve an fp32 query.
+
+Values. ``measure`` entries: ``{"seconds", "source", "when"}`` where
+``source`` is ``microbench`` (the measurement harness) or ``telemetry``
+(production-observed, folded back by ``export_calibration``).
+``analyze`` entries: ``{"flops", "bytes", "source", "when"}``.
+
+Activation. The DB engages only when a path is configured —
+``FFConfig.cost_db_path`` / ``--cost-db`` / the ``FF_COST_DB`` env var —
+so tests and one-off scripts keep hermetic in-process caches unless they
+opt in. ``hits``/``misses``/``stores``/``illegal`` counters (``stats()``)
+make the warm-start contract pinnable: a warm-started search re-measures
+ZERO already-keyed ops (misses == 0).
+
+Calibration. ``export_calibration(model)`` closes the loop: it reads the
+PR-13 ``ff_train_step_seconds`` histogram (observed p50) and the PR-15
+HBM ledger, compares them against the search's predicted step time and
+fflint's footprint estimate, publishes ``ff_csim_error_ratio`` /
+``ff_csim_predicted_step_seconds`` / ``ff_csim_observed_step_seconds``
+(+ ``ff_csim_hbm_error_ratio``) gauges so simulator drift is
+continuously observable, and — when the DB is active — persists the
+observation as a ``calib`` entry tagged ``source: telemetry``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+from flexflow_tpu.search import table_store
+
+_STATS = {"hits": 0, "misses": 0, "stores": 0, "illegal": 0}
+_WARNED_ILLEGAL = set()
+
+
+def default_db_path() -> str:
+    env = os.environ.get("FF_COST_DB", "")
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache", "flexflow_tpu",
+                        "cost_db.json")
+
+
+def resolve_path(path: Optional[str] = None) -> Optional[str]:
+    """The DB path this call should use, or None when the DB is OFF.
+    Explicit path wins; else the FF_COST_DB env var; else inactive —
+    persistence is strictly opt-in so unconfigured runs stay hermetic."""
+    if path:
+        return path
+    env = os.environ.get("FF_COST_DB", "")
+    return env or None
+
+
+def _key(kind: str, sig: Tuple) -> str:
+    from flexflow_tpu.search.measure import _env_signature
+
+    body = sig
+    if isinstance(sig, tuple) and sig and sig[-1] == _env_signature():
+        body = sig[:-1]  # env identity lives in the readable key prefix
+    return f"{kind}|{table_store.env_key()}|{body!r}"
+
+
+def _get(kind: str, sig: Tuple, path: str) -> Optional[Dict]:
+    entries = table_store.load(path)
+    e = entries.get(_key(kind, sig))
+    if isinstance(e, dict):
+        return e
+    return None
+
+
+def _record(kind: str, sig: Tuple, value: Dict, path: str) -> str:
+    entries = table_store.load(path, reload=True)
+    key = _key(kind, sig)
+    entries[key] = dict(value,
+                        when=time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime()))
+    table_store.publish(path, entries)
+    _STATS["stores"] += 1
+    return key
+
+
+def _note_illegal(kind: str, sig: Tuple, entry: Dict):
+    _STATS["illegal"] += 1
+    tag = _key(kind, sig)
+    if tag in _WARNED_ILLEGAL:
+        return
+    _WARNED_ILLEGAL.add(tag)
+    from flexflow_tpu.logger import fflogger
+
+    fflogger.warning("cost_db: entry %s is malformed (%r) — treating as "
+                     "a miss", tag, entry)
+
+
+def get_measured(sig: Tuple, path: Optional[str] = None) -> Optional[float]:
+    """Persisted fwd+bwd seconds for one op signature, or None (miss /
+    DB off). A malformed entry counts as illegal + miss, never a hit."""
+    path = resolve_path(path)
+    if path is None:
+        return None
+    e = _get("measure", sig, path)
+    if e is not None:
+        s = e.get("seconds")
+        if isinstance(s, (int, float)) and s > 0:
+            _STATS["hits"] += 1
+            return float(s)
+        _note_illegal("measure", sig, e)
+    _STATS["misses"] += 1
+    return None
+
+
+def record_measured(sig: Tuple, seconds: float, source: str = "microbench",
+                    path: Optional[str] = None) -> Optional[str]:
+    path = resolve_path(path)
+    if path is None:
+        return None
+    return _record("measure", sig, {"seconds": float(seconds),
+                                    "source": str(source)}, path)
+
+
+def get_analyzed(sig: Tuple, path: Optional[str] = None
+                 ) -> Optional[Tuple[float, float]]:
+    """Persisted (flops, bytes_accessed) for one op signature, or None."""
+    path = resolve_path(path)
+    if path is None:
+        return None
+    e = _get("analyze", sig, path)
+    if e is not None:
+        f, b = e.get("flops"), e.get("bytes")
+        if isinstance(f, (int, float)) and isinstance(b, (int, float)):
+            _STATS["hits"] += 1
+            return float(f), float(b)
+        _note_illegal("analyze", sig, e)
+    _STATS["misses"] += 1
+    return None
+
+
+def record_analyzed(sig: Tuple, flops: float, nbytes: float,
+                    source: str = "microbench",
+                    path: Optional[str] = None) -> Optional[str]:
+    path = resolve_path(path)
+    if path is None:
+        return None
+    return _record("analyze", sig, {"flops": float(flops),
+                                    "bytes": float(nbytes),
+                                    "source": str(source)}, path)
+
+
+def entry_count(path: Optional[str] = None) -> int:
+    path = resolve_path(path)
+    if path is None:
+        return 0
+    return len(table_store.load(path, reload=True))
+
+
+def stats() -> Dict[str, int]:
+    return dict(_STATS)
+
+
+def reset_stats():
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+# ---- telemetry feedback -----------------------------------------------------
+
+def _observed_step_p50() -> Optional[float]:
+    """p50 of the PR-13 per-step wall-time histogram, merged across label
+    children, or None when fit() has not observed any steps."""
+    from flexflow_tpu.runtime.telemetry import bucket_quantile, registry
+
+    fam = registry().family("ff_train_step_seconds")
+    if fam is None:
+        return None
+    bounds = None
+    counts = None
+    for child in fam.children():
+        cb = getattr(child, "bounds", None)
+        cc = getattr(child, "counts", None)
+        if cb is None or cc is None:
+            continue
+        if counts is None:
+            bounds, counts = cb, list(cc)
+        elif cb == bounds:
+            counts = [a + b for a, b in zip(counts, cc)]
+    if not counts or sum(counts) <= 0:
+        return None
+    p50 = bucket_quantile(bounds, counts, 0.5)
+    return p50 if p50 > 0 else None
+
+
+def export_calibration(model, path: Optional[str] = None) -> Optional[Dict]:
+    """Predicted-vs-observed calibration: compare the search's predicted
+    step time (``model._predicted_step_time``, stashed by
+    ``optimize_strategies_multi`` / compile) with the telemetry-observed
+    p50, publish the ``ff_csim_*`` gauges, fold the observation into the
+    DB as a ``calib`` entry tagged ``source: telemetry``, and return the
+    record (None when either side is missing)."""
+    from flexflow_tpu.runtime.telemetry import registry
+
+    predicted = getattr(model, "_predicted_step_time", None)
+    observed = _observed_step_p50()
+    if not predicted or not observed:
+        return None
+    ratio = float(predicted) / float(observed)
+    reg = registry()
+    reg.gauge("ff_csim_predicted_step_seconds",
+              "search cost-model predicted step time").set(float(predicted))
+    reg.gauge("ff_csim_observed_step_seconds",
+              "telemetry-observed per-step wall time (p50)").set(
+        float(observed))
+    reg.gauge("ff_csim_error_ratio",
+              "predicted / observed step time — simulator drift "
+              "(1.0 = perfectly calibrated)").set(ratio)
+    rec = {"predicted_s": float(predicted), "observed_s": float(observed),
+           "ratio": ratio, "source": "telemetry"}
+    # HBM side: fflint's footprint estimate vs the PR-15 tracked ledger
+    try:
+        from flexflow_tpu.runtime import flightrec
+
+        snap = flightrec.hbm_ledger().snapshot()
+        est = snap.get("lint_estimated_bytes")
+        tracked = snap.get("total_tracked_bytes", 0)
+        if est and tracked:
+            hbm_ratio = float(est) / max(float(tracked), 1.0)
+            reg.gauge("ff_csim_hbm_error_ratio",
+                      "lint-estimated / telemetry-tracked per-chip HBM "
+                      "bytes").set(hbm_ratio)
+            rec["hbm_estimated_bytes"] = float(est)
+            rec["hbm_tracked_bytes"] = float(tracked)
+            rec["hbm_ratio"] = hbm_ratio
+    except Exception:
+        pass  # ledger optional: calibration must not fail a fit teardown
+    dbp = resolve_path(path)
+    if dbp is not None:
+        sig = ("step_time", getattr(model, "name", None)
+               or type(model).__name__)
+        _record("calib", sig, rec, dbp)
+    return rec

@@ -29,6 +29,15 @@ AxisMap = Dict[str, Optional[int]]
 
 MEM_PENALTY_PER_BYTE = 1e-3 / 1e6  # 1 ms per MB over HBM (simulator.cc:612-617)
 
+# Per-op memory-relief modes the multi-objective search chooses among
+# (ISSUE 19): each trades step time for per-chip HBM, priced by
+# op_mem_bytes (bytes side) + mem_mode_time (time side). "zero1" and
+# "zero3" map onto REAL execution modes (FFConfig.overlap_grad_sync's
+# ZeRO-1 sharded optimizer / fsdp_axis ZeRO-3); "remat" re-runs the
+# forward in backward instead of stashing activations; "offload" parks
+# grads + optimizer state host-side at host_bw streaming cost.
+MEM_MODES = ("none", "remat", "zero1", "zero3", "offload")
+
 
 def _parts(axis_map: AxisMap, mesh_shape: Dict[str, int]) -> int:
     n = 1
@@ -116,13 +125,15 @@ class CostModel:
     # ---- per-op --------------------------------------------------------------
 
     def op_compute_time(self, op: Op, axis_map: AxisMap) -> float:
-        from flexflow_tpu.parallel.pconfig import CONTRACT, STAGE
+        from flexflow_tpu.parallel.pconfig import CONTRACT, EXPERT, STAGE
 
         parts = _parts(axis_map, self.mesh_shape)
         contract_axes = [ax for ax, d in (axis_map or {}).items()
                          if d == CONTRACT]
         stage_axes = [ax for ax, d in (axis_map or {}).items()
                       if d == STAGE]
+        expert_axes = [ax for ax, d in (axis_map or {}).items()
+                       if d == EXPERT]
         t = None
         if self.measured:
             # real-device measurement keyed by choice_key — per-shard output
@@ -188,6 +199,18 @@ class CostModel:
             mb_bytes = out_bytes / m
             t += 2.0 * ticks * (mb_bytes / self.machine.ici_bw
                                 + self.machine.ici_latency)
+        # EXPERT (expert-parallel) axes: experts shard over the axis (the
+        # 1/n compute is in `parts`, the weight shards via
+        # weight_partition) and tokens move to their experts and back —
+        # a dispatch + combine all-to-all in forward, mirrored in
+        # backward (4 all-to-alls of the activation volume per axis).
+        if expert_axes:
+            out_bytes = (sum(t_.volume() for t_ in op.outputs)
+                         * self.dtype_bytes
+                         / max(_parts_out(axis_map, self.mesh_shape), 1))
+            for ax in expert_axes:
+                t += 4.0 * self.machine.all_to_all_time(
+                    out_bytes, self.mesh_shape[ax], ax)
         return t
 
     def op_grad_sync_time(self, op: Op, axis_map: AxisMap) -> float:
@@ -247,23 +270,84 @@ class CostModel:
                     wbytes / shard_deg / n, n, self.fsdp_axis)
         return total
 
-    def op_mem_bytes(self, op: Op, axis_map: AxisMap) -> float:
+    def _relief_degree(self, axis_map: AxisMap) -> int:
+        """Product of mesh-axis sizes the op does NOT parallelize over —
+        the replication degree ZeRO-style relief modes shard weights /
+        optimizer state across (the real executor shards over the data
+        or fsdp axis; replicated axes are exactly where those live)."""
+        used = {ax for ax, d in (axis_map or {}).items() if d is not None}
+        n = 1
+        for ax, size in self.mesh_shape.items():
+            if ax not in used:
+                n *= size
+        return max(n, 1)
+
+    def op_mem_bytes(self, op: Op, axis_map: AxisMap,
+                     mem_mode: str = "none") -> float:
         """Per-device HBM bytes under this choice: weights + grads + opt
         state (x3) plus activations, divided over the partition. CONTRACT
         axes shard the weight but leave the output replicated.
 
+        ``mem_mode`` (one of MEM_MODES) applies the search-chosen relief:
+          remat    — stash ~1/4 of activations, recompute the rest in bwd;
+          zero1    — optimizer state (2/3 of the x3) shards over the op's
+                     replication axes (overlap_grad_sync's ZeRO-1 update);
+          zero3    — weights + grads + opt state all shard over the
+                     replication axes (fsdp_axis / ZeRO-3);
+          offload  — grads + optimizer state live host-side (2/3 of the
+                     weight term leaves HBM), streamed per step.
+
         Approximation note: dividing the weight term by the FULL partition
         count credits per-shard weight slices even on pure replication
         (DP) axes — per-shard task accounting in the reference's style
-        (simulator.cc:595-620). A consequence: FSDP's memory saving is
-        already implicitly credited here, so fsdp_axis adds no further
-        division (it would double-count); FSDP shows up in the TIME model
-        instead (op_grad_sync_time: weight all-gathers + grad
-        reduce-scatter)."""
+        (simulator.cc:595-620). A consequence: plain fsdp_axis adds no
+        further division here (it would double-count) and shows up in the
+        TIME model instead; the explicit zero1/zero3 mem modes DO divide
+        further — they are the search's optimistic relief pricing, paid
+        for on the time side by mem_mode_time."""
         parts = _parts(axis_map, self.mesh_shape)
-        return (op.weight_bytes() * 3 / max(parts, 1)
-                + op.output_bytes()
-                / max(_parts_out(axis_map, self.mesh_shape), 1))
+        w = op.weight_bytes()
+        weight_term = w * 3 / max(parts, 1)
+        act_term = (op.output_bytes()
+                    / max(_parts_out(axis_map, self.mesh_shape), 1))
+        if mem_mode == "remat":
+            act_term *= 0.25
+        elif mem_mode == "zero1":
+            r = self._relief_degree(axis_map)
+            weight_term = w * (1.0 + 2.0 / r) / max(parts, 1)
+        elif mem_mode == "zero3":
+            r = self._relief_degree(axis_map)
+            weight_term = w * 3 / max(parts, 1) / r
+        elif mem_mode == "offload":
+            weight_term = w / max(parts, 1)
+        return weight_term + act_term
+
+    def mem_mode_time(self, op: Op, axis_map: AxisMap,
+                      mem_mode: str = "none") -> float:
+        """Step-time overhead the relief mode costs — what the
+        multi-objective search trades HBM bytes against.
+          remat    — one extra forward: ~1/3 of the fwd+bwd compute time;
+          zero1    — params all-gather once per step over the relief axes;
+          zero3    — weight all-gather at fwd use + again for bwd, plus
+                     the grad reduce-scatter (3 collectives);
+          offload  — grads out + updated params back over host_bw."""
+        if mem_mode in ("none", "") or mem_mode is None:
+            return 0.0
+        parts = max(_parts(axis_map, self.mesh_shape), 1)
+        w = op.weight_bytes() / parts
+        if mem_mode == "remat":
+            return self.op_compute_time(op, axis_map) / 3.0
+        r = self._relief_degree(axis_map)
+        if mem_mode == "zero1":
+            return self.machine.all_gather_time(w / r, r) if r > 1 else 0.0
+        if mem_mode == "zero3":
+            if r <= 1:
+                return 0.0
+            return (2.0 * self.machine.all_gather_time(w / r, r)
+                    + self.machine.reduce_scatter_time(w, r))
+        if mem_mode == "offload":
+            return 2.0 * w / self.machine.host_bw
+        return 0.0
 
     def resharding_time(self, producer_map: AxisMap, consumer_map: AxisMap,
                         tensor) -> float:

@@ -293,7 +293,8 @@ def get_search_problem(model, cost, mesh_shape: Dict[str, int],
 def native_optimize(model, cost, mesh_shape: Dict[str, int], budget: int,
                     alpha: float, seed: int,
                     verbose: bool = False,
-                    restarts: int = 4) -> Dict[str, ParallelConfig]:
+                    restarts: int = 4,
+                    warm_start=None) -> Dict[str, ParallelConfig]:
     from flexflow_tpu.search.driver import (data_parallel_strategy,
                                             hierarchical_strategy)
 
@@ -303,6 +304,7 @@ def native_optimize(model, cost, mesh_shape: Dict[str, int], budget: int,
     prob = get_search_problem(model, cost, mesh_shape, epp, eap)
     init = prob.choices_for(data_parallel_strategy(model, mesh_shape))
     dp_cost = prob.simulate(init)
+    init_cost = dp_cost
     # two-tier machine: the hierarchical ICI/DCN candidate (data/STAGE on
     # the DCN axes, CONTRACT/TP inside ICI) is a first-class move — it
     # seeds the chains when it beats flat DP, and it competes with the
@@ -313,8 +315,21 @@ def native_optimize(model, cost, mesh_shape: Dict[str, int], budget: int,
         hier_c = prob.choices_for(hierarchical_strategy(
             model, mesh_shape, cost.machine.dcn_axes, epp, eap))
         hier_cost = prob.simulate(hier_c)
-        if hier_cost < dp_cost:
-            init = hier_c
+        if hier_cost < init_cost:
+            init, init_cost = hier_c, hier_cost
+    # warm start (ISSUE 19d): a previous search's strategy — already
+    # normalized by driver.warm_start_seed to this mesh's legal maps —
+    # seeds the chains when cheaper and competes with the winner below,
+    # so an N-chip result can only help, never hurt, the M-chip search
+    warm_c = warm_cost = None
+    if warm_start is not None:
+        try:
+            warm_c = prob.choices_for(warm_start)
+            warm_cost = prob.simulate(warm_c)
+            if warm_cost < init_cost:
+                init, init_cost = warm_c, warm_cost
+        except ValueError:
+            warm_c = warm_cost = None  # stale strategy: ignore, not fatal
     # FSDP shards every weight over the full fsdp mesh axis; a sub-mesh
     # placement cannot hold such a weight, so the annealer must not
     # propose device-block moves (compile would reject its own winner)
@@ -326,6 +341,10 @@ def native_optimize(model, cost, mesh_shape: Dict[str, int], budget: int,
         best_c, best_p, best_cost = (hier_c,
                                      np.zeros(len(prob.ops), np.int32),
                                      hier_cost)
+    if warm_cost is not None and warm_cost < best_cost:
+        best_c, best_p, best_cost = (warm_c,
+                                     np.zeros(len(prob.ops), np.int32),
+                                     warm_cost)
     if verbose:
         print(f"[search/native] best {best_cost * 1e3:.3f} ms vs DP "
               f"{dp_cost * 1e3:.3f} ms "

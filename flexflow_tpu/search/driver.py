@@ -36,7 +36,7 @@ def legal_axis_maps(op, mesh_shape: Dict[str, int],
     --enable-attribute-parallel for conv spatial dims, model.cc:2027 — minus
     the upstream bug where the latter sets the former)."""
     from flexflow_tpu.ffconst import OperatorType
-    from flexflow_tpu.parallel.pconfig import CONTRACT, STAGE
+    from flexflow_tpu.parallel.pconfig import CONTRACT, EXPERT, STAGE
 
     dims = list(op.partitionable_output_dims())
     out_shape = op.outputs[0].dims
@@ -54,6 +54,8 @@ def legal_axis_maps(op, mesh_shape: Dict[str, int],
         dims = [d for d in dims if d not in (2, 3)]
     # CONTRACT (row-parallel) proposals, gated like parameter parallelism
     csize = op.contract_size() if enable_parameter_parallel else None
+    # EXPERT (MoE expert-parallel) proposals, same gate: sharded weights
+    esize = op.expert_parallel_size() if enable_parameter_parallel else None
     axes = [a for a in mesh_shape if mesh_shape[a] > 1]
     single_axis = set(op.single_axis_dims())
     maps = [{}]
@@ -78,6 +80,13 @@ def legal_axis_maps(op, mesh_shape: Dict[str, int],
                         deg *= mesh_shape[a2]
                 if csize % deg == 0:
                     new_maps.append({**m, ax: CONTRACT})
+            if esize is not None:
+                deg = size
+                for a2, d2 in m.items():
+                    if d2 == EXPERT:
+                        deg *= mesh_shape[a2]
+                if esize % deg == 0:
+                    new_maps.append({**m, ax: EXPERT})
             # STAGE (pipeline-parallel) proposals: one mesh axis becomes the
             # ppermute ring the op's stacked layers pipeline over. Single
             # axis only — the GPipe/1F1B loop rotates around ONE named axis
@@ -151,7 +160,47 @@ def data_parallel_strategy(model, mesh_shape: Dict[str, int]) -> Dict[str, AxisM
     return out
 
 
-def rank_mesh_candidates(model, candidates, strategies=None):
+def warm_start_seed(model, mesh_shape: Dict[str, int],
+                    warm_start, enable_parameter_parallel: bool = True,
+                    enable_attribute_parallel: bool = True
+                    ) -> Optional[Dict[str, AxisMap]]:
+    """Normalize a saved strategy dict ({op_name: ParallelConfig}, e.g.
+    searched at a DIFFERENT chip count) into a per-op axis-map seed legal
+    on THIS mesh. Each saved map is restricted to the new mesh's axes and
+    kept only when it matches one of the op's legal maps; illegal or
+    missing maps fall back to data parallel. Returns None when nothing
+    carries over — the elastic N->M transfer path (ISSUE 19d)."""
+    if not warm_start:
+        return None
+    dp = data_parallel_strategy(model, mesh_shape)
+    out: Dict[str, AxisMap] = {}
+    carried = 0
+    for op in model.ops:
+        if isinstance(op, InputOp):
+            continue
+        pc = warm_start.get(op.name)
+        am = None
+        if pc is not None:
+            saved = pc.axis_map if hasattr(pc, "axis_map") else pc
+            if saved:
+                cand = {ax: d for ax, d in saved.items()
+                        if ax in mesh_shape and d is not None}
+                # an empty restriction (the saved map used only axes this
+                # mesh lacks) carries nothing — DP fallback, not replicated
+                if cand:
+                    legal = legal_axis_maps(op, mesh_shape,
+                                            enable_parameter_parallel,
+                                            enable_attribute_parallel)
+                    norm = [{a: d for a, d in m.items() if d is not None}
+                            for m in legal]
+                    if cand in norm:
+                        am = cand
+                        carried += 1
+        out[op.name] = am if am is not None else dp.get(op.name, {})
+    return out if carried else None
+
+
+def rank_mesh_candidates(model, candidates, strategies=None, measured=None):
     """Elastic-recovery helper (runtime/elastic.py): score candidate mesh
     shapes — factorizations of the SURVIVING device count over the saved
     axis names — by the cost model's iteration time under a re-partition
@@ -161,12 +210,14 @@ def rank_mesh_candidates(model, candidates, strategies=None):
     infeasible candidate scores inf rather than raising, so the caller
     always gets a usable ranking. This is the "fast csim-ranked
     re-partition" path — a full re-search at the new count is
-    ``research_strategies``."""
+    ``research_strategies``. `measured` (a MeasuredTable, possibly
+    cost-DB warm-started) prices every candidate from the same measured
+    entries the original search used."""
     ops = [op for op in model.ops if not isinstance(op, InputOp)]
     scored = []
     for idx, mesh_shape in enumerate(candidates):
         try:
-            cost = CostModel(model, mesh_shape)
+            cost = CostModel(model, mesh_shape, measured=measured)
             amaps: Dict[str, AxisMap] = {}
             dp = data_parallel_strategy(model, mesh_shape)
             for op in ops:
@@ -184,7 +235,8 @@ def rank_mesh_candidates(model, candidates, strategies=None):
 
 
 def research_strategies(model, mesh_shape: Dict[str, int],
-                        budget: int = 0) -> Dict[str, ParallelConfig]:
+                        budget: int = 0,
+                        warm_start=None) -> Dict[str, ParallelConfig]:
     """Re-run the strategy search at an explicit mesh — the elastic
     ``on_topology_change="research"`` entry point: the checkpointed
     strategy was searched for the OLD device count, and the paper's whole
@@ -192,13 +244,17 @@ def research_strategies(model, mesh_shape: Dict[str, int],
     so a changed machine gets a fresh search. Budget defaults to the
     model's configured search_budget, else a small fixed sweep (the
     resumed job should start training again in seconds, not re-pay the
-    original search)."""
+    original search). ``warm_start`` — the saved {op: ParallelConfig}
+    from the N-chip job — seeds the M-chip anneal (ISSUE 19d), and the
+    cost DB (when configured) supplies the measured entries, so the
+    transfer re-measures zero already-keyed ops."""
     if budget <= 0:
         budget = getattr(model.config, "search_budget", 0) or 100
     return optimize_strategies(model, budget=budget,
                                alpha=getattr(model.config, "search_alpha",
                                              0.05),
-                               mesh_shape=mesh_shape)
+                               mesh_shape=mesh_shape,
+                               warm_start=warm_start)
 
 
 def optimize_strategies(model, budget: int = 1000, alpha: float = 0.05,
@@ -206,30 +262,36 @@ def optimize_strategies(model, budget: int = 1000, alpha: float = 0.05,
                         machine: Optional[MachineModel] = None,
                         measured: Optional[Dict] = None,
                         seed: int = 0, verbose: bool = False,
-                        use_native: bool = True) -> Dict[str, ParallelConfig]:
-    """Run the search; returns {op_name: ParallelConfig} for the best found."""
+                        use_native: bool = True,
+                        warm_start=None) -> Dict[str, ParallelConfig]:
+    """Run the search; returns {op_name: ParallelConfig} for the best found.
+    ``warm_start`` ({op: ParallelConfig} from a previous search, possibly
+    at a different chip count) becomes a competing seed after
+    normalization against this mesh's legal maps."""
     mesh_shape = mesh_shape or model.config.mesh_shape
     cost = CostModel(model, mesh_shape, machine=machine, measured=measured)
+    cfgflags = getattr(model, "config", None)
+    epp = getattr(cfgflags, "enable_parameter_parallel", True)
+    eap = getattr(cfgflags, "enable_attribute_parallel", True)
+    warm = warm_start_seed(model, mesh_shape, warm_start, epp, eap)
 
     if use_native:
         try:
             from flexflow_tpu.search.csim import native_optimize
 
             return native_optimize(model, cost, mesh_shape, budget, alpha, seed,
-                                   verbose=verbose)
+                                   verbose=verbose, warm_start=warm)
         except (ImportError, OSError):
             pass  # fall through to the Python annealer
 
     rng = random.Random(seed)
     ops = [op for op in model.ops if not isinstance(op, InputOp)]
-    cfgflags = getattr(model, "config", None)
-    epp = getattr(cfgflags, "enable_parameter_parallel", True)
-    eap = getattr(cfgflags, "enable_attribute_parallel", True)
     # proposal distributions, precomputed once per op
     op_maps = {op.name: legal_axis_maps(op, mesh_shape, epp, eap) for op in ops}
 
     # seed candidates: flat data-parallel always; on a two-tier machine
-    # also the hierarchical ICI/DCN candidate. The anneal starts from the
+    # also the hierarchical ICI/DCN candidate; plus the warm-start seed
+    # when a previous strategy carries over. The anneal starts from the
     # CHEAPER seed, and `best` starts at that seed's cost — best-of-chain
     # can only improve on it, so the hierarchical structure survives even
     # a short or unlucky chain (the losing seed costs strictly more and
@@ -238,6 +300,8 @@ def optimize_strategies(model, budget: int = 1000, alpha: float = 0.05,
     if cost.machine.dcn_axes:
         seeds.append(hierarchical_strategy(model, mesh_shape,
                                            cost.machine.dcn_axes, epp, eap))
+    if warm is not None:
+        seeds.append(warm)
     scored = sorted(((cost.iteration_time(s), i, s)
                      for i, s in enumerate(seeds)), key=lambda t: t[:2])
     current, current_cost = dict(scored[0][2]), scored[0][0]
@@ -270,4 +334,93 @@ def optimize_strategies(model, budget: int = 1000, alpha: float = 0.05,
         am = best.get(op.name, {})
         out[op.name] = ParallelConfig.from_axis_map(
             op.outputs[0].num_dims, mesh_shape, am)
+    return out
+
+
+def optimize_strategies_multi(model, budget: int = 1000, alpha: float = 0.05,
+                              mesh_shape: Optional[Dict[str, int]] = None,
+                              machine: Optional[MachineModel] = None,
+                              measured: Optional[Dict] = None,
+                              seed: int = 0,
+                              hbm_cap_bytes: Optional[float] = None,
+                              warm_start=None, verbose: bool = False,
+                              use_native: bool = True
+                              ) -> Dict[str, ParallelConfig]:
+    """Multi-objective search (ISSUE 19c): minimize step time SUBJECT TO a
+    per-chip HBM cap. Runs the time-objective anneal, then — only if the
+    winning strategy's footprint exceeds ``hbm_cap_bytes`` (default: the
+    machine model's per-chip capacity) — greedily buys memory relief per
+    op from ``cost_model.MEM_MODES`` (gradient remat, ZeRO-1/ZeRO-3
+    optimizer/weight sharding, host offload), each priced by
+    ``CostModel.mem_mode_time``, picking the (op, mode) upgrade with the
+    best bytes-saved-per-second-added until under cap or out of relief.
+    The chosen mode lands on each ``ParallelConfig.mem_mode`` so the
+    executor (PR 9's real remat/ZeRO/offload modes) runs what the search
+    priced, and fflint's footprint pass audits the same accounting.
+
+    Stashes ``model._predicted_step_time`` (base + relief overhead) and
+    ``model._search_summary`` for telemetry calibration
+    (``cost_db.export_calibration``) and the bench tier."""
+    from flexflow_tpu.search.cost_model import MEM_MODES
+
+    mesh_shape = mesh_shape or model.config.mesh_shape
+    cost = CostModel(model, mesh_shape, machine=machine, measured=measured)
+    cap = (float(hbm_cap_bytes) if hbm_cap_bytes is not None
+           else float(cost.machine.hbm_bytes))
+
+    out = optimize_strategies(model, budget=budget, alpha=alpha,
+                              mesh_shape=mesh_shape, machine=machine,
+                              measured=measured, seed=seed, verbose=verbose,
+                              use_native=use_native, warm_start=warm_start)
+    ops = {op.name: op for op in model.ops if not isinstance(op, InputOp)}
+    amaps = {n: (pc.axis_map or {}) for n, pc in out.items() if n in ops}
+    base_time = cost.iteration_time(amaps)
+
+    modes: Dict[str, str] = {n: "none" for n in amaps}
+
+    def peak_bytes() -> float:
+        return sum(cost.op_mem_bytes(ops[n], amaps[n], mem_mode=modes[n])
+                   for n in amaps)
+
+    while peak_bytes() > cap:
+        # the upgrade with the best bytes-saved per second-added
+        pick = None  # (ratio, name, mode)
+        for n in amaps:
+            cur_b = cost.op_mem_bytes(ops[n], amaps[n], mem_mode=modes[n])
+            cur_t = cost.mem_mode_time(ops[n], amaps[n], modes[n])
+            for mode in MEM_MODES:
+                if mode in ("none", modes[n]):
+                    continue
+                saved = cur_b - cost.op_mem_bytes(ops[n], amaps[n],
+                                                  mem_mode=mode)
+                if saved <= 0:
+                    continue
+                dt = cost.mem_mode_time(ops[n], amaps[n], mode) - cur_t
+                ratio = saved / max(dt, 1e-12)
+                if pick is None or ratio > pick[0]:
+                    pick = (ratio, n, mode)
+        if pick is None:
+            break  # no relief left: return over-cap, fflint will flag it
+        _, n, mode = pick
+        modes[n] = mode
+        if verbose:
+            print(f"[search] relief: {n} -> {mode} "
+                  f"(peak {peak_bytes() / 1e9:.2f} GB, cap {cap / 1e9:.2f} GB)")
+
+    for n, mode in modes.items():
+        out[n].mem_mode = mode
+    overhead = sum(cost.mem_mode_time(ops[n], amaps[n], modes[n])
+                   for n in amaps)
+    peak = peak_bytes()
+    predicted = base_time + overhead
+    model._predicted_step_time = predicted
+    model._search_summary = {
+        "predicted_step_s": predicted,
+        "base_step_s": base_time,
+        "mem_overhead_s": overhead,
+        "peak_hbm_bytes": peak,
+        "hbm_cap_bytes": cap,
+        "mem_modes": {n: m for n, m in modes.items() if m != "none"},
+        "over_cap": peak > cap,
+    }
     return out

@@ -49,16 +49,13 @@ DEFAULT_CANDIDATES: Tuple[Tuple[int, int], ...] = (
 # the file's (mtime_ns, size) so an out-of-process re-tune (the documented
 # `python -m flexflow_tpu.search.kernel_tune` flow) is picked up by the
 # NEXT trace in a long-lived consumer without a restart. Lookups happen at
-# trace time only, so the stat() is off every warm path.
-_TABLES: Dict[str, Tuple] = {}
+# trace time only, so the stat() is off every warm path. The machinery
+# lives in search/table_store.py (shared with the op-cost DB, ISSUE 19);
+# `_TABLES` aliases its cache so existing fixtures keep working.
+from flexflow_tpu.search import table_store as _store
 
-
-def _stat_sig(path: str):
-    try:
-        st = os.stat(path)
-        return (st.st_mtime_ns, st.st_size)
-    except OSError:
-        return None
+_TABLES: Dict[str, Tuple] = _store._CACHE
+_stat_sig = _store.stat_sig
 _STATS = {"hits": 0, "misses": 0, "illegal": 0}
 _WARNED_ILLEGAL = set()
 
@@ -74,13 +71,11 @@ def default_table_path() -> str:
 def device_key() -> str:
     """Device-identity half of the table key: backend, chip kind, jax
     version — measure._env_signature, the ONE environment probe every
-    persisted cost key shares. A version bump (jax or the libtpu it
-    pins) changes Mosaic codegen, so old timings stop matching new
-    executables — they must miss, not mislead."""
-    from flexflow_tpu.search.measure import _env_signature
-
-    backend, kind, version = _env_signature()
-    return f"{backend}|{kind}|jax-{version}"
+    persisted cost key shares (table_store.env_key, shared with the
+    op-cost DB). A version bump (jax or the libtpu it pins) changes
+    Mosaic codegen, so old timings stop matching new executables —
+    they must miss, not mislead."""
+    return _store.env_key()
 
 
 def shape_sig(*, seq_q: int, seq_k: int, head_dim: int, dtype,
@@ -109,19 +104,7 @@ def load_table(path: Optional[str] = None, reload: bool = False) -> Dict:
     is served on the next call, never silently shadowed by a cached
     empty read. ``reload=True`` forces the re-read regardless."""
     path = path or default_table_path()
-    sig = _stat_sig(path)
-    if not reload and path in _TABLES and _TABLES[path][0] == sig:
-        return _TABLES[path][1]
-    entries: Dict = {}
-    try:
-        with open(path) as f:
-            data = json.load(f)
-        if isinstance(data, dict):
-            entries = data.get("entries", {})
-    except (OSError, ValueError):
-        entries = {}
-    _TABLES[path] = (sig, entries)
-    return entries
+    return _store.load(path, reload=reload)
 
 
 def reload(path: Optional[str] = None) -> Dict:
@@ -205,13 +188,7 @@ def record(kernel: str, sig: str, blocks: Optional[Tuple[int, int]],
     if candidates:
         entries[key]["candidates"] = {
             f"{bq}x{bk}": float(s) for (bq, bk), s in candidates.items()}
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump({"version": 1, "entries": entries}, f, indent=1,
-                  sort_keys=True)
-    os.replace(tmp, path)
-    _TABLES[path] = (_stat_sig(path), entries)
+    _store.publish(path, entries)
     return key
 
 

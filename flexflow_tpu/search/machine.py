@@ -32,6 +32,9 @@ class MachineModel:
     dcn_bw: float = 6.25e9  # bytes/s per host
     ici_latency: float = 1e-6  # seconds per hop
     dcn_latency: float = 1e-5  # seconds per hop (host NIC + switch)
+    # host<->device (PCIe-class) bandwidth: prices the search's per-op
+    # host-offload memory mode (cost_model.mem_mode_time, ISSUE 19)
+    host_bw: float = 1.6e10  # bytes/s
     mxu_efficiency: float = 0.5  # achievable fraction of peak on real shapes
     # mesh axis name -> number of hosts the axis spans (1 = pure ICI)
     dcn_axes: Dict[str, int] = dataclasses.field(default_factory=dict)

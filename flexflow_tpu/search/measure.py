@@ -11,7 +11,10 @@ XLA compiles are seconds, not kernel launches (SURVEY §7 hard part 1), so:
     identical ops — a 12-layer transformer measures each distinct layer shape
     once, not 12x;
   * only shard shapes reachable from `legal_axis_maps` are measured;
-  * results persist in-process in `_SIGNATURE_CACHE` across searches.
+  * results persist in-process in `_SIGNATURE_CACHE` across searches, and
+    — when a cost-DB path is configured (FFConfig.cost_db_path /
+    FF_COST_DB, search/cost_db.py) — across PROCESSES: a warm-started
+    search re-measures zero already-keyed ops.
 """
 
 from __future__ import annotations
@@ -25,8 +28,14 @@ import numpy as np
 from flexflow_tpu.ffconst import DataType, dtype_to_np
 from flexflow_tpu.ops.base import InputOp, Op
 
-# (signature) -> seconds for fwd+bwd of one shard
-_SIGNATURE_CACHE: Dict[Tuple, float] = {}
+# ("measure", signature) -> seconds for fwd+bwd of one shard;
+# ("analyze", signature) -> (flops, bytes_accessed).
+# The kind prefix is a 2-tuple NESTING (not the historical flat
+# ("analyze",) + sig concatenation): measured and analyzed rows carry
+# structurally distinct keys AND value types, so neither can collide
+# with or shadow the other here or in the persisted DB (ISSUE 19
+# satellite; pinned by tests/test_cost_db.py round-trips).
+_SIGNATURE_CACHE: Dict[Tuple, object] = {}
 
 
 class MeasuredTable(dict):
@@ -56,10 +65,11 @@ def choice_key(op_name: str, out_dims, axis_map,
     alone cannot distinguish CONTRACT (row-parallel) from plain data
     parallelism — contract axes shard the inputs and weights, not the
     output — so the contract degree is appended when present."""
-    from flexflow_tpu.parallel.pconfig import CONTRACT, STAGE
+    from flexflow_tpu.parallel.pconfig import CONTRACT, EXPERT, STAGE
 
     cdeg = 1
     sdeg = 1
+    edeg = 1
     for ax, d in (axis_map or {}).items():
         if d == CONTRACT:
             cdeg *= mesh_shape.get(ax, 1)
@@ -68,11 +78,17 @@ def choice_key(op_name: str, out_dims, axis_map,
             # stage's slice over the full batch); the output shape alone
             # would collide with the replicated choice
             sdeg *= mesh_shape.get(ax, 1)
+        elif d == EXPERT:
+            # EXPERT shards the expert dim of the weights — same
+            # output-shape collision as STAGE
+            edeg *= mesh_shape.get(ax, 1)
     key = (op_name, shard_shape(out_dims, axis_map, mesh_shape))
     if cdeg > 1:
         key = key + (("contract", cdeg),)
     if sdeg > 1:
         key = key + (("stage", sdeg),)
+    if edeg > 1:
+        key = key + (("expert", edeg),)
     return key
 
 
@@ -253,7 +269,8 @@ def time_scalar_program(step, *args, warmup: int = 1, iters: int = 5,
 
 
 def measure_one(op: Op, in_shapes, w_shapes, *, warmup=1, iters=5,
-                timeout_compile=None) -> Optional[float]:
+                timeout_compile=None,
+                db_path: Optional[str] = None) -> Optional[float]:
     """Time one jitted fwd+bwd of `op` at the given per-shard shapes on the
     default device (reference: every op implements measure_operator_cost,
     model.cu:20-62 — including attention/BN/LSTM, so we must too).
@@ -284,8 +301,18 @@ def measure_one(op: Op, in_shapes, w_shapes, *, warmup=1, iters=5,
     from jax import lax
 
     sig = _op_signature(op, in_shapes, w_shapes)
-    if sig in _SIGNATURE_CACHE:
-        return _SIGNATURE_CACHE[sig]
+    ck = ("measure", sig)
+    if ck in _SIGNATURE_CACHE:
+        return _SIGNATURE_CACHE[ck]
+    # cross-session tier: the persistent cost DB (when configured) serves
+    # already-keyed signatures with zero compiles/timings
+    from flexflow_tpu.search import cost_db
+
+    if cost_db.resolve_path(db_path) is not None:
+        dt = cost_db.get_measured(sig, path=db_path)
+        if dt is not None:
+            _SIGNATURE_CACHE[ck] = dt
+            return dt
     loop = _loop_count()
     rs = np.random.RandomState(0)
     try:
@@ -340,7 +367,8 @@ def measure_one(op: Op, in_shapes, w_shapes, *, warmup=1, iters=5,
     except Exception as e:
         _log_skip(op, e)
         return None
-    _SIGNATURE_CACHE[sig] = dt
+    _SIGNATURE_CACHE[ck] = dt
+    cost_db.record_measured(sig, dt, path=db_path)  # no-op when DB off
     return dt
 
 
@@ -364,7 +392,8 @@ def measure_op_costs(model, mesh_shape: Dict[str, int],
                      enable_parameter_parallel: bool = True,
                      enable_attribute_parallel: bool = True,
                      iters: int = 5, verbose: bool = False,
-                     time_budget_s: Optional[float] = None) -> Dict:
+                     time_budget_s: Optional[float] = None,
+                     db_path: Optional[str] = None) -> Dict:
     """Build the `measured` table for CostModel: {(op_name, shard_out_shape):
     seconds}. Measures every distinct per-shard signature reachable by the
     search's proposal space (reference: cache keyed by op+config hash,
@@ -377,7 +406,7 @@ def measure_op_costs(model, mesh_shape: Dict[str, int],
     (~tens of seconds), and an unbounded branchy graph (InceptionV3:
     hundreds of signatures) cannot finish a bounded session otherwise.
     The drop is logged, never silent."""
-    from flexflow_tpu.parallel.pconfig import CONTRACT, STAGE
+    from flexflow_tpu.parallel.pconfig import CONTRACT, EXPERT, STAGE
     from flexflow_tpu.search.driver import legal_axis_maps
 
     work = []  # (est_flops, op, key, in_shapes, w_shapes)
@@ -425,7 +454,7 @@ def measure_op_costs(model, mesh_shape: Dict[str, int],
             # "% FLOP mass measured" budget log
             wdeg = 1
             for ax, d in (am or {}).items():
-                if d in (CONTRACT, STAGE):
+                if d in (CONTRACT, STAGE, EXPERT):
                     wdeg *= mesh_shape.get(ax, 1)
             try:
                 est = float(op.flops()) * (shard_vol / full_vol) / wdeg
@@ -445,7 +474,8 @@ def measure_op_costs(model, mesh_shape: Dict[str, int],
                 and time.perf_counter() - t0 > time_budget_s):
             stopped_at = i
             break
-        dt = measure_one(op, in_shapes, w_shapes, iters=iters)
+        dt = measure_one(op, in_shapes, w_shapes, iters=iters,
+                         db_path=db_path)
         if dt is not None:
             measured[key] = dt
             sigs.add(_op_signature(op, in_shapes, w_shapes))
@@ -463,7 +493,7 @@ def measure_op_costs(model, mesh_shape: Dict[str, int],
         n_swept = 0
         for est, op, key, in_shapes, w_shapes in work[stopped_at:]:
             sig = _op_signature(op, in_shapes, w_shapes)
-            hit = _SIGNATURE_CACHE.get(sig)
+            hit = _SIGNATURE_CACHE.get(("measure", sig))
             if isinstance(hit, float):
                 measured[key] = hit
                 sigs.add(sig)
@@ -485,7 +515,9 @@ def measure_op_costs(model, mesh_shape: Dict[str, int],
     return measured
 
 
-def analyze_one(op: Op, in_shapes, w_shapes) -> Optional[Tuple[float, float]]:
+def analyze_one(op: Op, in_shapes, w_shapes,
+                db_path: Optional[str] = None
+                ) -> Optional[Tuple[float, float]]:
     """Compile (don't run) one op's fwd+bwd and read XLA's cost analysis.
     Returns (flops, bytes_accessed) or None. The compile-only middle tier
     between the analytic roofline and real timing (SURVEY §7: cost model
@@ -493,9 +525,17 @@ def analyze_one(op: Op, in_shapes, w_shapes) -> Optional[Tuple[float, float]]:
     import jax
     import jax.numpy as jnp
 
-    sig = ("analyze",) + _op_signature(op, in_shapes, w_shapes)
-    if sig in _SIGNATURE_CACHE:
-        return _SIGNATURE_CACHE[sig]
+    sig = _op_signature(op, in_shapes, w_shapes)
+    ck = ("analyze", sig)
+    if ck in _SIGNATURE_CACHE:
+        return _SIGNATURE_CACHE[ck]
+    from flexflow_tpu.search import cost_db
+
+    if cost_db.resolve_path(db_path) is not None:
+        hit = cost_db.get_analyzed(sig, path=db_path)
+        if hit is not None:
+            _SIGNATURE_CACHE[ck] = hit
+            return hit
     rs = np.random.RandomState(0)
     try:
         xs = [jnp.asarray(_rand_for(s, t.dtype, rs))
@@ -513,7 +553,8 @@ def analyze_one(op: Op, in_shapes, w_shapes) -> Optional[Tuple[float, float]]:
     except Exception as e:
         _log_skip(op, e)
         return None
-    _SIGNATURE_CACHE[sig] = out
+    _SIGNATURE_CACHE[ck] = out
+    cost_db.record_analyzed(sig, out[0], out[1], path=db_path)
     return out
 
 
@@ -521,7 +562,8 @@ def analyze_op_costs(model, mesh_shape: Dict[str, int],
                      machine=None,
                      enable_parameter_parallel: bool = True,
                      enable_attribute_parallel: bool = True,
-                     verbose: bool = False) -> Dict:
+                     verbose: bool = False,
+                     db_path: Optional[str] = None) -> Dict:
     """Compile-only cost table for CostModel.measured: XLA-reported
     flops/bytes per shard signature, converted to seconds by the machine
     model's roofline. ~10x cheaper than measure_op_costs (no execution,
@@ -565,7 +607,7 @@ def analyze_op_costs(model, mesh_shape: Dict[str, int],
                         if d < len(ws):
                             ws[d] = max(ws[d] // deg, 1)
                 w_shapes.append(tuple(ws))
-            fb = analyze_one(op, in_shapes, w_shapes)
+            fb = analyze_one(op, in_shapes, w_shapes, db_path=db_path)
             if fb is not None:
                 flops, nbytes = fb
                 table[key] = machine.compute_time(flops, nbytes, 4)

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Search v2 smoke (ci/run_ci.sh `search` tier): persistent op-cost DB,
+warm-started search, multi-objective HBM cap, calibration gauges.
+
+Proves the ISSUE 19 loop against a REAL DB file on disk, across the same
+cache-drop boundary a fresh process would cross:
+
+  1. COLD: a search with analyzed cost tables persists one entry per op
+     signature to the cost DB;
+  2. WARM: drop every in-process cache (simulating a new session), re-run
+     the same search — it must re-measure ZERO already-keyed ops
+     (misses == 0, hits > 0) and land within the cold search's cost;
+  3. DRILL: under a tight per-chip HBM cap the multi-objective search
+     chooses remat/ZeRO/offload relief and its strategy lints UNDER cap,
+     where the time-only objective lints over (escalated to error);
+  4. CALIBRATION: predicted-vs-observed gauges (ff_csim_error_ratio et
+     al.) appear in a telemetry scrape and a calib entry lands in the DB.
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+MESH = {"data": 2, "model": 2}
+
+
+def build_model():
+    from flexflow_tpu import ActiMode, FFConfig, FFModel
+
+    cfg = FFConfig(batch_size=16, mesh_shape=MESH)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([16, 32], name="x")
+    t = ff.dense(x, 64, ActiMode.AC_MODE_RELU, name="fc1")
+    t = ff.dense(t, 64, ActiMode.AC_MODE_RELU, name="fc2")
+    ff.dense(t, 8, name="out")
+    return ff
+
+
+def fresh_process_sim():
+    from flexflow_tpu.search import cost_db, measure, table_store
+
+    measure._SIGNATURE_CACHE.clear()
+    table_store.clear_cache()
+    cost_db.reset_stats()
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="ff_search_smoke_")
+    db = os.path.join(tmp, "cost_db.json")
+
+    from flexflow_tpu.analysis import analyze
+    from flexflow_tpu.runtime import telemetry
+    from flexflow_tpu.search import cost_db, measure
+    from flexflow_tpu.search.cost_model import MEM_MODES, CostModel
+    from flexflow_tpu.search.driver import (optimize_strategies,
+                                            optimize_strategies_multi)
+    from flexflow_tpu.search.machine import MachineModel
+
+    ff = build_model()
+
+    # 1. COLD: analyze + search, entries persisted
+    t0 = time.perf_counter()
+    measured = measure.analyze_op_costs(ff, MESH, db_path=db)
+    cold = optimize_strategies(ff, budget=80, mesh_shape=MESH, seed=3,
+                               measured=measured, use_native=False)
+    cold_wall = time.perf_counter() - t0
+    n = cost_db.entry_count(db)
+    assert os.path.exists(db), "cost DB file not written"
+    assert n > 0, "cold search persisted no entries"
+    s = cost_db.stats()
+    assert s["stores"] == n, (s, n)
+    print(f"[smoke] cold: {n} entries persisted, "
+          f"{len(measured)} table rows, {cold_wall * 1e3:.1f} ms")
+
+    # 2. WARM: fresh-process sim — zero re-measures, within cold cost
+    fresh_process_sim()
+    t0 = time.perf_counter()
+    measured_w = measure.analyze_op_costs(ff, MESH, db_path=db)
+    warm = optimize_strategies(ff, budget=80, mesh_shape=MESH, seed=3,
+                               measured=measured_w, use_native=False)
+    warm_wall = time.perf_counter() - t0
+    s = cost_db.stats()
+    assert s["misses"] == 0, f"warm search re-measured: {s}"
+    assert s["hits"] > 0, s
+    hit_rate = s["hits"] / max(s["hits"] + s["misses"], 1)
+    cost = CostModel(ff, MESH, measured=measured_w)
+    t_cold = cost.iteration_time({k: pc.axis_map for k, pc in cold.items()})
+    t_warm = cost.iteration_time({k: pc.axis_map for k, pc in warm.items()})
+    assert t_warm <= t_cold * 1.0001, (t_warm, t_cold)
+    print(f"[smoke] warm: 0 re-measures ({s['hits']} hits, hit rate "
+          f"{hit_rate:.0%}), {warm_wall * 1e3:.1f} ms wall, cost "
+          f"{t_warm * 1e3:.4f} ms <= cold {t_cold * 1e3:.4f} ms")
+
+    # 3. DRILL: tight HBM cap — multi-objective goes under, time-only not
+    ops = {op.name: op for op in ff.ops if op.name in cold}
+    base_cost = CostModel(ff, MESH)
+    peak = sum(base_cost.op_mem_bytes(ops[k], cold[k].axis_map or {})
+               for k in ops)
+    floor = sum(min(base_cost.op_mem_bytes(ops[k], cold[k].axis_map or {},
+                                           mem_mode=mm) for mm in MEM_MODES)
+                for k in ops)
+    cap = (peak + floor) / 2.0
+    tiny = MachineModel(hbm_bytes=cap)
+    rep = analyze(ff, strategies=cold, mesh_shape=MESH, machine=tiny,
+                  passes=("legality", "perf"))
+    over = rep.by_code("hbm-over-capacity")
+    assert over and over[0].severity == "error", \
+        "time-only strategy must lint over-cap (escalated: relief existed)"
+    multi = optimize_strategies_multi(ff, budget=80, mesh_shape=MESH,
+                                      seed=3, hbm_cap_bytes=cap,
+                                      use_native=False)
+    chosen = {k: pc.mem_mode for k, pc in multi.items()
+              if pc.mem_mode != "none"}
+    assert chosen, "tight cap chose no relief modes"
+    assert ff._search_summary["over_cap"] is False
+    rep2 = analyze(ff, strategies=multi, mesh_shape=MESH, machine=tiny,
+                   passes=("legality", "perf"))
+    assert not rep2.by_code("hbm-over-capacity"), \
+        "multi-objective strategy still lints over-cap"
+    print(f"[smoke] drill: cap {cap / 1e3:.1f} KB -> relief {chosen}, "
+          f"peak {ff._search_summary['peak_hbm_bytes'] / 1e3:.1f} KB "
+          f"under cap (time-only: over-cap error)")
+
+    # 4. CALIBRATION: gauges in a scrape + calib entry in the DB
+    telemetry.reset()
+    hist = telemetry.registry().histogram(
+        "ff_train_step_seconds", "fit() per-step wall time")
+    for _ in range(8):
+        hist.observe(0.010)
+    rec = cost_db.export_calibration(ff, path=db)
+    assert rec is not None and rec["source"] == "telemetry"
+    scrape = telemetry.registry().to_prometheus()
+    for gauge in ("ff_csim_predicted_step_seconds",
+                  "ff_csim_observed_step_seconds", "ff_csim_error_ratio"):
+        assert gauge in scrape, f"{gauge} missing from scrape"
+    from flexflow_tpu.search import table_store
+
+    assert any(k.startswith("calib|")
+               for k in table_store.load(db, reload=True))
+    print(f"[smoke] calibration: ratio {rec['ratio']:.2f}x, ff_csim_* "
+          f"gauges scraped, calib entry persisted")
+    telemetry.reset()
+
+    print("[smoke] search v2 cold->warm->drill->calibration: PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
