@@ -103,6 +103,7 @@ TIER_COST_S = {"tiny": 90, "mid": 150, "full": 240, "full_scan": 180,
                "tiered_prefix": 260,
                "multi_tenant": 200,
                "rolling_deploy": 260,
+               "elastic_fleet": 240,
                "long_context": 240,
                "input_overlap": 90,
                "collective_overlap": 120,
@@ -1765,6 +1766,191 @@ def _run_rolling_deploy_tier(n_dev, backend, dev_kind):
 
 
 
+def _run_elastic_fleet_tier(n_dev, backend, dev_kind):
+    """elastic_fleet row (ISSUE 20): one fleet walked through its whole
+    elastic lifecycle, each transition priced.
+
+    (1) CONGESTED — a 2x closed-loop flood (64 requests, 2 replicas)
+        after a seed round: the overloaded baseline p99 TTFT.
+    (2) SCALE-OUT — the same flood with add_replica() fired after the
+        submits land: add_replica latency, recovery seconds (newcomer
+        admitted -> fleet queue drained), p99 TTFT vs the congested
+        window, and a zero-survivor-recompile check (the newcomer warms
+        off-lock; the incumbents' programs must not be touched).
+    (3) SCALE-IN — the shared prefix's affinity home is retired via
+        remove_replica(): tokens/s capacity step-down (3 -> 2 replicas)
+        with the fleet prefix hit rate re-measured after the evacuation
+        — the home's hot pages must serve from survivors.
+    (4) PREEMPT DRILL — request_preempt() mid-flood on a live replica:
+        every request completes exactly once (no fence, no loss), and
+        the drill's evacuation bytes + deadline margin are stamped in
+        the config block."""
+    import numpy as np
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.llama import llama_lm
+
+    _phase("build_elastic_fleet")
+    vocab = 256
+    cfg = FFConfig(batch_size=2, mesh_shape={"data": 1}, serve_slots=4,
+                   kv_page_size=16, slo_window_s=1.0)
+    ff = FFModel(cfg)
+    _, logits = llama_lm(ff, 2, seq_len=16, hidden=128, layers=2, heads=4,
+                         kv_heads=2, vocab_size=vocab)
+    ff.compile(final_tensor=logits)
+
+    rs = np.random.RandomState(7)
+    # every prompt shares a 2-page system prefix (kv_page_size=16) so
+    # affinity concentrates its pages on one home replica — the replica
+    # the scale-in and preempt windows then take away
+    system = rs.randint(1, vocab, (32,)).astype(np.int32)
+    tails = [rs.randint(1, vocab, (n,)).astype(np.int32)
+             for n in SERVE_PROMPT_LENS]
+    warm = [np.concatenate([system, t]) for t in tails]
+    prompts = [warm[i % len(warm)] for i in range(ROUTER_REQUESTS)]
+    # the flood must OUTLAST the transition it measures (the newcomer's
+    # off-lock warmup takes ~10s of compile on a shared CPU host): a
+    # deep backlog of long decodes, not the quick ROUTER_REQUESTS burst
+    # the steady-state tiers use
+    flood_n, max_new = 896, 40
+
+    router = ff.make_serving_router(
+        replicas=2, max_seq_len=112, serve_slots=4, decode_chunk=2,
+        prefix_cache=True, start=False)
+    router.warmup(warm, max_new_tokens=4)
+
+    def run_round(n, tag):
+        _phase(f"time_elastic_{tag}")
+        t0 = time.perf_counter()
+        reqs = [router.submit(prompts[i % len(prompts)], max_new)
+                for i in range(n)]
+        return t0, reqs
+
+    def settle(t0, reqs, tag):
+        router.wait(reqs, timeout=1200)
+        dt = time.perf_counter() - t0
+        assert all(r.state == "done" for r in reqs), \
+            f"{tag}: a request was dropped through the transition"
+        ttfts = sorted(r.ttft for r in reqs)
+
+        def pct(p):
+            return round(ttfts[min(len(ttfts) - 1,
+                                   int(p * len(ttfts)))] * 1e3, 3)
+
+        return {"p99_ttft_ms": pct(0.99),
+                "tokens_per_s": round(len(reqs) * max_new / dt, 2)}
+
+    def hit_counters():
+        hits = lookups = 0
+        for eng in router.engines:
+            pc = eng.prefix_cache
+            if pc is not None:
+                hits += pc.hits
+                lookups += pc.lookups
+        return hits, lookups
+
+    try:
+        router.start()
+        time.sleep(0.05)
+        # seed round: both incumbents page the shared prefix and the
+        # affinity map homes it, so every timed window is equally warm
+        settle(*run_round(len(warm) * 2, "seed"), tag="seed")
+
+        # (1) congested baseline
+        t0, reqs = run_round(flood_n, "congested")
+        congested = settle(t0, reqs, "congested")
+
+        # (2) scale-out mid-flood: recovery is clocked from the SCALING
+        # DECISION (the add_replica call) to the backlog draining — the
+        # newcomer's off-lock build/warmup is part of the honest number
+        incumbent_compiles = [e.recompile_count for e in router.engines]
+        t0, reqs = run_round(flood_n, "scale_out")
+        t_add = time.perf_counter()
+        router.add_replica(warmup_prompts=warm, max_new_tokens=4)
+        add_s = time.perf_counter() - t_add
+        while router.health()["queued"] > 0:
+            time.sleep(0.005)
+        recovery_s = time.perf_counter() - t_add
+        scaled = settle(t0, reqs, "scale_out")
+        leaked = any(e.recompile_count != c for e, c
+                     in zip(router.engines, incumbent_compiles))
+
+        # (3) scale-in: retire the shared prefix's home, keep its pages
+        _phase("time_elastic_scale_in")
+        probe = router.submit(warm[0], 4)
+        router.wait([probe], timeout=600)
+        home = probe.replica
+        h0, l0 = hit_counters()
+        pre = settle(*run_round(96, "pre_scale_in"), tag="pre_scale_in")
+        h1, l1 = hit_counters()
+        snap = router.remove_replica(home)
+        assert not snap["fenced"], snap
+        post = settle(*run_round(96, "post_scale_in"),
+                      tag="post_scale_in")
+        h2, l2 = hit_counters()
+        hit_before = (h1 - h0) / max(1, l1 - l0)
+        hit_after = (h2 - h1) / max(1, l2 - l1)
+
+        # (4) preempt drill on one of the two remaining live replicas,
+        # mid-flood so it carries queued + in-flight work and hot pages
+        pre_drill = router.stats()
+        alive = [row["replica"] for row in pre_drill["per_replica"]
+                 if not row["fenced"] and not row["retired"]]
+        t0, reqs = run_round(128, "preempt")
+        time.sleep(0.5)
+        router.request_preempt(alive[0], 0.8)
+        settle(t0, reqs, "preempt")
+        st = router.stats()
+        assert st["preempts"] - pre_drill["preempts"] == 1, \
+            "preempt drill never fired (flood drained too early?)"
+        assert router.health()["fenced"] == 0, \
+            "preempt drill fenced a replica (evacuation should be clean)"
+        assert all(r.losses == 0 for r in reqs), \
+            "preempt drill counted a loss (evacuation is not a loss)"
+    finally:
+        router.close()
+
+    return {
+        "metric": "elastic_fleet_serving", "tier": "elastic_fleet",
+        # headline: seconds from newcomer-admitted to backlog-drained
+        # under the 2x flood, with the p99 TTFT ratio (scaled vs
+        # congested) as the baseline comparison
+        "value": round(recovery_s, 3), "unit": "s",
+        "vs_baseline": round(scaled["p99_ttft_ms"]
+                             / max(1e-9, congested["p99_ttft_ms"]), 3),
+        "p99_ttft_ms_congested": congested["p99_ttft_ms"],
+        "p99_ttft_ms_scaled": scaled["p99_ttft_ms"],
+        "add_replica_s": round(add_s, 3),
+        "recovery_s": round(recovery_s, 3),
+        "recompiles_after_warmup": bool(leaked),
+        "scale_in_tokens_per_s_before": pre["tokens_per_s"],
+        "scale_in_tokens_per_s_after": post["tokens_per_s"],
+        "scale_in_hit_rate_before": round(hit_before, 3),
+        "scale_in_hit_rate_after": round(hit_after, 3),
+        "backend": backend, "device_kind": dev_kind, "n_devices": n_dev,
+        "config": {"requests": flood_n,
+                   "max_new_tokens": max_new,
+                   "load_shape": "closed_loop_flood_2x",
+                   "replicas_start": 2, "replicas_peak": 3,
+                   "serve_slots": 4, "kv_page_size": 16,
+                   "shared_prefix_tokens": int(system.size),
+                   "max_seq_len": 112, "decode_chunk": 2,
+                   "hidden": 128, "layers": 2, "prefix_cache": True,
+                   # the preempt-drill stamps (ISSUE 20 acceptance):
+                   # deltas over the drill window, except the margin
+                   # (the drill is the fleet's only preemption)
+                   "preempt_deadline_s": 0.8,
+                   "preempt_margin_s": st["preempt_margin_s"],
+                   "evacuation_bytes": st["evacuation_bytes"]
+                       - pre_drill["evacuation_bytes"],
+                   "evacuated_requests": st["evacuated_requests"]
+                       - pre_drill["evacuated_requests"],
+                   "evacuated_slabs": st["evacuated_slabs"]
+                       - pre_drill["evacuated_slabs"],
+                   "evac_deadline_misses": st["evac_deadline_misses"]},
+    }
+
+
 def _run_long_context_tier(n_dev, backend, dev_kind):
     """long_context tier (ISSUE 18): the two long-context serving
     claims, measured.
@@ -2361,6 +2547,15 @@ def child():
             or deadline - time.time() >= TIER_COST_S["rolling_deploy"]):
         print(json.dumps(
             _run_rolling_deploy_tier(n_dev, backend, dev_kind)),
+            flush=True)
+    # elastic_fleet tier (ISSUE 20): p99 TTFT recovery after a mid-flood
+    # scale-out, scale-in capacity step-down with hit-rate retention,
+    # and the preempt drill's evacuation-bytes/deadline-margin stamp
+    if "elastic_fleet" not in skip and (
+            deadline is None
+            or deadline - time.time() >= TIER_COST_S["elastic_fleet"]):
+        print(json.dumps(
+            _run_elastic_fleet_tier(n_dev, backend, dev_kind)),
             flush=True)
     # long_context tier (ISSUE 18): decode inter-token p99 while a
     # maximal prompt admits (interleave on vs off) + the TTFT-vs-length

@@ -132,6 +132,19 @@
 #               strategy lints over (escalated to error), and
 #               calibration gauges (ff_csim_error_ratio et al.) land in
 #               a telemetry scrape + a calib entry in the DB
+#   elastic_serve — elastic fleet (ISSUE 20): SLO-driven autoscaling +
+#               preemption-tolerant serving. The policy/membership/
+#               evacuation suite (hysteresis + bounds, live add/remove
+#               token identity, the drain-contract requeue regression,
+#               bitwise survivor inheritance of prefix pages and
+#               adapters, preempt exactly-once, deadline-starved fence
+#               fallback), then the 2-leg smoke: a ~2x-capacity flood
+#               breaches queue_wait and the autoscaler grows the fleet
+#               to 3 (/healthz ok, zero survivor recompiles); a
+#               preempt(800)@replica drill mid-flood evacuates the home
+#               replica's requests + hot prefixes to survivors exactly
+#               once (warm round-2 hits, one manifest-intact bundle
+#               naming the preemption) — repeated under FF_SANITIZE=1
 #   sanitize  — ffsan plane (ISSUE 16): static concurrency/
 #               tracestability passes clean over runtime/ (tiered exit:
 #               warnings fail too) + the seeded-violation harness, then
@@ -140,7 +153,7 @@
 #               retrace sentinels) asserting zero violations and zero
 #               post-warmup retraces
 #
-# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|kernels|quant|disagg|obs|router|tenancy|deploy|longctx|search|sanitize|all]
+# Usage: ci/run_ci.sh [unit|sweep|accuracy|native|docs|lint|resilience|serving|overlap|elastic|kernels|quant|disagg|obs|router|tenancy|deploy|longctx|search|elastic_serve|sanitize|all]
 set -e
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -394,6 +407,23 @@ run_search() {
   python scripts/search_smoke.py
 }
 
+# elastic_serve tier (ISSUE 20): SLO-driven autoscaling + preemption-
+# tolerant serving. The suite (policy hysteresis/bounds, live
+# add/remove_replica token identity, the drain-contract requeue
+# regression, bitwise survivor inheritance, preempt exactly-once, the
+# deadline-starved fence fallback), then the 2-leg smoke — a flood at
+# ~2x capacity must breach queue_wait and autoscale to 3 replicas
+# (/healthz back to ok, zero survivor recompiles), and a preempt(800)
+# drill mid-flood must complete every request exactly once with the
+# evacuated prefix serving warm survivor hits and one manifest-intact
+# bundle naming the preemption — re-run under FF_SANITIZE=1 to prove
+# the membership/evacuation paths lock in order and never retrace.
+run_elastic_serve() {
+  python -m pytest tests/test_elastic_serve.py -q
+  python scripts/elastic_serve_smoke.py 60
+  FF_SANITIZE=1 python scripts/elastic_serve_smoke.py 40
+}
+
 case "$TIER" in
   unit)     run_unit ;;
   sweep)    run_sweep ;;
@@ -414,8 +444,9 @@ case "$TIER" in
   deploy)   run_deploy ;;
   longctx)  run_longctx ;;
   search)   run_search ;;
+  elastic_serve) run_elastic_serve ;;
   sanitize) run_sanitize ;;
-  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_kernels; run_quant; run_disagg; run_obs; run_router; run_tenancy; run_deploy; run_longctx; run_search; run_sanitize; run_native; run_docs; run_sweep ;;
+  all)      run_lint; run_unit; run_resilience; run_serving; run_overlap; run_elastic; run_kernels; run_quant; run_disagg; run_obs; run_router; run_tenancy; run_deploy; run_longctx; run_search; run_elastic_serve; run_sanitize; run_native; run_docs; run_sweep ;;
   *) echo "unknown tier $TIER"; exit 2 ;;
 esac
 echo "ci($TIER): PASSED"

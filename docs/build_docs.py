@@ -32,7 +32,8 @@ PAGES = [("index", os.path.join(ROOT, "README.md"), "Overview"),
          ("serving", os.path.join(DOCS, "serving.md"),
           "Serving (continuous batching, prefix cache, fleet router, "
           "quantized tier, disaggregated fleet + tiered cache, "
-          "sampling + multi-tenant LoRA, rolling deployment)"),
+          "sampling + multi-tenant LoRA, rolling deployment, "
+          "elastic fleet + preemption)"),
          ("performance", os.path.join(DOCS, "performance.md"),
           "Performance (host + in-graph overlap, Pallas kernel tier, "
           "search v2: persistent cost DB + multi-objective search)"),

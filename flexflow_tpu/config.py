@@ -427,6 +427,23 @@ class FFConfig:
     # (seconds): a replica that cannot quiesce aborts the deploy
     # (state "failed") instead of wedging the roll forever
     deploy_drain_timeout_s: float = 120.0
+    # ---- elastic fleet (runtime/autoscale.py, ISSUE 20) ----
+    # AutoscalePolicy bounds + hysteresis: scale OUT only after
+    # slo_queue_wait/slo_ttft breaches persist across this many
+    # consecutive policy windows, scale IN only after this many idle
+    # windows, and never act twice within the cooldown — a breach
+    # storm cannot thrash the fleet. One policy window = one
+    # slo_window_s evaluation.
+    autoscale_min_replicas: int = 1
+    autoscale_max_replicas: int = 8
+    autoscale_breach_windows: int = 2    # breach windows before scale-out
+    autoscale_idle_windows: int = 6      # idle windows before scale-in
+    autoscale_cooldown_s: float = 30.0   # min seconds between actions
+    # preemption evacuation: a SIGTERM'd (or FF_FAULT `preempt`) replica
+    # races this deadline to hand queued/in-flight requests and hot
+    # prefix slabs to survivors; on expiry it degrades to a plain fence
+    # (remaining work resubmits cold, exactly-once either way)
+    preempt_deadline_s: float = 5.0
 
     # populated at FFModel construction
     strategies: Dict[str, "ParallelConfig"] = dataclasses.field(default_factory=dict)
@@ -583,6 +600,31 @@ class FFConfig:
             raise ValueError(
                 f"deploy_drain_timeout_s={self.deploy_drain_timeout_s}: "
                 f"must be > 0")
+        if self.autoscale_min_replicas < 1:
+            raise ValueError(
+                f"autoscale_min_replicas={self.autoscale_min_replicas}: "
+                f"must be >= 1 (the fleet must keep a survivor)")
+        if self.autoscale_max_replicas < self.autoscale_min_replicas:
+            raise ValueError(
+                f"autoscale_max_replicas={self.autoscale_max_replicas}: "
+                f"must be >= autoscale_min_replicas "
+                f"({self.autoscale_min_replicas})")
+        if self.autoscale_breach_windows < 1:
+            raise ValueError(
+                f"autoscale_breach_windows={self.autoscale_breach_windows}"
+                f": must be >= 1")
+        if self.autoscale_idle_windows < 1:
+            raise ValueError(
+                f"autoscale_idle_windows={self.autoscale_idle_windows}: "
+                f"must be >= 1")
+        if self.autoscale_cooldown_s < 0:
+            raise ValueError(
+                f"autoscale_cooldown_s={self.autoscale_cooldown_s}: "
+                f"must be >= 0")
+        if self.preempt_deadline_s <= 0:
+            raise ValueError(
+                f"preempt_deadline_s={self.preempt_deadline_s}: must be "
+                f"> 0 (the evacuation race needs a budget)")
         if self.paged_attention_impl not in ("auto", "pallas", "einsum"):
             raise ValueError(
                 f"paged_attention_impl={self.paged_attention_impl!r}: "
@@ -816,6 +858,23 @@ class FFConfig:
         p.add_argument("--slo-trip-recorder", action="store_true",
                        help="an SLO breach also trips the flight "
                             "recorder (needs --flight-recorder-dir)")
+        p.add_argument("--autoscale-min-replicas", type=int, default=1,
+                       help="elastic fleet: scale-in floor")
+        p.add_argument("--autoscale-max-replicas", type=int, default=8,
+                       help="elastic fleet: scale-out ceiling")
+        p.add_argument("--autoscale-breach-windows", type=int, default=2,
+                       help="consecutive SLO-breach windows before the "
+                            "autoscaler adds a replica")
+        p.add_argument("--autoscale-idle-windows", type=int, default=6,
+                       help="consecutive idle windows before the "
+                            "autoscaler retires a replica")
+        p.add_argument("--autoscale-cooldown-s", type=float,
+                       default=30.0,
+                       help="refractory period between autoscaler "
+                            "actions")
+        p.add_argument("--preempt-deadline-s", type=float, default=5.0,
+                       help="default evacuation budget when a replica "
+                            "is preempted (SIGTERM/request_preempt)")
         # e.g. --mesh data=4,model=2 (replaces -ll:gpu device-count knobs)
         p.add_argument("--mesh", type=str, default="")
         args, _ = p.parse_known_args(argv)
@@ -891,4 +950,10 @@ class FFConfig:
             slo_window_s=args.slo_window_s,
             slo_clear_windows=args.slo_clear_windows,
             slo_trip_recorder=args.slo_trip_recorder,
+            autoscale_min_replicas=args.autoscale_min_replicas,
+            autoscale_max_replicas=args.autoscale_max_replicas,
+            autoscale_breach_windows=args.autoscale_breach_windows,
+            autoscale_idle_windows=args.autoscale_idle_windows,
+            autoscale_cooldown_s=args.autoscale_cooldown_s,
+            preempt_deadline_s=args.preempt_deadline_s,
         )

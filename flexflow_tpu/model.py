@@ -1483,10 +1483,15 @@ class FFModel:
         ``prefill`` replicas absorb long-prompt admission and hand the
         finished KV pages off to ``decode`` replicas as a serialized
         page slab — greedy streams stay token-identical, and a dead
-        tier degrades to the mixed path. Router kwargs (``max_queue``,
-        ``health_timeout_s``, ``dispatch_backlog``, ``roles``,
-        ``handoff_min_pages``, ``start``) are split out; everything
-        else is forwarded to every replica's ServingEngine."""
+        tier degrades to the mixed path. ``replicas`` is only the
+        STARTING size: membership is live (``add_replica`` /
+        ``remove_replica`` / ``request_preempt`` with exactly-once
+        state evacuation), and runtime/autoscale.py's AutoscalePolicy
+        can drive it from the SLO monitor's breach signal. Router
+        kwargs (``max_queue``, ``health_timeout_s``,
+        ``dispatch_backlog``, ``roles``, ``handoff_min_pages``,
+        ``start``) are split out; everything else is forwarded to
+        every replica's ServingEngine."""
         from flexflow_tpu.runtime.router import ServingRouter
 
         return ServingRouter(self, replicas=replicas, **kwargs)

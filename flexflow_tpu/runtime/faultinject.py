@@ -71,7 +71,16 @@ Consumers:
     the engine is the deploy canary, stalling its admissions by ``<ms>``
     — the deterministic canary SLO-breach drill that must end in an
     automatic rollback plus a post-mortem bundle naming the breached
-    SLO.
+    SLO;
+  * the elastic fleet (ISSUE 20) drives the preemption drills:
+    ``runtime/router.py`` checks ``preempt(<deadline_ms>)@replica:<r>``
+    at replica *r*'s first busy tick (identity-indexed, like ``crash``)
+    and delivers a SIGTERM-equivalent preemption — the replica races
+    the ``<deadline_ms>`` evacuation deadline (FFConfig.
+    preempt_deadline_s when omitted); and the evacuation loop checks
+    ``slow_evac(<ms>)@evacuate:<n>`` (occurrence-counted) to stall the
+    n-th prefix-slab export by ``<ms>``, so the deadline-starved
+    fallback (fence + cold resubmit) is deterministically drillable.
 
 The active plan is parsed lazily from ``FF_FAULT`` and re-parsed (with
 occurrence counters reset) whenever the env value changes; tests that

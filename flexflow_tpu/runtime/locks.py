@@ -68,13 +68,16 @@ __all__ = [
 # holding rank R may only acquire ranks > R. Gaps are deliberate —
 # future locks slot in without renumbering.
 #
-#   deploy > router > engine > prefix-cache > adapter-pool > loader/saver >
-#   watchdog > flightrec/slo/hbm > telemetry > native-loader
+#   deploy > autoscale > router > engine > prefix-cache > adapter-pool >
+#   loader/saver > watchdog > flightrec/slo/hbm > telemetry > native-loader
 #
 LOCK_RANKS: Dict[str, int] = {
     "deploy": 5,             # RollingDeployer roll state (outermost: a
     #                          deploy step acquires router + engine
     #                          locks beneath it)
+    "autoscale": 7,          # AutoscalePolicy decision state (a scale
+    #                          step acquires router + engine locks
+    #                          beneath it, never the deploy lock)
     "router": 10,            # ServingRouter fleet ledger (RLock)
     "engine": 20,            # ServingEngine tick/queue/slots (RLock)
     "prefix-cache": 30,      # RadixPrefixCache tiered-migration publisher cv

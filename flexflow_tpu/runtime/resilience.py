@@ -64,6 +64,21 @@ def reset_counters():
     COUNTERS.clear()
 
 
+def install_sigterm(handler):
+    """Install ``handler`` as the SIGTERM disposition — the ONE
+    preemption-notice entry point (ISSUE 20): TrainSupervisor (checkpoint
+    then exit) and ServingRouter.install_preempt_handler (evacuate a
+    replica against a deadline) both route the cloud's preemption signal
+    through here. Returns ``(installed, previous_disposition)`` —
+    ``(False, None)`` off the main thread (the signal module's rule),
+    where the owner must call its programmatic ``request_preempt()``
+    instead."""
+    try:
+        return True, signal.signal(signal.SIGTERM, handler)
+    except ValueError:
+        return False, None
+
+
 # --------------------------------------------------------------- retry
 
 
@@ -373,11 +388,11 @@ class TrainSupervisor:
         happens at the next step boundary, where params are consistent."""
         if self._installed:
             return
-        try:
-            self._prev_sigterm = signal.signal(
-                signal.SIGTERM, self._on_sigterm)
+        ok, prev = install_sigterm(self._on_sigterm)
+        if ok:
+            self._prev_sigterm = prev
             self._installed = True
-        except ValueError:
+        else:
             # not the main thread: preemption must then be signalled by
             # calling request_preempt() from whoever owns the signal
             fflogger.warning(

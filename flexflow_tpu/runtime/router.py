@@ -73,12 +73,29 @@ level up: this router routes, sheds and fails over on the live
     whose pages demoted to the host tier keeps routing home (promotion
     beats recompute) and only drops when the prefix dies in both tiers.
 
+  * ELASTIC MEMBERSHIP (ISSUE 20) — the fleet breathes at runtime:
+    ``add_replica()`` builds, adapter-replays and warms a new engine off
+    the router lock and admits it atomically; ``remove_replica()``
+    retires one, requeueing its never-admitted work (the PR-5 drain
+    contract's missing half) and handing its cached prefix paths to
+    survivors as page slabs under their original namespaces. Preemption
+    is a first-class event: SIGTERM / ``request_preempt()`` / the
+    FF_FAULT ``preempt`` drill race a configurable deadline to evacuate
+    queued + in-flight requests (clean ownership transfer — no loss
+    counted, so "evacuated then failed-over" still completes exactly
+    once) and hot prefix slabs; a blown deadline degrades to the
+    ordinary fence, resubmitting the remainder cold. runtime/autoscale.py
+    drives scale decisions from the SLO monitor's breach windows.
+
 Failure drills are deterministic in CI via FF_FAULT
 (runtime/faultinject.py): ``crash@replica:<r>`` kills replica r's driver
 at its first busy tick (``crash(<t>)@replica:<r>`` at its t-th),
-``hang@replica:<r>`` wedges it until the heartbeat sweep fences it, and
+``hang@replica:<r>`` wedges it until the heartbeat sweep fences it,
 ``slow(<ms>)@serve:<n>`` stalls an engine admission so an in-flight
-deadline expires on cue.
+deadline expires on cue, ``preempt(<deadline_ms>)@replica:<r>`` delivers
+a SIGTERM-equivalent preemption with that evacuation deadline, and
+``slow_evac(<ms>)@evacuate:<n>`` stalls the n-th evacuation slab export
+so the deadline fallback is deterministically drillable.
 """
 
 from __future__ import annotations
@@ -106,6 +123,22 @@ class ReplicaCrash(RuntimeError):
 # process-wide router ids: trace ids must be unique across fleets in one
 # process (two routers both start their rids at 0)
 _ROUTER_IDS = iter(range(1 << 30))
+
+
+def _slab_nbytes(slab: Dict) -> int:
+    """Host bytes a page slab's payload actually moves (the evacuation
+    cost the bench stamps and the placement advisor prices)."""
+    total = 0
+    stack = [slab.get("payload")]
+    while stack:
+        x = stack.pop()
+        if isinstance(x, np.ndarray):
+            total += x.nbytes
+        elif isinstance(x, (list, tuple)):
+            stack.extend(x)
+        elif isinstance(x, dict):
+            stack.extend(x.values())
+    return total
 
 
 @dataclass
@@ -261,6 +294,11 @@ class ServingRouter:
             raise ValueError(
                 f"max_queue={self.max_queue}: must be >= 0 (0 = unbounded)")
         self.health_timeout_s = float(health_timeout_s)
+        # kept verbatim for live scale-out (ISSUE 20): add_replica()
+        # builds its engine with the SAME kwargs the fleet was built
+        # with, so a scaled-out replica is indistinguishable from a
+        # founding one
+        self._engine_kwargs = dict(engine_kwargs)
         self.engines = [model.make_serving_engine(**engine_kwargs)
                         for _ in range(self.n)]
         self.page_size = self.engines[0].page_size
@@ -306,6 +344,27 @@ class ServingRouter:
         self._deploying = False
         self._swaps_completed = 0
         self._rollbacks = 0
+        # elastic fleet (ISSUE 20): a RETIRED replica left the fleet
+        # cleanly (scale-in or evacuated preemption) — indices stay
+        # stable (parallel lists never compact), it is excluded from
+        # _alive()/dispatch/rollups, and unlike a fence it owes the
+        # router nothing: everything it held was handed to survivors
+        self._retired = [False] * self.n
+        # replica -> evacuation deadline (seconds): set by SIGTERM /
+        # request_preempt / FF_FAULT `preempt`, consumed by the
+        # replica's own driver tick (the evacuation runs there)
+        self._preempt_req: Dict[int, float] = {}
+        self._default_preempt_deadline_s = float(
+            getattr(cfg, "preempt_deadline_s", 5.0))
+        self._sigterm_installed = False
+        self._prev_sigterm = None
+        # fleet-wide adapter registry replay (ISSUE 20): register_adapter
+        # fans out to every live replica at call time; a replica added
+        # LATER replays this so survivors and newcomers always share one
+        # registry view
+        self._adapter_registry: "collections.OrderedDict" = \
+            collections.OrderedDict()
+        self._warm_prompts = None   # captured by warmup() for add_replica
         self._next_rid = 0
         # router counters (stats()): the fleet-level ledger
         self._submitted = 0
@@ -325,6 +384,19 @@ class ServingRouter:
         # sequence-parallel prefills completed (every shard exported and
         # the request queued for decode with its slab LIST)
         self._seq_parallel = 0
+        # elastic-fleet ledger (ISSUE 20): membership changes, and the
+        # evacuation half of exactly-once — requests moved OFF a
+        # retiring/preempted replica cleanly (ownership transfer, no
+        # loss counted; a survivor death afterwards still caps at 2)
+        self._scale_outs = 0
+        self._scale_ins = 0
+        self._preempts = 0
+        self._evacuated_requests = 0
+        self._evacuated_slabs = 0
+        self._evacuated_pages = 0
+        self._evacuation_bytes = 0
+        self._evac_deadline_misses = 0
+        self._preempt_margin_s: Optional[float] = None
         self._ttfts = collections.deque(maxlen=4096)
         # unified telemetry plane (ISSUE 13): fleet identity on every
         # replica's metric labels + trace track, the fleet TTFT
@@ -496,6 +568,8 @@ class ServingRouter:
                 raise RuntimeError(
                     "this fleet has no adapter pool: build replicas "
                     "with adapter_pool_pages > 0")
+            if self._fenced[r] or self._retired[r]:
+                continue
             res = eng.lora.resident.get(name)
             if res is not None and res.ref > 0:
                 pinned.append(r)
@@ -504,8 +578,16 @@ class ServingRouter:
                 f"adapter {name!r} is pinned by live slots on "
                 f"replica(s) {pinned}: drain its traffic before "
                 f"replacing it (no replica was modified)")
-        for eng in self.engines:
+        for r, eng in enumerate(self.engines):
+            if self._fenced[r] or self._retired[r]:
+                continue
             eng.register_adapter(name, weights, alpha)
+        # replayed onto replicas added later (add_replica), so the whole
+        # fleet — newcomers included — shares one registry view and a
+        # retiree's tenants keep serving from survivors with no caller
+        # re-register (ISSUE 20)
+        with self._lock:
+            self._adapter_registry[name] = (weights, alpha)
 
     def wait(self, reqs: Optional[List[FleetRequest]] = None,
              timeout: Optional[float] = None):
@@ -551,13 +633,25 @@ class ServingRouter:
         replica. Call while the fleet is quiet (before routed
         traffic)."""
         plist = [np.asarray(p, np.int32).reshape(-1) for p in prompts]
-        for eng in self.engines:
+        # captured so add_replica() can warm a scaled-out engine to the
+        # same program set before it takes traffic (ISSUE 20)
+        self._warm_prompts = ([p.copy() for p in plist],
+                              int(max_new_tokens))
+        for r, eng in enumerate(self.engines):
+            if self._fenced[r] or self._retired[r]:
+                continue
             eng.warmup(plist, max_new_tokens=max_new_tokens)
-        if self._handoff_capable:
+        # ANY prefix-cached replica can receive a page slab now — from a
+        # prefill handoff or from a retiring/preempted peer's evacuation
+        # (ISSUE 20) — so the shared import writer is warmed fleet-wide,
+        # not just on role-split fleets: a preemption mid-flood must
+        # cost survivors zero compiles
+        if any(eng.prefix_cache is not None for eng in self.engines):
             cand = max((p for p in plist if p.size >= self.page_size),
                        key=lambda p: p.size, default=None)
             for r, eng in enumerate(self.engines):
-                if eng.prefix_cache is None:
+                if (eng.prefix_cache is None or self._fenced[r]
+                        or self._retired[r]):
                     continue
                 if cand is None or not eng.warm_page_import(cand):
                     fflogger.warning(
@@ -579,7 +673,7 @@ class ServingRouter:
         self.wait(None)
         self.close()
         for r, eng in enumerate(self.engines):
-            if not self._fenced[r]:
+            if not self._fenced[r] and not self._retired[r]:
                 eng.drain()
         snap = self.stats()
         snap["drained"] = True
@@ -646,10 +740,440 @@ class ServingRouter:
         with self._lock:
             self._rollbacks += 1
 
+    # ---- elastic fleet (ISSUE 20): live membership + preemption -------------
+
+    def add_replica(self, role: str = "mixed", warmup_prompts=None,
+                    max_new_tokens: int = 4) -> int:
+        """Scale OUT: build one more replica engine and admit it to the
+        fleet. The engine is constructed, adapter-replayed and warmed
+        entirely OFF the router lock (the live fleet keeps serving
+        through the whole build), then joins under one short lock
+        acquisition: parallel lists extend, a driver thread spawns, and
+        the SLO windows rebaseline so the capacity step does not smear
+        into the breach history. Warmup uses ``warmup_prompts`` when
+        given, else the prompt set the fleet's own warmup() captured —
+        either way the newcomer's programs (page-import writer included,
+        so it can receive evacuation/handoff slabs) are warm BEFORE its
+        first dispatch: scale-out adds capacity, never a compile stall.
+        Returns the new replica index."""
+        if role not in self.ROLES:
+            raise ValueError(
+                f"role={role!r}: must be one of {self.ROLES}")
+        with self._lock:
+            if self._draining:
+                raise RuntimeError(
+                    "ServingRouter is draining: the fleet cannot grow")
+            registry = list(self._adapter_registry.items())
+            warm = self._warm_prompts
+        eng = self.model.make_serving_engine(**self._engine_kwargs)
+        if eng.lora is not None:
+            for name, (weights, alpha) in registry:
+                eng.register_adapter(name, weights, alpha)
+        if warmup_prompts is not None:
+            warm = ([np.asarray(p, np.int32).reshape(-1)
+                     for p in warmup_prompts], int(max_new_tokens))
+        if warm is not None:
+            plist, mnt = warm
+            eng.warmup(plist, max_new_tokens=mnt)
+            if eng.prefix_cache is not None:
+                cand = max((p for p in plist
+                            if p.size >= self.page_size),
+                           key=lambda p: p.size, default=None)
+                if cand is not None:
+                    eng.warm_page_import(cand)
+        with self._lock:
+            r = self.n
+            self.engines.append(eng)
+            self.roles.append(role)
+            self._outstanding.append({})
+            self._to_submit.append(collections.deque())
+            self._fenced.append(False)
+            self._fence_reason.append("")
+            self._retired.append(False)
+            self._suspended.append(False)
+            self._heartbeat.append(time.monotonic())
+            self._busy_ticks.append(0)
+            self.n += 1
+            self._scale_outs += 1
+            self._handoff_capable = (
+                any(t == "prefill" for t in self.roles)
+                and self.engines[0].prefix_cache is not None)
+            eng.set_telemetry_identity(r, role)
+            thread = None
+            if self._started:
+                thread = threading.Thread(
+                    target=self._replica_main, args=(r,), daemon=True,
+                    name=f"ff-router-replica-{r}")
+                self._threads.append(thread)
+        if thread is not None:
+            thread.start()
+        if self._tm_on:
+            telemetry.tracer().instant("scale_out", track="router",
+                                       replica=r, role=role,
+                                       warmed=warm is not None)
+            flightrec.slo_monitor().rebaseline()
+        fflogger.info(
+            "router: scaled OUT to replica %d (role %s, warmed=%s, "
+            "%d adapters replayed)", r, role, warm is not None,
+            len(registry))
+        return r
+
+    def remove_replica(self, r: int, timeout_s: float = 60.0) -> Dict:
+        """Scale IN: retire replica r without losing a request or a
+        cached prefix. The replica is first suspended (no new
+        dispatches), its never-admitted work — the engine queue drain()
+        deliberately parks (the PR-5 contract) plus anything assigned
+        but not yet handed over — is requeued to survivors, in-flight
+        requests finish in place (bounded by ``timeout_s``; a replica
+        that cannot quiesce is fenced, which resubmits exactly-once),
+        the engine drains, and its cached prefix paths are exported as
+        page slabs into the least-loaded survivors under their original
+        per-version/per-adapter namespaces. Resident adapters already
+        live fleet-wide (register_adapter fans out; add_replica
+        replays), so tenants keep serving with no caller action.
+        Returns an evacuation summary dict."""
+        with self._lock:
+            self._check_member_locked(r)
+            survivors = [s for s in self._alive()
+                         if s != r and not self._suspended[s]]
+            if not survivors:
+                raise RuntimeError(
+                    f"remove_replica({r}): no live survivor to inherit "
+                    f"its work — the fleet cannot scale below 1")
+            if all(self.roles[s] == "prefill" for s in survivors):
+                raise RuntimeError(
+                    f"remove_replica({r}): the survivors are all "
+                    f"prefill replicas — nowhere to decode")
+            self._suspended[r] = True
+        eng = self.engines[r]
+        # pull back un-admitted work, then wait for in-flight slots to
+        # retire on the replica's own driver; re-reclaim each pass —
+        # racing submissions that were mid-handoff when we suspended
+        # land in the engine queue one driver tick later
+        pending: Dict[int, object] = {}
+        requeued = 0
+        t0 = time.monotonic()
+        while True:
+            for ereq in eng.reclaim_queued():
+                pending[id(ereq)] = ereq
+            with self._lock:
+                requeued += self._pull_unadmitted_locked(
+                    r, pending, "scale_in")
+                open_work = (bool(self._outstanding[r])
+                             or bool(self._to_submit[r]))
+                fenced = self._fenced[r]
+            if fenced or not open_work:
+                break
+            if time.monotonic() - t0 > timeout_s:
+                with self._lock:
+                    self._fence_locked(
+                        r, f"scale-in: failed to quiesce in "
+                           f"{timeout_s}s")
+                    fenced = True
+                break
+            time.sleep(0.003)
+        evac = {"slabs": 0, "pages": 0, "bytes": 0, "paths": 0,
+                "deadline_missed": False}
+        if not fenced:
+            eng.drain()
+            evac = self._evacuate_prefixes(r, deadline_t=None)
+        with self._lock:
+            self._retired[r] = True
+            self._scale_ins += 1
+            self._drop_affinity_locked(r)
+        if self._tm_on:
+            telemetry.tracer().instant(
+                "scale_in", track="router", replica=r,
+                requeued=requeued, slabs=evac["slabs"],
+                pages=evac["pages"])
+            flightrec.slo_monitor().rebaseline()
+        fflogger.info(
+            "router: scaled IN replica %d — %d never-admitted requests "
+            "requeued, %d prefix slabs (%d pages, %d bytes) inherited "
+            "by survivors", r, requeued, evac["slabs"], evac["pages"],
+            evac["bytes"])
+        return {"replica": r, "requeued": requeued, "fenced": fenced,
+                **evac}
+
+    def request_preempt(self, r: int,
+                        deadline_s: Optional[float] = None):
+        """Preemption notice for replica r (the programmatic SIGTERM,
+        resilience.py's request_preempt applied to the fleet): flag the
+        replica for evacuation; its own driver runs the deadline race on
+        its next tick. ``deadline_s`` defaults to
+        FFConfig.preempt_deadline_s."""
+        with self._lock:
+            self._check_member_locked(r)
+            self._preempt_req[r] = float(
+                deadline_s if deadline_s is not None
+                else self._default_preempt_deadline_s)
+
+    def install_preempt_handler(self, replica: int = 0,
+                                deadline_s: Optional[float] = None):
+        """Route a real SIGTERM (the cloud's preemption notice) to
+        ``request_preempt(replica, deadline_s)`` — the serving half of
+        resilience.py's handler path. Main thread only (signal module
+        rule); off the main thread this warns and the owner calls
+        request_preempt() itself. Idempotent."""
+        if self._sigterm_installed:
+            return
+        from flexflow_tpu.runtime import resilience
+
+        def _on_sigterm(signum, frame):
+            self.request_preempt(replica, deadline_s)
+
+        ok, prev = resilience.install_sigterm(_on_sigterm)
+        if ok:
+            self._sigterm_installed = True
+            self._prev_sigterm = prev
+        else:
+            fflogger.warning(
+                "router: cannot install SIGTERM handler outside the "
+                "main thread; call request_preempt() instead")
+
+    def _check_member_locked(self, r: int):
+        if r < 0 or r >= self.n:
+            raise ValueError(f"replica {r}: not in [0, {self.n})")
+        if self._retired[r]:
+            raise ValueError(f"replica {r} already retired")
+        if self._fenced[r]:
+            raise ValueError(
+                f"replica {r} is fenced ({self._fence_reason[r]})")
+
+    def _preempt_scheduled(self, r: int) -> bool:
+        """Driver-tick check: a pending request_preempt/SIGTERM notice,
+        or the FF_FAULT drill ``preempt(<deadline_ms>)@replica:<r>``
+        (fires at the replica's first busy tick; the value is the
+        evacuation deadline, defaulting to preempt_deadline_s)."""
+        if r in self._preempt_req:
+            return True
+        plan = faultinject.active_plan()
+        scheduled, value = plan.pending("preempt", "replica", r)
+        if scheduled and self._busy_ticks[r] >= 1:
+            plan.at_site("preempt", "replica", r)
+            self._preempt_req[r] = (
+                value / 1e3 if value is not None
+                else self._default_preempt_deadline_s)
+            return True
+        return False
+
+    def _preempt_now(self, r: int):
+        """The evacuation race (runs on replica r's own driver thread,
+        which exits right after): against ``deadline_s``, (1) requeue
+        every never-admitted request (cheap — host memory), (2) export
+        hot prefix paths as page slabs into survivors, hottest first,
+        checking the deadline between slabs (FF_FAULT ``slow_evac``
+        stalls here), (3) transfer in-flight requests to the router
+        queue under one lock acquisition — ownership flips, so the dying
+        replica's late completions are discarded by _collect's owner
+        check and the survivor's re-decode is the request's ONE stream.
+        Evacuated requests count no loss (clean transfer: a survivor
+        death afterwards still fails over normally). A blown deadline
+        degrades to _fence_locked — whatever was not yet evacuated
+        resubmits cold with a loss counted, the existing exactly-once
+        path. Either way the replica ends retired."""
+        with self._lock:
+            if self._fenced[r] or self._retired[r]:
+                self._preempt_req.pop(r, None)
+                return
+            deadline_s = self._preempt_req.pop(
+                r, self._default_preempt_deadline_s)
+            self._suspended[r] = True
+            self._preempts += 1
+        deadline_t = time.perf_counter() + deadline_s
+        if self._tm_on:
+            telemetry.tracer().instant(
+                "preempt", track="router", replica=r,
+                deadline_s=deadline_s)
+        fflogger.warning(
+            "router: replica %d PREEMPTED — evacuating against a "
+            "%.3fs deadline", r, deadline_s)
+        eng = self.engines[r]
+        pending = {id(e): e for e in eng.reclaim_queued()}
+        with self._lock:
+            evacuated = self._pull_unadmitted_locked(
+                r, pending, "preempt")
+        evac = self._evacuate_prefixes(r, deadline_t)
+        missed = (evac["deadline_missed"]
+                  or time.perf_counter() >= deadline_t)
+        with self._lock:
+            if not missed:
+                evacuated += self._evacuate_inflight_locked(r)
+            if self._outstanding[r] or self._to_submit[r]:
+                # hard-deadline fallback: a clean fence — remaining
+                # work resubmits cold through the exactly-once path
+                self._evac_deadline_misses += 1
+                self._fence_locked(
+                    r, f"preempt deadline ({deadline_s:.3f}s) expired "
+                       f"mid-evacuation")
+            self._retired[r] = True
+            self._drop_affinity_locked(r)
+            margin = deadline_t - time.perf_counter()
+            # last drill's deadline headroom (negative = starved) — the
+            # bench stamps it next to evacuation_bytes
+            self._preempt_margin_s = round(margin, 4)
+        if self._tm_on:
+            flightrec.trip(
+                "preempt", replica=r, deadline_s=deadline_s,
+                evacuated_requests=evacuated, slabs=evac["slabs"],
+                pages=evac["pages"], bytes=evac["bytes"],
+                deadline_missed=missed,
+                deadline_margin_s=round(margin, 4))
+            flightrec.slo_monitor().rebaseline()
+        fflogger.warning(
+            "router: replica %d preemption %s — %d requests evacuated, "
+            "%d slabs / %d pages / %d bytes inherited, %.3fs deadline "
+            "margin", r, "DEADLINE-STARVED (fenced)" if missed
+            else "evacuated cleanly", evacuated, evac["slabs"],
+            evac["pages"], evac["bytes"], margin)
+
+    def _pull_unadmitted_locked(self, r: int, pending: Dict,
+                                reason: str) -> int:
+        """Requeue replica r's never-admitted work: everything still on
+        the hand-off deque, plus engine-queue requests the caller
+        reclaimed (matched by engine-Request identity — ``pending`` maps
+        id(ereq) -> ereq and unmatched entries stay for the caller's
+        next pass, closing the race where reclaim beats the driver's
+        outstanding-ledger write). No loss is counted: the engine never
+        admitted these, so requeue is a pure ownership transfer."""
+        moved = []
+        while self._to_submit[r]:
+            req = self._to_submit[r].pop()
+            self._outstanding[r].pop(req.rid, None)
+            if req.state == "dispatched" and req.replica == r:
+                moved.append(req)
+        for rid in list(self._outstanding[r].keys()):
+            req, ereq = self._outstanding[r][rid]
+            if ereq is None or id(ereq) not in pending:
+                continue
+            del pending[id(ereq)]
+            del self._outstanding[r][rid]
+            if req.state == "dispatched" and req.replica == r:
+                moved.append(req)
+        moved.sort(key=lambda q: q.rid)
+        now = time.perf_counter()
+        for req in moved:
+            if req.deadline is not None and now >= req.deadline:
+                self._finalize_locked(
+                    req, "timeout",
+                    f"deadline expired while queued on retiring "
+                    f"replica {r}")
+                continue
+            req.state = "queued"
+            req.replica = -1
+            req.tokens = []
+            self._evacuated_requests += 1
+            if self._tm_on:
+                telemetry.tracer().instant(
+                    "evacuate", trace_id=req.trace_id, track="router",
+                    from_replica=r, reason=reason, admitted=False)
+        for req in reversed([q for q in moved if q.state == "queued"]):
+            self._queue.appendleft(req)
+        return sum(1 for q in moved if q.state == "queued")
+
+    def _evacuate_inflight_locked(self, r: int) -> int:
+        """Clean ownership transfer of replica r's admitted in-flight
+        requests back to the router queue (the preemption path: the
+        hardware is going away, so their decode cannot finish here).
+        Tokens are discarded — the survivor re-decodes the identical
+        stream from scratch — and NO loss is counted: this is an
+        evacuation, not a death, so a survivor crash afterwards still
+        gets its one failover before the cap."""
+        out = self._outstanding[r]
+        self._outstanding[r] = {}
+        self._to_submit[r].clear()
+        now = time.perf_counter()
+        moved = []
+        for _, (req, _ereq) in sorted(out.items()):
+            if req.state != "dispatched" or req.replica != r:
+                continue
+            if req.deadline is not None and now >= req.deadline:
+                self._finalize_locked(
+                    req, "timeout",
+                    f"deadline expired in flight on preempted "
+                    f"replica {r}")
+                continue
+            req.state = "queued"
+            req.replica = -1
+            req.tokens = []
+            moved.append(req)
+            self._evacuated_requests += 1
+            if self._tm_on:
+                telemetry.tracer().instant(
+                    "evacuate", trace_id=req.trace_id, track="router",
+                    from_replica=r, reason="preempt", admitted=True)
+        for req in reversed(moved):
+            self._queue.appendleft(req)
+        return len(moved)
+
+    def _evacuate_prefixes(self, r: int,
+                           deadline_t: Optional[float]) -> Dict:
+        """Export replica r's cached prefix paths as page slabs and
+        import each into the least-loaded live survivor, hottest path
+        first. ``deadline_t`` (absolute perf_counter, or None for
+        unbounded scale-in) is checked BETWEEN slabs — a preemption
+        deadline can starve the tail, never wedge mid-transfer. The
+        FF_FAULT drill ``slow_evac(<ms>)@evacuate:<n>`` stalls the n-th
+        export to make the starved path deterministic. Namespaces ride
+        each slab verbatim, so per-version/per-adapter prefixes land on
+        survivors under the exact keys they were cached under, and the
+        importer's dedupe makes shared interior pages free."""
+        eng = self.engines[r]
+        stats = {"slabs": 0, "pages": 0, "bytes": 0, "paths": 0,
+                 "deadline_missed": False}
+        if eng.prefix_cache is None:
+            return stats
+        manifest = eng.cached_prefix_manifest()
+        stats["paths"] = len(manifest)
+        plan = faultinject.active_plan()
+        for tokens, ns in manifest:
+            if (deadline_t is not None
+                    and time.perf_counter() >= deadline_t):
+                stats["deadline_missed"] = True
+                break
+            if plan.fire("slow_evac", "evacuate"):
+                time.sleep((plan.last_value or 0) / 1e3)
+                if (deadline_t is not None
+                        and time.perf_counter() >= deadline_t):
+                    stats["deadline_missed"] = True
+                    break
+            slab = eng.export_prefix_path(tokens, ns)
+            if slab is None:
+                continue        # evicted since the manifest walk
+            with self._lock:
+                cands = [s for s in self._alive()
+                         if s != r and not self._suspended[s]
+                         and self.engines[s].prefix_cache is not None]
+            if not cands:
+                break           # nobody can inherit: stop exporting
+            dest = min(cands, key=lambda s: (
+                self._load(s), self.engines[s].load()["queued"], s))
+            try:
+                self.engines[dest].import_prefix_slab(slab)
+            except Exception as e:  # noqa: BLE001 — a survivor that
+                #   cannot ingest must not abort the whole evacuation
+                fflogger.warning(
+                    "router: evacuation import on replica %d failed "
+                    "(%s) — slab dropped", dest, e)
+                continue
+            nbytes = _slab_nbytes(slab)
+            stats["slabs"] += 1
+            # pages CARRIED by the slab (like `bytes`): the importer
+            # dedupes pages the survivor already holds, and a dedup is
+            # still a successful evacuation, not a smaller one
+            stats["pages"] += len(slab["payload"])
+            stats["bytes"] += nbytes
+            with self._lock:
+                self._evacuated_slabs += 1
+                self._evacuated_pages += len(slab["payload"])
+                self._evacuation_bytes += nbytes
+        return stats
+
     # ---- dispatch (router lock held) ----------------------------------------
 
     def _alive(self) -> List[int]:
-        return [r for r in range(self.n) if not self._fenced[r]]
+        return [r for r in range(self.n)
+                if not self._fenced[r] and not self._retired[r]]
 
     def _load(self, r: int) -> int:
         # the health() counters, via the router's exact outstanding
@@ -925,7 +1449,7 @@ class ServingRouter:
             return
         now = time.monotonic()
         for r in range(self.n):
-            if self._fenced[r]:
+            if self._fenced[r] or self._retired[r]:
                 continue
             if not self._outstanding[r] and not self._to_submit[r]:
                 continue
@@ -968,7 +1492,7 @@ class ServingRouter:
         eng = self.engines[r]
         while not self._stop.is_set():
             with self._lock:
-                if self._fenced[r]:
+                if self._fenced[r] or self._retired[r]:
                     return
                 self._sweep_hangs_locked()
                 self._dispatch_locked()
@@ -984,6 +1508,14 @@ class ServingRouter:
                     self._busy_ticks[r] += 1
                     if self._maybe_injected_fault(r):
                         return
+                if self._preempt_scheduled(r):
+                    # the evacuation runs HERE, on the replica's own
+                    # driver thread, then the driver exits. `assigned`
+                    # is safe to drop: dispatch already recorded every
+                    # entry in the outstanding ledger, and the
+                    # evacuation requeues from there.
+                    self._preempt_now(r)
+                    return
                 for req in assigned:
                     if req.phase == "prefill":
                         # prefill-replica half of the handoff: prefill
@@ -1268,6 +1800,26 @@ class ServingRouter:
         for tier, pages in st["fleet"]["pages_by_tier"].items():
             reg.gauge("ff_fleet_kv_pages", "fleet KV pages by tier",
                       labels=("tier",)).labels(tier).set(pages)
+        # elastic fleet (ISSUE 20): the replica-count gauge the
+        # autoscaler and dashboards watch, plus the preemption ledger
+        reg.gauge("ff_fleet_replica_count",
+                  "live (non-fenced, non-retired) replicas"
+                  ).set(st["alive"])
+        reg.gauge("ff_preempt_total",
+                  "replica preemptions handled").set(st["preempts"])
+        reg.gauge("ff_preempt_evacuated_requests",
+                  "requests cleanly evacuated off retiring/preempted "
+                  "replicas (no loss counted)"
+                  ).set(st["evacuated_requests"])
+        reg.gauge("ff_preempt_evacuated_pages",
+                  "prefix-cache pages inherited by survivors"
+                  ).set(st["evacuated_pages"])
+        reg.gauge("ff_preempt_evacuation_bytes",
+                  "host bytes moved by prefix evacuation"
+                  ).set(st["evacuation_bytes"])
+        reg.gauge("ff_preempt_deadline_misses",
+                  "evacuations that blew their deadline and fell back "
+                  "to a fence").set(st["evac_deadline_misses"])
         live = reg.gauge("ff_router_replica_up",
                          "1 = replica live, 0 = fenced",
                          labels=("replica", "role"))
@@ -1306,14 +1858,21 @@ class ServingRouter:
                 row = {"replica": r, "role": self.roles[r],
                        "fenced": self._fenced[r],
                        "fence_reason": self._fence_reason[r],
+                       "retired": self._retired[r],
                        "outstanding": self._load(r),
                        "weight_version": eng.weight_version,
                        "deploy_state": eng.deploy_state,
                        "suspended": self._suspended[r],
                        **eng.load()}
                 per_replica.append(row)
+            retired = sum(self._retired)
             return {
-                "replicas": self.n,
+                # "replicas" is the CURRENT fleet size (retirees left
+                # cleanly — they are not capacity and not down);
+                # "replicas_total" counts every index ever created
+                "replicas": self.n - retired,
+                "replicas_total": self.n,
+                "retired": retired,
                 "alive": len(self._alive()),
                 "roles": list(self.roles),
                 "submitted": self._submitted,
@@ -1332,6 +1891,18 @@ class ServingRouter:
                 "swaps_completed": self._swaps_completed,
                 "rollbacks": self._rollbacks,
                 "deploying": self._deploying,
+                # elastic-fleet ledger (ISSUE 20, keys pinned):
+                # membership changes + the evacuation half of
+                # exactly-once (clean transfers, losses NOT counted)
+                "scale_outs": self._scale_outs,
+                "scale_ins": self._scale_ins,
+                "preempts": self._preempts,
+                "evacuated_requests": self._evacuated_requests,
+                "evacuated_slabs": self._evacuated_slabs,
+                "evacuated_pages": self._evacuated_pages,
+                "evacuation_bytes": self._evacuation_bytes,
+                "evac_deadline_misses": self._evac_deadline_misses,
+                "preempt_margin_s": self._preempt_margin_s,
                 "queued": len(self._queue),
                 "max_queue": self.max_queue,
                 "ttft_p50_ms": round(pct(0.50) * 1e3, 3),
@@ -1374,6 +1945,9 @@ class ServingRouter:
         agg["seq_parallel_prefills"] = self._seq_parallel
         per_role: Dict[str, Dict] = {}
         for r, role in enumerate(self.roles):
+            if self._retired[r]:
+                continue    # a retiree is not capacity (its historical
+                #             counters still ride the aggregate above)
             row = per_role.setdefault(role, {
                 "replicas": 0, "alive": 0, "outstanding": 0,
                 "queued": 0, "active_slots": 0})
@@ -1407,10 +1981,13 @@ class ServingRouter:
                 "status": status,
                 "admitting": not self._draining and bool(alive),
                 "alive": len(alive),
-                "replicas": self.n,
+                # current fleet size: retirees left cleanly and are not
+                # "down" — the /healthz rollup compares alive against
+                # this, so a finished scale-in reads ok, not degraded
+                "replicas": self.n - sum(self._retired),
+                "retired": sum(self._retired),
                 "queued": len(self._queue),
-                "outstanding": sum(self._load(r) for r in range(self.n)
-                                   if not self._fenced[r]),
+                "outstanding": sum(self._load(r) for r in self._alive()),
                 "fenced": self._fenced_count,
                 "max_queue": self.max_queue,
                 # rolling deploy (ISSUE 17): /healthz reports every

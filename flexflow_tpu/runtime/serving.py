@@ -137,7 +137,7 @@ import math
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -486,6 +486,39 @@ class RadixPrefixCache:
             n = stack.pop()
             yield n
             stack.extend(n.children.values())
+
+    def cached_paths(self) -> List[Tuple[np.ndarray, object, int]]:
+        """Every root-to-leaf cached prefix, hottest first, as
+        ``(tokens, ns, last_use)`` — the evacuation manifest a
+        preempted/retiring replica walks (ISSUE 20). Tokens are
+        reconstructed from the edge chunks themselves (the first edge's
+        ``("ns", ns)`` salt is peeled back into the namespace), so the
+        caller can re-export each path with export_prefix_slab under the
+        exact per-version/per-adapter key it was cached under. Leaves
+        only: exporting a leaf path carries every interior page, and the
+        importer dedupes shared prefixes. Dead (lost-host-copy) nodes
+        prune their subtrees — there is nothing to evacuate below them."""
+        out = []
+        for first, child in self.root.children.items():
+            if first and first[0] == "ns":
+                ns, toks0 = first[1], first[2:]
+            else:
+                ns, toks0 = None, first
+            stack = [(child, toks0)]
+            while stack:
+                node, toks = stack.pop()
+                if node.tier == "dead":
+                    continue
+                kids = [(c.chunk, c) for c in node.children.values()
+                        if c.tier != "dead"]
+                if not kids:
+                    out.append((np.asarray(toks, np.int32), ns,
+                                node.last_use))
+                    continue
+                for chunk, c in kids:
+                    stack.append((c, toks + chunk))
+        out.sort(key=lambda e: -e[2])
+        return out
 
     def evict(self, need: int, protect=(), pressure: bool = True) \
             -> List[int]:
@@ -2881,6 +2914,16 @@ class ServingEngine:
         prefix must still be cached HERE (shards import their
         predecessors' slabs before prefilling), so the exported pages'
         KV attends the true full prefix."""
+        return self._export_slab_ns(prompt, self._cache_ns(adapter),
+                                    start_page)
+
+    def _export_slab_ns(self, prompt, ns, start_page: int = 0) \
+            -> Optional[Dict]:
+        """export_prefix_slab against an EXPLICIT salted namespace — the
+        evacuation path (ISSUE 20) re-exports trie paths whose
+        (version, adapter) salt was read back off the trie itself, so a
+        retiring replica's A/B-versioned and per-adapter prefixes land
+        on survivors under the exact key they were cached under."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         with self._lock:
             if self.prefix_cache is None:
@@ -2892,8 +2935,7 @@ class ServingEngine:
                 raise ValueError(
                     f"start_page={start_page}: must be in [0, {last}) "
                     f"for this prompt's {last} full prefix pages")
-            path = self.prefix_cache.match(
-                prompt, last, ns=self._cache_ns(adapter))
+            path = self.prefix_cache.match(prompt, last, ns=ns)
             if len(path) < last:
                 return None
             tail = path[start_page:]
@@ -2919,7 +2961,7 @@ class ServingEngine:
             # cross-version KV (zero stale hits by construction)
             return {"page_size": self.page_size,
                     "tokens": prompt[:last * self.page_size].copy(),
-                    "ns": self._cache_ns(adapter),
+                    "ns": ns,
                     "start_page": int(start_page),
                     "payload": payloads}
 
@@ -3034,6 +3076,26 @@ class ServingEngine:
                 if sp > 0:
                     self._partial_slab_imports += 1
             return imported
+
+    def cached_prefix_manifest(self) -> List[Tuple[np.ndarray, object]]:
+        """Evacuation manifest (ISSUE 20): ``(tokens, ns)`` per cached
+        root-to-leaf prefix path on this engine, hottest first, each
+        under its original salted namespace. A preempted or retiring
+        replica walks this in heat order, re-exporting each entry with
+        export_prefix_path() — checking its evacuation deadline BETWEEN
+        slabs — so the hottest state lands on survivors first."""
+        with self._lock:
+            if self.prefix_cache is None:
+                return []
+            return [(t, ns) for t, ns, _ in self.prefix_cache
+                    .cached_paths()]
+
+    def export_prefix_path(self, tokens, ns) -> Optional[Dict]:
+        """One evacuation slab: a cached_prefix_manifest() entry
+        re-exported verbatim under its original namespace. None when the
+        path's pages were evicted since the manifest walk — the entry
+        simply drops out of the evacuation."""
+        return self._export_slab_ns(tokens, ns)
 
     def warm_page_import(self, prompt) -> bool:
         """Compile and run the shared page-import writer once (H2D tier
@@ -3459,6 +3521,22 @@ class ServingEngine:
             "%d recompiles", snap["completed"], snap["failed"],
             snap["queued"], snap["occupancy"], snap["recompiles"])
         return snap
+
+    def reclaim_queued(self) -> List["Request"]:
+        """Pull every queued-never-admitted request OUT of this engine
+        and return it — the missing half of the drain() contract
+        (ISSUE 20 bugfix): drain() deliberately parks queued requests
+        for the caller to re-submit, but the fleet's scale-in path never
+        collected them, stranding work on a retiring engine. A retiring
+        or preempted replica's owner calls this (before or after the
+        drain — the queue gate is the engine lock either way) and
+        requeues the returned requests on survivors. The requests are
+        untouched: never admitted, no slots, no pages, no counters to
+        unwind."""
+        with self._lock:
+            out = list(self._queue)
+            del self._queue[:]
+            return out
 
     def reopen(self):
         """Readmit after a drain() (ISSUE 17 satellite: drain used to be

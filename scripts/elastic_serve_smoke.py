@@ -1,0 +1,308 @@
+#!/usr/bin/env python
+"""CI elastic-fleet smoke (ci/run_ci.sh `elastic_serve` tier): a
+2-replica fleet flooded past its capacity, the SLO-driven autoscaler
+growing it live, then a deadline-raced preemption drill. Proves the
+ISSUE-20 acceptance end to end on CPU:
+
+  leg 1 — breach-driven scale-out:
+  * a closed-loop flood at ~2x fleet capacity breaches the
+    ``queue_wait_p99`` SLO; the AutoscalePolicy holds through its
+    hysteresis window, then grows the fleet to 3 via add_replica();
+  * the newcomer is warmed BEFORE admission and takes real work;
+    /healthz returns to ``ok`` within a bounded recovery window once
+    the capacity step lands;
+  * ZERO survivor recompiles: scale-out adds capacity, never a
+    compile stall on the replicas already serving.
+
+  leg 2 — preemption with exactly-once evacuation:
+  * FF_FAULT ``preempt(800)@replica:<home>`` fells the shared-prefix
+    home replica mid-flood: it races the 800 ms deadline to evacuate
+    its queued + in-flight requests and hot prefix pages to survivors,
+    then retires WITHOUT a fence;
+  * every flood request completes EXACTLY ONCE (router ledger ==
+    per-engine completions; zero losses burned — a later real failover
+    would still fit the cap);
+  * zero evacuated prefixes lost: round 2 of the shared prompt serves
+    a WARM hit from a survivor;
+  * exactly one manifest-intact flight-recorder bundle lands, its
+    trigger naming the preemption.
+
+Run under FF_SANITIZE=1 (the CI tier's second leg) to also assert zero
+lock-order violations and zero post-warmup retraces.
+
+Usage: python scripts/elastic_serve_smoke.py [N_min_leg2]
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flexflow_tpu._env import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(1)
+
+import numpy as np  # noqa: E402
+
+from flexflow_tpu import FFConfig, FFModel  # noqa: E402
+from flexflow_tpu.models.llama import llama_lm  # noqa: E402
+from flexflow_tpu.runtime import faultinject, flightrec  # noqa: E402
+from flexflow_tpu.runtime.autoscale import AutoscalePolicy  # noqa: E402
+
+VOCAB = 128
+MAX_NEW = 12
+WINDOW_S = 0.5
+
+
+def build_model(flight_dir):
+    cfg = FFConfig(batch_size=2, mesh_shape={"data": 1}, serve_slots=2,
+                   kv_page_size=8, slo_window_s=WINDOW_S,
+                   slo_queue_wait_p99_s=0.02,
+                   flight_recorder_dir=flight_dir,
+                   flight_debounce_s=1.0)
+    ff = FFModel(cfg)
+    _, logits = llama_lm(ff, 2, seq_len=16, hidden=64, layers=1, heads=4,
+                         kv_heads=2, vocab_size=VOCAB)
+    ff.compile(final_tensor=logits)
+    return ff
+
+
+class Feeder(threading.Thread):
+    """Closed-loop skewed flood (80% share a 64-token system prompt).
+    ``max_inflight`` is live-tunable: the flood runs at ~2x fleet
+    capacity to force the breach, then recedes so the recovery window
+    measures the capacity step, not an unbounded arrival rate."""
+
+    def __init__(self, router, rs, system, max_inflight):
+        super().__init__(daemon=True)
+        self.router, self.rs, self.system = router, rs, system
+        self.max_inflight = max_inflight
+        self.reqs = []
+        self._halt = threading.Event()
+
+    def _prompt(self):
+        if self.rs.randint(5) < 4:
+            tail = self.rs.randint(
+                1, VOCAB, (int(self.rs.randint(1, 8)),)).astype(np.int32)
+            return np.concatenate([self.system, tail])
+        return self.rs.randint(
+            1, VOCAB, (int(self.rs.randint(3, 25)),)).astype(np.int32)
+
+    def run(self):
+        while not self._halt.is_set():
+            if sum(1 for r in self.reqs
+                   if not r.settled) >= self.max_inflight:
+                time.sleep(0.004)
+                continue
+            self.reqs.append(self.router.submit(self._prompt(), MAX_NEW))
+
+    def stop(self):
+        self._halt.set()
+        self.join(timeout=60)
+
+
+def settle(router, feeder):
+    feeder.stop()
+    router.wait(feeder.reqs, timeout=1200)
+    n = len(feeder.reqs)
+    assert all(r.settled for r in feeder.reqs), "requests lost"
+    assert [r.state for r in feeder.reqs] == ["done"] * n, \
+        f"{sum(1 for r in feeder.reqs if r.state != 'done')} of {n} " \
+        f"requests did not complete"
+    return n
+
+
+def leg1_scale_out(router, pol, rs, system):
+    warm_compiles = [e.recompile_count for e in router.engines]
+    feeder = Feeder(router, rs, system, max_inflight=32)
+    feeder.start()
+    while len(feeder.reqs) < 8:         # the flood is live
+        time.sleep(0.01)
+
+    # tick at the SLO window cadence: the breach must PERSIST across
+    # pol.breach_windows evaluated windows before the fleet grows
+    t0 = time.perf_counter()
+    action = None
+    while time.perf_counter() - t0 < 120:
+        action = pol.tick()
+        if action is not None:
+            break
+        time.sleep(WINDOW_S)
+    breach_s = time.perf_counter() - t0
+    assert action == "scale_out", (
+        f"flood at 2x capacity never drove a scale-out "
+        f"(policy state {pol.state()})")
+    st = router.stats()
+    assert st["alive"] == 3 and st["scale_outs"] == 1
+    newcomer_warm = router.engines[2].stats()["completed"]
+    assert newcomer_warm > 0, "the newcomer joined un-warmed"
+
+    # recede to below the GROWN fleet's capacity: /healthz must return
+    # to ok within a bounded recovery window
+    feeder.max_inflight = 2
+    t0 = time.perf_counter()
+    status = None
+    while time.perf_counter() - t0 < 120:
+        status = flightrec.health_rollup()["status"]
+        if status == "ok":
+            break
+        time.sleep(WINDOW_S)
+    recover_s = time.perf_counter() - t0
+    assert status == "ok", (
+        f"/healthz stuck at {flightrec.health_rollup()!r} after the "
+        f"capacity step")
+
+    n = settle(router, feeder)
+    assert all(r.attempts == 1 for r in feeder.reqs), \
+        "no fault was armed that justifies a resubmission"
+    for r in (0, 1):
+        assert router.engines[r].recompile_count == warm_compiles[r], (
+            f"survivor {r} compiled "
+            f"{router.engines[r].recompile_count - warm_compiles[r]} "
+            f"programs during scale-out")
+    assert router.engines[2].stats()["completed"] > newcomer_warm, \
+        "the scaled-out replica never took flood work"
+    assert router.stats()["fenced"] == 0
+    print(f"elastic_smoke[scale_out]: breach -> 3 replicas in "
+          f"{breach_s:.1f}s, /healthz ok {recover_s:.1f}s after the "
+          f"step, {n} requests exactly-once, 0 survivor recompiles")
+
+
+def leg2_preempt(router, rs, system, n_target, flight_dir):
+    # the preemption target is the shared prefix's affinity HOME — the
+    # replica guaranteed to hold hot pages and live traffic
+    probe = np.concatenate(
+        [system, rs.randint(1, VOCAB, (4,)).astype(np.int32)])
+    home = router.run([probe], max_new_tokens=4, timeout=600)[0].replica
+    survivors = [r for r in range(3) if r != home]
+    base = [e.stats()["completed"] for e in router.engines]
+
+    feeder = Feeder(router, rs, system, max_inflight=10)
+    feeder.start()
+    while len(feeder.reqs) < max(8, n_target // 4):
+        time.sleep(0.01)
+    os.environ["FF_FAULT"] = f"preempt(800)@replica:{home}"
+    faultinject.reset()
+    try:
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 60:
+            if router.stats()["preempts"]:
+                break
+            time.sleep(0.02)
+        while len(feeder.reqs) < n_target:   # post-preempt traffic too
+            time.sleep(0.01)
+        n = settle(router, feeder)
+    finally:
+        os.environ.pop("FF_FAULT", None)
+        faultinject.reset()
+
+    st = router.stats()
+    assert st["preempts"] == 1, "the preemption drill never fired"
+    assert st["fenced"] == 0, \
+        "a clean preemption must not count as a replica loss"
+    assert st["evac_deadline_misses"] == 0, \
+        "an 800 ms deadline must cover this evacuation"
+    assert st["per_replica"][home]["retired"]
+    assert st["evacuated_slabs"] >= 1 and st["evacuation_bytes"] > 0, \
+        "the home replica held hot prefixes: evacuation moved nothing"
+    # exactly-once: router ledger == per-engine completions, and the
+    # evacuation burned ZERO losses (the failover cap keeps headroom)
+    done = [e.stats()["completed"] - b
+            for e, b in zip(router.engines, base)]
+    assert sum(done) == n, (
+        f"duplicated or lost across preemption: {done} vs {n} flood")
+    assert all(r.losses == 0 for r in feeder.reqs), \
+        "evacuated requests must not burn the exactly-once loss cap"
+    assert all(1 <= r.attempts <= 2 for r in feeder.reqs)
+    assert all(r.replica != home
+               for r in feeder.reqs if r.attempts == 2), \
+        "an evacuated request settled on the retired replica"
+
+    # round 2: zero evacuated prefixes lost — the shared prompt serves
+    # WARM from a survivor
+    hits0 = sum(router.engines[s].stats()["prefix_hits"]
+                for s in survivors)
+    got = router.run([probe], max_new_tokens=4, timeout=600)[0]
+    assert got.state == "done" and got.replica in survivors
+    hits1 = sum(router.engines[s].stats()["prefix_hits"]
+                for s in survivors)
+    assert hits1 > hits0, \
+        "the evacuated shared prefix never served a warm survivor hit"
+
+    # exactly one manifest-intact bundle, naming the preemption
+    path = flightrec.recorder().flush()
+    bundles = [os.path.join(flight_dir, d)
+               for d in os.listdir(flight_dir)]
+    assert len(bundles) == 1, f"expected exactly 1 bundle: {bundles}"
+    assert path == bundles[0]
+    flightrec.verify_bundle(bundles[0])
+    trigger = json.load(open(os.path.join(bundles[0], "trigger.json")))
+    blob = json.dumps(trigger)
+    assert "preempt" in blob and f'"replica": {home}' in blob, \
+        f"the bundle's trigger must name the preemption: {blob[:400]}"
+    print(f"elastic_smoke[preempt]: replica {home} evacuated "
+          f"{st['evacuated_requests']} requests + "
+          f"{st['evacuated_pages']} pages "
+          f"({st['evacuation_bytes']} B) inside the deadline, {n} "
+          f"requests exactly-once, warm survivor hits, bundle "
+          f"{os.path.basename(bundles[0])} intact")
+
+
+def sanitize_check(router):
+    if not os.environ.get("FF_SANITIZE"):
+        return
+    from flexflow_tpu.runtime import locks
+
+    assert locks.mode() != "off", "FF_SANITIZE set but sanitizer off"
+    assert locks.violations() == [], (
+        "lock-order violations under FF_SANITIZE:\n"
+        + "\n".join(f"{v['outer']} -> {v['inner']}\n{v['inner_stack']}"
+                    for v in locks.violations()))
+    assert locks.retrace_log() == [], (
+        "post-warmup retraces under FF_SANITIZE:\n"
+        + "\n".join(f"{r['program']} {r['signature']}\n{r['stack']}"
+                    for r in locks.retrace_log()))
+    retr = [e.stats()["sanitizer_retraces"] for e in router.engines]
+    assert sum(retr) == 0, f"per-engine sentinel hits: {retr}"
+    print("elastic_smoke[sanitize]: zero violations, zero retraces "
+          "across both legs")
+
+
+def main():
+    n_target = int(sys.argv[1]) if len(sys.argv) > 1 else 60
+    work = tempfile.mkdtemp(prefix="ff_elastic_smoke_")
+    flight = os.path.join(work, "flight")
+    os.makedirs(flight)
+    ff = build_model(flight)
+    rs = np.random.RandomState(0)
+    system = rs.randint(1, VOCAB, (64,)).astype(np.int32)  # 8 full pages
+
+    router = ff.make_serving_router(
+        replicas=2, max_seq_len=112, decode_buckets=[32, 96], start=False)
+    warm_tail = rs.randint(1, VOCAB, (3,)).astype(np.int32)
+    router.warmup([rs.randint(1, VOCAB, (10,)).astype(np.int32),
+                   np.concatenate([system, warm_tail]),
+                   np.concatenate([system, warm_tail + 1])],
+                  max_new_tokens=4)
+    router.start()
+    pol = AutoscalePolicy(router, min_replicas=2, max_replicas=3,
+                          breach_windows=2, idle_windows=10 ** 6,
+                          cooldown_s=0.0, interval_s=WINDOW_S)
+    try:
+        leg1_scale_out(router, pol, rs, system)
+        leg2_preempt(router, rs, system, n_target, flight)
+        sanitize_check(router)
+    finally:
+        pol.close()
+        router.close()
+        shutil.rmtree(work, ignore_errors=True)
+    print("elastic_serve_smoke: PASSED")
+
+
+if __name__ == "__main__":
+    main()

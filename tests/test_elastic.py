@@ -194,6 +194,40 @@ def test_fault_parser_deploy_kinds():
     assert r.last_value == 300
 
 
+def test_fault_parser_elastic_fleet_kinds():
+    """The elastic-fleet drill grammar (ISSUE 20):
+    preempt(<deadline_ms>)@replica:<r> is identity-indexed — the value
+    is an evacuation DEADLINE in ms, not a tick, and the router consumes
+    it once at replica r's first busy tick; slow_evac(<ms>)@evacuate:<n>
+    is occurrence-counted and stalls the n-th prefix-slab export, the
+    lever that forces a deadline miss deterministically."""
+    p = FaultPlan.parse("preempt(800)@replica:0,slow_evac(250)@evacuate:2")
+    assert ("preempt", "replica", 0) in p.events
+    assert ("slow_evac", "evacuate", 2) in p.events
+    # identity-indexed: peek without consuming, any number of times
+    assert p.pending("preempt", "replica", 0) == (True, 800)
+    assert p.pending("preempt", "replica", 0) == (True, 800)
+    # the wrong replica never matches
+    assert p.pending("preempt", "replica", 1) == (False, None)
+    # one-shot consume carries the deadline; a second consume is inert
+    assert p.at_site("preempt", "replica", 0) and p.last_value == 800
+    assert not p.at_site("preempt", "replica", 0)
+    assert p.pending("preempt", "replica", 0) == (False, None)
+    # evacuate counter: export 1 clean, export 2 stalled by 250 ms
+    assert not p.fire("slow_evac", "evacuate")
+    assert p.fire("slow_evac", "evacuate") and p.last_value == 250
+    assert not p.fire("slow_evac", "evacuate")
+    # a deadline-less preempt is legal (router falls back to the
+    # FFConfig.preempt_deadline_s default)
+    q = FaultPlan.parse("preempt@replica:1")
+    assert q.pending("preempt", "replica", 1) == (True, None)
+    # an unrelated plan never accumulates evacuate counters
+    r = FaultPlan.parse("nan_loss@step:1")
+    for _ in range(3):
+        assert not r.fire("slow_evac", "evacuate")
+    assert ("slow_evac", "evacuate") not in r._counts
+
+
 # ------------------------------------------------- integrity manifest
 
 
